@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Benchmark driver entry: prints ONE JSON line.
+
+Measures training throughput (tokens/sec) of GPT-2-125M under ZeRO-1 + bf16
+on the attached accelerator — BASELINE.json configs[0]. ``vs_baseline``
+converts achieved model FLOPs to TFLOPS/chip and divides by the reference's
+published DP-only figure (~30 TFLOPS/GPU, docs/_posts/2021-03-08-zero3-offload.md:65),
+the closest apples-to-apples published number for this config.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2_model
+
+    if on_tpu:
+        preset, batch, seq, steps = "gpt2-125m", 8, 1024, 8
+    else:  # smoke path for hosts without a chip
+        preset, batch, seq, steps = "gpt2-tiny", 8, 128, 3
+
+    model = gpt2_model(preset, dtype=jnp.bfloat16, remat=True)
+    config = {
+        "train_micro_batch_size_per_gpu": batch,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+
+    rng = np.random.default_rng(0)
+    batch_data = {"input_ids": rng.integers(0, model.config.vocab_size, size=(batch, seq))}
+
+    # warmup / compile
+    jax.block_until_ready(engine.train_batch(batch_data))
+    jax.tree.map(lambda x: x.block_until_ready(), engine.state["params"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch_data)
+    jax.block_until_ready(loss)
+    jax.tree.map(lambda x: x.block_until_ready(), engine.state["params"])
+    dt = time.perf_counter() - t0
+
+    tokens = batch * seq * steps
+    tokens_per_sec = tokens / dt
+
+    # 6*N FLOPs per token (fwd+bwd) + attention term, per Kaplan convention
+    n_params = model.config.num_parameters()
+    flops_per_token = 6 * n_params + 6 * model.config.num_layers * model.config.hidden_size * seq
+    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
+    ref_tflops = 30.0  # reference DP baseline, V100 (see module docstring)
+
+    print(json.dumps({
+        "metric": f"train tokens/sec ({preset}, ZeRO-1, bf16, {'tpu' if on_tpu else 'cpu-smoke'})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(achieved_tflops / ref_tflops, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

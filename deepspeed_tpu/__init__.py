@@ -1,0 +1,84 @@
+"""DeepSpeed-TPU: a TPU-native training & inference framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of DeepSpeed
+(reference ``deepspeed/__init__.py``): ZeRO-style memory partitioning,
+tensor/sequence/expert/pipeline parallelism, mixed precision with loss
+scaling, checkpointing, monitoring and profiling, and ragged-batch inference
+— expressed as sharding specs over a ``jax.sharding.Mesh`` instead of NCCL
+process groups and CUDA kernels.
+
+Front door (reference ``deepspeed/__init__.py:64``):
+
+    engine, optimizer, dataloader, lr_scheduler = deepspeed_tpu.initialize(
+        model=model, config=config_dict)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
+from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
+from .runtime.engine import DeepSpeedEngine  # noqa: F401
+from .runtime.topology import MeshTopology, TopologyConfig  # noqa: F401
+from .comm.comm import init_distributed  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port: int = 29500,
+               topology: Optional[MeshTopology] = None,
+               dist_init_required: Optional[bool] = None,
+               collate_fn=None,
+               config: Optional[Any] = None,
+               config_params: Optional[Dict[str, Any]] = None,
+               seed: int = 42):
+    """Build a ready-to-train engine (reference ``deepspeed.initialize``,
+    ``deepspeed/__init__.py:64``).
+
+    ``model`` is a module object exposing ``init(rng, dtype) -> params``,
+    ``specs() -> PartitionSpec tree``, ``loss(params, batch) -> scalar``
+    (e.g. ``deepspeed_tpu.models.TransformerLM``). Returns the same 4-tuple
+    as the reference: (engine, optimizer_descriptor, dataloader, lr_scheduler).
+    """
+    assert model is not None, "deepspeed_tpu.initialize: model is required"
+    config = config if config is not None else config_params
+    if isinstance(config, str):  # JSON path (reference-supported form)
+        import json
+        with open(config) as f:
+            config = json.load(f)
+
+    init_distributed()
+
+    engine = DeepSpeedEngine(
+        model=model,
+        config_dict=config if isinstance(config, dict) else None,
+        config=config if isinstance(config, DeepSpeedConfig) else None,
+        topology=topology,
+        seed=seed,
+        init_params=model_parameters,
+    )
+
+    dataloader = None
+    if training_data is not None:
+        from .runtime.dataloader import DeepSpeedDataLoader
+        dp = engine.topology.data_parallel_size
+        dataloader = DeepSpeedDataLoader(
+            training_data,
+            batch_size=engine.train_micro_batch_size_per_gpu * dp,
+            collate_fn=collate_fn)
+
+    return engine, engine.optimizer, dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:269``)."""
+    from .inference.engine import InferenceEngine
+    return InferenceEngine(model=model, config=config, **kwargs)

@@ -1,0 +1,173 @@
+"""Accelerator abstraction.
+
+Counterpart of the reference ``accelerator/abstract_accelerator.py:12-276``
+(~60-method ``DeepSpeedAccelerator`` interface). The reference abstracts over
+torch device runtimes (cuda/xpu/npu/...); here the abstraction is over JAX
+backends (tpu/cpu/gpu), and several CUDA-specific concepts collapse:
+
+- *streams/events*: XLA schedules async execution itself; stream APIs are
+  no-ops kept for interface parity, events map to ``block_until_ready``.
+- *memory stats*: ``jax.Device.memory_stats()``.
+- *communication backend*: always XLA collectives ("xla") — the reference's
+  per-device backend names (nccl/ccl/hccl, ``abstract_accelerator.py:189``)
+  choose a wire protocol; XLA picks ICI/DCN itself.
+- *op builder dir*: selects the native-kernel implementation directory, the
+  hook the reference uses to plug per-device kernels (``op_builder/all_ops.py``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name: Optional[str] = None
+        self._communication_backend_name: Optional[str] = None
+
+    # -- device APIs --------------------------------------------------------
+    @abc.abstractmethod
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index: Optional[int] = None):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    def set_device(self, device_index: int) -> None:  # XLA manages placement
+        ...
+
+    def current_device(self) -> int:
+        return 0
+
+    def current_device_name(self) -> str:
+        return self.device_name(self.current_device())
+
+    @abc.abstractmethod
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        ...
+
+    # -- RNG APIs -----------------------------------------------------------
+    def random(self):
+        import jax
+        return jax.random
+
+    def manual_seed(self, seed: int):
+        import jax
+        return jax.random.PRNGKey(seed)
+
+    def initial_seed(self) -> int:
+        return 0
+
+    def default_generator(self, device_index: int):
+        return None
+
+    # -- streams/events (no-op parity layer) --------------------------------
+    class _NoopStream:
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def synchronize(self):
+            ...
+
+        def wait_stream(self, other):
+            ...
+
+    def Stream(self, *args, **kwargs):
+        return self._NoopStream()
+
+    def stream(self, stream):
+        return self._NoopStream()
+
+    def current_stream(self, device_index: Optional[int] = None):
+        return self._NoopStream()
+
+    def default_stream(self, device_index: Optional[int] = None):
+        return self._NoopStream()
+
+    def Event(self, **kwargs):
+        return None
+
+    # -- memory -------------------------------------------------------------
+    @abc.abstractmethod
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
+        ...
+
+    def memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("peak_bytes_in_use", 0))
+
+    def reset_peak_memory_stats(self, device_index: Optional[int] = None) -> None:
+        ...
+
+    def total_memory(self, device_index: Optional[int] = None) -> int:
+        return int(self.memory_stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index: Optional[int] = None) -> int:
+        stats = self.memory_stats(device_index)
+        return int(stats.get("bytes_limit", 0)) - int(stats.get("bytes_in_use", 0))
+
+    def empty_cache(self) -> None:
+        ...
+
+    # -- dtype support ------------------------------------------------------
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    def supported_dtypes(self) -> List[Any]:
+        import jax.numpy as jnp
+        dtypes = [jnp.float32]
+        if self.is_bf16_supported():
+            dtypes.append(jnp.bfloat16)
+        if self.is_fp16_supported():
+            dtypes.append(jnp.float16)
+        return dtypes
+
+    # -- misc ---------------------------------------------------------------
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    def range_push(self, msg: str):
+        """Profiler annotation push (reference accelerator range_push →
+        nvtx; here jax.profiler trace annotations via utils.nvtx)."""
+        ...
+
+    def range_pop(self):
+        ...
+
+    def lazy_call(self, callback):
+        callback()
+
+    def communication_backend_version(self) -> str:
+        import jax
+        return jax.__version__
+
+    # -- op builder hooks (reference abstract_accelerator.py:258) -----------
+    @abc.abstractmethod
+    def op_builder_dir(self) -> str:
+        ...
+
+    def on_accelerator(self, tensor) -> bool:
+        return True

@@ -1,0 +1,61 @@
+"""CPU accelerator — used for tests (virtual multi-device CPU meshes) and as
+the fallback when no TPU is attached. Mirrors the slot of the reference's
+``accelerator/cpu_accelerator.py`` (295 LoC)."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class CPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return "cpu"
+        return f"cpu:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        import jax
+        return jax.local_devices(backend="cpu")[device_index or 0]
+
+    def device_count(self) -> int:
+        import jax
+        return len(jax.local_devices(backend="cpu"))
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        import jax
+        jax.effects_barrier()
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
+        try:
+            import psutil  # pragma: no cover - optional
+            vm = psutil.virtual_memory()
+            return {"bytes_in_use": vm.used, "bytes_limit": vm.total}
+        except ImportError:
+            if hasattr(os, "sysconf"):
+                total = os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+                return {"bytes_in_use": 0, "bytes_limit": total}
+            return {}
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return False
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def is_available(self) -> bool:
+        return True
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.cpu"

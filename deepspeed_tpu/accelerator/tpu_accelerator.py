@@ -1,0 +1,75 @@
+"""TPU accelerator (the primary backend).
+
+Fills the slot the reference fills with ``accelerator/cuda_accelerator.py``
+(338 LoC): device discovery, memory stats, dtype capability, comm-backend
+name, op-builder directory.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"
+
+    def _devices(self):
+        import jax
+        return jax.local_devices()
+
+    def device_name(self, device_index: Optional[int] = None) -> str:
+        if device_index is None:
+            return "tpu"
+        return f"tpu:{device_index}"
+
+    def device(self, device_index: Optional[int] = None):
+        devs = self._devices()
+        return devs[device_index or 0]
+
+    def device_count(self) -> int:
+        return len(self._devices())
+
+    def synchronize(self, device_index: Optional[int] = None) -> None:
+        import jax
+        jax.effects_barrier()
+
+    def memory_stats(self, device_index: Optional[int] = None) -> Dict[str, Any]:
+        dev = self.device(device_index)
+        stats = dev.memory_stats()
+        return dict(stats) if stats else {}
+
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        # TPUs compute in bf16; fp16 storage is supported but bf16 preferred.
+        return True
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def is_available(self) -> bool:
+        try:
+            import jax
+            return any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            return False
+
+    def range_push(self, msg: str):
+        import jax
+        self._trace = jax.profiler.TraceAnnotation(msg)
+        self._trace.__enter__()
+
+    def range_pop(self):
+        if getattr(self, "_trace", None) is not None:
+            self._trace.__exit__(None, None, None)
+            self._trace = None
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops.tpu"

@@ -1,0 +1,1 @@
+from .store import load_checkpoint, save_checkpoint  # noqa: F401

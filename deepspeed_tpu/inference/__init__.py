@@ -1,0 +1,1 @@
+from .engine import InferenceConfig, InferenceEngine  # noqa: F401

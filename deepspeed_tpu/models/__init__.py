@@ -1,0 +1,4 @@
+from .transformer import MoEConfig, TransformerConfig, TransformerLM  # noqa: F401
+from .gpt2 import gpt2_config, gpt2_model  # noqa: F401
+from .llama import llama_config, llama_model  # noqa: F401
+from .mixtral import mixtral_config, mixtral_model  # noqa: F401

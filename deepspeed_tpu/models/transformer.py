@@ -1,0 +1,267 @@
+"""Decoder-only transformer family (GPT-2 / Llama / Mistral / Mixtral).
+
+The reference ships models two ways — HF models patched by kernel injection
+(``module_inject/replace_module.py``) and per-arch inference impls
+(``inference/v2/model_implementations``). Here one TPU-first implementation
+covers the family via config: pre-norm blocks, learned or rotary positions,
+LayerNorm or RMSNorm, GELU MLP or gated-SiLU MLP, MHA or GQA, optional MoE.
+
+TPU-first structure:
+- **scan over layers**: block parameters are stacked with a leading layer
+  dimension and the stack is executed with ``lax.scan`` — one trace/compile of
+  the block regardless of depth, XLA-friendly.
+- **remat**: each block is wrapped in ``jax.checkpoint`` with a configurable
+  policy (counterpart of ``runtime/activation_checkpointing/checkpointing.py``).
+- **sharding**: params carry PartitionSpecs (TP over ``model``); activations
+  are constrained to ``[data, seq, -]``; Ulysses resharding happens inside
+  attention (see ``sequence/layer.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..nn import layers as nn
+from ..ops.transformer.attention import flash_attention
+from ..runtime.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..sequence.layer import ulysses_attention
+
+Params = Dict[str, Any]
+
+ACT_SPEC = P(DATA_AXIS, SEQ_AXIS, None)  # [batch, seq, hidden]
+
+
+def _c(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, TypeError, RuntimeError):
+        return x
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    min_capacity: int = 4
+    aux_loss_coef: float = 0.01
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50257
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    num_kv_heads: Optional[int] = None  # None => MHA
+    hidden_size: int = 768
+    intermediate_size: Optional[int] = None  # None => 4*hidden
+    activation: str = "gelu"        # 'gelu' | 'silu_gated'
+    norm: str = "layernorm"          # 'layernorm' | 'rmsnorm'
+    position: str = "learned"        # 'learned' | 'rope'
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    dtype: Any = jnp.float32         # compute dtype (params kept by engine policy)
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    moe: Optional[MoEConfig] = None
+    moe_layer_freq: int = 1          # every k-th layer is MoE when moe is set
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+    def num_parameters(self) -> int:
+        h, v, L = self.hidden_size, self.vocab_size, self.num_layers
+        ffn = self.ffn_size
+        kv = self.kv_heads * self.head_dim
+        attn = h * (h + 2 * kv) + h * h
+        if self.activation == "silu_gated":
+            mlp = 3 * h * ffn
+        else:
+            mlp = 2 * h * ffn
+        if self.moe is not None:
+            mlp = mlp * self.moe.num_experts + h * self.moe.num_experts
+        embed = v * h + (0 if self.position == "rope" else self.max_seq_len * h)
+        head = 0 if self.tie_embeddings else v * h
+        return embed + head + L * (attn + mlp)
+
+
+class TransformerLM:
+
+    def __init__(self, config: TransformerConfig):
+        self.config = config
+        c = config
+        self._wte = nn.Embedding(c.vocab_size, c.hidden_size, shard=True)
+        self._wpe = nn.Embedding(c.max_seq_len, c.hidden_size) if c.position == "learned" else None
+        norm_cls = nn.LayerNorm if c.norm == "layernorm" else nn.RMSNorm
+        self._norm = norm_cls
+        self._ln_f = norm_cls(c.hidden_size)
+        if not c.tie_embeddings:
+            self._lm_head = nn.Linear(c.hidden_size, c.vocab_size, use_bias=False, shard="column")
+
+        use_bias = c.norm == "layernorm"  # gpt2-style models use biases
+        kv_out = c.kv_heads * c.head_dim
+        self._block_layers = {
+            "ln_1": norm_cls(c.hidden_size),
+            "q_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=use_bias, shard="column"),
+            "k_proj": nn.Linear(c.hidden_size, kv_out, use_bias=use_bias, shard="column"),
+            "v_proj": nn.Linear(c.hidden_size, kv_out, use_bias=use_bias, shard="column"),
+            "o_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=use_bias, shard="row"),
+            "ln_2": norm_cls(c.hidden_size),
+        }
+        if c.moe is not None:
+            from ..moe.layer import MoE
+            self._moe = MoE(
+                hidden_size=c.hidden_size,
+                intermediate_size=c.ffn_size,
+                num_experts=c.moe.num_experts,
+                top_k=c.moe.top_k,
+                capacity_factor=c.moe.capacity_factor,
+                min_capacity=c.moe.min_capacity,
+                activation=c.activation,
+            )
+        elif c.activation == "silu_gated":
+            self._block_layers.update({
+                "gate_proj": nn.Linear(c.hidden_size, c.ffn_size, use_bias=False, shard="column"),
+                "up_proj": nn.Linear(c.hidden_size, c.ffn_size, use_bias=False, shard="column"),
+                "down_proj": nn.Linear(c.ffn_size, c.hidden_size, use_bias=False, shard="row"),
+            })
+        else:
+            self._block_layers.update({
+                "fc_in": nn.Linear(c.hidden_size, c.ffn_size, use_bias=use_bias, shard="column"),
+                "fc_out": nn.Linear(c.ffn_size, c.hidden_size, use_bias=use_bias, shard="row"),
+            })
+
+    # -- init / specs --------------------------------------------------------
+    def init(self, rng: jax.Array, dtype=jnp.float32) -> Params:
+        c = self.config
+        rng_embed, rng_blocks, rng_head = jax.random.split(rng, 3)
+        params: Params = {"wte": self._wte.init(rng_embed, dtype)}
+        if self._wpe is not None:
+            params["wpe"] = self._wpe.init(jax.random.fold_in(rng_embed, 1), dtype)
+        params["ln_f"] = self._ln_f.init(rng_head, dtype)
+        if not c.tie_embeddings:
+            params["lm_head"] = self._lm_head.init(rng_head, dtype)
+
+        def init_block(r):
+            block, _ = nn.init_tree(self._block_layers, r, dtype)
+            if c.moe is not None:
+                block["moe"] = self._moe.init(jax.random.fold_in(r, 7), dtype)
+            return block
+
+        params["blocks"] = jax.vmap(init_block)(jax.random.split(rng_blocks, c.num_layers))
+        return params
+
+    def specs(self) -> Params:
+        c = self.config
+        specs: Params = {"wte": self._wte.specs()}
+        if self._wpe is not None:
+            specs["wpe"] = self._wpe.specs()
+        specs["ln_f"] = self._ln_f.specs()
+        if not c.tie_embeddings:
+            specs["lm_head"] = self._lm_head.specs()
+        block_specs = {name: layer.specs() for name, layer in self._block_layers.items()}
+        if c.moe is not None:
+            block_specs["moe"] = self._moe.specs()
+        # stacked over layers: prepend None for the layer dim
+        block_specs = jax.tree.map(
+            lambda s: P(None, *s), block_specs,
+            is_leaf=lambda s: isinstance(s, P))
+        specs["blocks"] = block_specs
+        return specs
+
+    # -- forward -------------------------------------------------------------
+    def _attn(self, block: Params, x: jax.Array, positions: jax.Array) -> jax.Array:
+        c = self.config
+        B, S, _ = x.shape
+        h = self._block_layers["ln_1"](block["ln_1"], x)
+        q = self._block_layers["q_proj"](block["q_proj"], h).reshape(B, S, c.num_heads, c.head_dim)
+        k = self._block_layers["k_proj"](block["k_proj"], h).reshape(B, S, c.kv_heads, c.head_dim)
+        v = self._block_layers["v_proj"](block["v_proj"], h).reshape(B, S, c.kv_heads, c.head_dim)
+        if c.position == "rope":
+            q = nn.rotary_embedding(q, positions, c.rope_theta)
+            k = nn.rotary_embedding(k, positions, c.rope_theta)
+        out = ulysses_attention(flash_attention, q, k, v, causal=True)
+        out = out.reshape(B, S, c.num_heads * c.head_dim)
+        return self._block_layers["o_proj"](block["o_proj"], out)
+
+    def _mlp(self, block: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        c = self.config
+        h = self._block_layers["ln_2"](block["ln_2"], x)
+        aux = jnp.zeros((), dtype=jnp.float32)
+        if c.moe is not None:
+            out, aux = self._moe(block["moe"], h)
+        elif c.activation == "silu_gated":
+            gate = nn.silu(self._block_layers["gate_proj"](block["gate_proj"], h))
+            up = self._block_layers["up_proj"](block["up_proj"], h)
+            out = self._block_layers["down_proj"](block["down_proj"], gate * up)
+        else:
+            h2 = nn.gelu(self._block_layers["fc_in"](block["fc_in"], h))
+            out = self._block_layers["fc_out"](block["fc_out"], h2)
+        return out, aux
+
+    def _block_fn(self, carry, block: Params):
+        x, positions, aux_acc = carry
+        x = x + self._attn(block, x, positions)
+        mlp_out, aux = self._mlp(block, x)
+        x = _c(x + mlp_out, ACT_SPEC)
+        return (x, positions, aux_acc + aux), None
+
+    def apply(self, params: Params, input_ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """Return (logits [B,S,V] in fp32, moe_aux_loss scalar)."""
+        c = self.config
+        positions = jnp.arange(input_ids.shape[1])[None, :]
+        x = self._wte(params["wte"], input_ids)
+        if self._wpe is not None:
+            x = x + self._wpe(params["wpe"], positions)
+        x = _c(x.astype(c.dtype), ACT_SPEC)
+
+        block_fn = self._block_fn
+        if c.remat:
+            policy = None
+            if c.remat_policy and c.remat_policy not in ("full", "nothing_saveable"):
+                policy = getattr(jax.checkpoint_policies, c.remat_policy)
+            block_fn = jax.checkpoint(block_fn, policy=policy)
+
+        (x, _, aux), _ = jax.lax.scan(block_fn, (x, positions, jnp.zeros((), jnp.float32)),
+                                      params["blocks"])
+        x = self._ln_f(params["ln_f"], x)
+        if c.tie_embeddings:
+            logits = self._wte.attend(params["wte"], x)
+        else:
+            logits = self._lm_head(params["lm_head"], x)
+        return logits.astype(jnp.float32), aux
+
+    def loss(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
+        """Next-token cross-entropy. batch: input_ids [B,S], optional labels,
+        optional loss_mask."""
+        input_ids = batch["input_ids"]
+        labels = batch.get("labels")
+        if labels is None:
+            labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+        logits, aux = self.apply(params, input_ids)
+        valid = labels >= 0
+        safe_labels = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        token_loss = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        mask = valid.astype(jnp.float32)
+        if "loss_mask" in batch:
+            mask = mask * batch["loss_mask"].astype(jnp.float32)
+        loss = jnp.sum(token_loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        if self.config.moe is not None:
+            loss = loss + self.config.moe.aux_loss_coef * aux / self.config.num_layers
+        return loss

@@ -1,0 +1,83 @@
+"""Gating + expert dispatch math.
+
+Counterpart of the reference ``deepspeed/moe/sharded_moe.py``: ``TopKGate``
+(:348), ``top1gating`` (:184), ``_capacity`` (:162), ``_AllToAll`` (:95),
+``MOELayer`` (:425). The reference dispatches tokens with einsum-built
+one-hot masks and a ``torch.distributed`` all-to-all across the expert
+group; here the same capacity-bucketed dispatch is built with static shapes
+(XLA requirement) and the expert exchange is expressed through sharding:
+the dispatch tensor [experts, capacity, d] carries a sharding constraint
+that splits the expert dim over the ``expert`` mesh axis, so the SPMD
+partitioner emits the all-to-all over ICI.
+
+Load-balancing aux loss follows the reference (GShard l_aux = E * Σ me·ce,
+sharded_moe.py:266-272).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def capacity(num_tokens: int, num_experts: int, capacity_factor: float,
+             min_capacity: int) -> int:
+    """Reference ``_capacity`` (sharded_moe.py:162) — tokens per expert."""
+    cap = int(num_tokens * capacity_factor * 1.0 / num_experts)
+    return max(cap, min_capacity)
+
+
+def top_k_gating(logits: jax.Array, top_k: int, capacity_: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-k gate with capacity.
+
+    logits: [tokens, experts]. Returns
+      combine   [tokens, experts, capacity]  — weights for gathering results
+      dispatch  [tokens, experts, capacity]  — boolean one-hot routing
+      aux_loss  scalar (GShard load-balancing loss, scaled by E)
+      me        [experts] mean gate probability (for monitoring)
+    """
+    tokens, num_experts = logits.shape
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # top-k expert choice per token
+    _, expert_idx = jax.lax.top_k(gates, top_k)  # [tokens, k]
+
+    # aux loss from the top-1 assignment like the reference (top1gating :238)
+    mask1 = jax.nn.one_hot(expert_idx[:, 0], num_experts, dtype=jnp.float32)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    aux_loss = jnp.sum(me * ce) * num_experts
+
+    # position of each (token, choice) inside its expert's capacity bucket
+    combine = jnp.zeros((tokens, num_experts, capacity_), dtype=jnp.float32)
+    dispatch = jnp.zeros((tokens, num_experts, capacity_), dtype=bool)
+
+    # process the k choices sequentially so capacity counting is consistent
+    counts = jnp.zeros((num_experts,), dtype=jnp.int32)
+    gate_sum = jnp.zeros((tokens,), dtype=jnp.float32)
+    chosen = []
+    for k in range(top_k):
+        idx_k = expert_idx[:, k]  # [tokens]
+        mask_k = jax.nn.one_hot(idx_k, num_experts, dtype=jnp.int32)
+        # rank of each token within the tokens routed to the same expert
+        pos_in_expert = jnp.cumsum(mask_k, axis=0) - mask_k  # [tokens, experts]
+        pos_k = jnp.sum(pos_in_expert * mask_k, axis=1) + counts[idx_k]
+        keep = pos_k < capacity_
+        gate_k = jnp.take_along_axis(gates, idx_k[:, None], axis=1)[:, 0] * keep
+        chosen.append((idx_k, pos_k, keep, gate_k))
+        counts = counts + jnp.sum(mask_k * keep[:, None], axis=0)
+        gate_sum = gate_sum + gate_k
+
+    # normalize combine weights over kept choices (reference top2gating :341)
+    denom = jnp.maximum(gate_sum, 1e-9)
+    token_ids = jnp.arange(tokens)
+    for idx_k, pos_k, keep, gate_k in chosen:
+        w = gate_k / denom
+        safe_pos = jnp.minimum(pos_k, capacity_ - 1)
+        combine = combine.at[token_ids, idx_k, safe_pos].add(jnp.where(keep, w, 0.0))
+        dispatch = dispatch.at[token_ids, idx_k, safe_pos].max(keep)
+
+    return combine, dispatch, aux_loss, me

@@ -1,0 +1,120 @@
+"""Metrics sinks behind one API.
+
+Counterpart of ``deepspeed/monitor/monitor.py`` (``Monitor`` :13,
+``MonitorMaster`` :29) with TensorBoard / W&B / CSV backends
+(``tensorboard.py:13``, ``wandb.py:12``, ``csv_monitor.py:12``). Events are
+``(tag, value, step)`` tuples, written only from process 0 like the
+reference's rank-0 guard.
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+
+    def __init__(self, monitor_config):
+        self.monitor_config = monitor_config
+        self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+
+    def __init__(self, tensorboard_config):
+        super().__init__(tensorboard_config)
+        self.summary_writer = None
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            path = os.path.join(tensorboard_config.output_path or "./runs",
+                                tensorboard_config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=path)
+            self.enabled = tensorboard_config.enabled
+        except ImportError:
+            logger.warning("tensorboard not available; TensorBoardMonitor disabled")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for tag, value, step in event_list:
+            self.summary_writer.add_scalar(tag, float(value), step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+
+    def __init__(self, wandb_config):
+        super().__init__(wandb_config)
+        try:
+            import wandb  # pragma: no cover - optional dep
+            wandb.init(project=wandb_config.project, group=wandb_config.group,
+                       entity=wandb_config.team)
+            self._wandb = wandb
+            self.enabled = wandb_config.enabled
+        except ImportError:
+            self._wandb = None
+            if wandb_config.enabled:
+                logger.warning("wandb not installed; WandbMonitor disabled")
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self._wandb is None:
+            return
+        for tag, value, step in event_list:
+            self._wandb.log({tag: value}, step=step)
+
+
+class csvMonitor(Monitor):
+
+    def __init__(self, csv_config):
+        super().__init__(csv_config)
+        self.filenames = {}
+        self.output_path = os.path.join(csv_config.output_path or "./csv_logs",
+                                        csv_config.job_name)
+        self.enabled = csv_config.enabled
+        if self.enabled:
+            os.makedirs(self.output_path, exist_ok=True)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for tag, value, step in event_list:
+            fname = os.path.join(self.output_path, tag.replace("/", "_") + ".csv")
+            is_new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if is_new:
+                    w.writerow(["step", tag])
+                w.writerow([step, float(value)])
+
+
+class MonitorMaster(Monitor):
+    """Fan-out to all enabled sinks (reference monitor.py:29)."""
+
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.monitors: List[Monitor] = []
+        import jax
+        try:
+            rank = jax.process_index()
+        except Exception:
+            rank = 0
+        if rank == 0:
+            if monitor_config.tensorboard.enabled:
+                self.monitors.append(TensorBoardMonitor(monitor_config.tensorboard))
+            if monitor_config.wandb.enabled:
+                self.monitors.append(WandbMonitor(monitor_config.wandb))
+            if monitor_config.csv_monitor.enabled:
+                self.monitors.append(csvMonitor(monitor_config.csv_monitor))
+        self.enabled = any(m.enabled for m in self.monitors)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for m in self.monitors:
+            if m.enabled:
+                m.write_events(event_list)

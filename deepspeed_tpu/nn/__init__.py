@@ -1,0 +1,2 @@
+from . import layers  # noqa: F401
+from .layers import Embedding, LayerNorm, Linear, RMSNorm  # noqa: F401

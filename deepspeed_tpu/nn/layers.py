@@ -1,0 +1,183 @@
+"""Functional layer library with sharding metadata.
+
+This fills the role the reference fills with raw ``torch.nn`` plus its TP
+wrappers (``module_inject/layers.py:16,62`` ``LinearAllreduce``/
+``LinearLayer``): every layer is a small dataclass that can ``init`` a params
+pytree, report a parallel ``specs`` pytree of ``PartitionSpec`` describing its
+tensor-parallel layout over the ``model`` mesh axis, and apply itself purely.
+
+Instead of *replacing* modules to introduce TP (the reference's AutoTP,
+``module_inject/auto_tp.py:187``), layers declare ``shard='column'|'row'``
+and XLA's SPMD partitioner inserts the all-reduces the reference does by hand
+— a row-sharded Linear after a column-sharded one needs exactly one psum,
+which XLA places automatically from the sharding constraints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.topology import MODEL_AXIS
+
+Params = Dict[str, Any]
+
+
+def _init_dense(rng, shape, scale: float, dtype) -> jax.Array:
+    return (jax.random.normal(rng, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Linear:
+    """Dense layer; ``shard='column'`` splits out_features over the model
+    axis (reference ``LinearLayer``), ``shard='row'`` splits in_features and
+    relies on a following psum (reference ``LinearAllreduce``)."""
+    in_features: int
+    out_features: int
+    use_bias: bool = True
+    shard: Optional[str] = None  # None | 'column' | 'row'
+    init_scale: float = 0.02
+
+    def init(self, rng, dtype=jnp.float32) -> Params:
+        k_rng, _ = jax.random.split(rng)
+        params = {"kernel": _init_dense(k_rng, (self.in_features, self.out_features), self.init_scale, dtype)}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.out_features,), dtype=dtype)
+        return params
+
+    def specs(self) -> Params:
+        if self.shard == "column":
+            kernel, bias = P(None, MODEL_AXIS), P(MODEL_AXIS)
+        elif self.shard == "row":
+            kernel, bias = P(MODEL_AXIS, None), P()
+        else:
+            kernel, bias = P(None, None), P()
+        out = {"kernel": kernel}
+        if self.use_bias:
+            out["bias"] = bias
+        return out
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        y = x @ params["kernel"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+@dataclasses.dataclass(frozen=True)
+class Embedding:
+    """Token embedding, vocab-sharded over the model axis when ``shard``."""
+    num_embeddings: int
+    features: int
+    shard: bool = False
+    init_scale: float = 0.02
+
+    def init(self, rng, dtype=jnp.float32) -> Params:
+        return {"embedding": _init_dense(rng, (self.num_embeddings, self.features), self.init_scale, dtype)}
+
+    def specs(self) -> Params:
+        return {"embedding": P(MODEL_AXIS, None) if self.shard else P(None, None)}
+
+    def __call__(self, params: Params, ids: jax.Array) -> jax.Array:
+        return jnp.take(params["embedding"], ids, axis=0)
+
+    def attend(self, params: Params, x: jax.Array) -> jax.Array:
+        """Tied-unembedding logits."""
+        return x @ params["embedding"].astype(x.dtype).T
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerNorm:
+    features: int
+    eps: float = 1e-5
+    use_bias: bool = True
+
+    def init(self, rng, dtype=jnp.float32) -> Params:
+        p = {"scale": jnp.ones((self.features,), dtype=dtype)}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.features,), dtype=dtype)
+        return p
+
+    def specs(self) -> Params:
+        out = {"scale": P()}
+        if self.use_bias:
+            out["bias"] = P()
+        return out
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        # Norm statistics in fp32 regardless of compute dtype (matches the
+        # reference's fused LN kernels which accumulate in fp32).
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["scale"].astype(jnp.float32)
+        if self.use_bias:
+            y = y + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class RMSNorm:
+    """Pre-norm used by Llama-family models (reference rms_norm.cu)."""
+    features: int
+    eps: float = 1e-6
+
+    def init(self, rng, dtype=jnp.float32) -> Params:
+        return {"scale": jnp.ones((self.features,), dtype=dtype)}
+
+    def specs(self) -> Params:
+        return {"scale": P()}
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        xf = x.astype(jnp.float32)
+        y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (y * params["scale"].astype(jnp.float32)).astype(x.dtype)
+
+
+def gelu(x: jax.Array) -> jax.Array:
+    return jax.nn.gelu(x, approximate=True)
+
+
+def silu(x: jax.Array) -> jax.Array:
+    return jax.nn.silu(x)
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
+    """Apply rotary position embeddings.
+
+    x: [..., seq, heads, head_dim]; positions: [..., seq].
+    TPU-native equivalent of the reference's ``apply_rotary_pos_emb.cu``; left
+    to XLA fusion (elementwise, fuses into the surrounding matmuls).
+    """
+    head_dim = x.shape[-1]
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, half]
+    cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
+    sin = jnp.sin(angles)[..., :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    y1 = x1 * cos - x2 * sin
+    y2 = x2 * cos + x1 * sin
+    return jnp.concatenate([y1, y2], axis=-1).astype(x.dtype)
+
+
+def dropout(rng, x: jax.Array, rate: float, deterministic: bool) -> jax.Array:
+    if deterministic or rate == 0.0:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0)
+
+
+def init_tree(layers: Dict[str, Any], rng, dtype=jnp.float32) -> Tuple[Params, Params]:
+    """Init a dict of layers → (params, specs) trees with per-layer rng split."""
+    params, specs = {}, {}
+    rngs = jax.random.split(rng, len(layers))
+    for r, (name, layer) in zip(rngs, sorted(layers.items())):
+        params[name] = layer.init(r, dtype)
+        specs[name] = layer.specs()
+    return params, specs

@@ -1,0 +1,65 @@
+"""Config plumbing shared by all subsystem configs.
+
+Counterpart of ``runtime/config_utils.py:16`` (``DeepSpeedConfigModel``, a
+pydantic BaseModel subclass with deprecated-field migration support).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Base for every subsystem config.
+
+    Supports the reference's ``new_param`` deprecation mechanism in a reduced
+    form: declare ``json_schema_extra={"deprecated": True, "new_param": "x"}``
+    on a field and the value is forwarded to the replacement when the new one
+    was not explicitly set.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="forbid",
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict: bool = False, **data):
+        if not strict:  # drop None values so defaults apply (reference behavior)
+            data = {k: v for k, v in data.items() if (v != "auto" or k == "replace_method") and v is not None}
+        super().__init__(**data)
+        self._forward_deprecated()
+
+    def _forward_deprecated(self) -> None:
+        fields_set = self.model_fields_set
+        for name, field in type(self).model_fields.items():
+            extra = field.json_schema_extra or {}
+            if not isinstance(extra, dict) or not extra.get("deprecated"):
+                continue
+            new_param = extra.get("new_param")
+            if new_param and name in fields_set and new_param not in fields_set:
+                object.__setattr__(self, new_param, getattr(self, name))
+
+
+def get_scalar_param(param_dict: Dict[str, Any], param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict[str, Any], param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d

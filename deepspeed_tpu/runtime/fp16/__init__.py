@@ -1,0 +1,2 @@
+from .loss_scaler import (dynamic_loss_scale_state, has_overflow,  # noqa: F401
+                          static_loss_scale_state, update_scale)

@@ -1,0 +1,75 @@
+"""Loss scaling.
+
+Counterpart of ``runtime/fp16/loss_scaler.py`` (``LossScaler`` :67,
+``DynamicLossScaler`` :91). State is a small pytree of scalars that lives in
+the jitted TrainState so scale updates and the skip-on-overflow decision
+(``lax.cond``) happen on-device — the reference's CheckOverflow + INITIAL_
+LOSS_SCALE/SCALE_WINDOW/MIN_LOSS_SCALE semantics (fp16 config,
+runtime/config.py fp16 block).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+LossScaleState = Dict[str, jax.Array]
+
+
+def static_loss_scale_state(scale: float) -> LossScaleState:
+    return {
+        "cur_scale": jnp.asarray(scale, jnp.float32),
+        "cur_hysteresis": jnp.asarray(1, jnp.int32),
+        "last_overflow_iter": jnp.asarray(-1, jnp.int32),
+        "iter": jnp.asarray(0, jnp.int32),
+        "dynamic": jnp.asarray(False),
+    }
+
+
+def dynamic_loss_scale_state(initial_scale_power: int = 16, hysteresis: int = 2) -> LossScaleState:
+    state = static_loss_scale_state(2.0 ** initial_scale_power)
+    state["dynamic"] = jnp.asarray(True)
+    state["cur_hysteresis"] = jnp.asarray(hysteresis, jnp.int32)
+    return state
+
+
+def has_overflow(grads) -> jax.Array:
+    """Global non-finite check over a grad pytree (reference CheckOverflow,
+    runtime/utils.py:208)."""
+    leaves = jax.tree.leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g))) for g in leaves]
+    return jnp.any(jnp.stack(flags))
+
+
+def update_scale(state: LossScaleState, overflow: jax.Array, *,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 hysteresis: int = 2, scale_factor: float = 2.0) -> LossScaleState:
+    """One DynamicLossScaler.update_scale step (reference loss_scaler.py:91).
+
+    On overflow: consume hysteresis; once exhausted, halve the scale.
+    After ``scale_window`` clean iters: double the scale.
+    Static scaling (dynamic=False) passes through unchanged.
+    """
+    it = state["iter"]
+    cur = state["cur_scale"]
+    hyst = state["cur_hysteresis"]
+
+    def on_overflow(_):
+        new_hyst = hyst - 1
+        drop = new_hyst <= 0
+        new_scale = jnp.where(drop, jnp.maximum(cur / scale_factor, min_scale), cur)
+        return new_scale, jnp.where(drop, jnp.asarray(hysteresis, jnp.int32), new_hyst), it
+
+    def on_clean(_):
+        grow = (it - state["last_overflow_iter"]) % scale_window == scale_window - 1
+        return jnp.where(grow, cur * scale_factor, cur), hyst, state["last_overflow_iter"]
+
+    new_scale, new_hyst, last_of = jax.lax.cond(overflow, on_overflow, on_clean, None)
+    out = dict(state)
+    out["cur_scale"] = jnp.where(state["dynamic"], new_scale, cur)
+    out["cur_hysteresis"] = jnp.where(state["dynamic"], new_hyst, hyst)
+    out["last_overflow_iter"] = jnp.where(state["dynamic"] & overflow, it, last_of)
+    out["iter"] = it + 1
+    return out

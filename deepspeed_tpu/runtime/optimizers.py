@@ -1,0 +1,196 @@
+"""Built-in optimizers.
+
+Fills the slot of the reference's optimizer zoo: FusedAdam
+(``csrc/adam/multi_tensor_adam.cu``), DeepSpeedCPUAdam (``csrc/adam/
+cpu_adam.cpp``), FusedLamb (``csrc/lamb``), Lion (``csrc/lion``), Adagrad
+(``csrc/adagrad``) — selected by config name in ``engine._configure_basic_
+optimizer`` (engine.py:1267). On TPU a "fused multi-tensor" optimizer is
+simply a jitted pytree update: XLA fuses the elementwise chain across all
+leaves into a handful of kernels, which is what the CUDA multi-tensor-apply
+machinery exists to do by hand. A Pallas fused step over flat shards exists in
+``ops/adam/fused_adam.py`` for the ZeRO flat-partition path.
+
+All optimizers keep fp32 master state; the engine decides how states are
+sharded (ZeRO) by placing sharding constraints on the pytrees.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+OptState = Dict[str, Any]
+
+
+def _tree_zeros_like(tree, dtype=jnp.float32):
+    return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype=dtype), tree)
+
+
+def _unzip(out, index: int):
+    """Select element ``index`` from a pytree whose leaves are tuples."""
+    return jax.tree.map(lambda t: t[index], out, is_leaf=lambda x: isinstance(x, tuple))
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """A stateless descriptor; state lives in the engine's TrainState."""
+    name: str = "adamw"
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    # lamb
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    # sgd
+    momentum: float = 0.0
+
+    def init(self, params: Params) -> OptState:
+        master = jax.tree.map(lambda x: x.astype(jnp.float32), params)
+        state: OptState = {"step": jnp.zeros((), jnp.int32), "master": master}
+        if self.name in ("adam", "adamw", "lamb", "onebit_adam", "zero_one_adam", "muadam", "muadamw"):
+            state["exp_avg"] = _tree_zeros_like(params)
+            state["exp_avg_sq"] = _tree_zeros_like(params)
+        elif self.name in ("lion", "momentum_sgd"):
+            state["exp_avg"] = _tree_zeros_like(params)
+        elif self.name == "adagrad":
+            state["sum_sq"] = _tree_zeros_like(params)
+        elif self.name == "sgd":
+            if self.momentum > 0:
+                state["exp_avg"] = _tree_zeros_like(params)
+        else:
+            raise ValueError(f"Unknown optimizer '{self.name}'")
+        return state
+
+    # -- single-leaf updates -------------------------------------------------
+    def _adam_leaf(self, g, p, m, v, step, lr, decoupled_wd: bool):
+        b1, b2 = self.betas
+        if self.weight_decay and not decoupled_wd:
+            g = g + self.weight_decay * p
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        update = mhat / (jnp.sqrt(vhat) + self.eps)
+        if self.weight_decay and decoupled_wd:
+            update = update + self.weight_decay * p
+        return p - lr * update, m, v
+
+    def _lamb_leaf(self, g, p, m, v, step, lr):
+        b1, b2 = self.betas
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        update = m / (jnp.sqrt(v) + self.eps) + self.weight_decay * p
+        w_norm = jnp.linalg.norm(p)
+        u_norm = jnp.linalg.norm(update)
+        trust = jnp.where(
+            (w_norm > 0) & (u_norm > 0),
+            jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff), 1.0)
+        return p - lr * trust * update, m, v
+
+    def _lion_leaf(self, g, p, m, lr):
+        b1, b2 = self.betas
+        update = jnp.sign(b1 * m + (1 - b1) * g) + self.weight_decay * p
+        m = b2 * m + (1 - b2) * g
+        return p - lr * update, m
+
+    # -- pytree update -------------------------------------------------------
+    def update(self, grads: Params, state: OptState, lr) -> Tuple[Params, OptState]:
+        """Apply one step on fp32 master params. Returns (new_master, new_state)."""
+        step = state["step"] + 1
+        master = state["master"]
+        new_state: OptState = {"step": step}
+        if self.name in ("adam", "adamw", "muadam", "muadamw", "onebit_adam", "zero_one_adam"):
+            decoupled = self.name in ("adamw", "muadamw")
+            out = jax.tree.map(
+                lambda g, p, m, v: self._adam_leaf(g.astype(jnp.float32), p, m, v, step, lr, decoupled),
+                grads, master, state["exp_avg"], state["exp_avg_sq"])
+            new_master = _unzip(out, 0)
+            new_state["exp_avg"] = _unzip(out, 1)
+            new_state["exp_avg_sq"] = _unzip(out, 2)
+        elif self.name == "lamb":
+            out = jax.tree.map(
+                lambda g, p, m, v: self._lamb_leaf(g.astype(jnp.float32), p, m, v, step, lr),
+                grads, master, state["exp_avg"], state["exp_avg_sq"])
+            new_master = _unzip(out, 0)
+            new_state["exp_avg"] = _unzip(out, 1)
+            new_state["exp_avg_sq"] = _unzip(out, 2)
+        elif self.name == "lion":
+            out = jax.tree.map(
+                lambda g, p, m: self._lion_leaf(g.astype(jnp.float32), p, m, lr),
+                grads, master, state["exp_avg"])
+            new_master = _unzip(out, 0)
+            new_state["exp_avg"] = _unzip(out, 1)
+        elif self.name == "adagrad":
+            sum_sq = jax.tree.map(lambda s, g: s + g.astype(jnp.float32) ** 2, state["sum_sq"], grads)
+            new_master = jax.tree.map(
+                lambda p, g, s: p - lr * g.astype(jnp.float32) / (jnp.sqrt(s) + self.eps),
+                master, grads, sum_sq)
+            new_state["sum_sq"] = sum_sq
+        elif self.name == "sgd":
+            if self.momentum > 0:
+                m = jax.tree.map(lambda m_, g: self.momentum * m_ + g.astype(jnp.float32),
+                                 state["exp_avg"], grads)
+                new_master = jax.tree.map(lambda p, m_: p - lr * m_, master, m)
+                new_state["exp_avg"] = m
+            else:
+                new_master = jax.tree.map(lambda p, g: p - lr * g.astype(jnp.float32), master, grads)
+        else:
+            raise ValueError(f"Unknown optimizer '{self.name}'")
+        new_state["master"] = new_master
+        return new_master, new_state
+
+
+_ALIASES = {
+    "adam": "adam",
+    "adamw": "adamw",
+    "torchadam": "adam",
+    "fusedadam": "adam",
+    "fusedadamw": "adamw",
+    "fusedlamb": "lamb",
+    "lamb": "lamb",
+    "lion": "lion",
+    "fusedlion": "lion",
+    "adagrad": "adagrad",
+    "sgd": "sgd",
+    "onebit_adam": "onebit_adam",
+    "onebitadam": "onebit_adam",
+    "zero_one_adam": "zero_one_adam",
+    "zerooneadam": "zero_one_adam",
+    "onebit_lamb": "lamb",
+    "onebitlamb": "lamb",
+    "muadam": "muadam",
+    "muadamw": "muadamw",
+    "musgd": "sgd",
+}
+
+
+def build_optimizer(opt_config) -> Optimizer:
+    """Map a config ``optimizer`` block to an Optimizer descriptor
+    (reference engine.py:1267 ``_configure_basic_optimizer``)."""
+    if opt_config is None:
+        return Optimizer(name="adamw")
+    name = _ALIASES.get(opt_config.type.lower().replace("-", "_"))
+    if name is None:
+        raise ValueError(f"Unknown optimizer type '{opt_config.type}'")
+    p = dict(opt_config.params)
+    kwargs: Dict[str, Any] = {"name": name}
+    if "lr" in p:
+        kwargs["lr"] = p["lr"]
+    if "betas" in p:
+        kwargs["betas"] = tuple(p["betas"])
+    if "eps" in p:
+        kwargs["eps"] = p["eps"]
+    if "weight_decay" in p:
+        kwargs["weight_decay"] = p["weight_decay"]
+    if "momentum" in p:
+        kwargs["momentum"] = p["momentum"]
+    if "max_coeff" in p:
+        kwargs["max_coeff"] = p["max_coeff"]
+    if "min_coeff" in p:
+        kwargs["min_coeff"] = p["min_coeff"]
+    return Optimizer(**kwargs)

@@ -1,0 +1,2 @@
+from .config import DeepSpeedZeroConfig  # noqa: F401
+from .partition import ZeroPartitionPlan, add_axes_to_spec  # noqa: F401

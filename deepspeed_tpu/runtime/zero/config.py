@@ -1,0 +1,94 @@
+"""ZeRO configuration.
+
+Counterpart of ``runtime/zero/config.py`` (317 LoC) + ``zero/offload_config.py``.
+Stages map to sharding of the training state over the compound data axes
+(see ``runtime/topology.py``):
+
+- stage 0: everything replicated; gradients all-reduced.
+- stage 1: optimizer state sharded (reference ``DeepSpeedZeroOptimizer`` S1).
+- stage 2: + gradients reduce-scattered into shards.
+- stage 3: + parameters sharded, gathered per-layer in forward/backward
+  (reference ``DeepSpeedZeroOptimizer_Stage3``).
+
+ZeRO++-style knobs (``zero_quantized_weights/gradients``, hpZ secondary
+partition) are carried here; quantized collectives use the Pallas quantizer.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field
+
+from ..config_utils import DeepSpeedConfigModel
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """Reference ``zero/offload_config.py`` param section."""
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(100_000_000, ge=0)
+    max_in_cpu: int = Field(1_000_000_000, ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    """Reference ``zero/offload_config.py`` optimizer section."""
+    device: OffloadDeviceEnum = OffloadDeviceEnum.none
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = Field(1.0, ge=0.0, le=1.0)
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(int(5e8), ge=0)
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(int(5e8), ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    sub_group_size: int = Field(int(1e9), ge=0)
+    stage3_max_live_parameters: int = Field(int(1e9), ge=0)
+    stage3_max_reuse_distance: int = Field(int(1e9), ge=0)
+    stage3_prefetch_bucket_size: int = Field(int(5e7), ge=0)
+    stage3_param_persistence_threshold: int = Field(int(1e5), ge=0)
+    stage3_gather_16bit_weights_on_model_save: bool = False
+
+    ignore_unused_parameters: bool = True
+    round_robin_gradients: bool = False
+
+    # ZeRO++ (reference engine.py:849-858)
+    zero_hpz_partition_size: int = Field(1, ge=0)
+    zero_quantized_weights: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+    zero_quantized_gradients: bool = False
+
+    mics_shard_size: int = Field(-1)
+    mics_hierarchical_params_gather: bool = False
+
+    memory_efficient_linear: bool = True
+
+    def __init__(self, **data):
+        super().__init__(**data)
+        if self.overlap_comm is None:
+            # reference defaults overlap_comm True for stage 3, False otherwise
+            object.__setattr__(self, "overlap_comm", self.stage == 3)

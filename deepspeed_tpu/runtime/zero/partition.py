@@ -1,0 +1,148 @@
+"""ZeRO partitioning as sharding specs.
+
+This is the TPU-native re-design of the reference's three ZeRO optimizers
+(``stage_1_and_2.py:96``, ``stage3.py:73``, ``partition_parameters.py:734``).
+The reference implements partitioning *imperatively*: flatten params into
+contiguous buffers, slice per rank, register autograd hooks that reduce-scatter
+gradient buckets and all-gather params around use. Under XLA the same memory
+and communication behavior is expressed *declaratively*: each leaf of the
+training state gets a ``PartitionSpec`` that adds the data-parallel mesh axes
+to one of its dimensions, and the SPMD partitioner emits exactly the
+collectives the reference issues by hand —
+
+- stage 1: optimizer state sharded  → XLA all-reduces grads, updates the
+  local optimizer shard, all-gathers updated params (the reference's
+  ``all_gather_dp_groups``, runtime/utils.py:967).
+- stage 2: + gradients sharded      → the grad all-reduce becomes
+  reduce-scatter (the reference's ``average_tensor`` slice-per-owner path,
+  stage_1_and_2.py:1004).
+- stage 3: + parameters sharded     → all-gather before use, freed after
+  (the reference's fetch/release hooks, parameter_offload.py:342). With
+  scan-over-layers the gather happens per layer, and XLA's latency-hiding
+  scheduler overlaps the next layer's gather with compute — the equivalent
+  of the reference's prefetch coordinator (partitioned_param_coordinator.py).
+
+Small leaves stay replicated below ``stage3_param_persistence_threshold``,
+matching the reference's persistence behavior for tiny params.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..topology import (DENSE_GRAD_AXES, EXPERT_AXIS, EXPERT_GRAD_AXES, MeshTopology)
+from .config import DeepSpeedZeroConfig
+
+
+def _flatten_spec_axes(spec: P) -> set:
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def add_axes_to_spec(spec: Optional[P], shape: Tuple[int, ...], axes: Tuple[str, ...],
+                     axis_sizes, min_size: int = 0) -> P:
+    """Extend ``spec`` by sharding one dimension of ``shape`` over ``axes``.
+
+    Picks the largest dimension that is unsharded in ``spec`` and divisible by
+    the product of axis sizes. Returns ``spec`` unchanged (replicated w.r.t.
+    ``axes``) if nothing fits or the leaf is below ``min_size`` — the
+    persistence-threshold behavior.
+    """
+    spec = spec if spec is not None else P(*([None] * len(shape)))
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = _flatten_spec_axes(spec)
+    axes = tuple(a for a in axes if a not in used)
+    if not axes:
+        return P(*entries)
+    n = int(np.prod([axis_sizes[a] for a in axes]))
+    if n == 1 or int(np.prod(shape)) < max(min_size, 1):
+        return P(*entries)
+    candidates = [i for i, e in enumerate(entries) if e is None and shape[i] % n == 0 and shape[i] >= n]
+    if not candidates:
+        return P(*entries)
+    best = max(candidates, key=lambda i: (shape[i], i))
+    entries[best] = axes if len(axes) > 1 else axes[0]
+    return P(*entries)
+
+
+class ZeroPartitionPlan:
+    """Computes the sharding trees for params / grads / optimizer state."""
+
+    def __init__(self, topology: MeshTopology, zero_config: DeepSpeedZeroConfig,
+                 param_specs: Any, param_shapes: Any):
+        self.topology = topology
+        self.config = zero_config
+        self.stage = zero_config.stage
+        self.param_specs = param_specs
+        self.param_shapes = param_shapes
+        self._axis_sizes = dict(topology.mesh.shape)
+
+    # -- helpers -------------------------------------------------------------
+    def _grad_axes_for(self, spec: P) -> Tuple[str, ...]:
+        """Expert-sharded params sync/partition over the expert-DP axes only
+        (reference ``_create_expert_data_and_model_parallel``, groups.py:239)."""
+        if EXPERT_AXIS in _flatten_spec_axes(spec):
+            return EXPERT_GRAD_AXES
+        return DENSE_GRAD_AXES
+
+    def _zero_leaf_spec(self, spec: P, shape, min_size: int = 0) -> P:
+        return add_axes_to_spec(spec, shape, self._grad_axes_for(spec), self._axis_sizes, min_size)
+
+    def _map(self, fn):
+        return jax.tree.map(fn, self.param_specs, self.param_shapes,
+                            is_leaf=lambda s: isinstance(s, P))
+
+    def _tp_only(self):
+        return self._map(lambda spec, shape: P(*spec))
+
+    def _zero_sharded(self, min_size: int = 0):
+        return self._map(lambda spec, shape: self._zero_leaf_spec(spec, shape, min_size))
+
+    # -- public: spec trees --------------------------------------------------
+    def param_spec_tree(self):
+        """Model (bit16) params: sharded only at stage 3."""
+        if self.stage >= 3:
+            return self._zero_sharded(self.config.stage3_param_persistence_threshold)
+        return self._tp_only()
+
+    def grad_spec_tree(self):
+        """Gradient accumulator: sharded at stage >= 2."""
+        if self.stage >= 2:
+            return self._zero_sharded()
+        return self._tp_only()
+
+    def optimizer_spec_tree(self):
+        """fp32 master + moments: sharded at stage >= 1."""
+        if self.stage >= 1:
+            return self._zero_sharded()
+        return self._tp_only()
+
+    # -- public: NamedSharding trees ----------------------------------------
+    def _named(self, spec_tree):
+        mesh = self.topology.mesh
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                            is_leaf=lambda s: isinstance(s, P))
+
+    def param_shardings(self):
+        return self._named(self.param_spec_tree())
+
+    def grad_shardings(self):
+        return self._named(self.grad_spec_tree())
+
+    def summary(self) -> str:
+        dp = self.topology.data_parallel_size
+        return (f"ZeRO stage {self.stage}: params "
+                f"{'sharded' if self.stage >= 3 else 'replicated'}, grads "
+                f"{'sharded' if self.stage >= 2 else 'replicated'}, optimizer "
+                f"{'sharded' if self.stage >= 1 else 'replicated'} over dp={dp}")

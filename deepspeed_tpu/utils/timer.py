@@ -1,0 +1,201 @@
+"""Wall-clock + throughput timers.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` timer.py:43, ``ThroughputTimer`` timer.py:198).
+CUDA events do not exist here; synchronization is
+``jax.block_until_ready`` / ``jax.effects_barrier`` on demand. Timers default
+to *not* synchronizing (XLA dispatch is async) and only block when a reading
+is taken, mirroring the reference's lazy event elapsed computation.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _sync():
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:  # pragma: no cover
+        pass
+
+
+class _Timer:
+
+    def __init__(self, name: str, synchronize: bool = True):
+        self.name = name
+        self.synchronize = synchronize
+        self.started_ = False
+        self.start_time = 0.0
+        self.elapsed_ = 0.0
+        self.records: List[float] = []
+
+    def start(self):
+        if self.started_:
+            return
+        if self.synchronize:
+            _sync()
+        self.start_time = time.perf_counter()
+        self.started_ = True
+
+    def stop(self, record: bool = True):
+        if not self.started_:
+            return
+        if self.synchronize:
+            _sync()
+        delta = time.perf_counter() - self.start_time
+        self.elapsed_ += delta
+        if record:
+            self.records.append(delta)
+        self.started_ = False
+
+    def reset(self):
+        self.started_ = False
+        self.elapsed_ = 0.0
+
+    def elapsed(self, reset: bool = True) -> float:
+        was_started = self.started_
+        if was_started:
+            self.stop(record=False)
+        value = self.elapsed_
+        if reset:
+            self.reset()
+        if was_started:
+            self.start()
+        return value
+
+    def mean(self) -> float:
+        if not self.records:
+            return 0.0
+        return sum(self.records) / len(self.records)
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference timer.py:43)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name: str) -> bool:
+        return name in self.timers
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True, memory_breakdown=None, ranks=None):
+        from .logging import log_dist
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {elapsed:.2f}")
+        if parts:
+            log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
+
+    def get_mean(self, names: List[str], normalizer: float = 1.0) -> Dict[str, float]:
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names if name in self.timers
+        }
+
+
+class NoopTimer:
+
+    class _N:
+
+        def start(self):
+            ...
+
+        def stop(self, **kwargs):
+            ...
+
+        def reset(self):
+            ...
+
+        def elapsed(self, **kwargs):
+            return 0.0
+
+    def __init__(self):
+        self._n = self._N()
+
+    def __call__(self, name):
+        return self._n
+
+    def has_timer(self, name):
+        return False
+
+    def log(self, *args, **kwargs):
+        ...
+
+    def get_mean(self, *args, **kwargs):
+        return {}
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS estimation (reference timer.py:198)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: Optional[int] = None, monitor_memory: bool = False, logging_fn=None):
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn
+        self.initialized = False
+        self.num_steps = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self.start_time = 0.0
+        self.started = False
+
+    def update_epoch_count(self):
+        self.initialized = False
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.num_steps >= self.start_step:
+            _sync()
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step: bool = False, report_speed: bool = True):
+        if not self.started:
+            return
+        self.started = False
+        self.num_steps += 1
+        if self.num_steps > self.start_step:
+            _sync()
+            duration = time.perf_counter() - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and self.steps_per_output and report_speed and \
+                    self.num_steps % self.steps_per_output == 0:
+                if self.logging:
+                    self.logging(
+                        f"epoch step {self.num_steps}: "
+                        f"{self.avg_samples_per_sec():.2f} samples/sec, "
+                        f"batch time {self.step_elapsed_time / self.steps_per_output:.3f}s")
+                self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        if self.num_steps > self.start_step and self.total_elapsed_time > 0:
+            samples = (self.num_steps - self.start_step) * self.batch_size
+            return samples / self.total_elapsed_time
+        return 0.0

@@ -1,0 +1,42 @@
+"""Test harness: a virtual 8-device CPU mesh.
+
+Counterpart of the reference's ``tests/unit/common.py`` DistributedTest
+harness (common.py:105): the reference forks N processes with real NCCL over
+localhost; here the same multi-device semantics come from XLA's host-platform
+device partitioning — one process, 8 virtual CPU devices, real collectives,
+real shardings. Must run before jax initializes its backends.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+
+import jax  # noqa: E402
+
+# The environment may have imported jax at interpreter startup (site hooks)
+# with a different platform already selected via env; force CPU through the
+# config API, which wins as long as no backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+from deepspeed_tpu.runtime import topology as topo_mod  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    topo_mod.reset()
+    yield
+    topo_mod.reset()
+
+
+@pytest.fixture
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

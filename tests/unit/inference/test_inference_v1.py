@@ -1,0 +1,41 @@
+"""Inference v1 engine tests (reference tests/unit/inference/test_inference.py)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2_model, llama_model
+
+
+def test_init_inference_forward(eight_devices):
+    model = gpt2_model("gpt2-tiny", max_seq_len=64, vocab_size=256, remat=False)
+    engine = deepspeed_tpu.init_inference(model=model, config={
+        "tensor_parallel": {"tp_size": 2}, "dtype": jnp.float32})
+    ids = np.random.default_rng(0).integers(0, 256, size=(2, 16))
+    logits = engine.forward(ids)
+    assert logits.shape == (2, 16, 256)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_greedy_generate_deterministic(eight_devices):
+    model = llama_model("llama2-tiny", dtype=jnp.float32, max_seq_len=64,
+                        vocab_size=256, remat=False)
+    engine = deepspeed_tpu.init_inference(model=model, config={"dtype": jnp.float32})
+    prompt = np.arange(8)[None, :]
+    out1 = engine.generate(prompt, max_new_tokens=8)
+    out2 = engine.generate(prompt, max_new_tokens=8)
+    assert out1.shape == (1, 16)
+    np.testing.assert_array_equal(out1, out2)
+    np.testing.assert_array_equal(out1[:, :8], prompt)
+
+
+def test_tp_generate_matches_single(eight_devices):
+    prompt = np.arange(6)[None, :]
+    m1 = gpt2_model("gpt2-tiny", max_seq_len=64, vocab_size=256, remat=False)
+    m2 = gpt2_model("gpt2-tiny", max_seq_len=64, vocab_size=256, remat=False)
+    e1 = deepspeed_tpu.init_inference(model=m1, config={"dtype": jnp.float32}, seed=3)
+    e2 = deepspeed_tpu.init_inference(model=m2, config={
+        "tensor_parallel": {"tp_size": 4}, "dtype": jnp.float32}, seed=3)
+    np.testing.assert_array_equal(
+        e1.generate(prompt, max_new_tokens=6), e2.generate(prompt, max_new_tokens=6))

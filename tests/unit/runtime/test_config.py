@@ -1,0 +1,56 @@
+"""Batch-size resolution + config parsing (reference runtime/config.py tests)."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.topology import MeshTopology, TopologyConfig
+
+
+def test_batch_resolution_micro_only(eight_devices):
+    topo = MeshTopology()
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2}, mesh_topology=topo)
+    assert cfg.train_batch_size == 16  # 2 * 1 gas * 8 dp
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_resolution_full(eight_devices):
+    topo = MeshTopology()
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 2}, mesh_topology=topo)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_mismatch_raises(eight_devices):
+    topo = MeshTopology()
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({
+            "train_batch_size": 64,
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 3,
+        }, mesh_topology=topo)
+
+
+def test_zero_config_defaults():
+    cfg = DeepSpeedConfig({"zero_optimization": {"stage": 2}}, mesh_topology=None)
+    assert cfg.zero_config.stage == 2
+    assert cfg.zero_config.overlap_comm is False
+    cfg3 = DeepSpeedConfig({"zero_optimization": {"stage": 3}}, mesh_topology=None)
+    assert cfg3.zero_config.overlap_comm is True
+
+
+def test_fp16_and_scheduler_parse():
+    cfg = DeepSpeedConfig({
+        "fp16": {"enabled": True, "initial_scale_power": 8},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10}},
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4}},
+    }, mesh_topology=None)
+    assert cfg.fp16.enabled and cfg.fp16.initial_scale_power == 8
+    assert cfg.scheduler.type == "WarmupLR"
+    assert cfg.optimizer.params["lr"] == 3e-4
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p), mesh_topology=None)

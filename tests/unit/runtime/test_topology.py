@@ -1,0 +1,41 @@
+"""Mesh topology tests (reference: tests/unit/ utils group tests)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.topology import (DENSE_GRAD_AXES, MeshTopology, TopologyConfig)
+
+
+def test_default_topology_all_data_parallel(eight_devices):
+    topo = MeshTopology()
+    assert topo.world_size == 8
+    assert topo.data_parallel_size == 8
+    assert topo.model_parallel_size == 1
+
+
+def test_infer_data_degree(eight_devices):
+    topo = MeshTopology(TopologyConfig(model=2))
+    assert topo.axis_size("model") == 2
+    assert topo.axis_size("data") == 4
+    assert topo.data_parallel_size == 4  # data * expert * seq
+
+
+def test_expert_axis_counts_as_data_parallel(eight_devices):
+    topo = MeshTopology(TopologyConfig(expert=4))
+    assert topo.expert_parallel_size == 4
+    assert topo.data_parallel_size == 8  # dense params still sync over all 8
+    assert topo.expert_data_parallel_size == 2
+
+
+def test_invalid_topology_raises(eight_devices):
+    with pytest.raises(ValueError):
+        MeshTopology(TopologyConfig(model=3))  # 8 % 3 != 0
+    with pytest.raises(ValueError):
+        MeshTopology(TopologyConfig(data=2, model=2))  # 2*2 != 8
+
+
+def test_compound_axes(eight_devices):
+    topo = MeshTopology(TopologyConfig(seq=2, model=2))
+    assert topo.sequence_parallel_size == 2
+    assert topo.data_parallel_size == 4  # 2 data * 1 expert * 2 seq
+    assert topo.axis_size(DENSE_GRAD_AXES) == 4

@@ -1,0 +1,72 @@
+"""ZeRO partition-plan tests (reference tests/unit/runtime/zero/test_zero.py
+parametrized over stages, test_zero.py:55-57)."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.runtime.topology import MeshTopology, TopologyConfig
+from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+from deepspeed_tpu.runtime.zero.partition import ZeroPartitionPlan, add_axes_to_spec
+
+
+def make_plan(stage, topo, threshold=0):
+    specs = {
+        "w": P(None, None),          # [256, 512] dense
+        "tp_w": P(None, "model"),    # [256, 512] column-sharded
+        "bias": P(),                 # [512]
+        "scale": P(),                # [8] tiny
+    }
+    shapes = {"w": (256, 512), "tp_w": (256, 512), "bias": (512,), "scale": (8,)}
+    zcfg = DeepSpeedZeroConfig(stage=stage, stage3_param_persistence_threshold=threshold)
+    return ZeroPartitionPlan(topo, zcfg, specs, shapes)
+
+
+def test_add_axes_picks_largest_free_dim(eight_devices):
+    sizes = {"data": 8}
+    spec = add_axes_to_spec(P(None, None), (256, 512), ("data",), sizes)
+    assert spec == P(None, "data")
+    # dim already sharded by TP: falls to the other dim
+    spec = add_axes_to_spec(P(None, "model"), (256, 512), ("data",), sizes)
+    assert spec == P("data", "model")
+
+
+def test_add_axes_indivisible_stays_replicated(eight_devices):
+    sizes = {"data": 8}
+    spec = add_axes_to_spec(P(None,), (6,), ("data",), sizes)
+    assert spec == P(None)
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_stage_sharding_matrix(eight_devices, stage):
+    topo = MeshTopology()
+    plan = make_plan(stage, topo)
+    params = plan.param_spec_tree()
+    grads = plan.grad_spec_tree()
+    opts = plan.optimizer_spec_tree()
+
+    sharded = P(None, ("data", "expert", "seq"))
+    dense_rep = P(None, None)
+    assert params["w"] == (sharded if stage >= 3 else dense_rep)
+    assert grads["w"] == (sharded if stage >= 2 else dense_rep)
+    assert opts["w"] == (sharded if stage >= 1 else dense_rep)
+
+
+def test_stage3_respects_tp_and_threshold(eight_devices):
+    topo = MeshTopology(TopologyConfig(model=2))
+    plan = make_plan(3, topo, threshold=100)
+    params = plan.param_spec_tree()
+    # TP dim untouched, zero axes go to the free dim
+    assert params["tp_w"] == P(("data", "expert", "seq"), "model")
+    # tiny leaf below persistence threshold stays replicated
+    assert params["scale"] == P(None)
+
+
+def test_expert_params_partition_over_expert_dp_only(eight_devices):
+    topo = MeshTopology(TopologyConfig(expert=4))
+    specs = {"expert_w": P("expert", None, None)}
+    shapes = {"expert_w": (4, 128, 256)}
+    plan = ZeroPartitionPlan(topo, DeepSpeedZeroConfig(stage=3), specs, shapes)
+    spec = plan.param_spec_tree()["expert_w"]
+    # expert axis already used; zero adds only (data, seq)
+    assert spec == P("expert", None, ("data", "seq"))

@@ -1,0 +1,3 @@
+from .elasticity import (compute_elastic_config, ensure_immutable_elastic_config,  # noqa: F401
+                         ElasticityConfigError, ElasticityError,
+                         ElasticityIncompatibleWorldSize)

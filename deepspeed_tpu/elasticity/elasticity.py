@@ -1,0 +1,132 @@
+"""Elastic batch-size solver.
+
+Counterpart of the reference ``deepspeed/elasticity/elasticity.py``
+(``compute_elastic_config`` :233, ``_get_compatible_gpus_v01/v02`` :83,126):
+pre-computes global batch sizes compatible with a *range* of accelerator
+counts so a job restarted on a resized TPU slice keeps identical batch
+semantics. The math is hardware-agnostic and ports directly; "gpus" in the
+reference API means model replicas, i.e. chips/data-parallel ranks here.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityError(Exception):
+    ...
+
+
+class ElasticityConfigError(ElasticityError):
+    ...
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    ...
+
+
+def get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
+    """All multiples of each base micro-batch up to the cap (reference :35)."""
+    candidates = set()
+    for base in base_list:
+        if base <= 0:
+            continue
+        value = base
+        while value <= max_acceptable_batch_size:
+            candidates.add(value)
+            value += base
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_valid_gpus: int,
+                   max_valid_gpus: int) -> List[int]:
+    """Device counts that evenly divide batch into one of the micro sizes
+    (reference :47)."""
+    valid = set()
+    for micro in micro_batches:
+        if micro <= 0 or batch_size % micro:
+            continue
+        max_gpus = batch_size // micro
+        for n in range(1, max_gpus + 1):
+            if max_gpus % n == 0 and min_valid_gpus <= n <= max_valid_gpus:
+                valid.add(n)
+    return sorted(valid)
+
+
+def _get_compatible_gpus_v01(micro_batches: List[int], max_acceptable_batch_size: int,
+                             min_gpus: int = 1, max_gpus: int = 10000,
+                             prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    """Reference :83 — pick the batch size maximizing compatible device counts."""
+    candidates = get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
+    best: Tuple[int, List[int]] = (0, [])
+    for batch in (candidates if not prefer_larger else reversed(candidates)):
+        valid = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        if len(valid) > len(best[1]):
+            best = (batch, valid)
+    if not best[1]:
+        raise ElasticityError(
+            f"No compatible batch size found for micro_batches={micro_batches} "
+            f"max={max_acceptable_batch_size} gpus=[{min_gpus},{max_gpus}]")
+    return best
+
+
+def _get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size, current_num_gpus,
+                             min_gpus=1, max_gpus=10000, prefer_larger=True,
+                             num_gpus_per_node: int = 1, model_parallel_size: int = 1):
+    """Reference :126 — v0.2 accounts for model parallelism: batch applies to
+    data-parallel replicas = world / mp."""
+    if current_num_gpus % model_parallel_size:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {current_num_gpus} not divisible by mp {model_parallel_size}")
+    dp = current_num_gpus // model_parallel_size
+    batch, valid = _get_compatible_gpus_v01(
+        micro_batches, max_acceptable_batch_size,
+        min_gpus=max(1, min_gpus // model_parallel_size),
+        max_gpus=max_gpus // model_parallel_size,
+        prefer_larger=prefer_larger)
+    if dp not in valid:
+        raise ElasticityIncompatibleWorldSize(
+            f"data-parallel size {dp} not in compatible set {valid}")
+    return batch, [v * model_parallel_size for v in valid], batch // dp
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Reference :233 — resolve (final_batch_size, valid_gpus[, micro_batch])."""
+    e = ds_config.get("elasticity", {})
+    if not e.get("enabled", False):
+        raise ElasticityConfigError("elasticity not enabled in config")
+    micro_batches = e.get("micro_batch_sizes", [2, 4, 6])
+    max_batch = e.get("max_train_batch_size", 2000)
+    min_gpus, max_gpus = e.get("min_gpus", 1), e.get("max_gpus", 10000)
+    prefer_larger = e.get("prefer_larger_batch", True)
+    version = e.get("version", LATEST_ELASTICITY_VERSION)
+
+    if float(version) >= 0.2 and world_size > 0:
+        mp = e.get("model_parallel_size", 1)
+        batch, valid, micro = _get_compatible_gpus_v02(
+            micro_batches, max_batch, world_size, min_gpus, max_gpus,
+            prefer_larger, model_parallel_size=mp)
+        return (batch, valid, micro) if return_microbatch else (batch, valid)
+
+    batch, valid = _get_compatible_gpus_v01(
+        micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)
+    if world_size > 0 and world_size not in valid:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not compatible: valid={valid}")
+    if return_microbatch:
+        dp = world_size if world_size > 0 else valid[-1]
+        return batch, valid, max(1, batch // dp)
+    return batch, valid
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict,
+                                    frozen_elastic_config_dict: Dict) -> None:
+    """Reference :208 — elastic config must not change across restarts."""
+    if runtime_elastic_config_dict != frozen_elastic_config_dict:
+        raise ElasticityConfigError(
+            "Elastic config changed between scheduler and runtime; "
+            f"frozen={frozen_elastic_config_dict} runtime={runtime_elastic_config_dict}")

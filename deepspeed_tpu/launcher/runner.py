@@ -1,0 +1,185 @@
+"""Multi-host launcher.
+
+Counterpart of the reference ``deepspeed/launcher/runner.py`` (``main``
+:388: hostfile parsing, resource filters, PDSH/MPI/SLURM runners) and
+``launch.py`` (:132: per-rank ``Popen`` + signal fan-out).
+
+TPU redesign: a TPU pod slice runs ONE process per host and JAX discovers
+peers via the TPU metadata service, so the reference's per-GPU rank spawning
+and NCCL rendezvous vanish. What remains and is implemented here:
+
+- hostfile / include-exclude resource filtering (same syntax:
+  ``host:slot1,slot2@host2``) for DCN (multi-slice / CPU cluster) launches;
+- environment propagation (.deepspeed_env equivalent);
+- per-host remote execution over ssh (the PDSH-style runner);
+- local single-host exec (the common TPU-VM case) with signal forwarding.
+
+CLI: ``python -m deepspeed_tpu.launcher.runner [args] script.py ...`` or the
+``bin/dstpu`` wrapper.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import shlex
+import signal
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHON", "PATH", "LD_LIBRARY", "JAX", "XLA", "TPU", "DSTPU"]
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="deepspeed_tpu launcher",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile listing 'hostname slots=N' per line")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="nodes to include: 'host1@host2' or 'host1:0,1'")
+    parser.add_argument("-e", "--exclude", type=str, default="",
+                        help="nodes to exclude (same syntax)")
+    parser.add_argument("--master_addr", type=str, default="",
+                        help="coordinator address (defaults to first host)")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="ssh",
+                        choices=["ssh", "local"], help="remote exec method")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str, help="training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse 'hostname slots=N' lines (reference runner.py:200)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool: Dict[str, int] = {}
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^(\S+)\s+slots=(\d+)", line)
+            if m is None:
+                raise ValueError(f"Malformed hostfile line: '{line}'")
+            host, slots = m.group(1), int(m.group(2))
+            if host in resource_pool:
+                raise ValueError(f"Duplicate host {host} in hostfile")
+            resource_pool[host] = slots
+    return resource_pool
+
+
+def _parse_inclusion_exclusion(resource_pool: Dict[str, int], inclusion: str,
+                               exclusion: str) -> Dict[str, List[int]]:
+    """Reference runner.py:255 parse_resource_filter."""
+    active: Dict[str, List[int]] = {k: list(range(v)) for k, v in resource_pool.items()}
+    if inclusion:
+        included: Dict[str, List[int]] = {}
+        for node in inclusion.split("@"):
+            if ":" in node:
+                host, slots = node.split(":")
+                included[host] = [int(s) for s in slots.split(",")]
+            else:
+                included[node] = active.get(node, [])
+            if node.split(":")[0] not in active:
+                raise ValueError(f"Included host {node} not in hostfile")
+        active = included
+    if exclusion:
+        for node in exclusion.split("@"):
+            if ":" in node:
+                host, slots = node.split(":")
+                excl = {int(s) for s in slots.split(",")}
+                active[host] = [s for s in active.get(host, []) if s not in excl]
+            else:
+                active.pop(node, None)
+        active = {k: v for k, v in active.items() if v}
+    return active
+
+
+def encode_world_info(resource_pool: Dict[str, List[int]]) -> str:
+    import base64
+    import json
+    return base64.urlsafe_b64encode(json.dumps(resource_pool).encode()).decode()
+
+
+def _collect_env_exports() -> Dict[str, str]:
+    exports = {}
+    for key, value in os.environ.items():
+        if any(key.startswith(prefix) for prefix in EXPORT_ENVS):
+            exports[key] = value
+    if os.path.isfile(DEEPSPEED_ENVIRONMENT_NAME):
+        with open(DEEPSPEED_ENVIRONMENT_NAME) as f:
+            for line in f:
+                if "=" in line:
+                    k, v = line.strip().split("=", 1)
+                    exports[k] = v
+    return exports
+
+
+def _run_local(args) -> int:
+    """Single-host exec with signal forwarding (reference launch.py:249,313)."""
+    cmd = [sys.executable, args.user_script] + args.user_args
+    logger.info(f"launching local: {' '.join(map(shlex.quote, cmd))}")
+    proc = subprocess.Popen(cmd)
+
+    def forward(sig, frame):
+        proc.send_signal(sig)
+
+    signal.signal(signal.SIGINT, forward)
+    signal.signal(signal.SIGTERM, forward)
+    return proc.wait()
+
+
+def _run_ssh(args, active: Dict[str, List[int]]) -> int:
+    """PDSH-style per-host ssh runner (reference multinode_runner.py:51)."""
+    hosts = list(active.keys())
+    master = args.master_addr or hosts[0]
+    exports = _collect_env_exports()
+    procs = []
+    for idx, host in enumerate(hosts):
+        env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in exports.items())
+        remote = (f"{env_str} JAX_COORDINATOR_ADDRESS={master}:{args.master_port} "
+                  f"JAX_NUM_PROCESSES={len(hosts)} JAX_PROCESS_ID={idx} "
+                  f"{sys.executable} {args.user_script} "
+                  + " ".join(map(shlex.quote, args.user_args)))
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
+        logger.info(f"launching on {host} (process {idx}/{len(hosts)})")
+        procs.append(subprocess.Popen(cmd))
+
+    def fan_out(sig, frame):
+        for p in procs:
+            p.send_signal(sig)
+
+    signal.signal(signal.SIGINT, fan_out)
+    signal.signal(signal.SIGTERM, fan_out)
+    rc = 0
+    for p in procs:
+        rc = p.wait() or rc
+        if rc:  # kill-all-on-any-failure (reference launch.py:313)
+            for q in procs:
+                if q.poll() is None:
+                    q.terminate()
+    return rc
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    resource_pool = fetch_hostfile(args.hostfile)
+    if not resource_pool or args.launcher == "local":
+        return _run_local(args)
+    active = _parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+    if len(active) == 1 and not args.force_multi:
+        return _run_local(args)
+    return _run_ssh(args, active)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

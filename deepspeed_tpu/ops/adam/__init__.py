@@ -1,0 +1,1 @@
+from .fused_adam import fused_adam_reference, fused_adam_update  # noqa: F401

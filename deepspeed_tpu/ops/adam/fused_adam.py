@@ -1,0 +1,114 @@
+"""Pallas fused Adam.
+
+TPU-native counterpart of the reference's multi-tensor fused Adam
+(``csrc/adam/multi_tensor_adam.cu``, ``fused_adam_frontend.cpp:22``): one
+kernel pass updating params + both moments in place over a flat shard,
+avoiding one HBM round-trip per tensor per quantity that a naive chain of
+elementwise jnp ops could incur if XLA declined to fuse.
+
+The kernel runs on 1-D flat buffers (the ZeRO flat-partition layout) tiled
+into VMEM blocks; bias correction is precomputed on the host side of the
+trace (scalars). On CPU (tests) the kernel runs in interpret mode with
+identical semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK = 1024 * 128  # elements per grid step; multiple of (8,128) tiles
+
+
+def _adam_kernel(g_ref, p_ref, m_ref, v_ref, scal_ref,
+                 p_out, m_out, v_out):
+    lr = scal_ref[0]
+    beta1 = scal_ref[1]
+    beta2 = scal_ref[2]
+    eps = scal_ref[3]
+    wd = scal_ref[4]
+    bc1 = scal_ref[5]  # 1 / (1 - b1^t)
+    bc2 = scal_ref[6]  # 1 / (1 - b2^t)
+    decoupled = scal_ref[7]  # 1.0 => adamw
+
+    g = g_ref[:]
+    p = p_ref[:]
+    # adam-style (coupled) weight decay folds into the gradient
+    g = jnp.where(decoupled > 0, g, g + wd * p)
+    m = beta1 * m_ref[:] + (1.0 - beta1) * g
+    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
+    update = (m * bc1) / (jnp.sqrt(v * bc2) + eps)
+    update = jnp.where(decoupled > 0, update + wd * p, update)
+    p_out[:] = p - lr * update
+    m_out[:] = m
+    v_out[:] = v
+
+
+@functools.partial(jax.jit, static_argnames=("adamw", "interpret"))
+def fused_adam_update(grads: jax.Array, params: jax.Array, exp_avg: jax.Array,
+                      exp_avg_sq: jax.Array, step: jax.Array, lr, beta1=0.9,
+                      beta2=0.999, eps=1e-8, weight_decay=0.0, adamw: bool = True,
+                      interpret: bool = False) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One Adam step on flat fp32 buffers. Returns (params, m, v)."""
+    assert grads.ndim == 1, "fused_adam_update operates on flat shards"
+    n = grads.shape[0]
+    stepf = step.astype(jnp.float32)
+    scalars = jnp.stack([
+        jnp.asarray(lr, jnp.float32),
+        jnp.asarray(beta1, jnp.float32),
+        jnp.asarray(beta2, jnp.float32),
+        jnp.asarray(eps, jnp.float32),
+        jnp.asarray(weight_decay, jnp.float32),
+        1.0 / (1.0 - jnp.asarray(beta1, jnp.float32) ** stepf),
+        1.0 / (1.0 - jnp.asarray(beta2, jnp.float32) ** stepf),
+        jnp.asarray(1.0 if adamw else 0.0, jnp.float32),
+    ])
+
+    block = min(_BLOCK, n)
+    if n % block != 0:  # pad to a whole number of blocks
+        pad = block - n % block
+        grads = jnp.pad(grads, (0, pad))
+        params_p = jnp.pad(params, (0, pad))
+        m_p = jnp.pad(exp_avg, (0, pad))
+        v_p = jnp.pad(exp_avg_sq, (0, pad))
+    else:
+        pad = 0
+        params_p, m_p, v_p = params, exp_avg, exp_avg_sq
+
+    total = grads.shape[0]
+    grid = (total // block,)
+    spec = pl.BlockSpec((block,), lambda i: (i,))
+    scal_spec = pl.BlockSpec((8,), lambda i: (0,))
+    out_shape = [jax.ShapeDtypeStruct((total,), jnp.float32)] * 3
+    p_new, m_new, v_new = pl.pallas_call(
+        _adam_kernel,
+        grid=grid,
+        in_specs=[spec, spec, spec, spec, scal_spec],
+        out_specs=[spec, spec, spec],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(grads.astype(jnp.float32), params_p.astype(jnp.float32), m_p, v_p, scalars)
+    if pad:
+        p_new, m_new, v_new = p_new[:n], m_new[:n], v_new[:n]
+    return p_new, m_new, v_new
+
+
+def fused_adam_reference(grads, params, m, v, step, lr, beta1=0.9, beta2=0.999,
+                         eps=1e-8, weight_decay=0.0, adamw=True):
+    """Pure-jnp reference for parity tests (mirrors the kernel math)."""
+    g = grads.astype(jnp.float32)
+    if not adamw:
+        g = g + weight_decay * params
+    m2 = beta1 * m + (1 - beta1) * g
+    v2 = beta2 * v + (1 - beta2) * g * g
+    stepf = step.astype(jnp.float32)
+    mhat = m2 / (1 - beta1 ** stepf)
+    vhat = v2 / (1 - beta2 ** stepf)
+    update = mhat / (jnp.sqrt(vhat) + eps)
+    if adamw:
+        update = update + weight_decay * params
+    return params - lr * update, m2, v2

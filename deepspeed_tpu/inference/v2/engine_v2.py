@@ -1,0 +1,222 @@
+"""Continuous-batching inference engine.
+
+Counterpart of the reference ``InferenceEngineV2``
+(``inference/v2/engine_v2.py:30``): ``put`` schedules new tokens for a set of
+UIDs and returns next-token logits, ``query``/``can_schedule`` expose KV
+budget for the scheduler, ``flush`` retires sequences.
+
+TPU-first structure: ``put`` decomposes the ragged work into the two
+bucketed static-shape programs of :class:`RaggedInferenceModel` — chunked
+prefill per new sequence and one batched paged decode for continuing
+sequences — each jitted once per bucket with the KV cache donated. This is
+the XLA expression of Dynamic SplitFuse: the scheduler (scheduler.py) still
+mixes prompt chunks and generation inside one token budget per engine step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...models.transformer import TransformerLM
+from ...runtime.topology import MODEL_AXIS, MeshTopology, TopologyConfig
+from ...utils.logging import log_dist
+from .config_v2 import RaggedInferenceEngineConfig
+from .model import RaggedInferenceModel
+from .ragged.kv_cache import BlockedKVCache
+from .ragged.ragged_manager import DSStateManager
+from .ragged.ragged_wrapper import RaggedBatchWrapper, _next_bucket
+
+
+class InferenceEngineV2:
+
+    def __init__(self,
+                 model: TransformerLM,
+                 config: Optional[RaggedInferenceEngineConfig] = None,
+                 params: Optional[Any] = None,
+                 topology: Optional[MeshTopology] = None,
+                 seed: int = 0):
+        self.config = config or RaggedInferenceEngineConfig()
+        c = model.config
+        self.topology = topology or MeshTopology(
+            TopologyConfig(model=self.config.tensor_parallel_degree, data=-1))
+        self.mesh = self.topology.mesh
+
+        sm = self.config.state_manager
+        block_size = self.config.kv_block_size
+        max_ctx = min(sm.max_context, c.max_seq_len)
+        self.max_blocks_per_seq = -(-max_ctx // block_size)
+        num_blocks = self.config.num_kv_blocks
+        if num_blocks is None:
+            # enough for max_ragged_sequence_count sequences at half context
+            num_blocks = 1 + sm.max_ragged_sequence_count * max(
+                1, self.max_blocks_per_seq // 2)
+        self.kv_cache = BlockedKVCache(
+            c.num_layers, c.kv_heads, c.head_dim, num_blocks, block_size,
+            dtype=self.config.kv_cache_dtype)
+        self.state_manager = DSStateManager(sm, self.kv_cache)
+        self.batch = RaggedBatchWrapper(sm.max_ragged_sequence_count,
+                                        self.max_blocks_per_seq)
+
+        self._model = RaggedInferenceModel(model, block_size, self.max_blocks_per_seq)
+        self.model = model
+
+        specs = model.specs()
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                                 is_leaf=lambda s: isinstance(s, P))
+        with self.mesh:
+            if params is not None:
+                self.params = jax.jit(
+                    lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
+                    out_shardings=shardings)(params)
+            else:
+                self.params = jax.jit(lambda rng: model.init(rng, c.dtype),
+                                      out_shardings=shardings)(jax.random.PRNGKey(seed))
+            kv_spec = NamedSharding(self.mesh, P(None, MODEL_AXIS))
+            self.kv_cache.update(
+                jax.device_put(self.kv_cache.k_pages, kv_spec),
+                jax.device_put(self.kv_cache.v_pages, kv_spec))
+
+        self._prefill_jits: Dict[int, Any] = {}
+        self._decode_jits: Dict[int, Any] = {}
+        log_dist(
+            f"InferenceEngineV2: {num_blocks} KV blocks × {block_size} tokens "
+            f"({self.kv_cache.mem_bytes() / 2**20:.0f} MiB), "
+            f"tp={self.topology.model_parallel_size}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    # compiled-program cache
+    # ------------------------------------------------------------------
+    def _prefill_fn(self, bucket: int):
+        fn = self._prefill_jits.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._model.prefill_chunk, donate_argnums=(1, 2))
+            self._prefill_jits[bucket] = fn
+        return fn
+
+    def _decode_fn(self, bucket: int):
+        fn = self._decode_jits.get(bucket)
+        if fn is None:
+            fn = jax.jit(self._model.decode, donate_argnums=(1, 2))
+            self._decode_jits[bucket] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # scheduling queries (reference engine_v2.py:153,179)
+    # ------------------------------------------------------------------
+    def query(self, uid: int) -> Dict[str, int]:
+        seq = self.state_manager.get_sequence(uid)
+        return {
+            "seen_tokens": 0 if seq is None else seq.seen_tokens,
+            "cur_allocated_blocks": 0 if seq is None else seq.cur_allocated_blocks,
+            "free_blocks": self.state_manager.free_blocks,
+        }
+
+    def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
+        """Dry-run KV block budgeting (reference ``can_schedule``/
+        ``get_length_needed``)."""
+        sm = self.config.state_manager
+        if len(uids) > sm.max_ragged_sequence_count:
+            return False
+        if sum(lengths) > sm.max_ragged_batch_size:
+            return False
+        need = 0
+        for uid, n in zip(uids, lengths):
+            seq = self.state_manager.get_sequence(uid)
+            seen = 0 if seq is None else seq.seen_tokens
+            have = 0 if seq is None else seq.cur_allocated_blocks
+            total_blocks = -(-(seen + n) // self.state_manager.block_size)
+            need += max(0, total_blocks - have)
+        return need <= self.state_manager.free_blocks
+
+    def flush(self, uid: int) -> None:
+        self.state_manager.flush_sequence(uid)
+
+    # ------------------------------------------------------------------
+    # forward (reference engine_v2.py:107 put)
+    # ------------------------------------------------------------------
+    def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]) -> np.ndarray:
+        """Schedule new tokens for each UID; returns last-token logits
+        [len(uids), vocab]."""
+        sm = self.config.state_manager
+        if not self.can_schedule(batch_uids, [len(t) for t in batch_tokens]):
+            raise RuntimeError("batch does not fit KV/budget; call can_schedule first")
+
+        decode_uids, decode_tokens = [], []
+        out_logits: Dict[int, np.ndarray] = {}
+        for uid, tokens in zip(batch_uids, batch_tokens):
+            tokens = np.asarray(tokens, np.int32)
+            seq = self.state_manager.get_or_create_sequence(uid)
+            self.state_manager.allocate_blocks(seq, len(tokens))
+            if len(tokens) == 1 and seq.seen_tokens > 0:
+                decode_uids.append(uid)
+                decode_tokens.append(tokens)
+            else:
+                out_logits[uid] = self._run_prefill(seq, tokens)
+
+        if decode_uids:
+            for uid, logits in zip(decode_uids,
+                                   self._run_decode(decode_uids, decode_tokens)):
+                out_logits[uid] = logits
+        return np.stack([out_logits[u] for u in batch_uids])
+
+    def _run_prefill(self, seq, tokens: np.ndarray) -> np.ndarray:
+        """Chunked prefill of one sequence (SplitFuse chunks)."""
+        chunk_cap = self.config.max_prefill_chunk
+        logits = None
+        off = 0
+        while off < len(tokens):
+            chunk = tokens[off:off + chunk_cap]
+            n = len(chunk)
+            bucket = _next_bucket(n, lo=16)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:n] = chunk
+            hist = seq.seen_tokens
+            positions = hist + np.arange(bucket, dtype=np.int32)
+            bt = np.zeros((self.max_blocks_per_seq,), np.int32)
+            bt[:len(seq.blocks)] = seq.blocks
+            fn = self._prefill_fn(bucket)
+            with self.mesh:
+                lg, k_pages, v_pages = fn(
+                    self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
+                    jnp.asarray(padded), jnp.asarray(positions), jnp.asarray(bt),
+                    jnp.asarray(hist, jnp.int32), jnp.asarray(n, jnp.int32))
+            self.kv_cache.update(k_pages, v_pages)
+            seq.post_forward(n)
+            logits = lg
+            off += n
+        return np.asarray(logits)
+
+    def _run_decode(self, uids: List[int], tokens: List[np.ndarray]) -> np.ndarray:
+        self.batch.clear()
+        for uid, toks in zip(uids, tokens):
+            seq = self.state_manager.get_sequence(uid)
+            self.batch.insert_sequence(uid, toks, seq.seen_tokens, seq.blocks)
+        meta = self.batch.finalize()
+        n = meta["num_seqs"]
+        # padded rows: context_len 1 against the null block (finite softmax)
+        ctx = meta["context_lens"]
+        ctx[n:] = 1
+        fn = self._decode_fn(len(meta["tokens"]))
+        with self.mesh:
+            logits, k_pages, v_pages = fn(
+                self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
+                jnp.asarray(meta["tokens"]), jnp.asarray(meta["positions"]),
+                jnp.asarray(ctx), jnp.asarray(meta["block_tables"]))
+        self.kv_cache.update(k_pages, v_pages)
+        for uid in uids:
+            self.state_manager.get_sequence(uid).post_forward(1)
+        return np.asarray(logits)[:n]
+
+
+def build_engine(model: TransformerLM,
+                 config: Optional[RaggedInferenceEngineConfig] = None,
+                 params: Optional[Any] = None,
+                 **kwargs) -> InferenceEngineV2:
+    """Reference ``engine_factory.build_hf_engine`` (engine_factory.py:65)."""
+    return InferenceEngineV2(model, config=config, params=params, **kwargs)

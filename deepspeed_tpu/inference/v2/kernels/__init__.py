@@ -1,0 +1,1 @@
+from .paged_attention import chunk_prefill_attention, paged_decode_attention  # noqa: F401

@@ -1,0 +1,129 @@
+"""Paged attention over a blocked KV cache.
+
+The TPU-native replacement for the reference's ragged CUDA kernel set
+(``inference/v2/kernels/ragged_ops``: ``blocked_flash`` / ``atom_builder`` /
+``linear_blocked_kv_rotary``, ``ragged_ops.cpp:20-47``). Two entry points
+mirror the two static-shape programs the engine compiles:
+
+- :func:`paged_decode_attention` — one new token per sequence, attention
+  against that sequence's block table. On TPU dispatches to the Pallas
+  ``paged_attention`` kernel (HBM-resident pages streamed block-by-block);
+  elsewhere an XLA gather fallback with identical semantics.
+- :func:`chunk_prefill_attention` — a chunk of one sequence's tokens
+  attending to gathered history + themselves (causal), the SplitFuse
+  prefill-chunk program.
+
+Page layout everywhere: ``[kv_heads, num_pages, page_size, head_dim]``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.3819763e38  # pallas kernel's mask value
+
+
+@functools.lru_cache(None)
+def _pallas_paged_available() -> bool:
+    if jax.default_backend() != "tpu":
+        return False
+    try:
+        from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention  # noqa: F401
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _gather_pages(pages: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """pages [kvH, P, ps, D], block_tables [B, mp] -> [B, kvH, mp*ps, D]."""
+    g = jnp.take(pages, block_tables, axis=1)          # [kvH, B, mp, ps, D]
+    kvH, B, mp, ps, D = g.shape
+    return g.transpose(1, 0, 2, 3, 4).reshape(B, kvH, mp * ps, D)
+
+
+def _gqa_logits(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
+    """q [B, H, D], k [B, kvH, C, D] -> logits [B, H, C] (fp32)."""
+    B, H, D = q.shape
+    kvH = k.shape[1]
+    group = H // kvH
+    qg = q.reshape(B, kvH, group, D)
+    logits = jnp.einsum("bkgd,bkcd->bkgc", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    return logits.reshape(B, H, k.shape[2])
+
+
+def _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables,
+                      scale: float) -> jax.Array:
+    k = _gather_pages(k_pages, block_tables)
+    v = _gather_pages(v_pages, block_tables)
+    B, kvH, C, D = k.shape
+    H = q.shape[1]
+    logits = _gqa_logits(q, k, scale)                   # [B, H, C]
+    mask = jnp.arange(C)[None, :] < context_lens[:, None]
+    logits = jnp.where(mask[:, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    pg = probs.reshape(B, kvH, H // kvH, C)
+    out = jnp.einsum("bkgc,bkcd->bkgd", pg, v)
+    return out.reshape(B, H, D)
+
+
+def paged_decode_attention(q: jax.Array,
+                           k_pages: jax.Array,
+                           v_pages: jax.Array,
+                           context_lens: jax.Array,
+                           block_tables: jax.Array,
+                           scale: Optional[float] = None,
+                           use_pallas: Optional[bool] = None) -> jax.Array:
+    """q [B, H, D]; returns [B, H, D].
+
+    ``context_lens[b]`` counts tokens *including* the one just written at
+    position ``context_lens[b]-1``.
+    """
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    if use_pallas is None:
+        use_pallas = _pallas_paged_available()
+    if use_pallas:
+        from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention as pa
+        pages_per_block = min(8, block_tables.shape[1])
+        while block_tables.shape[1] % pages_per_block:
+            pages_per_block -= 1
+        try:
+            return pa.paged_attention(
+                (q * scale).astype(q.dtype),  # kernel applies no softmax scale itself
+                k_pages, v_pages,
+                lengths=context_lens, page_indices=block_tables,
+                pages_per_compute_block=pages_per_block)
+        except Exception:  # pragma: no cover - shape/backend constraint
+            pass
+    return _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables, scale)
+
+
+def chunk_prefill_attention(q: jax.Array,
+                            k_ctx: jax.Array,
+                            v_ctx: jax.Array,
+                            history_len: jax.Array,
+                            scale: Optional[float] = None) -> jax.Array:
+    """SplitFuse prefill-chunk attention for ONE sequence.
+
+    q [T, H, D] — chunk queries at absolute positions history_len + i.
+    k_ctx/v_ctx [kvH, C, D] — the sequence's gathered context (history +
+    this chunk, already written). Causal: query i sees context positions
+    <= history_len + i. Returns [T, H, D].
+    """
+    T, H, D = q.shape
+    kvH, C, _ = k_ctx.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    group = H // kvH
+    qg = q.reshape(T, kvH, group, D)
+    logits = jnp.einsum("tkgd,kcd->tkgc", qg, k_ctx,
+                        preferred_element_type=jnp.float32) * scale
+    allowed = jnp.arange(C)[None, :] <= (history_len + jnp.arange(T))[:, None]
+    logits = jnp.where(allowed[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("tkgc,kcd->tkgd", probs, v_ctx)
+    return out.reshape(T, H, D)

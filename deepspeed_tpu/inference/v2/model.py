@@ -1,0 +1,187 @@
+"""Ragged inference model over a blocked KV cache.
+
+Counterpart of the reference per-arch inference models
+(``inference/v2/model_implementations/llama_v2/model.py:217`` — forward =
+``_forward_embed`` → per-layer attention/MLP over ragged batch →
+``_forward_unembed``). One implementation covers the whole decoder family by
+reusing :class:`~deepspeed_tpu.models.transformer.TransformerLM`'s config and
+parameter layout (GPT-2 / Llama / Mistral / Mixtral presets).
+
+Two static-shape programs replace the reference's ragged CUDA path
+(Dynamic SplitFuse is preserved at the scheduler level, see
+``scheduler.py``):
+
+- ``prefill_chunk``: T tokens of ONE sequence (bucketed T), writes their KV
+  into the sequence's pages, causal attention over gathered history+chunk,
+  returns the last valid token's logits.
+- ``decode``: B sequences × 1 token (bucketed B), writes KV, paged
+  attention via the Pallas TPU kernel, returns logits for all B.
+
+The KV cache flows through functionally ([L, kvH, P, ps, D], carried through
+the layer loop with dynamic_update_slice; donated at the jit boundary so XLA
+updates it in place).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer import TransformerLM
+from ...nn import layers as nn
+from .kernels.paged_attention import chunk_prefill_attention, paged_decode_attention
+
+Params = Dict[str, Any]
+
+
+class RaggedInferenceModel:
+
+    def __init__(self, model: TransformerLM, block_size: int, max_blocks_per_seq: int,
+                 use_pallas: bool = None):
+        self.model = model
+        self.config = model.config
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.use_pallas = use_pallas
+        c = self.config
+        assert c.max_seq_len <= max_blocks_per_seq * block_size or True
+
+    # -- shared pieces ------------------------------------------------------
+    def _embed(self, params: Params, tokens: jax.Array, positions: jax.Array) -> jax.Array:
+        """tokens [N] -> [N, hidden] (reference ``_forward_embed``, ragged_embed)."""
+        m = self.model
+        x = m._wte(params["wte"], tokens)
+        if m._wpe is not None:
+            pos = jnp.clip(positions, 0, self.config.max_seq_len - 1)
+            x = x + m._wpe(params["wpe"], pos)
+        return x.astype(self.config.dtype)
+
+    def _unembed(self, params: Params, x: jax.Array) -> jax.Array:
+        """x [N, hidden] -> logits [N, vocab] fp32 (reference
+        ``_forward_unembed``, gather_for_logits)."""
+        m = self.model
+        x = m._ln_f(params["ln_f"], x)
+        if self.config.tie_embeddings:
+            logits = m._wte.attend(params["wte"], x)
+        else:
+            logits = m._lm_head(params["lm_head"], x)
+        return logits.astype(jnp.float32)
+
+    def _qkv(self, block: Params, x: jax.Array, positions: jax.Array):
+        """x [N, hidden] -> q [N, H, D], k/v [N, kvH, D] with rope applied."""
+        c, m = self.config, self.model
+        N = x.shape[0]
+        h = m._block_layers["ln_1"](block["ln_1"], x)
+        q = m._block_layers["q_proj"](block["q_proj"], h).reshape(N, c.num_heads, c.head_dim)
+        k = m._block_layers["k_proj"](block["k_proj"], h).reshape(N, c.kv_heads, c.head_dim)
+        v = m._block_layers["v_proj"](block["v_proj"], h).reshape(N, c.kv_heads, c.head_dim)
+        if c.position == "rope":
+            q = nn.rotary_embedding(q, positions, c.rope_theta)
+            k = nn.rotary_embedding(k, positions, c.rope_theta)
+        return q, k, v
+
+    def _mlp(self, block: Params, x: jax.Array) -> jax.Array:
+        c, m = self.config, self.model
+        h = m._block_layers["ln_2"](block["ln_2"], x)
+        if c.moe is not None:
+            out, _ = m._moe(block["moe"], h[None, :, :])
+            return out[0]
+        if c.activation == "silu_gated":
+            gate = nn.silu(m._block_layers["gate_proj"](block["gate_proj"], h))
+            up = m._block_layers["up_proj"](block["up_proj"], h)
+            return m._block_layers["down_proj"](block["down_proj"], gate * up)
+        h2 = nn.gelu(m._block_layers["fc_in"](block["fc_in"], h))
+        return m._block_layers["fc_out"](block["fc_out"], h2)
+
+    def _write_kv(self, pages: jax.Array, new: jax.Array, flat_idx: jax.Array) -> jax.Array:
+        """pages [kvH, P, ps, D]; new [N, kvH, D]; flat_idx [N] into P*ps.
+
+        The reference's ``linear_kv_copy``/``kv_rotary_embeddings`` kernel
+        (ragged_ops.cpp:20-47) — here a scatter XLA turns into an in-place
+        dynamic update on the donated cache.
+        """
+        kvH, P, ps, D = pages.shape
+        flat = pages.reshape(kvH, P * ps, D)
+        flat = flat.at[:, flat_idx, :].set(new.astype(pages.dtype).transpose(1, 0, 2))
+        return flat.reshape(kvH, P, ps, D)
+
+    def _layer_loop(self, params: Params, k_pages, v_pages, x, attn_fn, write_idx,
+                    positions):
+        """Run all layers with the stacked cache carried functionally."""
+        L = self.config.num_layers
+        blocks = params["blocks"]
+
+        def body(l, carry):
+            x, k_pages, v_pages = carry
+            block = jax.tree.map(lambda a: a[l], blocks)
+            q, k, v = self._qkv(block, x, positions)
+            k_l = self._write_kv(k_pages[l], k, write_idx)
+            v_l = self._write_kv(v_pages[l], v, write_idx)
+            k_pages = k_pages.at[l].set(k_l)
+            v_pages = v_pages.at[l].set(v_l)
+            attn_out = attn_fn(q, k_l, v_l)
+            o = self.model._block_layers["o_proj"](
+                block["o_proj"], attn_out.reshape(x.shape[0], -1))
+            x = x + o
+            x = x + self._mlp(block, x)
+            return (x, k_pages, v_pages)
+
+        x, k_pages, v_pages = jax.lax.fori_loop(0, L, body, (x, k_pages, v_pages))
+        return x, k_pages, v_pages
+
+    # -- programs -----------------------------------------------------------
+    def prefill_chunk(self, params: Params, k_pages, v_pages, tokens, positions,
+                      block_table, history_len, n_valid):
+        """One sequence, T_pad chunk tokens. Returns (last_logits [V],
+        k_pages, v_pages)."""
+        ps = self.block_size
+        T = tokens.shape[0]
+        max_flat = k_pages.shape[2] * ps  # P * ps
+
+        x = self._embed(params, tokens, positions)
+
+        pos_c = jnp.clip(positions, 0, self.max_blocks_per_seq * ps - 1)
+        pages_of = jnp.take(block_table, pos_c // ps, mode="clip")
+        write_idx = jnp.where(jnp.arange(T) < n_valid,
+                              pages_of * ps + pos_c % ps, 0)
+        write_idx = jnp.clip(write_idx, 0, max_flat - 1)
+
+        ctx_idx = (block_table[:, None] * ps + jnp.arange(ps)[None, :]).reshape(-1)
+
+        def attn(q, k_l, v_l):
+            kf = k_l.reshape(k_l.shape[0], -1, k_l.shape[-1])
+            k_ctx = kf[:, ctx_idx, :]
+            vf = v_l.reshape(v_l.shape[0], -1, v_l.shape[-1])
+            v_ctx = vf[:, ctx_idx, :]
+            return chunk_prefill_attention(q, k_ctx, v_ctx, history_len)
+
+        x, k_pages, v_pages = self._layer_loop(
+            params, k_pages, v_pages, x, attn, write_idx, positions)
+        last = jnp.clip(n_valid - 1, 0, T - 1)
+        logits = self._unembed(params, x[last][None, :])[0]
+        return logits, k_pages, v_pages
+
+    def decode(self, params: Params, k_pages, v_pages, tokens, positions,
+               context_lens, block_tables):
+        """B sequences × 1 token. Returns (logits [B, V], k_pages, v_pages)."""
+        ps = self.block_size
+        B = tokens.shape[0]
+        max_flat = k_pages.shape[2] * ps
+
+        x = self._embed(params, tokens, positions)
+
+        pos_c = jnp.clip(positions, 0, self.max_blocks_per_seq * ps - 1)
+        pages_of = jnp.take_along_axis(block_tables, (pos_c // ps)[:, None],
+                                       axis=1)[:, 0]
+        write_idx = jnp.clip(pages_of * ps + pos_c % ps, 0, max_flat - 1)
+
+        def attn(q, k_l, v_l):
+            return paged_decode_attention(q, k_l, v_l, context_lens, block_tables,
+                                          use_pallas=self.use_pallas)
+
+        x, k_pages, v_pages = self._layer_loop(
+            params, k_pages, v_pages, x, attn, write_idx, positions)
+        logits = self._unembed(params, x)
+        return logits, k_pages, v_pages

@@ -1,0 +1,5 @@
+from .blocked_allocator import BlockedAllocator  # noqa: F401
+from .kv_cache import BlockedKVCache  # noqa: F401
+from .ragged_manager import DSStateManager  # noqa: F401
+from .ragged_wrapper import RaggedBatchWrapper  # noqa: F401
+from .sequence_descriptor import DSSequenceDescriptor  # noqa: F401

@@ -1,0 +1,50 @@
+"""Free-list allocator for KV-cache blocks.
+
+Counterpart of the reference ``inference/v2/ragged/blocked_allocator.py:11``
+(``BlockedAllocator``): O(1) allocate/free of fixed-size block ids. Host-side
+pure Python — block *ids* are host metadata; block *contents* live on device
+in :class:`~deepspeed_tpu.inference.v2.ragged.kv_cache.BlockedKVCache`.
+
+Block id 0 is reserved as the null/scratch block: padded block-table entries
+and padded token writes are directed at it so static-shape programs never
+corrupt live cache state.
+"""
+
+from __future__ import annotations
+
+
+class BlockedAllocator:
+
+    NULL_BLOCK = 0
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 reserved), got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free_list = list(range(num_blocks - 1, 0, -1))  # id 0 reserved
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free_list)
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks - 1
+
+    def allocate(self, num_blocks: int) -> list:
+        """Pop ``num_blocks`` ids; raises if insufficient (caller should have
+        consulted ``free_blocks`` — reference ``can_schedule`` pattern)."""
+        if num_blocks > len(self._free_list):
+            raise ValueError(
+                f"cannot allocate {num_blocks} blocks, {len(self._free_list)} free")
+        out = self._free_list[-num_blocks:] if num_blocks else []
+        del self._free_list[len(self._free_list) - num_blocks:]
+        return out
+
+    def free(self, blocks) -> None:
+        for blk in blocks:
+            if blk == self.NULL_BLOCK:
+                raise ValueError("cannot free the null block")
+            if not (0 < blk < self._num_blocks):
+                raise ValueError(f"block id {blk} out of range")
+        self._free_list.extend(blocks)

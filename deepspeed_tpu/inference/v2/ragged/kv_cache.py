@@ -1,0 +1,57 @@
+"""Blocked (paged) KV cache on device.
+
+Counterpart of the reference ``inference/v2/ragged/kv_cache.py:40``
+(``BlockedKVCache``). Layout is chosen for the Pallas TPU paged-attention
+kernel: per layer ``k_pages``/``v_pages`` of shape
+``[kv_heads, num_blocks, block_size, head_dim]``, stacked over layers into
+one array ``[L, kv_heads, num_blocks, block_size, head_dim]`` so the model's
+``lax.scan`` over layers can consume/produce cache slices.
+
+The cache is a functional value: forward passes take it as a donated jit
+argument and return the updated array (XLA aliases the buffer in place), the
+engine swaps in the new handle — no mutation, no streams.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class BlockedKVCache:
+
+    def __init__(self,
+                 num_layers: int,
+                 num_kv_heads: int,
+                 head_dim: int,
+                 num_blocks: int,
+                 block_size: int,
+                 dtype=jnp.bfloat16):
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.dtype = dtype
+        shape = (num_layers, num_kv_heads, num_blocks, block_size, head_dim)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+
+    @property
+    def per_token_bytes(self) -> int:
+        itemsize = jnp.dtype(self.dtype).itemsize
+        return 2 * self.num_layers * self.num_kv_heads * self.head_dim * itemsize
+
+    @property
+    def pages(self) -> Tuple[jax.Array, jax.Array]:
+        return self.k_pages, self.v_pages
+
+    def update(self, k_pages: jax.Array, v_pages: jax.Array) -> None:
+        """Swap in the post-forward cache handles."""
+        self.k_pages = k_pages
+        self.v_pages = v_pages
+
+    def mem_bytes(self) -> int:
+        return 2 * self.k_pages.size * jnp.dtype(self.dtype).itemsize

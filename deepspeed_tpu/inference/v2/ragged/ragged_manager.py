@@ -1,0 +1,68 @@
+"""Sequence state manager.
+
+Counterpart of the reference ``inference/v2/ragged/ragged_manager.py:19``
+(``DSStateManager``): UID → sequence descriptor tracking, block accounting
+against the :class:`BlockedAllocator`, and KV-cache ownership.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..config_v2 import DeepSpeedTPStateManagerConfig
+from .blocked_allocator import BlockedAllocator
+from .kv_cache import BlockedKVCache
+from .sequence_descriptor import DSSequenceDescriptor
+
+
+class DSStateManager:
+
+    def __init__(self,
+                 config: DeepSpeedTPStateManagerConfig,
+                 kv_cache: BlockedKVCache):
+        self._config = config
+        self.kv_cache = kv_cache
+        self.block_size = kv_cache.block_size
+        self._allocator = BlockedAllocator(kv_cache.num_blocks)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    # -- queries (reference ragged_manager.py properties) -------------------
+    @property
+    def free_blocks(self) -> int:
+        return self._allocator.free_blocks
+
+    @property
+    def tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        """Reference ``ragged_manager.py:132`` (get_or_create_sequence)."""
+        seq = self._seqs.get(uid)
+        if seq is None:
+            if len(self._seqs) >= self._config.max_tracked_sequences:
+                raise RuntimeError(
+                    f"tracking {len(self._seqs)} sequences, limit "
+                    f"{self._config.max_tracked_sequences}")
+            seq = DSSequenceDescriptor(uid, self.block_size)
+            self._seqs[uid] = seq
+        return seq
+
+    # -- block lifecycle ----------------------------------------------------
+    def can_allocate(self, uid: int, new_tokens: int) -> bool:
+        seq = self._seqs.get(uid) or DSSequenceDescriptor(uid, self.block_size)
+        return seq.blocks_needed(new_tokens) <= self.free_blocks
+
+    def allocate_blocks(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
+        need = seq.blocks_needed(new_tokens)
+        if need:
+            seq.extend_blocks(self._allocator.allocate(need))
+
+    def flush_sequence(self, uid: int) -> None:
+        """Free a sequence's blocks and forget it (reference
+        ``engine_v2.py:228`` flush)."""
+        seq = self._seqs.pop(uid, None)
+        if seq is not None and seq.blocks:
+            self._allocator.free(seq.blocks)

@@ -1,0 +1,79 @@
+"""Static-shape ragged batch metadata.
+
+Counterpart of the reference ``inference/v2/ragged/ragged_wrapper.py``
+(``RaggedBatchWrapper``): the host-built, device-shipped description of one
+forward pass over a ragged set of sequences. The reference builds pinned
+host buffers + async copy; on TPU the same role is a dict of padded numpy
+arrays handed to a bucketed jitted program (padding → static shapes → one
+compiled program per bucket, the XLA analogue of ragged kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+
+def _next_bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class RaggedBatchWrapper:
+
+    def __init__(self, max_seqs: int, max_blocks_per_seq: int):
+        self.max_seqs = max_seqs
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.clear()
+
+    def clear(self) -> None:
+        self._uids: List[int] = []
+        self._tokens: List[np.ndarray] = []
+        self._start_pos: List[int] = []
+        self._block_tables: List[List[int]] = []
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self._uids)
+
+    @property
+    def current_tokens(self) -> int:
+        return int(sum(len(t) for t in self._tokens))
+
+    def insert_sequence(self, uid: int, tokens: np.ndarray, start_pos: int,
+                        blocks: List[int]) -> None:
+        """Reference ``engine_v2.py:124-131`` / ``ragged_manager.py:132``."""
+        if len(self._uids) >= self.max_seqs:
+            raise ValueError(f"batch already holds {self.max_seqs} sequences")
+        self._uids.append(uid)
+        self._tokens.append(np.asarray(tokens, np.int32))
+        self._start_pos.append(int(start_pos))
+        self._block_tables.append(list(blocks))
+
+    def finalize(self, bucket_seqs: bool = True) -> Dict[str, np.ndarray]:
+        """Pad to a static bucket: decode-style batches become
+        ``[B_pad]``-shaped arrays; per-seq block tables pad with the null
+        block. Returns host arrays ready for ``jax.device_put``."""
+        n = len(self._uids)
+        B = _next_bucket(n) if bucket_seqs else n
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        context_lens = np.zeros((B,), np.int32)
+        block_tables = np.zeros((B, self.max_blocks_per_seq), np.int32)
+        for i in range(n):
+            assert len(self._tokens[i]) == 1, "finalize() builds decode batches"
+            tokens[i] = self._tokens[i][0]
+            positions[i] = self._start_pos[i]
+            context_lens[i] = self._start_pos[i] + 1
+            bt = self._block_tables[i][:self.max_blocks_per_seq]
+            block_tables[i, :len(bt)] = bt
+        return {
+            "tokens": tokens,
+            "positions": positions,
+            "context_lens": context_lens,
+            "block_tables": block_tables,
+            "num_seqs": n,
+        }

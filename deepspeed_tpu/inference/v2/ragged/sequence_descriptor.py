@@ -1,0 +1,39 @@
+"""Per-sequence tracking state.
+
+Counterpart of the reference ``inference/v2/ragged/sequence_descriptor.py``
+(``DSSequenceDescriptor``): UID, tokens seen so far, and the ordered list of
+KV blocks the sequence owns. The reference keeps this in pinned host tensors
+mirrored to device; here the block table is plain host ints, padded into the
+batch's device metadata at schedule time.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class DSSequenceDescriptor:
+
+    def __init__(self, uid: int, block_size: int):
+        self.uid = uid
+        self._block_size = block_size
+        self.seen_tokens = 0           # tokens whose KV is in cache
+        self.blocks: List[int] = []    # ordered KV block ids
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.blocks)
+
+    def blocks_needed(self, new_tokens: int) -> int:
+        """Additional blocks required to hold ``new_tokens`` more tokens."""
+        total = self.seen_tokens + new_tokens
+        needed = -(-total // self._block_size)  # ceil
+        return max(0, needed - len(self.blocks))
+
+    def extend_blocks(self, blocks: List[int]) -> None:
+        self.blocks.extend(blocks)
+
+    def post_forward(self, new_tokens: int) -> None:
+        """Advance the seen-token count after a forward pass (reference
+        ``sequence_descriptor`` update in ``engine_v2.py:146``)."""
+        self.seen_tokens += new_tokens

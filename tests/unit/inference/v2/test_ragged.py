@@ -1,0 +1,77 @@
+"""Ragged-state unit tests (reference tests/unit/inference/v2/ragged)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.config_v2 import DeepSpeedTPStateManagerConfig
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.ragged.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.ragged.ragged_manager import DSStateManager
+from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import DSSequenceDescriptor
+
+
+class TestBlockedAllocator:
+
+    def test_allocate_free_roundtrip(self):
+        alloc = BlockedAllocator(16)
+        assert alloc.free_blocks == 15  # block 0 reserved
+        a = alloc.allocate(4)
+        assert len(set(a)) == 4 and 0 not in a
+        assert alloc.free_blocks == 11
+        alloc.free(a)
+        assert alloc.free_blocks == 15
+
+    def test_exhaustion_raises(self):
+        alloc = BlockedAllocator(4)
+        alloc.allocate(3)
+        with pytest.raises(ValueError):
+            alloc.allocate(1)
+
+    def test_cannot_free_null(self):
+        alloc = BlockedAllocator(4)
+        with pytest.raises(ValueError):
+            alloc.free([0])
+
+    def test_all_ids_distinct_and_reusable(self):
+        alloc = BlockedAllocator(8)
+        a = alloc.allocate(7)
+        alloc.free(a[:3])
+        b = alloc.allocate(3)
+        assert set(b) <= set(a[:3])
+
+
+class TestSequenceDescriptor:
+
+    def test_blocks_needed(self):
+        seq = DSSequenceDescriptor(uid=1, block_size=16)
+        assert seq.blocks_needed(1) == 1
+        assert seq.blocks_needed(16) == 1
+        assert seq.blocks_needed(17) == 2
+        seq.extend_blocks([5])
+        seq.post_forward(16)
+        assert seq.blocks_needed(1) == 1
+        assert seq.blocks_needed(0) == 0
+
+
+class TestStateManager:
+
+    def _manager(self, num_blocks=32, block_size=4):
+        cache = BlockedKVCache(num_layers=1, num_kv_heads=1, head_dim=8,
+                               num_blocks=num_blocks, block_size=block_size)
+        return DSStateManager(DeepSpeedTPStateManagerConfig(), cache)
+
+    def test_lifecycle(self):
+        mgr = self._manager()
+        seq = mgr.get_or_create_sequence(7)
+        mgr.allocate_blocks(seq, 10)  # 10 tokens / bs 4 -> 3 blocks
+        assert seq.cur_allocated_blocks == 3
+        assert mgr.free_blocks == 31 - 3
+        seq.post_forward(10)
+        mgr.flush_sequence(7)
+        assert mgr.free_blocks == 31
+        assert mgr.get_sequence(7) is None
+
+    def test_can_allocate(self):
+        mgr = self._manager(num_blocks=4, block_size=4)  # 3 usable
+        assert mgr.can_allocate(1, 12)
+        assert not mgr.can_allocate(1, 13)

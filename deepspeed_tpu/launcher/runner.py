@@ -144,10 +144,12 @@ def _run_ssh(args, active: Dict[str, List[int]]) -> int:
     master = args.master_addr or hosts[0]
     exports = _collect_env_exports()
     procs = []
+    world_info = encode_world_info(active)
     for idx, host in enumerate(hosts):
         env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in exports.items())
         remote = (f"{env_str} JAX_COORDINATOR_ADDRESS={master}:{args.master_port} "
                   f"JAX_NUM_PROCESSES={len(hosts)} JAX_PROCESS_ID={idx} "
+                  f"DSTPU_WORLD_INFO={world_info} "
                   f"{sys.executable} {args.user_script} "
                   + " ".join(map(shlex.quote, args.user_args)))
         cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
@@ -162,8 +164,10 @@ def _run_ssh(args, active: Dict[str, List[int]]) -> int:
     signal.signal(signal.SIGTERM, fan_out)
     rc = 0
     for p in procs:
-        rc = p.wait() or rc
-        if rc:  # kill-all-on-any-failure (reference launch.py:313)
+        code = p.wait()
+        if code and not rc:
+            rc = code  # keep the FIRST failure's code, not peers' SIGTERM status
+            # kill-all-on-any-failure (reference launch.py:313)
             for q in procs:
                 if q.poll() is None:
                     q.terminate()

@@ -48,11 +48,12 @@ def _adam_kernel(g_ref, p_ref, m_ref, v_ref, scal_ref,
     v_out[:] = v
 
 
-@functools.partial(jax.jit, static_argnames=("adamw", "interpret"))
+@functools.partial(jax.jit, static_argnames=("adamw", "interpret", "block_size"))
 def fused_adam_update(grads: jax.Array, params: jax.Array, exp_avg: jax.Array,
                       exp_avg_sq: jax.Array, step: jax.Array, lr, beta1=0.9,
                       beta2=0.999, eps=1e-8, weight_decay=0.0, adamw: bool = True,
-                      interpret: bool = False) -> Tuple[jax.Array, jax.Array, jax.Array]:
+                      interpret: bool = False,
+                      block_size: int = _BLOCK) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One Adam step on flat fp32 buffers. Returns (params, m, v)."""
     assert grads.ndim == 1, "fused_adam_update operates on flat shards"
     n = grads.shape[0]
@@ -68,7 +69,7 @@ def fused_adam_update(grads: jax.Array, params: jax.Array, exp_avg: jax.Array,
         jnp.asarray(1.0 if adamw else 0.0, jnp.float32),
     ])
 
-    block = min(_BLOCK, n)
+    block = min(block_size, n)
     if n % block != 0:  # pad to a whole number of blocks
         pad = block - n % block
         grads = jnp.pad(grads, (0, pad))

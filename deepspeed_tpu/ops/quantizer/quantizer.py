@@ -95,8 +95,12 @@ def quantized_all_gather(x: jax.Array, axis: str = "data", num_bits: int = 8,
     s_g = jax.lax.all_gather(scale, axis, axis=0, tiled=True)
     z_g = jax.lax.all_gather(zero, axis, axis=0, tiled=True)
     n = jax.lax.axis_size(axis)
-    out = dequantize_blockwise(q_g, s_g, z_g, num_bits, group_size,
-                               out_size=x.size * n)
+    # Each shard's segment carries its own group padding at its tail; slice
+    # per segment, not once at the end (segments are x.size rounded up to a
+    # group multiple).
+    out = dequantize_blockwise(q_g, s_g, z_g, num_bits, group_size)
+    padded = -(-x.size // group_size) * group_size
+    out = out.reshape(n, padded)[:, :x.size]
     return out.reshape((x.shape[0] * n,) + x.shape[1:]).astype(x.dtype)
 
 
@@ -108,11 +112,20 @@ def quantized_reduce_scatter(x: jax.Array, axis: str = "data", num_bits: int = 8
     quantization error exactly like the reference."""
     n = jax.lax.axis_size(axis)
     assert x.shape[0] % n == 0
-    q, scale, zero = quantize_blockwise(x, num_bits, group_size)
+    # Quantize each destination chunk separately so the all-to-all splits on
+    # exact chunk boundaries even when chunk size is not a group multiple
+    # (padding lives at each chunk's tail; zeros quantize exactly under
+    # symmetric quant, so summed padding stays zero).
+    chunk = x.size // n
+    xr = x.astype(jnp.float32).reshape(n, chunk)
+    pad = (-chunk) % group_size
+    if pad:
+        xr = jnp.pad(xr, ((0, 0), (0, pad)))
+    q, scale, zero = quantize_blockwise(xr, num_bits, group_size)
     q_t = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
     s_t = jax.lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)
     z_t = jax.lax.all_to_all(zero, axis, split_axis=0, concat_axis=0, tiled=True)
-    shard = dequantize_blockwise(q_t, s_t, z_t, num_bits, group_size,
-                                 out_size=x.size)
-    shard = shard.reshape((n, x.shape[0] // n) + x.shape[1:])
-    return jnp.sum(shard, axis=0).astype(x.dtype)
+    shard = dequantize_blockwise(q_t, s_t, z_t, num_bits, group_size)
+    shard = shard.reshape(n, chunk + pad)[:, :chunk]
+    out = jnp.sum(shard, axis=0)
+    return out.reshape((x.shape[0] // n,) + x.shape[1:]).astype(x.dtype)

@@ -122,6 +122,9 @@ class DeepSpeedEngine:
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(config.monitor_config)
 
+        from ..profiling.flops_profiler.profiler import FlopsProfiler
+        self.flops_profiler = FlopsProfiler(model=model, ds_engine=self)
+
         from .. import comm as dist
         if config.comms_logger_enabled:
             dist.configure(config=config)
@@ -326,6 +329,10 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter_or_batch) -> jax.Array:
         """One full optimizer step: gas micro-steps + apply (the
         PipelineEngine-style entry, pipe/engine.py:321)."""
+        fp_cfg = self.config.flops_profiler_config
+        profiling = fp_cfg.enabled and self.global_steps == fp_cfg.profile_step
+        if profiling:
+            self.flops_profiler.start_profile()
         self.tput_timer.start()
         if isinstance(data_iter_or_batch, dict):
             batches = [data_iter_or_batch] * self.gradient_accumulation_steps
@@ -337,7 +344,28 @@ class DeepSpeedEngine:
             self.backward()
         self.step()
         self.tput_timer.stop(global_step=True)
+        if profiling:
+            self.flops_profiler.stop_profile()
+            self.flops_profiler.set_flops(
+                self._micro_step_flops(batches[0]) * len(batches))
+            self.flops_profiler.print_model_profile(
+                profile_step=fp_cfg.profile_step, output_file=fp_cfg.output_file)
+            self.flops_profiler.end_profile()
         return jnp.mean(jnp.stack(losses))
+
+    def _micro_step_flops(self, batch) -> float:
+        """XLA's exact cost analysis of the compiled micro-step (the
+        hook-based estimate of the reference's profiler.py:228)."""
+        try:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                (self.state, self._device_batch(batch)))
+            cost = self._jit_micro_step.lower(*abstract).compile().cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0] if cost else {}
+            return float(cost.get("flops", 0.0))
+        except Exception:
+            return 0.0
 
     def eval_batch(self, batch: Dict[str, Any]) -> jax.Array:
         if getattr(self, "_jit_eval", None) is None:

@@ -23,9 +23,22 @@ GroupHandle = Union[str, Tuple[str, ...]]
 def initialize(ep_size: int = 1, mpu=None, sp_size: int = 1, tp_size: int = 1,
                pp_size: int = 1) -> MeshTopology:
     """Create the global topology (reference groups.py:51 creates EP groups
-    carved out of DP; here the degrees define the mesh)."""
-    return topo.initialize(TopologyConfig(pipe=pp_size, expert=ep_size,
-                                          seq=sp_size, model=tp_size, data=-1))
+    carved out of DP; here the degrees define the mesh).
+
+    Re-initializes the global topology if one exists with different degrees —
+    silently returning a mismatched cached mesh would drop the requested
+    parallelism.
+    """
+    requested = TopologyConfig(pipe=pp_size, expert=ep_size,
+                               seq=sp_size, model=tp_size, data=-1)
+    if topo.is_initialized():
+        cur = topo.get_topology()
+        if (cur.pipe_parallel_size, cur.expert_parallel_size,
+                cur.sequence_parallel_size, cur.model_parallel_size) != (
+                    pp_size, ep_size, sp_size, tp_size):
+            return topo.initialize(requested, force=True)
+        return cur
+    return topo.initialize(requested)
 
 
 def _ensure():
@@ -88,19 +101,23 @@ def get_expert_model_parallel_world_size() -> int:
     return _ensure().model_parallel_size
 
 
-# -- ranks (meaningful inside shard_map; host-level returns process index) ---
+# -- ranks -------------------------------------------------------------------
+# Inside shard_map these return a *traced* scalar (per-device axis index —
+# converting to a Python int there is impossible by construction); at host
+# level they return a concrete process-level int.
 
-def get_data_parallel_rank() -> int:
+def _axis_rank(axis: str, host_default: int):
     import jax
     try:
-        return int(jax.lax.axis_index(DATA_AXIS))
-    except Exception:
-        return jax.process_index()
+        return jax.lax.axis_index(axis)  # traced value inside shard_map
+    except Exception:  # not under a mesh binding -> host context
+        return host_default
 
 
-def get_model_parallel_rank() -> int:
+def get_data_parallel_rank():
     import jax
-    try:
-        return int(jax.lax.axis_index(MODEL_AXIS))
-    except Exception:
-        return 0
+    return _axis_rank(DATA_AXIS, jax.process_index())
+
+
+def get_model_parallel_rank():
+    return _axis_rank(MODEL_AXIS, 0)

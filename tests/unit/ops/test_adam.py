@@ -11,9 +11,14 @@ from deepspeed_tpu.ops.adam import fused_adam_reference, fused_adam_update
 INTERPRET = jax.default_backend() == "cpu"
 
 
-@pytest.mark.parametrize("n", [128, 1024, 1000])  # 1000: padding path
+@pytest.mark.parametrize("n,block_size", [
+    (128, None),      # single partial block
+    (1024, None),     # whole block
+    (1000, 256),      # multi-block with tail padding (exercises pad + slice-back)
+    (512, 256),       # multi-block, exact fit
+])
 @pytest.mark.parametrize("adamw", [True, False])
-def test_fused_adam_matches_reference(n, adamw):
+def test_fused_adam_matches_reference(n, block_size, adamw):
     rng = np.random.default_rng(0)
     g = jnp.asarray(rng.normal(size=n), jnp.float32)
     p = jnp.asarray(rng.normal(size=n), jnp.float32)
@@ -21,7 +26,8 @@ def test_fused_adam_matches_reference(n, adamw):
     v = jnp.asarray(np.abs(rng.normal(size=n)) * 0.01, jnp.float32)
     step = jnp.asarray(3, jnp.int32)
     kw = dict(lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, weight_decay=0.01, adamw=adamw)
-    p1, m1, v1 = fused_adam_update(g, p, m, v, step, interpret=INTERPRET, **kw)
+    bs = {} if block_size is None else {"block_size": block_size}
+    p1, m1, v1 = fused_adam_update(g, p, m, v, step, interpret=INTERPRET, **kw, **bs)
     p2, m2, v2 = fused_adam_reference(g, p, m, v, step, **kw)
     np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-6, atol=1e-6)
     np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), rtol=1e-6, atol=1e-6)

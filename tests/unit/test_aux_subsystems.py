@@ -161,3 +161,23 @@ def test_get_model_profile_counts_matmul_flops():
     prof = get_model_profile(f, a, b)
     # 2*M*N*K = 2*128*256*64
     assert prof["flops"] == pytest.approx(2 * 128 * 256 * 64, rel=0.01)
+
+
+class TestFlopsProfilerWiring:
+    def test_engine_profiles_at_step(self, tmp_path):
+        import numpy as np
+        import deepspeed_tpu
+        from deepspeed_tpu.models.gpt2 import gpt2_model
+        out = str(tmp_path / "flops.txt")
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "flops_profiler": {"enabled": True, "profile_step": 0,
+                               "output_file": out},
+        })
+        b = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+        eng.train_batch(b)
+        assert eng.flops_profiler.flops > 0
+        with open(out) as f:
+            assert "flops profiler @ step 0" in f.read()

@@ -1,0 +1,117 @@
+"""Host (CPU) Adam for offloaded optimizer state.
+
+Counterpart of the reference ``ops/adam/cpu_adam.py`` (``DeepSpeedCPUAdam``)
+over the C++ kernel in ``csrc/optimizers/cpu_optimizers.cpp`` (reference
+``csrc/adam/cpu_adam_impl.cpp`` AVX path). Operates in place on flat numpy
+fp32 buffers — the ZeRO-Offload layout where the host owns the master
+params + moments and the TPU only sees bf16 params. Falls back to a numpy
+implementation when no C++ toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder.all_ops import CPUAdamBuilder
+
+
+def _fp(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class DeepSpeedCPUAdam:
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
+                 weight_decay: float = 0.0, adamw_mode: bool = True,
+                 fp32_optimizer_states: bool = True):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self._lib = CPUAdamBuilder().load()
+        self.step_count = 0
+
+    @property
+    def using_native(self) -> bool:
+        return self._lib is not None
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+             exp_avg_sq: np.ndarray, step: Optional[int] = None,
+             lr: Optional[float] = None) -> None:
+        """One in-place Adam step on flat contiguous fp32 arrays."""
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        lr = self.lr if lr is None else lr
+        for a in (params, grads, exp_avg, exp_avg_sq):
+            assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"], \
+                "cpu_adam needs contiguous fp32 buffers"
+        if self._lib is not None:
+            self._lib.ds_cpu_adam_step(
+                _fp(params), _fp(exp_avg), _fp(exp_avg_sq), _fp(grads),
+                params.size, lr, self.beta1, self.beta2, self.eps,
+                self.weight_decay, step, int(self.adamw_mode))
+            return
+        # numpy fallback (same math as the kernel)
+        g = grads if self.adamw_mode else grads + self.weight_decay * params
+        exp_avg *= self.beta1
+        exp_avg += (1 - self.beta1) * g
+        exp_avg_sq *= self.beta2
+        exp_avg_sq += (1 - self.beta2) * g * g
+        bc1 = 1.0 / (1.0 - self.beta1 ** step)
+        bc2 = 1.0 / (1.0 - self.beta2 ** step)
+        update = (exp_avg * bc1) / (np.sqrt(exp_avg_sq * bc2) + self.eps)
+        if self.adamw_mode:
+            update = update + self.weight_decay * params
+        params -= lr * update
+
+
+class DeepSpeedCPULion:
+    """Reference ``ops/lion/cpu_lion.py`` over csrc lion kernel."""
+
+    def __init__(self, lr: float = 1e-4, betas=(0.9, 0.99),
+                 weight_decay: float = 0.0):
+        self.lr = lr
+        self.beta1, self.beta2 = betas
+        self.weight_decay = weight_decay
+        self._lib = CPUAdamBuilder().load()
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        if self._lib is not None:
+            self._lib.ds_cpu_lion_step(_fp(params), _fp(exp_avg), _fp(grads),
+                                       params.size, lr, self.beta1, self.beta2,
+                                       self.weight_decay)
+            return
+        c = self.beta1 * exp_avg + (1 - self.beta1) * grads
+        params -= lr * (np.sign(c) + self.weight_decay * params)
+        exp_avg *= self.beta2
+        exp_avg += (1 - self.beta2) * grads
+
+
+class DeepSpeedCPUAdagrad:
+    """Reference ``ops/adagrad/cpu_adagrad.py``."""
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
+                 weight_decay: float = 0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self._lib = CPUAdamBuilder().load()
+
+    def step(self, params: np.ndarray, grads: np.ndarray, sq_sum: np.ndarray,
+             lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        if self._lib is not None:
+            self._lib.ds_cpu_adagrad_step(_fp(params), _fp(sq_sum), _fp(grads),
+                                          params.size, lr, self.eps,
+                                          self.weight_decay)
+            return
+        g = grads + self.weight_decay * params
+        sq_sum += g * g
+        params -= lr * g / (np.sqrt(sq_sum) + self.eps)

@@ -1,0 +1,114 @@
+"""Python wrapper over the native AIO engine.
+
+Counterpart of the reference ``aio_handle``
+(``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp``): async pread/pwrite of
+tensors to files with explicit synchronize, the primitive under ZeRO-Infinity
+NVMe swapping (``runtime/swap_tensor``). Buffers are numpy arrays (host
+memory — the TPU equivalent of the reference's pinned CPU tensors); a pure-
+Python thread-pool fallback keeps the API available without a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import List, Optional
+
+import numpy as np
+
+from ..op_builder.all_ops import AsyncIOBuilder
+
+
+def aio_available() -> bool:
+    return AsyncIOBuilder().load() is not None
+
+
+class AsyncIOHandle:
+    """API mirror of the reference aio_handle: async_pread/async_pwrite
+    accumulate in-flight ops; wait() blocks for all and returns the count."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 num_threads: int = 2):
+        self.block_size = block_size
+        self._lib = AsyncIOBuilder().load()
+        self._handle = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._futures: List[Future] = []
+        if self._lib is not None:
+            self._handle = self._lib.aio_create(block_size, queue_depth, num_threads)
+        else:  # pure-python fallback
+            self._pool = ThreadPoolExecutor(max_workers=num_threads)
+
+    # -- helpers -------------------------------------------------------------
+    @staticmethod
+    def _buf(arr: np.ndarray):
+        assert arr.flags["C_CONTIGUOUS"], "aio buffers must be contiguous"
+        return arr.ctypes.data_as(ctypes.c_char_p)
+
+    # -- async ops -----------------------------------------------------------
+    def async_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> None:
+        if self._handle is not None:
+            self._lib.aio_pwrite(self._handle, self._buf(buffer),
+                                 path.encode(), buffer.nbytes, file_offset)
+        else:
+            def write(b=buffer, p=path, off=file_offset):
+                with open(p, "r+b" if os.path.exists(p) else "wb") as f:
+                    f.seek(off)
+                    f.write(b.tobytes())
+            self._futures.append(self._pool.submit(write))
+
+    def async_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> None:
+        if self._handle is not None:
+            self._lib.aio_pread(self._handle, self._buf(buffer),
+                                path.encode(), buffer.nbytes, file_offset)
+        else:
+            def read(b=buffer, p=path, off=file_offset):
+                with open(p, "rb") as f:
+                    f.seek(off)
+                    data = f.read(b.nbytes)
+                b[...] = np.frombuffer(data, dtype=b.dtype).reshape(b.shape)
+            self._futures.append(self._pool.submit(read))
+
+    def wait(self) -> int:
+        """Block until all in-flight ops complete; returns completed count.
+        Raises OSError on any IO failure (reference: negative return)."""
+        if self._handle is not None:
+            rc = self._lib.aio_wait(self._handle)
+            if rc < 0:
+                raise OSError(-rc, os.strerror(-rc))
+            return int(rc)
+        n = 0
+        for f in self._futures:
+            f.result()  # propagate exceptions
+            n += 1
+        self._futures.clear()
+        return n
+
+    def pending(self) -> int:
+        if self._handle is not None:
+            return int(self._lib.aio_pending(self._handle))
+        return sum(0 if f.done() else 1 for f in self._futures)
+
+    # -- sync ops ------------------------------------------------------------
+    def sync_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> None:
+        self.async_pwrite(buffer, path, file_offset)
+        self.wait()
+
+    def sync_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> None:
+        self.async_pread(buffer, path, file_offset)
+        self.wait()
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.aio_destroy(self._handle)
+            self._handle = None
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass

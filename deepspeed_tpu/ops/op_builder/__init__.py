@@ -1,0 +1,2 @@
+from .builder import OpBuilder, get_default_compute_capabilities  # noqa: F401
+from .all_ops import ALL_OPS, AsyncIOBuilder, CPUAdagradBuilder, CPUAdamBuilder, CPULionBuilder  # noqa: F401

@@ -1,0 +1,68 @@
+"""Registry of native op builders (reference ``op_builder/all_ops.py:33``
+``ALL_OPS``)."""
+
+from __future__ import annotations
+
+import ctypes
+
+from .builder import OpBuilder
+
+c_i64 = ctypes.c_int64
+c_f32 = ctypes.c_float
+c_fp = ctypes.POINTER(ctypes.c_float)
+
+
+class AsyncIOBuilder(OpBuilder):
+    """Reference ``op_builder/async_io.py`` — csrc/aio."""
+    NAME = "dstpu_aio"
+    SOURCES = ["aio/dstpu_aio.cpp"]
+    EXTRA_FLAGS = ["-pthread"]
+
+    def _bind(self, lib):
+        lib.aio_create.argtypes = [c_i64, ctypes.c_int, ctypes.c_int]
+        lib.aio_create.restype = ctypes.c_void_p
+        lib.aio_destroy.argtypes = [ctypes.c_void_p]
+        for fn in (lib.aio_pread, lib.aio_pwrite):
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                           c_i64, c_i64]
+            fn.restype = c_i64
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+        lib.aio_wait.restype = c_i64
+        lib.aio_pending.argtypes = [ctypes.c_void_p]
+        lib.aio_pending.restype = c_i64
+        for fn in (lib.aio_read_sync, lib.aio_write_sync):
+            fn.argtypes = [ctypes.c_char_p, ctypes.c_char_p, c_i64, c_i64, c_i64]
+            fn.restype = ctypes.c_int
+
+
+class CPUAdamBuilder(OpBuilder):
+    """Reference ``op_builder/cpu_adam.py`` — csrc/adam/cpu_adam.cpp."""
+    NAME = "dstpu_cpu_optimizers"
+    SOURCES = ["optimizers/cpu_optimizers.cpp"]
+    EXTRA_FLAGS = ["-fopenmp-simd", "-ffast-math"]
+
+    def _bind(self, lib):
+        lib.ds_cpu_adam_step.argtypes = [c_fp, c_fp, c_fp, c_fp, c_i64, c_f32,
+                                         c_f32, c_f32, c_f32, c_f32, c_i64,
+                                         ctypes.c_int]
+        lib.ds_cpu_lion_step.argtypes = [c_fp, c_fp, c_fp, c_i64, c_f32, c_f32,
+                                         c_f32, c_f32]
+        lib.ds_cpu_adagrad_step.argtypes = [c_fp, c_fp, c_fp, c_i64, c_f32,
+                                            c_f32, c_f32]
+
+
+class CPULionBuilder(CPUAdamBuilder):
+    """Same shared library; separate name for registry parity
+    (reference ``op_builder/cpu_lion.py``)."""
+
+
+class CPUAdagradBuilder(CPUAdamBuilder):
+    """Reference ``op_builder/cpu_adagrad.py``."""
+
+
+ALL_OPS = {
+    "async_io": AsyncIOBuilder,
+    "cpu_adam": CPUAdamBuilder,
+    "cpu_lion": CPULionBuilder,
+    "cpu_adagrad": CPUAdagradBuilder,
+}

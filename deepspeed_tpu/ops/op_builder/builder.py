@@ -1,0 +1,110 @@
+"""Native-op JIT build + load layer.
+
+Counterpart of the reference ``op_builder/builder.py`` (``OpBuilder.load``
+:462,480 — JIT-compile native sources on first use via
+``torch.utils.cpp_extension.load``, else use prebuilt). Torch-free TPU
+version: sources under ``csrc/`` compile with g++ into a shared library in a
+per-machine cache dir, loaded via ctypes. Python wrappers keep numpy
+fallbacks so every op degrades gracefully when no toolchain exists
+(reference ``is_compatible`` checks, ``op_builder/no_impl.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from pathlib import Path
+from typing import List, Optional
+
+from ...utils.logging import logger
+
+
+def get_default_compute_capabilities() -> str:
+    """Reference API parity; meaningless on TPU (no CUDA arch list)."""
+    return ""
+
+
+def _csrc_root() -> Path:
+    # repo layout: <root>/csrc next to the deepspeed_tpu package
+    return Path(__file__).resolve().parents[3] / "csrc"
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("DSTPU_OP_CACHE",
+                          os.path.join(os.path.expanduser("~"), ".cache",
+                                       "deepspeed_tpu", "ops"))
+    p = Path(base)
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+class OpBuilder:
+    NAME = "op"
+    SOURCES: List[str] = []        # relative to csrc/
+    EXTRA_FLAGS: List[str] = []
+
+    _loaded: Optional[ctypes.CDLL] = None
+    _load_failed = False
+
+    def sources(self) -> List[Path]:
+        return [_csrc_root() / s for s in self.SOURCES]
+
+    def is_compatible(self) -> bool:
+        return shutil.which("g++") is not None and all(
+            s.exists() for s in self.sources())
+
+    def _lib_path(self) -> Path:
+        h = hashlib.sha256()
+        for s in self.sources():
+            h.update(s.read_bytes())
+        h.update(" ".join(self.EXTRA_FLAGS).encode())
+        return _cache_dir() / f"lib{self.NAME}_{h.hexdigest()[:12]}.so"
+
+    def build(self) -> Path:
+        lib = self._lib_path()
+        if lib.exists():
+            return lib
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-march=native",
+               "-o", str(lib)] + [str(s) for s in self.sources()] + self.EXTRA_FLAGS
+        logger.info(f"building native op '{self.NAME}': {' '.join(cmd)}")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:  # pragma: no cover
+            # -march=native can fail on exotic hosts; retry portable
+            cmd.remove("-march=native")
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError as e2:
+                raise RuntimeError(
+                    f"native build of {self.NAME} failed:\n{e.stderr}\n{e2.stderr}")
+        return lib
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        """JIT-or-cached load (reference builder.py:462). Returns None when
+        the op can't be built (callers fall back to pure numpy/jnp)."""
+        cls = type(self)
+        if cls._loaded is not None:
+            return cls._loaded
+        if cls._load_failed:
+            return None
+        if not self.is_compatible():
+            cls._load_failed = True
+            logger.warning(f"native op '{self.NAME}' unavailable (no toolchain "
+                           f"or sources); using fallback")
+            return None
+        try:
+            lib = ctypes.CDLL(str(self.build()))
+            self._bind(lib)
+            cls._loaded = lib
+            return lib
+        except Exception as e:  # pragma: no cover
+            cls._load_failed = True
+            logger.warning(f"native op '{self.NAME}' failed to load ({e}); "
+                           f"using fallback")
+            return None
+
+    def _bind(self, lib: ctypes.CDLL) -> None:
+        """Declare argtypes/restypes; subclasses override."""

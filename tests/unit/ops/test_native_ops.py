@@ -1,0 +1,132 @@
+"""Native C++ op tests: build, numeric parity, AIO roundtrip (reference
+tests/unit/ops/{adam/test_cpu_adam.py, aio/test_aio.py})."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.adam.cpu_adam import (DeepSpeedCPUAdam, DeepSpeedCPUAdagrad,
+                                             DeepSpeedCPULion)
+from deepspeed_tpu.ops.aio import AsyncIOHandle, aio_available
+from deepspeed_tpu.ops.op_builder import ALL_OPS, AsyncIOBuilder, CPUAdamBuilder
+
+
+def test_builders_compile():
+    """The toolchain is baked into the image; native ops must really build."""
+    assert CPUAdamBuilder().load() is not None
+    assert AsyncIOBuilder().load() is not None
+    assert set(ALL_OPS) >= {"async_io", "cpu_adam", "cpu_lion", "cpu_adagrad"}
+
+
+def _numpy_adam(p, g, m, v, step, lr, b1, b2, eps, wd, adamw):
+    g = g if adamw else g + wd * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    upd = (m / (1 - b1 ** step)) / (np.sqrt(v / (1 - b2 ** step)) + eps)
+    if adamw:
+        upd = upd + wd * p
+    return p - lr * upd, m, v
+
+
+@pytest.mark.parametrize("adamw", [True, False])
+@pytest.mark.parametrize("n", [1, 255, 4096])
+def test_cpu_adam_parity(adamw, n):
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    m = (rng.normal(size=n) * 0.1).astype(np.float32)
+    v = np.abs(rng.normal(size=n)).astype(np.float32) * 0.01
+    kw = dict(lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, wd=0.01)
+    ref_p, ref_m, ref_v = _numpy_adam(p.copy(), g, m.copy(), v.copy(), 3,
+                                      kw["lr"], kw["b1"], kw["b2"], kw["eps"],
+                                      kw["wd"], adamw)
+    opt = DeepSpeedCPUAdam(lr=kw["lr"], betas=(kw["b1"], kw["b2"]), eps=kw["eps"],
+                           weight_decay=kw["wd"], adamw_mode=adamw)
+    assert opt.using_native
+    opt.step(p, g, m, v, step=3)
+    np.testing.assert_allclose(p, ref_p, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(m, ref_m, rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(v, ref_v, rtol=2e-5, atol=2e-6)
+
+
+def test_cpu_lion_parity():
+    rng = np.random.default_rng(1)
+    n = 1000
+    p = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    m = (rng.normal(size=n) * 0.1).astype(np.float32)
+    p0, m0 = p.copy(), m.copy()
+    c = 0.9 * m0 + 0.1 * g
+    ref_p = p0 - 1e-3 * (np.sign(c) + 0.01 * p0)
+    ref_m = 0.99 * m0 + 0.01 * g
+    opt = DeepSpeedCPULion(lr=1e-3, betas=(0.9, 0.99), weight_decay=0.01)
+    opt.step(p, g, m)
+    np.testing.assert_allclose(p, ref_p, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m, ref_m, rtol=1e-5, atol=1e-6)
+
+
+def test_cpu_adagrad_parity():
+    rng = np.random.default_rng(2)
+    n = 777
+    p = rng.normal(size=n).astype(np.float32)
+    g = rng.normal(size=n).astype(np.float32)
+    h = np.abs(rng.normal(size=n)).astype(np.float32)
+    p0, h0 = p.copy(), h.copy()
+    gg = g + 0.0 * p0
+    ref_h = h0 + gg * gg
+    ref_p = p0 - 1e-2 * gg / (np.sqrt(ref_h) + 1e-10)
+    opt = DeepSpeedCPUAdagrad(lr=1e-2)
+    opt.step(p, g, h)
+    np.testing.assert_allclose(p, ref_p, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h, ref_h, rtol=1e-5, atol=1e-6)
+
+
+class TestAIO:
+
+    def test_native_available(self):
+        assert aio_available()
+
+    def test_sync_roundtrip(self, tmp_path):
+        h = AsyncIOHandle(block_size=1 << 12)
+        data = np.random.default_rng(0).normal(size=100_000).astype(np.float32)
+        path = str(tmp_path / "swap.bin")
+        h.sync_pwrite(data, path)
+        out = np.empty_like(data)
+        h.sync_pread(out, path)
+        np.testing.assert_array_equal(out, data)
+        h.close()
+
+    def test_async_overlap_many_ops(self, tmp_path):
+        h = AsyncIOHandle(block_size=1 << 10, num_threads=4)
+        rng = np.random.default_rng(1)
+        bufs = [rng.normal(size=10_000).astype(np.float32) for _ in range(8)]
+        paths = [str(tmp_path / f"t{i}.bin") for i in range(8)]
+        for b, p in zip(bufs, paths):
+            h.async_pwrite(b, p)
+        assert h.wait() == 8
+        outs = [np.empty_like(b) for b in bufs]
+        for o, p in zip(outs, paths):
+            h.async_pread(o, p)
+        assert h.wait() == 8
+        for o, b in zip(outs, bufs):
+            np.testing.assert_array_equal(o, b)
+        h.close()
+
+    def test_offset_io(self, tmp_path):
+        h = AsyncIOHandle()
+        path = str(tmp_path / "off.bin")
+        a = np.arange(256, dtype=np.float32)
+        b = np.arange(256, 512, dtype=np.float32)
+        h.sync_pwrite(a, path, file_offset=0)
+        h.sync_pwrite(b, path, file_offset=a.nbytes)
+        out = np.empty(512, np.float32)
+        h.sync_pread(out, path)
+        np.testing.assert_array_equal(out, np.arange(512, dtype=np.float32))
+        h.close()
+
+    def test_read_missing_file_raises(self, tmp_path):
+        h = AsyncIOHandle()
+        buf = np.empty(16, np.float32)
+        h.async_pread(buf, str(tmp_path / "nope.bin"))
+        with pytest.raises(OSError):
+            h.wait()
+        h.close()

@@ -88,6 +88,13 @@ class DeepSpeedEngine:
         self.optimizer: Optimizer = build_optimizer(config.optimizer)
         self.lr_scheduler = build_lr_schedule(config.scheduler, self.optimizer.lr)
 
+        # -- ZeRO-Offload / Infinity (reference engine.py:1219: offload mode
+        #    selects the CPU optimizer; stage3 nvme pages moments) -----------
+        oc = config.zero_config.offload_optimizer
+        self._offload_device = (str(getattr(oc.device, "value", oc.device))
+                                if oc is not None else "none")
+        self._offload = None  # created after state init (needs master leaves)
+
         # -- ZeRO plan -------------------------------------------------------
         param_specs = model.specs()
         shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), self.param_dtype))
@@ -151,12 +158,15 @@ class DeepSpeedEngine:
                                           is_leaf=lambda s: isinstance(s, P))
         opt_named = named(opt_spec)
         rep = NamedSharding(mesh, P())
-        opt_template = jax.eval_shape(
-            lambda: self.optimizer.init(jax.tree.map(jnp.zeros_like, jax.eval_shape(
-                lambda: self.model.init(jax.random.PRNGKey(0), self.param_dtype)))))
-        opt_shardings = {}
-        for key in opt_template:
-            opt_shardings[key] = rep if key == "step" else opt_named
+        if self._offload_device != "none":
+            opt_shardings = {}
+        else:
+            opt_template = jax.eval_shape(
+                lambda: self.optimizer.init(jax.tree.map(jnp.zeros_like, jax.eval_shape(
+                    lambda: self.model.init(jax.random.PRNGKey(0), self.param_dtype)))))
+            opt_shardings = {}
+            for key in opt_template:
+                opt_shardings[key] = rep if key == "step" else opt_named
         return {
             "params": self._param_shardings,
             "grad_acc": self._grad_shardings,
@@ -167,12 +177,14 @@ class DeepSpeedEngine:
     def _init_state(self, seed: int, init_params: Optional[Any]) -> Dict[str, Any]:
         shardings = self._state_shardings()
 
+        offload = self._offload_device != "none"
+
         def make_state(rng):
             params = self.model.init(rng, self.param_dtype)
             return {
                 "params": params,
                 "grad_acc": jax.tree.map(lambda p: jnp.zeros(p.shape, self.grad_dtype), params),
-                "opt": self.optimizer.init(params),
+                "opt": {} if offload else self.optimizer.init(params),
                 "loss_scale": self._loss_scale_state(),
             }
 
@@ -182,12 +194,37 @@ class DeepSpeedEngine:
                 make = lambda p: {
                     "params": p,
                     "grad_acc": jax.tree.map(lambda q: jnp.zeros(q.shape, self.grad_dtype), p),
-                    "opt": self.optimizer.init(p),
+                    "opt": {} if offload else self.optimizer.init(p),
                     "loss_scale": self._loss_scale_state(),
                 }
-                return jax.jit(make, out_shardings=shardings)(params)
-            rng = jax.random.PRNGKey(seed)
-            return jax.jit(make_state, out_shardings=shardings)(rng)
+                state = jax.jit(make, out_shardings=shardings)(params)
+            else:
+                rng = jax.random.PRNGKey(seed)
+                state = jax.jit(make_state, out_shardings=shardings)(rng)
+        if offload:
+            self._init_offload_runner(state)
+        return state
+
+    def _init_offload_runner(self, state) -> None:
+        """Host master copy + CPU/NVMe optimizer (reference offload path)."""
+        from .zero.offload_optimizer import OffloadedOptimizerRunner
+        oc = self.config.zero_config.offload_optimizer
+        host_params = jax.device_get(
+            jax.tree.map(lambda p: p.astype(jnp.float32), state["params"]))
+        leaves, self._offload_treedef = jax.tree.flatten(host_params)
+        self._offload_shapes = [l.shape for l in leaves]
+        opt_cfg = self.config.optimizer
+        self._offload = OffloadedOptimizerRunner(
+            opt_type=opt_cfg.type if opt_cfg is not None else "adamw",
+            opt_params=dict(opt_cfg.params) if opt_cfg is not None else {},
+            leaves=[np.asarray(l).reshape(-1) for l in leaves],
+            device=self._offload_device,
+            nvme_path=oc.nvme_path,
+            pipeline=oc.pipeline_read or oc.pipeline_write)
+        log_dist(f"ZeRO-Offload: optimizer on {self._offload_device} "
+                 f"({len(leaves)} leaves, "
+                 f"{sum(l.size for l in leaves) / 1e6:.1f}M master params)",
+                 ranks=[0])
 
     # ------------------------------------------------------------------
     # jitted step functions
@@ -309,8 +346,11 @@ class DeepSpeedEngine:
         self._build_jits()
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = jnp.asarray(self.lr_scheduler.get_lr(), jnp.float32)
-        with self.mesh:
-            self.state, overflow, gnorm = self._jit_apply_step(self.state, lr)
+        if self._offload is not None:
+            overflow, gnorm = self._apply_step_offload(float(lr))
+        else:
+            with self.mesh:
+                self.state, overflow, gnorm = self._jit_apply_step(self.state, lr)
         self.global_steps += 1
         if self.config.fp16.enabled and bool(overflow):
             # skipped update does not consume schedule (reference engine.py:2053)
@@ -325,6 +365,63 @@ class DeepSpeedEngine:
             self.monitor.write_events([
                 ("Train/lr", self.lr_scheduler.get_lr(), self.global_steps),
             ])
+
+    def _apply_step_offload(self, lr: float):
+        """Optimizer boundary on the host (ZeRO-Offload): pull grads, unscale
+        + clip in numpy, native CPU optimizer step on the master copy,
+        push re-cast params. The TPU is free during the host step — the
+        overlap window the reference fills with the next micro-batch."""
+        grads_host = jax.device_get(self.state["grad_acc"])
+        # np.array: force a writable copy (device_get can return read-only views)
+        leaves = [np.array(l, np.float32).reshape(-1)
+                  for l in jax.tree.leaves(grads_host)]
+        scale = float(jax.device_get(self.state["loss_scale"]["cur_scale"]))
+
+        overflow = False
+        if self.config.fp16.enabled:
+            overflow = not all(np.isfinite(l).all() for l in leaves)
+        gnorm = 0.0
+        if not overflow:
+            inv = 1.0 / scale
+            sq = 0.0
+            for l in leaves:
+                l *= inv
+                sq += float(np.dot(l.astype(np.float64), l.astype(np.float64)))
+            gnorm = float(np.sqrt(sq))
+            if self.gradient_clipping > 0 and gnorm > self.gradient_clipping:
+                factor = self.gradient_clipping / (gnorm + 1e-6)
+                for l in leaves:
+                    l *= factor
+            master = self._offload.step(leaves, lr=lr)
+            host_params = jax.tree.unflatten(
+                self._offload_treedef,
+                [m.reshape(s).astype(self.param_dtype)
+                 for m, s in zip(master, self._offload_shapes)])
+            with self.mesh:
+                self.state["params"] = jax.device_put(host_params, self._param_shardings)
+
+        # zero the accumulator + update loss scale on device
+        if getattr(self, "_jit_offload_epilogue", None) is None:
+            shardings = self._cached_shardings
+            fp16c = self.config.fp16
+
+            def epilogue(grad_acc, scale_state, ovf):
+                new_acc = jax.tree.map(jnp.zeros_like, grad_acc)
+                new_scale = update_scale(scale_state, ovf,
+                                         scale_window=fp16c.loss_scale_window,
+                                         min_scale=fp16c.min_loss_scale,
+                                         hysteresis=fp16c.hysteresis)
+                return new_acc, new_scale
+
+            self._jit_offload_epilogue = jax.jit(
+                epilogue, donate_argnums=(0,),
+                out_shardings=(shardings["grad_acc"], shardings["loss_scale"]))
+        with self.mesh:
+            self.state["grad_acc"], self.state["loss_scale"] = \
+                self._jit_offload_epilogue(self.state["grad_acc"],
+                                           self.state["loss_scale"],
+                                           jnp.asarray(overflow))
+        return overflow, gnorm
 
     def train_batch(self, data_iter_or_batch) -> jax.Array:
         """One full optimizer step: gas micro-steps + apply (the
@@ -421,6 +518,12 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
         _save(save_dir, tag, self.state, client_state, save_latest=save_latest)
+        if self._offload is not None:
+            sd = self._offload.state_dict()
+            np.savez(os.path.join(save_dir, tag, "offload_optimizer.npz"),
+                     step=sd["step"],
+                     **{f"master_{i}": m for i, m in enumerate(sd["master"])},
+                     **{f"state_{i}": s for i, s in enumerate(sd["state"])})
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
@@ -433,6 +536,18 @@ class DeepSpeedEngine:
         if state is None:
             return None, {}
         self.state = state
+        if self._offload is not None and load_optimizer_states:
+            path = os.path.join(load_dir, tag or "", "offload_optimizer.npz")
+            if not os.path.exists(path):  # resolve tag from store result below
+                path = None
+            if path:
+                z = np.load(path)
+                n = len(self._offload.master)
+                self._offload.load_state_dict({
+                    "step": int(z["step"]),
+                    "master": [z[f"master_{i}"] for i in range(n)],
+                    "state": [z[f"state_{i}"] for i in range(n)],
+                })
         self.global_steps = client_state.get("global_steps", 0)
         self.skipped_steps = client_state.get("skipped_steps", 0)
         self.micro_steps = client_state.get("micro_steps", 0)

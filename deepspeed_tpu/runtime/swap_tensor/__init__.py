@@ -1,0 +1,4 @@
+from .async_swapper import AsyncTensorSwapper  # noqa: F401
+from .optimizer_swapper import OptimizerStateSwapper  # noqa: F401
+from .partitioned_param_swapper import AsyncPartitionedParameterSwapper  # noqa: F401
+from .swap_buffer import SwapBufferManager  # noqa: F401

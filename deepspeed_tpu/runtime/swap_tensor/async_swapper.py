@@ -1,0 +1,51 @@
+"""Asynchronous tensor write-behind.
+
+Counterpart of the reference ``swap_tensor/async_swapper.py``
+(``AsyncTensorSwapper`` :19): queue host tensors for file write-out and let
+the AIO threads drain the queue while compute continues; ``wait`` fences
+all pending writes and recycles buffers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+from .swap_buffer import SwapBufferManager
+
+
+class AsyncTensorSwapper:
+
+    def __init__(self, aio_handle: Optional[AsyncIOHandle] = None,
+                 buffer_manager: Optional[SwapBufferManager] = None):
+        self.aio = aio_handle or AsyncIOHandle()
+        self.buffers = buffer_manager
+        self._inflight: List[np.ndarray] = []
+
+    def swap_out(self, tensor: np.ndarray, path: str, copy: bool = True) -> None:
+        """Queue an async write. With ``copy`` (default) the data is staged
+        into a pool buffer so the caller may mutate ``tensor`` immediately —
+        the reference's pinned-buffer staging semantics."""
+        if copy:
+            if self.buffers is not None:
+                buf = self.buffers.allocate(tensor.size)
+                buf[...] = tensor.reshape(-1)
+            else:
+                buf = tensor.reshape(-1).copy()
+            self._inflight.append(buf)
+            self.aio.async_pwrite(buf, path)
+        else:
+            self.aio.async_pwrite(np.ascontiguousarray(tensor).reshape(-1), path)
+
+    def swap_in(self, buffer: np.ndarray, path: str) -> None:
+        self.aio.async_pread(buffer, path)
+
+    def wait(self) -> int:
+        n = self.aio.wait()
+        if self.buffers is not None:
+            for buf in self._inflight:
+                self.buffers.release(buf)
+        self._inflight.clear()
+        return n

@@ -1,0 +1,99 @@
+"""NVMe tiering of optimizer state.
+
+Counterpart of the reference ``swap_tensor/optimizer_utils.py``
+(``OptimizerSwapper`` :113) + ``partitioned_optimizer_swapper.py`` (:29) +
+``pipelined_optimizer_swapper.py`` (:51): optimizer-state tensors live in
+files; the step loop swaps each parameter group's state in before its
+update and writes it back after, with optional pipelining (prefetch the
+next group's read while the current group computes — double-buffered via
+two AIO handles exactly like the reference's read/write handle pair).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+
+
+class OptimizerStateSwapper:
+
+    def __init__(self, swap_dir: str, num_buffers: int = 4,
+                 pipeline: bool = True, block_size: int = 1 << 20):
+        os.makedirs(swap_dir, exist_ok=True)
+        self.swap_dir = swap_dir
+        self.pipeline = pipeline
+        self._read = AsyncIOHandle(block_size=block_size)
+        self._write = AsyncIOHandle(block_size=block_size)
+        self._sizes: Dict[str, Tuple[int, ...]] = {}
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.swap_dir, f"{key}.swp")
+
+    # -- initial population --------------------------------------------------
+    def register(self, key: str, value: np.ndarray) -> None:
+        """Write the initial state for ``key`` to NVMe."""
+        value = np.ascontiguousarray(value, dtype=np.float32)
+        self._sizes[key] = value.shape
+        self._write.async_pwrite(value.reshape(-1), self._path(key))
+        self._write.wait()
+
+    def shape(self, key: str) -> Tuple[int, ...]:
+        return self._sizes[key]
+
+    # -- step-loop API -------------------------------------------------------
+    def start_read(self, key: str, buffer: np.ndarray) -> None:
+        self._read.async_pread(buffer.reshape(-1), self._path(key))
+
+    def finish_read(self) -> None:
+        self._read.wait()
+
+    def start_write(self, key: str, value: np.ndarray) -> None:
+        self._write.async_pwrite(
+            np.ascontiguousarray(value, np.float32).reshape(-1), self._path(key))
+
+    def finish_writes(self) -> None:
+        self._write.wait()
+
+    def swap_groups(self, keys: Sequence[str],
+                    buffers: Sequence[np.ndarray]) -> Iterator[Tuple[str, np.ndarray]]:
+        """Pipelined iteration: yields (key, state_buffer) with the NEXT
+        key's read in flight while the caller updates the current one; the
+        caller's mutation is written back asynchronously on advance.
+
+        Requires len(buffers) >= 2 for double buffering.
+        """
+        if not keys:
+            return
+        nbuf = len(buffers)
+        assert nbuf >= 2 or len(keys) == 1, "pipelined swap needs >= 2 buffers"
+
+        def view(i: int) -> np.ndarray:
+            # exact-size view of the rotating buffer for keys[i]
+            n = int(np.prod(self._sizes[keys[i]]))
+            return buffers[i % nbuf].reshape(-1)[:n]
+
+        # prime first read
+        self.start_read(keys[0], view(0))
+        for i, key in enumerate(keys):
+            self.finish_read()
+            if self.pipeline and i + 1 < len(keys):
+                self.start_read(keys[i + 1], view(i + 1))
+            buf = view(i)
+            yield key, buf
+            # write back (async); fence before this buffer is reused for a read
+            self.start_write(key, buf)
+            if not self.pipeline:
+                self.finish_writes()
+            elif i + 2 < len(keys) and (i + 2) % nbuf == i % nbuf:
+                self.finish_writes()
+            if not self.pipeline and i + 1 < len(keys):
+                self.start_read(keys[i + 1], view(i + 1))
+        self.finish_writes()
+
+    def close(self) -> None:
+        self._read.close()
+        self._write.close()

@@ -1,0 +1,57 @@
+"""Reusable host swap buffers.
+
+Counterpart of the reference ``swap_tensor/utils.py`` (``SwapBufferManager``
+:180): a pool of fixed-size host buffers reused across swap operations so
+NVMe tiering never re-allocates in the steady state. The reference pins
+these for DMA; on a TPU-VM host numpy pages touched once stay resident,
+which is the moral equivalent for pread/pwrite.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class SwapBufferManager:
+
+    def __init__(self, num_elems: int, count: int, dtype=np.float32):
+        self.num_elems = num_elems
+        self.count = count
+        self.dtype = np.dtype(dtype)
+        self._free: List[np.ndarray] = [
+            np.zeros(num_elems, dtype=self.dtype) for _ in range(count)]
+        self._used: Dict[int, np.ndarray] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def allocate(self, num_elems: Optional[int] = None) -> np.ndarray:
+        """Get a buffer view of ``num_elems`` (<= pool buffer size)."""
+        if not self._free:
+            raise RuntimeError("swap buffer pool exhausted; release() first")
+        buf = self._free.pop()
+        self._used[id(buf)] = buf
+        if num_elems is not None:
+            if num_elems > self.num_elems:
+                raise ValueError(f"request {num_elems} > buffer {self.num_elems}")
+            view = buf[:num_elems]
+            self._used[id(view)] = buf
+            return view
+        return buf
+
+    def release(self, buf: np.ndarray) -> None:
+        base = self._used.pop(id(buf), None)
+        if base is None:
+            raise ValueError("buffer not from this pool")
+        # drop any aliases of the same base
+        for k in [k for k, v in self._used.items() if v is base]:
+            del self._used[k]
+        self._free.append(base)
+
+    def release_all(self) -> None:
+        bases = {id(v): v for v in self._used.values()}
+        self._used.clear()
+        self._free.extend(bases.values())

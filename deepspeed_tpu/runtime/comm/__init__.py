@@ -1,0 +1,1 @@
+from .compressed import compressed_allreduce, error_state  # noqa: F401

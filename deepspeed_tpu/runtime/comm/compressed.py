@@ -1,0 +1,85 @@
+"""Error-compensated 1-bit compressed allreduce.
+
+Counterpart of the reference ``runtime/comm/nccl.py``
+(``NcclBackend.compressed_allreduce`` :51; mpi/hccl variants): sign-SGD style
+compression with server/worker error feedback. Communication volume drops
+from 4 bytes/element to ~1 bit/element: each worker sends sign bits plus one
+fp32 scale per chunk, a "server" shard averages and re-compresses, and the
+result is all-gathered.
+
+TPU-native form: a pure function over ``jax.lax`` collectives
+(``all_to_all`` + ``all_gather`` on a named mesh axis) usable inside
+``shard_map`` — the cupy/NCCL packing of the reference becomes int8 sign
+tensors that XLA ships over ICI. Error feedback carries the compression
+residual into the next step, which is what keeps convergence (1-bit Adam
+paper; reference ``adam.py:306`` uses exactly this primitive).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def error_state(numel: int, axis_size: int) -> Tuple[jax.Array, jax.Array]:
+    """Zero-initialized (worker_error, server_error) for a flat tensor of
+    ``numel`` elements reduced over ``axis_size`` workers."""
+    padded = -(-numel // axis_size) * axis_size
+    return (jnp.zeros((padded,), jnp.float32),
+            jnp.zeros((padded // axis_size,), jnp.float32))
+
+
+def compressed_allreduce(x: jax.Array,
+                         worker_error: jax.Array,
+                         server_error: jax.Array,
+                         axis: str) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Approximate mean-allreduce of ``x`` over mesh ``axis``.
+
+    Call inside shard_map. Returns (result, new_worker_error,
+    new_server_error); result has x's shape/dtype.
+
+    Stage 1 (worker): compensate with carried error, compress to
+    sign*scale, remember the residual. Stage 2 (server): each rank owns one
+    chunk, averages the workers' compressed chunks, re-compresses with its
+    own error feedback, and all-gathers the result — two rounds of
+    ~1-bit-per-element traffic exactly like the reference's
+    all_to_all + allgather pipeline (nccl.py:51-130).
+    """
+    n = jax.lax.axis_size(axis)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.astype(jnp.float32).reshape(-1)
+    numel = flat.size
+    padded = worker_error.size
+    if padded != -(-numel // n) * n:
+        raise ValueError(f"worker_error size {padded} does not match tensor "
+                         f"{numel} over {n} workers")
+    flat = jnp.pad(flat, (0, padded - numel))
+
+    # -- worker compression --------------------------------------------------
+    compensated = flat + worker_error
+    scale = jnp.mean(jnp.abs(compensated))          # l1-preserving sign scale
+    signs = jnp.where(compensated >= 0, 1.0, -1.0)
+    new_worker_error = compensated - scale * signs
+
+    # ship: [n, chunk] int8 signs + my scale
+    chunk = padded // n
+    sign_chunks = signs.reshape(n, chunk).astype(jnp.int8)
+    recv_signs = jax.lax.all_to_all(sign_chunks, axis, split_axis=0,
+                                    concat_axis=0, tiled=True)      # [n, chunk]
+    scales = jax.lax.all_gather(scale, axis)                        # [n]
+
+    # -- server average + re-compression ------------------------------------
+    server_avg = jnp.mean(scales[:, None] * recv_signs.astype(jnp.float32), axis=0)
+    compensated_s = server_avg + server_error
+    scale_s = jnp.mean(jnp.abs(compensated_s))
+    signs_s = jnp.where(compensated_s >= 0, 1.0, -1.0)
+    new_server_error = compensated_s - scale_s * signs_s
+
+    out_signs = jax.lax.all_gather(signs_s.astype(jnp.int8), axis,
+                                   axis=0, tiled=True)              # [padded]
+    out_scales = jax.lax.all_gather(scale_s, axis)                  # [n]
+    out = (jnp.repeat(out_scales, chunk) * out_signs.astype(jnp.float32))
+    return (out[:numel].reshape(orig_shape).astype(orig_dtype),
+            new_worker_error, new_server_error)

@@ -95,6 +95,17 @@ class DeepSpeedEngine:
                                 if oc is not None else "none")
         self._offload = None  # created after state init (needs master leaves)
 
+        # -- 1-bit optimizers (reference runtime/fp16/onebit): explicit
+        #    shard_map DP step so gradients stay local for compression -------
+        self._onebit_opt = None
+        if self.optimizer.name in ("onebit_adam", "onebit_lamb", "zero_one_adam"):
+            t = self.topology
+            if (t.model_parallel_size * t.sequence_parallel_size
+                    * t.pipe_parallel_size * t.expert_parallel_size) != 1:
+                raise ValueError("1-bit optimizers support pure data parallelism "
+                                 "(the reference's supported regime)")
+            self._onebit_opt = self._build_onebit_optimizer(config)
+
         # -- ZeRO plan -------------------------------------------------------
         param_specs = model.specs()
         shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), self.param_dtype))
@@ -141,6 +152,31 @@ class DeepSpeedEngine:
         self._jit_train_step = None
 
     # ------------------------------------------------------------------
+    # 1-bit optimizer construction
+    # ------------------------------------------------------------------
+    def _build_onebit_optimizer(self, config):
+        from .fp16.onebit import OnebitAdam, OnebitLamb, ZeroOneAdam
+        from .topology import DATA_AXIS as AX
+        p = dict(config.optimizer.params) if config.optimizer is not None else {}
+        dp = self.topology.data_parallel_size
+        common = dict(lr=p.get("lr", 1e-3),
+                      betas=tuple(p.get("betas", (0.9, 0.999))),
+                      eps=p.get("eps", 1e-8),
+                      weight_decay=p.get("weight_decay", 0.0),
+                      axis=AX, axis_size=dp)
+        name = self.optimizer.name
+        if name == "onebit_adam":
+            return OnebitAdam(freeze_step=p.get("freeze_step", 100), **common)
+        if name == "onebit_lamb":
+            return OnebitLamb(freeze_step=p.get("freeze_step", 100),
+                              max_coeff=p.get("max_coeff", 10.0),
+                              min_coeff=p.get("min_coeff", 0.01), **common)
+        return ZeroOneAdam(
+            var_freeze_step=p.get("var_freeze_step", 100),
+            var_update_scaler=p.get("var_update_scaler", 16),
+            local_step_scaler=p.get("local_step_scaler", 4), **common)
+
+    # ------------------------------------------------------------------
     # state construction
     # ------------------------------------------------------------------
     def _loss_scale_state(self):
@@ -158,6 +194,8 @@ class DeepSpeedEngine:
                                           is_leaf=lambda s: isinstance(s, P))
         opt_named = named(opt_spec)
         rep = NamedSharding(mesh, P())
+        if self._onebit_opt is not None:
+            return self._onebit_state_shardings()
         if self._offload_device != "none":
             opt_shardings = {}
         else:
@@ -174,17 +212,55 @@ class DeepSpeedEngine:
             "loss_scale": jax.tree.map(lambda _: rep, self._loss_scale_state()),
         }
 
+    def _onebit_state_shardings(self) -> Dict[str, Any]:
+        from .topology import DATA_AXIS as AX
+        mesh = self.mesh
+        rep = NamedSharding(mesh, P())
+        dp_sharded = lambda tree: jax.tree.map(
+            lambda _: NamedSharding(mesh, P(AX)), tree)
+        template = jax.eval_shape(
+            lambda: self._onebit_opt.init(
+                self.model.init(jax.random.PRNGKey(0), self.param_dtype)))
+        opt_shardings = {k: (dp_sharded(v) if k in ("worker_error", "server_error")
+                             else jax.tree.map(lambda _: rep, v))
+                         for k, v in template.items()}
+        params_tmpl = jax.eval_shape(
+            lambda: self.model.init(jax.random.PRNGKey(0), self.param_dtype))
+        return {
+            "params": self._param_shardings,
+            "grad_acc": dp_sharded(params_tmpl),
+            "opt": opt_shardings,
+            "loss_scale": jax.tree.map(lambda _: rep, self._loss_scale_state()),
+        }
+
     def _init_state(self, seed: int, init_params: Optional[Any]) -> Dict[str, Any]:
         shardings = self._state_shardings()
 
         offload = self._offload_device != "none"
+        dp = self.topology.data_parallel_size
+
+        def make_opt(params):
+            if self._onebit_opt is not None:
+                opt = self._onebit_opt.init(params)
+                # per-worker error feedback: leading dp dim, sharded over data
+                for key in ("worker_error", "server_error"):
+                    opt[key] = jax.tree.map(
+                        lambda e: jnp.zeros((dp,) + e.shape, e.dtype), opt[key])
+                return opt
+            return {} if offload else self.optimizer.init(params)
+
+        def make_grad_acc(params):
+            if self._onebit_opt is not None:  # local per-device accumulators
+                return jax.tree.map(
+                    lambda p: jnp.zeros((dp,) + p.shape, self.grad_dtype), params)
+            return jax.tree.map(lambda p: jnp.zeros(p.shape, self.grad_dtype), params)
 
         def make_state(rng):
             params = self.model.init(rng, self.param_dtype)
             return {
                 "params": params,
-                "grad_acc": jax.tree.map(lambda p: jnp.zeros(p.shape, self.grad_dtype), params),
-                "opt": {} if offload else self.optimizer.init(params),
+                "grad_acc": make_grad_acc(params),
+                "opt": make_opt(params),
                 "loss_scale": self._loss_scale_state(),
             }
 
@@ -193,8 +269,8 @@ class DeepSpeedEngine:
                 params = jax.tree.map(lambda x: jnp.asarray(x, self.param_dtype), init_params)
                 make = lambda p: {
                     "params": p,
-                    "grad_acc": jax.tree.map(lambda q: jnp.zeros(q.shape, self.grad_dtype), p),
-                    "opt": {} if offload else self.optimizer.init(p),
+                    "grad_acc": make_grad_acc(p),
+                    "opt": make_opt(p),
                     "loss_scale": self._loss_scale_state(),
                 }
                 state = jax.jit(make, out_shardings=shardings)(params)
@@ -287,6 +363,100 @@ class DeepSpeedEngine:
         }
         return new_state, overflow, gnorm
 
+    # ------------------------------------------------------------------
+    # 1-bit step functions: explicit shard_map over the data axis so each
+    # device's gradients stay local for compression (reference
+    # runtime/fp16/onebit + runtime/comm/nccl.py backends)
+    # ------------------------------------------------------------------
+    def _build_onebit_jits(self, shardings, rep):
+        from jax import shard_map
+        from .topology import DATA_AXIS as AX
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps
+        model = self.model
+        onebit = self._onebit_opt
+        fp16_enabled = self.config.fp16.enabled
+        fp16c = self.config.fp16
+
+        p_rep = jax.tree.map(lambda _: P(), self.state["params"])
+        gacc_sp = jax.tree.map(lambda _: P(AX), self.state["grad_acc"])
+        opt_sp = {k: jax.tree.map(lambda _: P(AX) if k in ("worker_error",
+                                                           "server_error") else P(), v)
+                  for k, v in self.state["opt"].items()}
+
+        def local_micro(params, gacc, scale, batch):
+            def scaled_loss(p):
+                loss = model.loss(p, batch)
+                return loss * (scale / gas), loss
+
+            grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+            gacc = jax.tree.map(
+                lambda a, g: a + g.astype(self.grad_dtype)[None], gacc, grads)
+            return gacc, jax.lax.pmean(loss, AX)
+
+        def micro_step(state, batch):
+            batch_sp = jax.tree.map(lambda _: P(AX), batch)
+            sm = shard_map(local_micro, mesh=mesh,
+                           in_specs=(p_rep, gacc_sp, P(), batch_sp),
+                           out_specs=(gacc_sp, P()), check_vma=False)
+            gacc, loss = sm(state["params"], state["grad_acc"],
+                            state["loss_scale"]["cur_scale"], batch)
+            state = dict(state)
+            state["grad_acc"] = gacc
+            return state, loss
+
+        def local_apply(params, gacc, opt, scale, lr):
+            g_local = jax.tree.map(lambda g: g[0].astype(jnp.float32), gacc)
+            if fp16_enabled:
+                finite = jnp.all(jnp.stack([jnp.all(jnp.isfinite(g))
+                                            for g in jax.tree.leaves(g_local)]))
+                overflow = jax.lax.pmax((~finite).astype(jnp.int32), AX) > 0
+            else:
+                overflow = jnp.asarray(False)
+            inv = jnp.where(overflow, 0.0, 1.0 / scale)
+            g_local = jax.tree.map(lambda g: g * inv, g_local)
+            # reporting only: pmean of local sq-norms (global norm needs sync)
+            gnorm = jnp.sqrt(jax.lax.pmean(
+                sum(jnp.sum(g * g) for g in jax.tree.leaves(g_local)), AX))
+
+            opt_local = dict(opt)
+            for key in ("worker_error", "server_error"):
+                if key in opt_local:
+                    opt_local[key] = jax.tree.map(lambda e: e[0], opt_local[key])
+            master = opt_local["master"]
+
+            def do(_):
+                return onebit.update(g_local, opt_local, lr)
+
+            def skip(_):
+                return master, opt_local
+
+            new_master, new_opt = jax.lax.cond(overflow, skip, do, None)
+            new_params = jax.tree.map(lambda m_: m_.astype(self.param_dtype),
+                                      new_master)
+            for key in ("worker_error", "server_error"):
+                if key in new_opt:
+                    new_opt[key] = jax.tree.map(lambda e: e[None], new_opt[key])
+            new_gacc = jax.tree.map(jnp.zeros_like, gacc)
+            return new_params, new_gacc, new_opt, overflow, gnorm
+
+        def apply_step(state, lr):
+            sm = shard_map(local_apply, mesh=mesh,
+                           in_specs=(p_rep, gacc_sp, opt_sp, P(), P()),
+                           out_specs=(p_rep, gacc_sp, opt_sp, P(), P()),
+                           check_vma=False)
+            new_params, new_gacc, new_opt, overflow, gnorm = sm(
+                state["params"], state["grad_acc"], state["opt"],
+                state["loss_scale"]["cur_scale"], lr)
+            new_scale = update_scale(state["loss_scale"], overflow,
+                                     scale_window=fp16c.loss_scale_window,
+                                     min_scale=fp16c.min_loss_scale,
+                                     hysteresis=fp16c.hysteresis)
+            return ({"params": new_params, "grad_acc": new_gacc,
+                     "opt": new_opt, "loss_scale": new_scale}, overflow, gnorm)
+
+        return micro_step, apply_step
+
     def _build_jits(self):
         if self._jit_micro_step is not None and self._jit_apply_step is not None:
             return
@@ -294,6 +464,18 @@ class DeepSpeedEngine:
             self._cached_shardings = self._state_shardings()
         shardings = self._cached_shardings
         rep = NamedSharding(self.mesh, P())
+        if self._onebit_opt is not None:
+            micro_step, apply_step = self._build_onebit_jits(shardings, rep)
+            batch_sharding = NamedSharding(self.mesh, DATA_SPEC)
+            self._jit_micro_step = jax.jit(
+                micro_step, donate_argnums=(0,),
+                in_shardings=(shardings, batch_sharding),
+                out_shardings=(shardings, rep))
+            self._jit_apply_step = jax.jit(
+                apply_step, donate_argnums=(0,),
+                in_shardings=(shardings, rep),
+                out_shardings=(shardings, rep, rep))
+            return
         if self._jit_micro_step is None:
             batch_sharding = NamedSharding(self.mesh, DATA_SPEC)
             self._jit_micro_step = jax.jit(

@@ -1,0 +1,114 @@
+"""1-bit LAMB.
+
+Counterpart of the reference ``runtime/fp16/onebit/lamb.py`` (``OnebitLamb``
+:443 LoC): LAMB during warmup; after ``freeze_step`` the layerwise trust
+(scaling) coefficients are frozen at their running values and only the
+momentum is synchronized with the 1-bit compressed allreduce. The frozen
+coefficients are what make compressed LAMB sound: the trust ratio is a
+global (norm-based) quantity that cannot be recovered from compressed
+signals, so the reference caches ``scaling_coeff`` per layer — mirrored
+here as a per-leaf frozen coefficient captured by an exponential moving
+average during warmup (reference keeps ``lamb_coeff_freeze``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...comm.compressed import compressed_allreduce, error_state
+
+Params = Any
+OptState = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class OnebitLamb:
+    lr: float = 1e-3
+    betas: Tuple[float, float] = (0.9, 0.999)
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+    freeze_step: int = 100
+    max_coeff: float = 10.0
+    min_coeff: float = 0.01
+    coeff_beta: float = 0.9   # EMA for the frozen trust coefficient
+    axis: str = "data"
+    axis_size: int = 1
+
+    name = "onebit_lamb"
+
+    def init(self, params: Params) -> OptState:
+        z = lambda t: jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), t)
+        errors = jax.tree.map(lambda x: error_state(x.size, self.axis_size), params)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "master": jax.tree.map(lambda x: x.astype(jnp.float32), params),
+            "exp_avg": z(params),
+            "exp_avg_sq": z(params),
+            "lamb_coeff": jax.tree.map(lambda x: jnp.ones((), jnp.float32), params),
+            "worker_error": jax.tree.map(lambda e: e[0], errors,
+                                         is_leaf=lambda e: isinstance(e, tuple)),
+            "server_error": jax.tree.map(lambda e: e[1], errors,
+                                         is_leaf=lambda e: isinstance(e, tuple)),
+        }
+
+    def _trust(self, p, update):
+        w_norm = jnp.linalg.norm(p)
+        u_norm = jnp.linalg.norm(update)
+        return jnp.where((w_norm > 0) & (u_norm > 0),
+                         jnp.clip(w_norm / u_norm, self.min_coeff, self.max_coeff),
+                         1.0)
+
+    def _warmup_leaf(self, g_avg, p, m, v, coeff, lr):
+        b1, b2 = self.betas
+        m = b1 * m + (1 - b1) * g_avg
+        v = b2 * v + (1 - b2) * g_avg * g_avg
+        update = m / (jnp.sqrt(v) + self.eps) + self.weight_decay * p
+        trust = self._trust(p, update)
+        coeff = self.coeff_beta * coeff + (1 - self.coeff_beta) * trust
+        return p - lr * trust * update, m, v, coeff
+
+    def _compressed_leaf(self, g_local, p, m, v, coeff, we, se, lr):
+        b1, _ = self.betas
+        m_local = b1 * m + (1 - b1) * g_local
+        m_synced, we, se = compressed_allreduce(m_local, we, se, self.axis)
+        update = m_synced / (jnp.sqrt(v) + self.eps) + self.weight_decay * p
+        return p - lr * coeff * update, m_synced, v, coeff, we, se
+
+    def update(self, local_grads: Params, state: OptState, lr) -> Tuple[Params, OptState]:
+        step = state["step"] + 1
+
+        def sel(out, i):
+            return jax.tree.map(lambda t: t[i], out,
+                                is_leaf=lambda x: isinstance(x, tuple))
+
+        def warmup(_):
+            g_avg = jax.tree.map(
+                lambda g: jax.lax.pmean(g.astype(jnp.float32), self.axis),
+                local_grads)
+            out = jax.tree.map(
+                lambda g, p, m, v, c: self._warmup_leaf(g, p, m, v, c, lr),
+                g_avg, state["master"], state["exp_avg"], state["exp_avg_sq"],
+                state["lamb_coeff"])
+            return (sel(out, 0), sel(out, 1), sel(out, 2), sel(out, 3),
+                    state["worker_error"], state["server_error"])
+
+        def compressed(_):
+            out = jax.tree.map(
+                lambda g, p, m, v, c, we, se: self._compressed_leaf(
+                    g.astype(jnp.float32), p, m, v, c, we, se, lr),
+                local_grads, state["master"], state["exp_avg"],
+                state["exp_avg_sq"], state["lamb_coeff"],
+                state["worker_error"], state["server_error"])
+            return (sel(out, 0), sel(out, 1), sel(out, 2), sel(out, 3),
+                    sel(out, 4), sel(out, 5))
+
+        new_master, m, v, coeff, we, se = jax.lax.cond(
+            step <= self.freeze_step, warmup, compressed, None)
+        return new_master, {
+            "step": step, "master": new_master, "exp_avg": m, "exp_avg_sq": v,
+            "lamb_coeff": coeff, "worker_error": we, "server_error": se,
+        }

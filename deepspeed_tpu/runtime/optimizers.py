@@ -52,7 +52,8 @@ class Optimizer:
     def init(self, params: Params) -> OptState:
         master = jax.tree.map(lambda x: x.astype(jnp.float32), params)
         state: OptState = {"step": jnp.zeros((), jnp.int32), "master": master}
-        if self.name in ("adam", "adamw", "lamb", "onebit_adam", "zero_one_adam", "muadam", "muadamw"):
+        if self.name in ("adam", "adamw", "lamb", "onebit_adam", "onebit_lamb",
+                         "zero_one_adam", "muadam", "muadamw"):
             state["exp_avg"] = _tree_zeros_like(params)
             state["exp_avg_sq"] = _tree_zeros_like(params)
         elif self.name in ("lion", "momentum_sgd"):
@@ -112,7 +113,7 @@ class Optimizer:
             new_master = _unzip(out, 0)
             new_state["exp_avg"] = _unzip(out, 1)
             new_state["exp_avg_sq"] = _unzip(out, 2)
-        elif self.name == "lamb":
+        elif self.name in ("lamb", "onebit_lamb"):
             out = jax.tree.map(
                 lambda g, p, m, v: self._lamb_leaf(g.astype(jnp.float32), p, m, v, step, lr),
                 grads, master, state["exp_avg"], state["exp_avg_sq"])
@@ -161,8 +162,8 @@ _ALIASES = {
     "onebitadam": "onebit_adam",
     "zero_one_adam": "zero_one_adam",
     "zerooneadam": "zero_one_adam",
-    "onebit_lamb": "lamb",
-    "onebitlamb": "lamb",
+    "onebit_lamb": "onebit_lamb",
+    "onebitlamb": "onebit_lamb",
     "muadam": "muadam",
     "muadamw": "muadamw",
     "musgd": "sgd",

@@ -1,0 +1,147 @@
+"""1-bit optimizer tests (reference tests/unit/runtime/half_precision/onebit)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import gpt2_model
+from deepspeed_tpu.runtime.comm.compressed import compressed_allreduce, error_state
+from deepspeed_tpu.runtime.fp16.onebit import OnebitAdam
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+class TestCompressedAllreduce:
+
+    def test_exact_for_sign_tensors(self, eight_devices):
+        """±c tensors survive sign compression exactly (scale = c)."""
+        mesh = _mesh()
+        rng = np.random.default_rng(0)
+        x = (np.sign(rng.normal(size=(8, 64))) * 0.5).astype(np.float32)
+        we, se = error_state(64, 8)
+
+        def f(xs):
+            out, w, s = compressed_allreduce(xs[0], we, se, "data")
+            return out[None]
+
+        out = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                        check_vma=False)(jnp.asarray(x))
+        exact = x.mean(axis=0)
+        # mean of ±c signals re-compresses to sign(mean)*scale; error feedback
+        # holds the residual — the *result* is a biased estimate whose error
+        # is bounded by the server scale
+        err = np.abs(np.asarray(out[0]) - exact)
+        assert err.max() <= np.abs(exact).max() + 0.5
+
+    def test_error_feedback_reduces_bias_over_steps(self, eight_devices):
+        """Averaging compressed results over steps converges to the true mean
+        (error feedback keeps residuals; plain sign-SGD would not)."""
+        mesh = _mesh()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(8, 128)).astype(np.float32)
+        exact = x.mean(axis=0)
+        steps = 60
+
+        def run(xs):
+            we, se = error_state(128, 8)
+            first, _, _ = compressed_allreduce(xs[0], we, se, "data")
+
+            def body(carry, _):
+                we, se, acc = carry
+                out, we, se = compressed_allreduce(xs[0], we, se, "data")
+                return (we, se, acc + out), None
+            (_, _, acc), _ = jax.lax.scan(
+                body, (we, se, jnp.zeros(128, jnp.float32)), None, length=steps)
+            return jnp.stack([first, acc / steps])[None]
+
+        res = shard_map(run, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                        check_vma=False)(jnp.asarray(x))
+        err_single = float(np.abs(np.asarray(res[0, 0]) - exact).mean())
+        err_avg = float(np.abs(np.asarray(res[0, 1]) - exact).mean())
+        # error feedback makes the time-average debiased: far tighter than
+        # one-shot sign compression (which is what plain signSGD gives)
+        assert err_avg < 0.5 * err_single, (err_avg, err_single)
+        assert err_avg < 0.2
+
+    def test_quadratic_convergence_with_compression(self, eight_devices):
+        """sign-compressed gradient descent with error feedback converges on
+        a quadratic where each worker sees a different shifted objective."""
+        mesh = _mesh()
+        rng = np.random.default_rng(2)
+        targets = rng.normal(size=(8, 32)).astype(np.float32)  # per-worker shift
+        opt_target = targets.mean(axis=0)
+
+        def run(tgt):
+            we, se = error_state(32, 8)
+            p0 = jnp.zeros(32, jnp.float32)
+
+            def body(carry, t):
+                p, we, se = carry
+                g = p - tgt[0]          # local gradient
+                step, we, se = compressed_allreduce(g, we, se, "data")
+                lr = 0.1 / (1.0 + t / 100.0)   # decay beats the sign-noise floor
+                return (p - lr * step, we, se), None
+
+            (p, _, _), _ = jax.lax.scan(body, (p0, we, se),
+                                        jnp.arange(600, dtype=jnp.float32))
+            return p[None]
+
+        p = shard_map(run, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                      check_vma=False)(jnp.asarray(targets))
+        assert float(np.abs(np.asarray(p[0]) - opt_target).max()) < 0.05
+
+
+def _onebit_engine(opt_type, dp_batch=8, **opt_params):
+    m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+    eng, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": opt_type,
+                      "params": {"lr": 1e-3, **opt_params}},
+    })
+    return eng
+
+
+class TestOnebitEngines:
+
+    def _batch(self):
+        return {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+
+    @pytest.mark.parametrize("opt_type,params", [
+        ("onebit_adam", {"freeze_step": 2}),
+        ("onebit_lamb", {"freeze_step": 2}),
+        ("zero_one_adam", {"var_freeze_step": 4, "local_step_scaler": 2}),
+    ])
+    def test_trains_through_both_stages(self, opt_type, params):
+        """Loss keeps improving across the warmup→compression transition."""
+        eng = _onebit_engine(opt_type, **params)
+        b = self._batch()
+        losses = [float(eng.train_batch(b)) for _ in range(6)]
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0], losses
+
+    def test_onebit_warmup_matches_uncompressed(self):
+        """During warmup 1-bit Adam IS Adam (no bias correction variant):
+        two engines with huge freeze_step must track each other exactly."""
+        b = self._batch()
+        e1 = _onebit_engine("onebit_adam", freeze_step=1000)
+        e2 = _onebit_engine("onebit_adam", freeze_step=1000)
+        for _ in range(3):
+            l1 = float(e1.train_batch(b))
+            l2 = float(e2.train_batch(b))
+            assert l1 == l2
+
+    def test_rejects_model_parallel_mesh(self):
+        from deepspeed_tpu.runtime.topology import MeshTopology, TopologyConfig
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+        with pytest.raises(ValueError, match="pure data parallel"):
+            deepspeed_tpu.initialize(model=m, config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "onebit_adam", "params": {"lr": 1e-3}},
+            }, topology=MeshTopology(TopologyConfig(model=2, data=-1)))

@@ -57,7 +57,14 @@ def initialize(args=None,
 
     init_distributed()
 
-    engine = DeepSpeedEngine(
+    # engine selection (reference deepspeed/__init__.py:156-193: hybrid_engine
+    # config -> DeepSpeedHybridEngine, else DeepSpeedEngine)
+    engine_cls = DeepSpeedEngine
+    if isinstance(config, dict) and config.get("hybrid_engine", {}).get("enabled"):
+        from .runtime.hybrid_engine import DeepSpeedHybridEngine
+        engine_cls = DeepSpeedHybridEngine
+
+    engine = engine_cls(
         model=model,
         config_dict=config if isinstance(config, dict) else None,
         config=config if isinstance(config, DeepSpeedConfig) else None,

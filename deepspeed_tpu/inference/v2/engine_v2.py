@@ -89,6 +89,18 @@ class InferenceEngineV2:
             f"({self.kv_cache.mem_bytes() / 2**20:.0f} MiB), "
             f"tp={self.topology.model_parallel_size}", ranks=[0])
 
+    def update_params(self, params: Any) -> None:
+        """Rebind weights (hybrid-engine train->generate flip): cast into the
+        engine's shardings without touching compiled programs."""
+        c = self.model.config
+        specs = self.model.specs()
+        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                                 is_leaf=lambda s: isinstance(s, P))
+        with self.mesh:
+            self.params = jax.jit(
+                lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
+                out_shardings=shardings)(params)
+
     # ------------------------------------------------------------------
     # compiled-program cache
     # ------------------------------------------------------------------

@@ -1,0 +1,2 @@
+from .auto_tp import AutoTP, shard_param_tree  # noqa: F401
+from .layers import LinearAllreduce, LinearLayer  # noqa: F401

@@ -1,0 +1,133 @@
+"""Automatic tensor parallelism.
+
+Counterpart of the reference ``module_inject/auto_tp.py`` (``AutoTP`` :187,
+``tp_parser`` :271, ``_replace`` :317) + ``tp_shard.py``: decide, for every
+linear weight in a model, whether it should be column-sharded (sliced, no
+comm — reference ``LinearLayer``) or row-sharded (followed by an all-reduce —
+reference ``LinearAllreduce``), then shard checkpoint weights accordingly.
+
+The reference walks torch module graphs and maintains per-architecture
+policy lists. On TPU the model is a param *pytree*; classification runs on
+leaf paths + shapes, and "replacement" is emitting a ``PartitionSpec`` tree
+that the SPMD partitioner uses to insert the all-reduces the reference
+performs by hand. The same name heuristics are kept (reference
+``tp_parser`` looks for out_proj/o_proj/down_proj/dense_4h_to_h... as the
+all-reduce set).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.topology import MODEL_AXIS
+
+# reference auto_tp.py tp_parser: layers whose OUTPUT needs an all-reduce
+# (row-parallel). Everything matmul-like that isn't row-parallel and isn't
+# marked keep-replicated becomes column-parallel.
+_ROW_PATTERNS = (
+    "o_proj", "out_proj", "down_proj", "fc_out", "fc2", "dense_4h_to_h",
+    "attention.dense", "self_attention.dense", "attn.c_proj", "mlp.c_proj",
+    "wo", "w2",
+)
+_COLUMN_PATTERNS = (
+    "q_proj", "k_proj", "v_proj", "query", "key", "value", "qkv",
+    "gate_proj", "up_proj", "fc_in", "fc1", "dense_h_to_4h", "c_attn", "c_fc",
+    "wi", "w1", "w3", "query_key_value",
+)
+_REPLICATED_PATTERNS = (
+    "norm", "ln_", "layernorm", "bias_only", "rotary",
+)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for k in path:
+        parts.append(str(getattr(k, "key", getattr(k, "idx", k))))
+    return ".".join(parts).lower()
+
+
+class AutoTP:
+    """Classify a param tree into TP sharding specs.
+
+    ``tp_parser`` returns {'column': [...], 'row': [...], 'replicated': [...]}
+    path lists (the reference returns policy tuples); ``build_specs`` emits
+    the PartitionSpec tree.
+    """
+
+    def __init__(self, hidden_size: Optional[int] = None):
+        self.hidden_size = hidden_size
+
+    def classify(self, path: str, shape: Tuple[int, ...]) -> str:
+        if len(shape) < 2:
+            # 1-D: bias of a column-parallel layer is sharded with it; detect
+            # by the owning layer's name
+            if any(p in path for p in _COLUMN_PATTERNS):
+                return "column_bias"
+            return "replicated"
+        if any(p in path for p in _REPLICATED_PATTERNS):
+            return "replicated"
+        for pat in _ROW_PATTERNS:
+            if pat in path:
+                return "row"
+        for pat in _COLUMN_PATTERNS:
+            if pat in path:
+                return "column"
+        # shape heuristic (reference falls back to module-type scanning):
+        # widening matmul -> column, narrowing -> row
+        if self.hidden_size is not None and len(shape) >= 2:
+            d_in, d_out = shape[-2], shape[-1]
+            if d_in == self.hidden_size and d_out > d_in:
+                return "column"
+            if d_out == self.hidden_size and d_in > d_out:
+                return "row"
+        return "replicated"
+
+    def tp_parser(self, params: Any) -> Dict[str, List[str]]:
+        out: Dict[str, List[str]] = {"column": [], "row": [], "replicated": []}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            kind = self.classify(_path_str(path), np.shape(leaf)).replace("_bias", "")
+            out[kind].append(_path_str(path))
+        return out
+
+    def build_specs(self, params: Any) -> Any:
+        """PartitionSpec tree: the TPU form of ``AutoTP._replace``."""
+
+        def spec_for(path, leaf):
+            kind = self.classify(_path_str(path), np.shape(leaf))
+            nd = np.ndim(leaf)
+            if kind == "column":
+                return P(*([None] * (nd - 1)), MODEL_AXIS)
+            if kind == "row":
+                return P(*([None] * (nd - 2)), MODEL_AXIS, None)
+            if kind == "column_bias":
+                return P(*([None] * (nd - 1)), MODEL_AXIS)
+            return P(*([None] * nd))
+
+        return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def shard_param_tree(params: Any, specs: Any, tp_rank: int, tp_size: int) -> Any:
+    """Slice a full (host) param tree into rank ``tp_rank``'s TP shard —
+    the reference ``tp_shard.py`` checkpoint resharding used when loading a
+    non-TP checkpoint into a TP engine."""
+
+    def shard(leaf, spec):
+        leaf = np.asarray(leaf)
+        for dim, axis in enumerate(spec):
+            if axis == MODEL_AXIS:
+                size = leaf.shape[dim]
+                assert size % tp_size == 0, (leaf.shape, dim, tp_size)
+                k = size // tp_size
+                idx = [slice(None)] * leaf.ndim
+                idx[dim] = slice(tp_rank * k, (tp_rank + 1) * k)
+                return leaf[tuple(idx)]
+        return leaf
+
+    return jax.tree.map(shard, params, specs,
+                        is_leaf=lambda x: isinstance(x, P))

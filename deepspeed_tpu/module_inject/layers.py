@@ -1,0 +1,21 @@
+"""TP layer forms.
+
+Counterpart of the reference ``module_inject/layers.py`` (``LinearAllreduce``
+:16, ``LinearLayer`` :62). On TPU these are not module replacements but the
+two canonical sharding layouts of a dense layer over the ``model`` axis —
+re-exported views of :class:`deepspeed_tpu.nn.layers.Linear`:
+
+- ``LinearLayer``    ≡ ``Linear(shard='column')``: output features split;
+  no communication (the reference's sliced Linear).
+- ``LinearAllreduce`` ≡ ``Linear(shard='row')``: input features split; XLA
+  inserts the psum the reference calls explicitly after the matmul.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..nn.layers import Linear
+
+LinearLayer = functools.partial(Linear, shard="column")
+LinearAllreduce = functools.partial(Linear, shard="row")

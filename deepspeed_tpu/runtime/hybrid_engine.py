@@ -1,0 +1,121 @@
+"""Hybrid engine: training + generation sharing one set of weights.
+
+Counterpart of the reference ``runtime/hybrid_engine.py``
+(``DeepSpeedHybridEngine`` :32, ``generate`` :174): the RLHF loop needs a
+single engine that trains (actor update) and generates (experience
+collection) with the same weights. The reference flips ZeRO-3 gathered
+params into injected inference kernels and back; on TPU both sides are jit
+programs over the *same* device arrays, so the flip is handing the training
+params to the ragged inference engine — no copy, no re-layout (cast to the
+inference dtype happens inside the jitted program and XLA elides it when
+dtypes already match).
+
+LoRA fuse/unfuse (reference ``fuse_lora_weight`` :141) appears here as
+``fuse_lora``/``unfuse_lora`` over additive low-rank pairs in the param
+tree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+
+    def __init__(self, *args, inference_config=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_config = inference_config
+        self._iv2 = None
+        self._gen_step_of_params = -1
+
+    # -- generation side ----------------------------------------------------
+    def _inference_engine(self):
+        from ..inference.v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+        if self._iv2 is None:
+            cfg = self._inference_config or RaggedInferenceEngineConfig()
+            self._iv2 = InferenceEngineV2(self.model, config=cfg,
+                                          params=self.state["params"],
+                                          topology=self.topology)
+            self._gen_step_of_params = self.global_steps
+        elif self._gen_step_of_params != self.global_steps:
+            # weights advanced since last generate: rebind (device-side cast,
+            # the reference's _zero3_forward re-gather equivalent)
+            self._iv2.update_params(self.state["params"])
+            self._gen_step_of_params = self.global_steps
+        return self._iv2
+
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 64,
+                 temperature: float = 0.0, token_budget: Optional[int] = None) -> List[List[int]]:
+        """Experience generation with current training weights
+        (reference hybrid_engine.generate :174)."""
+        from ..inference.v2.scheduler import generate as _generate
+        eng = self._inference_engine()
+        return _generate(eng, prompts, max_new_tokens=max_new_tokens,
+                         temperature=temperature, token_budget=token_budget)
+
+    def _shardings_for(self, params):
+        """Declared param shardings extended with replicated entries for
+        adapter leaves (lora_a/lora_b) absent from the model's spec tree."""
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def merge(p_node, s_node):
+            if isinstance(p_node, dict):
+                return {k: merge(v, s_node.get(k) if isinstance(s_node, dict) else None)
+                        for k, v in p_node.items()}
+            return s_node if s_node is not None else rep
+
+        return merge(params, self._param_shardings)
+
+    # -- LoRA (reference :141 fuse_lora_weight / unfuse_lora_weight) ---------
+    @staticmethod
+    def _lora_pairs(params: Dict[str, Any]):
+        """Find {name: {... 'lora_a', 'lora_b' ...}} adapters next to 'kernel'."""
+        pairs = []
+
+        def walk(tree, path=()):
+            if isinstance(tree, dict):
+                if "kernel" in tree and "lora_a" in tree and "lora_b" in tree:
+                    pairs.append(path)
+                for k, v in tree.items():
+                    walk(v, path + (k,))
+
+        walk(params)
+        return pairs
+
+    def fuse_lora(self) -> int:
+        """kernel += lora_a @ lora_b; returns adapters fused."""
+        params = jax.device_get(self.state["params"])
+        pairs = self._lora_pairs(params)
+        for path in pairs:
+            node = params
+            for k in path:
+                node = node[k]
+            node["kernel"] = np.asarray(node["kernel"]) + (
+                np.asarray(node["lora_a"], np.float32)
+                @ np.asarray(node["lora_b"], np.float32)).astype(node["kernel"].dtype)
+        if pairs:
+            with self.mesh:
+                self.state["params"] = jax.device_put(params, self._shardings_for(params))
+        return len(pairs)
+
+    def unfuse_lora(self) -> int:
+        params = jax.device_get(self.state["params"])
+        pairs = self._lora_pairs(params)
+        for path in pairs:
+            node = params
+            for k in path:
+                node = node[k]
+            node["kernel"] = np.asarray(node["kernel"]) - (
+                np.asarray(node["lora_a"], np.float32)
+                @ np.asarray(node["lora_b"], np.float32)).astype(node["kernel"].dtype)
+        if pairs:
+            with self.mesh:
+                self.state["params"] = jax.device_put(params, self._shardings_for(params))
+        return len(pairs)

@@ -1,1 +1,4 @@
+from .checkpoint_engine import (AsyncCheckpointEngine, CheckpointEngine,  # noqa: F401
+                                NpzCheckpointEngine)
+from .ds_to_universal import ds_to_universal, load_universal  # noqa: F401
 from .store import load_checkpoint, save_checkpoint  # noqa: F401

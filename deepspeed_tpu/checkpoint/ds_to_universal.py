@@ -1,0 +1,95 @@
+"""Universal checkpoint conversion.
+
+Counterpart of the reference ``checkpoint/ds_to_universal.py``
+(``extract_zero_shards`` :87, ``merge_tp_slices`` :156): converts a training
+checkpoint into a *topology-independent* layout — one directory per logical
+parameter holding fp32 master weights + optimizer moments, loadable into any
+DP/TP/PP arrangement.
+
+Our store already saves leaves gathered and addressed by logical path (no
+per-rank shards to merge), so conversion is a re-keying: explode the state
+npz into per-parameter files under ``zero/<param-path>/{fp32,exp_avg,
+exp_avg_sq}.npy`` exactly mirroring the reference's universal directory
+contract, so external tooling written against that contract ports over.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+_SLOT_MAP = {
+    "master": "fp32",
+    "exp_avg": "exp_avg",
+    "exp_avg_sq": "exp_avg_sq",
+    "sum_sq": "exp_avg_sq",
+}
+
+
+def _load_state(ckpt_dir: str, tag: Optional[str]):
+    if tag is None:
+        with open(os.path.join(ckpt_dir, "latest")) as f:
+            tag = f.read().strip()
+    path = os.path.join(ckpt_dir, tag)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(path, "state.npz"))
+    return {k: data[f"leaf_{i}"] for i, k in enumerate(meta["keys"])}, meta, tag
+
+
+def ds_to_universal(ckpt_dir: str, out_dir: str, tag: Optional[str] = None) -> int:
+    """Write the universal layout; returns number of parameters emitted."""
+    by_key, meta, tag = _load_state(ckpt_dir, tag)
+    count = 0
+    for key, value in by_key.items():
+        parts = key.split("/")
+        if parts[0] == "opt" and len(parts) >= 3 and parts[1] in _SLOT_MAP:
+            slot, param_path = _SLOT_MAP[parts[1]], "/".join(parts[2:])
+        elif parts[0] == "params":
+            # bit16 model weights: only authoritative when no fp32 master
+            slot, param_path = "bit16", "/".join(parts[1:])
+        else:
+            continue
+        pdir = os.path.join(out_dir, "zero", param_path.replace("/", "."))
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, f"{slot}.npy"), value)
+        count += 1
+    with open(os.path.join(out_dir, "universal_meta.json"), "w") as f:
+        json.dump({"source_tag": tag, "format": "dstpu_universal_v1"}, f)
+    return count
+
+
+def load_universal(out_dir: str) -> Dict[str, np.ndarray]:
+    """Read back {param_path: fp32_weights} (reference
+    ``universal_checkpoint.py`` load hooks)."""
+    zero_dir = os.path.join(out_dir, "zero")
+    out = {}
+    for name in sorted(os.listdir(zero_dir)):
+        pdir = os.path.join(zero_dir, name)
+        fp32 = os.path.join(pdir, "fp32.npy")
+        bit16 = os.path.join(pdir, "bit16.npy")
+        if os.path.exists(fp32):
+            out[name] = np.load(fp32)
+        elif os.path.exists(bit16):
+            out[name] = np.load(bit16).astype(np.float32)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="Convert a DeepSpeed-TPU checkpoint "
+                                            "to the universal format")
+    p.add_argument("input_folder")
+    p.add_argument("output_folder")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    n = ds_to_universal(args.input_folder, args.output_folder, args.tag)
+    print(f"wrote {n} parameter slots to {args.output_folder}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

@@ -708,6 +708,19 @@ class DeepSpeedEngine:
                      **{f"state_{i}": s for i, s in enumerate(sd["state"])})
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
 
+    def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.npz") -> None:
+        """Gathered bit16 weights for deployment (reference
+        ``save_16bit_model``/``_zero3_consolidated_16bit_state_dict``,
+        engine.py:3546,3477)."""
+        sd = self.module_state_dict()
+        flat = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(sd)[0]:
+            key = ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+            flat[key] = np.asarray(leaf)
+        os.makedirs(save_dir, exist_ok=True)
+        np.savez(os.path.join(save_dir, save_filename), **flat)
+        log_dist(f"saved 16-bit model to {save_dir}/{save_filename}", ranks=[0])
+
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True) -> Tuple[Optional[str], Dict[str, Any]]:
         from ..checkpoint.store import load_checkpoint as _load

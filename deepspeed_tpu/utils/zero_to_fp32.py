@@ -1,0 +1,75 @@
+"""Offline fp32 weight consolidation.
+
+Counterpart of the reference ``deepspeed/utils/zero_to_fp32.py``
+(``_get_fp32_state_dict_from_zero3_checkpoint`` :447, zero2 variant :329):
+reconstruct full-precision model weights from a training checkpoint without
+constructing the engine — the script users run on a checkpoint dir to get
+deployable weights. Our store keeps leaves gathered, so "consolidation"
+selects the fp32 master copy when the optimizer saved one (ZeRO stages with
+mixed precision) and falls back to the bit16 model weights otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
+                                             tag: Optional[str] = None
+                                             ) -> Dict[str, np.ndarray]:
+    if tag is None:
+        with open(os.path.join(ckpt_dir, "latest")) as f:
+            tag = f.read().strip()
+    path = os.path.join(ckpt_dir, tag)
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    data = np.load(os.path.join(path, "state.npz"))
+    by_key = {k: data[f"leaf_{i}"] for i, k in enumerate(meta["keys"])}
+
+    out: Dict[str, np.ndarray] = {}
+    for key, value in by_key.items():
+        if key.startswith("params/"):
+            name = key[len("params/"):]
+            master_key = f"opt/master/{name}"
+            src = by_key.get(master_key, value)
+            out[name] = np.asarray(src, np.float32)
+    # offloaded optimizers keep the master outside the state tree
+    offload = os.path.join(path, "offload_optimizer.npz")
+    if os.path.exists(offload):
+        z = np.load(offload)
+        names = sorted(out.keys())
+        masters = [z[f"master_{i}"] for i in range(len(names))]
+        if len(masters) == len(names):
+            for name, m in zip(names, masters):
+                out[name] = np.asarray(m, np.float32).reshape(out[name].shape)
+    return out
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(ckpt_dir: str, output_file: str,
+                                               tag: Optional[str] = None) -> None:
+    sd = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    os.makedirs(os.path.dirname(output_file) or ".", exist_ok=True)
+    np.savez(output_file, **{k.replace("/", "."): v for k, v in sd.items()})
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Extract consolidated fp32 weights from a checkpoint "
+                    "(reference zero_to_fp32.py)")
+    p.add_argument("checkpoint_dir")
+    p.add_argument("output_file")
+    p.add_argument("--tag", default=None)
+    args = p.parse_args(argv)
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, args.tag)
+    print(f"saved fp32 state dict to {args.output_file}")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

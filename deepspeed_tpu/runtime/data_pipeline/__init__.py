@@ -1,0 +1,3 @@
+from .curriculum_scheduler import CurriculumScheduler  # noqa: F401
+from .data_sampler import DeepSpeedDataSampler  # noqa: F401
+from .random_ltd import RandomLTDScheduler, random_ltd_gather, random_ltd_scatter  # noqa: F401

@@ -1,0 +1,69 @@
+"""Curriculum learning scheduler.
+
+Counterpart of the reference ``runtime/data_pipeline/curriculum_scheduler.py``
+(``CurriculumScheduler`` :11): maps global step → difficulty (typically
+sequence length), with the reference's schedule types: ``fixed_linear``,
+``fixed_root``, ``fixed_discrete``, and ``custom``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        assert "curriculum_type" in config and "max_difficulty" in config \
+            and "min_difficulty" in config, \
+            "curriculum config needs curriculum_type/min_difficulty/max_difficulty"
+        self.curriculum_type = config["curriculum_type"]
+        self.min_difficulty = config["min_difficulty"]
+        self.max_difficulty = config["max_difficulty"]
+        self.current_difficulty = self.min_difficulty
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        cfg = config.get("schedule_config", {})
+        self.schedule_config = cfg
+        if self.curriculum_type in ("fixed_linear", "fixed_root"):
+            assert "total_curriculum_step" in cfg and "difficulty_step" in cfg
+        elif self.curriculum_type == "fixed_discrete":
+            assert "difficulty" in cfg and "max_step" in cfg
+            assert len(cfg["difficulty"]) == len(cfg["max_step"]) + 1
+        elif self.curriculum_type != "custom":
+            raise ValueError(f"unknown curriculum_type {self.curriculum_type}")
+
+    def get_difficulty(self, global_steps: int) -> int:
+        c = self.schedule_config
+        if self.curriculum_type == "custom":
+            assert self.custom_get_difficulty is not None
+            d = self.custom_get_difficulty(global_steps)
+        elif self.curriculum_type == "fixed_discrete":
+            d = c["difficulty"][-1]
+            for diff, until in zip(c["difficulty"], c["max_step"]):
+                if global_steps <= until:
+                    d = diff
+                    break
+        else:
+            total = c["total_curriculum_step"]
+            if self.curriculum_type == "fixed_root":
+                power = c.get("root_degree", 2)
+                frac = (min(global_steps, total) / total) ** (1.0 / power)
+            else:  # fixed_linear
+                frac = min(global_steps, total) / total
+            d = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+            step = c["difficulty_step"]
+            d = int(d // step) * step  # quantize (reference: difficulty_step)
+        d = max(self.min_difficulty, min(int(d), self.max_difficulty))
+        self.current_difficulty = d
+        return d
+
+    def update_difficulty(self, global_steps: int) -> int:
+        return self.get_difficulty(global_steps)
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_difficulty = sd["current_difficulty"]

@@ -1,0 +1,101 @@
+"""Curriculum-aware distributed data sampler.
+
+Counterpart of the reference ``data_pipeline/data_sampling/data_sampler.py``
+(``DeepSpeedDataSampler`` :36): deterministic, resumable sampling that
+(a) partitions the global batch across DP replicas, (b) optionally filters
+by a difficulty metric per sample under a curriculum schedule, and
+(c) supports exact mid-epoch resume via consumed-sample counts — the piece
+that makes data order a function of (seed, step) instead of process history.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self,
+                 total_samples: int,
+                 micro_batch_size: int,
+                 data_parallel_size: int,
+                 data_parallel_rank: int = 0,
+                 gradient_accumulation_steps: int = 1,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 difficulty_fn: Optional[Callable[[int], float]] = None,
+                 drop_last: bool = True,
+                 shuffle: bool = True,
+                 seed: int = 1234):
+        assert data_parallel_rank < data_parallel_size
+        self.total_samples = total_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_size = data_parallel_size
+        self.dp_rank = data_parallel_rank
+        self.gas = gradient_accumulation_steps
+        self.global_batch_size = micro_batch_size * data_parallel_size * self.gas
+        self.curriculum = curriculum
+        self.difficulty_fn = difficulty_fn
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.consumed_samples = 0
+        if curriculum is not None:
+            assert difficulty_fn is not None, \
+                "curriculum sampling needs a per-sample difficulty_fn"
+
+    def __len__(self) -> int:
+        return self.total_samples // self.global_batch_size if self.drop_last \
+            else -(-self.total_samples // self.global_batch_size)
+
+    @property
+    def curriculum_step(self) -> int:
+        return self.consumed_samples // self.global_batch_size
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        idx = np.arange(self.total_samples)
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch).shuffle(idx)
+        return idx
+
+    def __iter__(self) -> Iterator[List[int]]:
+        while True:
+            epoch = self.consumed_samples // self.total_samples
+            offset = self.consumed_samples % self.total_samples
+            order = self._epoch_order(epoch)[offset:]
+            if len(order) < self.global_batch_size and self.drop_last:
+                self.consumed_samples += len(order)  # skip ragged tail
+                continue
+            batch = order[:self.global_batch_size]
+            if len(batch) == 0:
+                continue
+            if self.curriculum is not None:
+                difficulty = self.curriculum.update_difficulty(self.curriculum_step)
+                keep = [i for i in batch if self.difficulty_fn(int(i)) <= difficulty]
+                # reference clips sequence length instead of dropping when
+                # possible; at the sampler level we refill from later samples
+                # to keep the batch full
+                rest = [i for i in order[self.global_batch_size:]
+                        if self.difficulty_fn(int(i)) <= difficulty]
+                batch = np.asarray((keep + rest)[:self.global_batch_size], dtype=np.int64)
+                if len(batch) < self.global_batch_size:
+                    batch = np.resize(batch, self.global_batch_size)
+            self.consumed_samples += self.global_batch_size
+            # rank's slice: contiguous block per micro-batch
+            my = []
+            for g in range(self.gas):
+                start = g * self.micro_batch_size * self.dp_size \
+                    + self.dp_rank * self.micro_batch_size
+                my.extend(batch[start:start + self.micro_batch_size].tolist())
+            yield my
+
+    # -- exact resume (reference data_sampler state_dict) --------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {"consumed_samples": self.consumed_samples, "seed": self.seed}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.consumed_samples = sd["consumed_samples"]
+        self.seed = sd.get("seed", self.seed)

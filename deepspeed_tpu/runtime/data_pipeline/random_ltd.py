@@ -1,0 +1,78 @@
+"""Random layerwise token dropping (random-LTD).
+
+Counterpart of the reference ``runtime/data_pipeline/data_routing/``
+(``RandomLTDScheduler`` scheduler.py:38) + the CUDA token sort/gather
+kernels (``csrc/random_ltd/{token_sort.cu,gather_scatter.cu}``): middle
+layers process a random subset of tokens; dropped tokens skip the layer and
+are scattered back afterwards. On TPU the kernels are ``jax.random.
+permutation`` + ``take``/``scatter`` — one-liners XLA fuses, with static
+kept-token counts per schedule stage so every stage is one compiled program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_ltd_indices(rng: jax.Array, seq_len: int, keep: int,
+                       batch: int) -> Tuple[jax.Array, jax.Array]:
+    """Sample per-example kept-token indices (sorted, so relative order is
+    preserved like the reference's token_sort.cu). Returns (kept [B, keep],
+    dropped [B, seq-keep])."""
+    def one(r):
+        perm = jax.random.permutation(r, seq_len)
+        return jnp.sort(perm[:keep]), jnp.sort(perm[keep:])
+
+    kept, dropped = jax.vmap(one)(jax.random.split(rng, batch))
+    return kept, dropped
+
+
+def random_ltd_gather(x: jax.Array, kept: jax.Array) -> jax.Array:
+    """x [B, S, H], kept [B, K] -> [B, K, H] (reference gather_scatter.cu)."""
+    return jnp.take_along_axis(x, kept[..., None], axis=1)
+
+
+def random_ltd_scatter(x_full: jax.Array, x_kept: jax.Array,
+                       kept: jax.Array) -> jax.Array:
+    """Write processed kept tokens back into the full sequence; dropped
+    tokens keep their input activations (the layer-skip semantics)."""
+    B, K, H = x_kept.shape
+    return x_full.at[jnp.arange(B)[:, None], kept].set(x_kept)
+
+
+class RandomLTDScheduler:
+    """Schedule of kept-token count (reference scheduler.py:38): linear ramp
+    from ``start_seq`` kept tokens to the full sequence over
+    ``total_layer_token_steps``, quantized to ``step_size`` so the number of
+    distinct compiled programs stays small."""
+
+    def __init__(self, config: Dict[str, Any]):
+        s = config.get("schedule", {})
+        self.start_seq = s.get("min_value", 128)
+        self.max_seq = s.get("max_value", 512)
+        self.step_size = s.get("step_size", 16)
+        self.total_steps = s.get("total_layer_token_steps",
+                                 s.get("schedule_config", {}).get("total_steps", 1000))
+        self.current_seq = self.start_seq
+        self.global_step = 0
+
+    def get_current_seq(self) -> int:
+        return self.current_seq
+
+    def update_seq(self, global_step: int) -> int:
+        frac = min(global_step, self.total_steps) / max(self.total_steps, 1)
+        seq = self.start_seq + frac * (self.max_seq - self.start_seq)
+        seq = int(seq // self.step_size) * self.step_size
+        self.current_seq = max(self.start_seq, min(seq, self.max_seq))
+        self.global_step = global_step
+        return self.current_seq
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"current_seq": self.current_seq, "global_step": self.global_step}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.current_seq = sd["current_seq"]
+        self.global_step = sd["global_step"]

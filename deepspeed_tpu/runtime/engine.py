@@ -143,6 +143,14 @@ class DeepSpeedEngine:
         from ..profiling.flops_profiler.profiler import FlopsProfiler
         self.flops_profiler = FlopsProfiler(model=model, ds_engine=self)
 
+        # curriculum learning (reference engine.py:339,1813: difficulty ->
+        # forward kwargs; here difficulty == sequence length truncation)
+        self.curriculum_scheduler = None
+        if config.curriculum_enabled_legacy:
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+            self.curriculum_scheduler = CurriculumScheduler(
+                config.curriculum_params_legacy)
+
         from .. import comm as dist
         if config.comms_logger_enabled:
             dist.configure(config=config)
@@ -499,10 +507,24 @@ class DeepSpeedEngine:
         sharding = NamedSharding(self.mesh, DATA_SPEC)
         return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
 
+    def _apply_curriculum(self, batch: Dict[str, Any]) -> Dict[str, Any]:
+        """Truncate sequences to the scheduled difficulty (reference
+        curriculum kwargs injection, engine.py:1813-1826). Difficulty is
+        quantized by the schedule's difficulty_step, bounding the number of
+        distinct compiled shapes."""
+        seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps)
+        out = {}
+        for k, v in batch.items():
+            v = np.asarray(v)
+            out[k] = v[:, :seqlen] if v.ndim >= 2 and v.shape[1] > seqlen else v
+        return out
+
     def forward(self, batch: Dict[str, Any]):
         """Compute loss (and gradients — fused; see module docstring)."""
         self._build_jits()
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self.curriculum_scheduler is not None:
+            batch = self._apply_curriculum(batch)
         batch = self._device_batch(batch)
         with self.mesh:
             self.state, loss = self._jit_micro_step(self.state, batch)

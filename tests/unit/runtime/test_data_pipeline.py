@@ -1,0 +1,153 @@
+"""Data-efficiency pipeline tests (reference tests/unit/runtime/
+test_data_efficiency.py + data sampling suites)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import gpt2_model
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler,
+                                                 DeepSpeedDataSampler,
+                                                 RandomLTDScheduler,
+                                                 random_ltd_gather,
+                                                 random_ltd_scatter)
+from deepspeed_tpu.runtime.data_pipeline.random_ltd import random_ltd_indices
+
+
+class TestCurriculumScheduler:
+
+    def test_fixed_linear(self):
+        s = CurriculumScheduler({
+            "curriculum_type": "fixed_linear", "min_difficulty": 8,
+            "max_difficulty": 64,
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+        assert s.get_difficulty(0) == 8
+        assert s.get_difficulty(50) == 32
+        assert s.get_difficulty(100) == 64
+        assert s.get_difficulty(10_000) == 64
+        # quantization to difficulty_step
+        assert s.get_difficulty(51) % 8 == 0
+
+    def test_fixed_root_grows_faster_early(self):
+        lin = CurriculumScheduler({
+            "curriculum_type": "fixed_linear", "min_difficulty": 0,
+            "max_difficulty": 100,
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1}})
+        root = CurriculumScheduler({
+            "curriculum_type": "fixed_root", "min_difficulty": 0,
+            "max_difficulty": 100,
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1,
+                                "root_degree": 2}})
+        assert root.get_difficulty(25) > lin.get_difficulty(25)
+
+    def test_fixed_discrete(self):
+        s = CurriculumScheduler({
+            "curriculum_type": "fixed_discrete", "min_difficulty": 4,
+            "max_difficulty": 64,
+            "schedule_config": {"difficulty": [4, 16, 64], "max_step": [10, 20]}})
+        assert s.get_difficulty(5) == 4
+        assert s.get_difficulty(15) == 16
+        assert s.get_difficulty(25) == 64
+
+
+class TestDataSampler:
+
+    def test_dp_partition_disjoint_and_complete(self):
+        samplers = [DeepSpeedDataSampler(total_samples=64, micro_batch_size=4,
+                                         data_parallel_size=4, data_parallel_rank=r)
+                    for r in range(4)]
+        batches = [next(iter(s)) for s in samplers]
+        all_idx = sum(batches, [])
+        assert len(all_idx) == 16
+        assert len(set(all_idx)) == 16  # disjoint
+
+    def test_resume_reproduces_order(self):
+        def take(sampler, n):
+            it = iter(sampler)
+            return [next(it) for _ in range(n)]
+
+        s1 = DeepSpeedDataSampler(total_samples=64, micro_batch_size=4,
+                                  data_parallel_size=2)
+        first = take(s1, 5)
+        sd = s1.state_dict()
+
+        s2 = DeepSpeedDataSampler(total_samples=64, micro_batch_size=4,
+                                  data_parallel_size=2)
+        take(s2, 5)
+        expected = take(s2, 3)
+
+        s3 = DeepSpeedDataSampler(total_samples=64, micro_batch_size=4,
+                                  data_parallel_size=2)
+        s3.load_state_dict(sd)
+        assert take(s3, 3) == expected
+
+    def test_curriculum_filters_difficulty(self):
+        cur = CurriculumScheduler({
+            "curriculum_type": "fixed_linear", "min_difficulty": 10,
+            "max_difficulty": 64,
+            "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 1}})
+        # difficulty of sample i is i
+        s = DeepSpeedDataSampler(total_samples=64, micro_batch_size=4,
+                                 data_parallel_size=1, curriculum=cur,
+                                 difficulty_fn=lambda i: i, shuffle=False)
+        it = iter(s)
+        first = next(it)
+        assert max(first) <= 10  # step 0: only easy samples
+
+
+class TestRandomLTD:
+
+    def test_gather_scatter_roundtrip(self):
+        rng = jax.random.PRNGKey(0)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 16, 8)),
+                        jnp.float32)
+        kept, dropped = random_ltd_indices(rng, 16, 10, 2)
+        assert kept.shape == (2, 10) and dropped.shape == (2, 6)
+        # kept+dropped partition the sequence
+        union = np.sort(np.concatenate([np.asarray(kept), np.asarray(dropped)], axis=1))
+        np.testing.assert_array_equal(union, np.tile(np.arange(16), (2, 1)))
+
+        sub = random_ltd_gather(x, kept)
+        assert sub.shape == (2, 10, 8)
+        out = random_ltd_scatter(x, sub * 2.0, kept)
+        # kept positions doubled, dropped untouched
+        for b in range(2):
+            np.testing.assert_allclose(np.asarray(out[b, np.asarray(kept[b])]),
+                                       np.asarray(x[b, np.asarray(kept[b])]) * 2)
+            np.testing.assert_allclose(np.asarray(out[b, np.asarray(dropped[b])]),
+                                       np.asarray(x[b, np.asarray(dropped[b])]))
+
+    def test_scheduler_ramp(self):
+        s = RandomLTDScheduler({"schedule": {
+            "min_value": 64, "max_value": 256, "step_size": 16,
+            "total_layer_token_steps": 100}})
+        assert s.update_seq(0) == 64
+        mid = s.update_seq(50)
+        assert 64 < mid < 256 and mid % 16 == 0
+        assert s.update_seq(100) == 256
+
+
+class TestEngineCurriculum:
+
+    def test_seqlen_truncation_schedule(self):
+        m = gpt2_model("gpt2-tiny", max_seq_len=32, vocab_size=128, remat=False)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "curriculum_learning": {
+                "enabled": True, "curriculum_type": "fixed_linear",
+                "min_difficulty": 8, "max_difficulty": 32,
+                "schedule_config": {"total_curriculum_step": 4,
+                                    "difficulty_step": 8}},
+        })
+        b = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 32))}
+        trunc = eng._apply_curriculum(b)
+        assert trunc["input_ids"].shape == (8, 8)  # step 0 -> min difficulty
+        loss = eng.train_batch(b)
+        assert np.isfinite(float(loss))
+        eng.global_steps = 100
+        trunc = eng._apply_curriculum(b)
+        assert trunc["input_ids"].shape == (8, 32)  # fully ramped

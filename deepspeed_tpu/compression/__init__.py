@@ -1,0 +1,4 @@
+from .compress import CompressionManager, init_compression, redundancy_clean, student_initialization  # noqa: F401
+from .ops import (fake_quantize_ste, head_prune_mask, magnitude_prune_mask,  # noqa: F401
+                  row_prune_mask)
+from .scheduler import CompressionScheduler  # noqa: F401

@@ -1,0 +1,182 @@
+"""Config-driven model compression.
+
+Counterpart of the reference ``compression/compress.py``
+(``init_compression`` :100, ``redundancy_clean`` :148,
+``student_initialization`` :192). The reference rewrites torch modules in
+place; here compression is a *pytree transform pipeline*: ``init_compression``
+parses the ``compression_training`` config into a :class:`CompressionManager`
+whose ``compress_params`` maps a param tree through fake-quant + pruning
+masks (applied during training under the scheduler's gating), and
+``redundancy_clean`` makes the zeros/quantization permanent for deployment.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .ops import fake_quantize_ste, head_prune_mask, magnitude_prune_mask, row_prune_mask
+
+_MATMUL_KEYS = ("kernel", "embedding", "wi", "wo", "wi_gate", "wi_up")
+
+
+def _leaf_name(path) -> str:
+    return ".".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def _group_cfg(section: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Reference config shape: {shared_parameters: {...}, different_groups:
+    {name: {params: {...}, modules: [patterns]}}}. Returns merged per-group
+    match list or None when disabled."""
+    if not section or not section.get("shared_parameters", {}).get("enabled",
+                                                                   section.get("enabled", False)):
+        return None
+    shared = section.get("shared_parameters", {})
+    groups = []
+    for name, g in section.get("different_groups", {}).items():
+        groups.append({
+            "name": name,
+            "modules": g.get("modules", ["*"]),
+            "params": g.get("params", {}),
+        })
+    if not groups:
+        groups.append({"name": "default", "modules": ["*"], "params": {}})
+    return {"shared": shared, "groups": groups}
+
+
+def _matches(name: str, patterns: List[str]) -> bool:
+    for p in patterns:
+        if p == "*" or re.search(p.replace("*", ".*"), name):
+            return True
+    return False
+
+
+class CompressionManager:
+
+    def __init__(self, config):
+        c = config
+        self.weight_quant = _group_cfg(c.weight_quantization)
+        self.act_quant = _group_cfg(c.activation_quantization)
+        self.sparse = _group_cfg(c.sparse_pruning)
+        self.row = _group_cfg(c.row_pruning)
+        self.head = _group_cfg(c.head_pruning)
+        self.layer_reduction = c.layer_reduction if c.layer_reduction.get("enabled") else None
+        self._masks: Dict[str, jax.Array] = {}
+
+    # -- weight transforms ---------------------------------------------------
+    def compress_params(self, params: Any, quant_enabled: bool = True,
+                        prune_enabled: bool = True) -> Any:
+        """Differentiable compression pass for QAT training (fake-quant with
+        STE + mask multiply). Use inside the loss: model.loss(cm.compress_
+        params(params), batch)."""
+
+        def transform(path, leaf):
+            name = _leaf_name(path)
+            if not any(k in name for k in _MATMUL_KEYS) or leaf.ndim < 2:
+                return leaf
+            x = leaf
+            if prune_enabled and name in self._masks:
+                x = x * self._masks[name].astype(x.dtype)
+            if quant_enabled and self.weight_quant is not None:
+                for g in self.weight_quant["groups"]:
+                    if _matches(name, g["modules"]):
+                        bits = g["params"].get("start_bits",
+                                               g["params"].get("bits", 8))
+                        x = fake_quantize_ste(x, num_bits=int(bits))
+                        break
+            return x
+
+        return jax.tree_util.tree_map_with_path(transform, params)
+
+    def update_masks(self, params: Any, num_heads: Optional[int] = None) -> int:
+        """(Re)compute pruning masks from current magnitudes — the reference
+        recomputes at schedule offsets (snip_momentum variant re-ranks)."""
+        self._masks.clear()
+        count = 0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+            name = _leaf_name(path)
+            if not any(k in name for k in _MATMUL_KEYS) or np.ndim(leaf) < 2:
+                continue
+            mask = None
+            if self.sparse is not None:
+                for g in self.sparse["groups"]:
+                    if _matches(name, g["modules"]):
+                        ratio = g["params"].get("dense_ratio", 0.5)
+                        mask = magnitude_prune_mask(jnp.asarray(leaf), 1.0 - ratio)
+                        break
+            if self.row is not None and mask is None:
+                for g in self.row["groups"]:
+                    if _matches(name, g["modules"]):
+                        ratio = g["params"].get("dense_ratio", 0.5)
+                        mask = row_prune_mask(jnp.asarray(leaf), 1.0 - ratio)
+                        break
+            if (self.head is not None and mask is None and num_heads
+                    and "o_proj" in name):
+                for g in self.head["groups"]:
+                    if _matches(name, g["modules"]):
+                        ratio = g["params"].get("dense_ratio", 0.5)
+                        mask = head_prune_mask(jnp.asarray(leaf), num_heads,
+                                               1.0 - ratio)
+                        break
+            if mask is not None:
+                self._masks[name] = mask
+                count += 1
+        return count
+
+    # -- activation hook -----------------------------------------------------
+    def quantize_activation(self, x: jax.Array) -> jax.Array:
+        if self.act_quant is None:
+            return x
+        bits = self.act_quant["groups"][0]["params"].get("bits", 8)
+        return fake_quantize_ste(x, num_bits=int(bits), symmetric=False)
+
+
+def init_compression(params_or_engine, config) -> CompressionManager:
+    """Reference compress.py:100. Accepts an engine (uses its config) or a
+    bare CompressionConfig/dict."""
+    from ..runtime.config import DeepSpeedConfig
+    if hasattr(params_or_engine, "config"):
+        cfg = params_or_engine.config.compression_config
+    elif isinstance(config, dict):
+        from ..runtime.config import CompressionConfig
+        cfg = CompressionConfig(**config)
+    else:
+        cfg = config
+    return CompressionManager(cfg)
+
+
+def redundancy_clean(params: Any, manager: CompressionManager,
+                     num_heads: Optional[int] = None) -> Any:
+    """Make compression permanent for deployment (reference compress.py:148):
+    bake masks and quantization into the weights (no STE)."""
+    manager.update_masks(params, num_heads=num_heads)
+    return manager.compress_params(params)
+
+
+def student_initialization(student_params: Any, teacher_params: Any,
+                           layer_map: List[int]) -> Any:
+    """Layer-reduction distillation init (reference compress.py:192 +
+    ``layer_reduction`` config): student layer i copies teacher layer
+    ``layer_map[i]``; stacked-block layout means this is an index-select on
+    the leading layer dim."""
+    idx = jnp.asarray(layer_map)
+
+    def pick(s_leaf, t_leaf):
+        if s_leaf.ndim >= 1 and t_leaf.ndim == s_leaf.ndim \
+                and s_leaf.shape[0] == len(layer_map) \
+                and t_leaf.shape[1:] == s_leaf.shape[1:]:
+            return jnp.take(jnp.asarray(t_leaf), idx, axis=0)
+        return jnp.asarray(t_leaf) if t_leaf.shape == s_leaf.shape else s_leaf
+
+    out = dict(student_params)
+    for key in student_params:
+        if key == "blocks":
+            out["blocks"] = jax.tree.map(pick, student_params["blocks"],
+                                         teacher_params["blocks"])
+        elif key in teacher_params:
+            out[key] = jax.tree.map(pick, student_params[key], teacher_params[key])
+    return out

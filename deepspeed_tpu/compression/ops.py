@@ -1,0 +1,77 @@
+"""Compression primitives.
+
+Counterpart of the reference ``compression/basic_layer.py`` +
+``compression/utils.py`` (QuantAct / LinearLayer_Compress quant & prune
+internals): quantization-aware-training fake-quant with a straight-through
+estimator, and magnitude/structured pruning masks. Pure jnp — on TPU these
+fuse into the surrounding matmuls; the STE is the standard
+``x + stop_gradient(q(x) - x)`` identity-gradient trick the reference gets
+from torch autograd Functions.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def fake_quantize_ste(x: jax.Array, num_bits: int = 8, symmetric: bool = True,
+                      per_channel_dim: Optional[int] = None) -> jax.Array:
+    """QAT fake quantization with straight-through gradients.
+
+    Forward: quantize-dequantize; backward: identity (reference
+    ``SymQuantizer``/``AsymQuantizer`` autograd Functions)."""
+    qmax = float((1 << (num_bits - 1)) - 1)
+    if per_channel_dim is not None:
+        axes = tuple(i for i in range(x.ndim) if i != per_channel_dim)
+    else:
+        axes = tuple(range(x.ndim))
+    if symmetric:
+        absmax = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+        scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+        q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax) * scale
+    else:
+        lo = jnp.min(x, axis=axes, keepdims=True)
+        hi = jnp.max(x, axis=axes, keepdims=True)
+        scale = jnp.where(hi == lo, 1.0, (hi - lo) / (2 * qmax + 1))
+        zero = jnp.round(-lo / scale)
+        q = (jnp.clip(jnp.round(x / scale + zero), 0, 2 * qmax + 1) - zero) * scale
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def magnitude_prune_mask(w: jax.Array, sparsity: float) -> jax.Array:
+    """Unstructured magnitude mask (reference sparse_pruning 'l1' method):
+    zero the smallest |w| fraction."""
+    if sparsity <= 0:
+        return jnp.ones_like(w, dtype=jnp.bool_)
+    k = int(w.size * (1.0 - sparsity))
+    if k <= 0:
+        return jnp.zeros_like(w, dtype=jnp.bool_)
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[-k]
+    return jnp.abs(w) >= thresh
+
+
+def row_prune_mask(w: jax.Array, sparsity: float, dim: int = -1) -> jax.Array:
+    """Structured row/channel mask by L1 norm over ``dim``'s complement
+    (reference row_pruning)."""
+    axes = tuple(i for i in range(w.ndim) if i != (dim % w.ndim))
+    norms = jnp.sum(jnp.abs(w), axis=axes, keepdims=True)
+    n = norms.size
+    k = max(1, int(n * (1.0 - sparsity)))
+    thresh = jnp.sort(norms.reshape(-1))[-k]
+    return jnp.broadcast_to(norms >= thresh, w.shape)
+
+
+def head_prune_mask(w_o: jax.Array, num_heads: int, sparsity: float) -> jax.Array:
+    """Attention-head mask for the output projection [H*D, out] (reference
+    head_pruning: rank heads by the L1 norm of their o_proj slice)."""
+    in_dim = w_o.shape[-2]
+    head_dim = in_dim // num_heads
+    heads = w_o.reshape(w_o.shape[:-2] + (num_heads, head_dim, w_o.shape[-1]))
+    norms = jnp.sum(jnp.abs(heads), axis=(-2, -1))           # [..., H]
+    k = max(1, int(num_heads * (1.0 - sparsity)))
+    thresh = jnp.sort(norms.reshape(-1))[-k]
+    mask = (norms >= thresh)[..., :, None, None]
+    return jnp.broadcast_to(mask, heads.shape).reshape(w_o.shape)

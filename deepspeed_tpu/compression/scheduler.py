@@ -1,0 +1,41 @@
+"""Compression scheduling.
+
+Counterpart of the reference ``compression/scheduler.py``: gates each
+compression feature by schedule offset (step ranges) so quantization/pruning
+ramp in during training rather than from step 0.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+class CompressionScheduler:
+
+    def __init__(self, manager, config: Dict[str, Any] = None):
+        self.manager = manager
+        cfg = config or {}
+        self.quant_offset = cfg.get("quantize_offset", cfg.get("schedule_offset", 0))
+        self.prune_offset = cfg.get("prune_offset", cfg.get("schedule_offset", 0))
+        self.mask_refresh_interval = cfg.get("mask_refresh_interval", 100)
+        self._last_mask_step = -1
+
+    def quant_enabled(self, step: int) -> bool:
+        return step >= self.quant_offset
+
+    def prune_enabled(self, step: int) -> bool:
+        return step >= self.prune_offset
+
+    def step(self, params, step: int, num_heads=None) -> None:
+        """Refresh pruning masks at interval boundaries past the offset."""
+        if (self.prune_enabled(step)
+                and (self._last_mask_step < 0
+                     or step - self._last_mask_step >= self.mask_refresh_interval)):
+            self.manager.update_masks(params, num_heads=num_heads)
+            self._last_mask_step = step
+
+    def compress(self, params, step: int):
+        return self.manager.compress_params(
+            params,
+            quant_enabled=self.quant_enabled(step),
+            prune_enabled=self.prune_enabled(step))

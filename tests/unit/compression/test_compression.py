@@ -1,0 +1,122 @@
+"""Compression tests (reference tests/unit/compression/test_compression.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.compression import (CompressionManager, CompressionScheduler,
+                                       fake_quantize_ste, init_compression,
+                                       magnitude_prune_mask, redundancy_clean,
+                                       row_prune_mask, student_initialization)
+from deepspeed_tpu.models import llama_model
+from deepspeed_tpu.runtime.config import CompressionConfig
+
+
+class TestQuantOps:
+
+    def test_fake_quant_roundtrip_error_bounded(self):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(64, 64)), jnp.float32)
+        q = fake_quantize_ste(x, num_bits=8)
+        step = float(jnp.max(jnp.abs(x))) / 127
+        assert float(jnp.max(jnp.abs(q - x))) <= step
+
+    def test_ste_gradient_is_identity(self):
+        x = jnp.linspace(-1, 1, 32)
+        g = jax.grad(lambda v: jnp.sum(fake_quantize_ste(v, 4) ** 2))(x)
+        # d/dx sum(q(x)^2) with STE = 2*q(x) (identity through quantizer)
+        np.testing.assert_allclose(np.asarray(g),
+                                   2 * np.asarray(fake_quantize_ste(x, 4)),
+                                   rtol=1e-5)
+
+    def test_prune_masks_sparsity(self):
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.normal(size=(32, 64)), jnp.float32)
+        m = magnitude_prune_mask(w, 0.75)
+        assert abs(float(jnp.mean(m.astype(jnp.float32))) - 0.25) < 0.02
+        r = row_prune_mask(w, 0.5, dim=-1)
+        # whole columns zeroed
+        col = np.asarray(r).all(axis=0) | (~np.asarray(r)).all(axis=0)
+        assert col.all()
+
+
+class TestManager:
+
+    def _cfg(self, **kw):
+        base = {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {
+                    "wq": {"params": {"start_bits": 8}, "modules": ["*"]}}},
+            "sparse_pruning": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {
+                    "sp": {"params": {"dense_ratio": 0.5}, "modules": ["q_proj"]}}},
+        }
+        base.update(kw)
+        return CompressionConfig(**base)
+
+    def test_compress_params_quantizes_matmuls_only(self):
+        model = llama_model("llama2-tiny", dtype=jnp.float32, remat=False)
+        params = model.init(jax.random.PRNGKey(0), jnp.float32)
+        cm = CompressionManager(self._cfg())
+        out = cm.compress_params(params)
+        # norm scales untouched; kernels changed
+        np.testing.assert_array_equal(np.asarray(out["ln_f"]["scale"]),
+                                      np.asarray(params["ln_f"]["scale"]))
+        assert not np.allclose(np.asarray(out["blocks"]["q_proj"]["kernel"]),
+                               np.asarray(params["blocks"]["q_proj"]["kernel"]))
+
+    def test_masks_match_patterns(self):
+        model = llama_model("llama2-tiny", dtype=jnp.float32, remat=False)
+        params = model.init(jax.random.PRNGKey(0), jnp.float32)
+        cm = CompressionManager(self._cfg())
+        n = cm.update_masks(params)
+        assert n == 1  # only q_proj matched
+        out = cm.compress_params(params, quant_enabled=False)
+        q = np.asarray(out["blocks"]["q_proj"]["kernel"])
+        assert (q == 0).mean() > 0.4  # ~50% pruned
+        k = np.asarray(out["blocks"]["k_proj"]["kernel"])
+        assert (k == 0).mean() < 0.05
+
+    def test_redundancy_clean_loss_still_finite(self):
+        model = llama_model("llama2-tiny", dtype=jnp.float32, remat=False)
+        params = model.init(jax.random.PRNGKey(0), jnp.float32)
+        cm = init_compression(None, {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True},
+                "different_groups": {"g": {"params": {"bits": 8},
+                                           "modules": ["*"]}}}})
+        cleaned = redundancy_clean(params, cm)
+        batch = {"input_ids": np.random.default_rng(0).integers(0, 1024, size=(2, 16))}
+        loss = model.loss(cleaned, batch)
+        assert np.isfinite(float(loss))
+
+    def test_scheduler_gates_offsets(self):
+        cm = CompressionManager(self._cfg())
+        sched = CompressionScheduler(cm, {"quantize_offset": 10, "prune_offset": 5})
+        assert not sched.quant_enabled(9) and sched.quant_enabled(10)
+        assert not sched.prune_enabled(4) and sched.prune_enabled(5)
+
+
+class TestStudentInit:
+
+    def test_layer_map_copies_teacher_layers(self):
+        teacher = llama_model("llama2-tiny", dtype=jnp.float32, remat=False,
+                              num_layers=4)
+        student = llama_model("llama2-tiny", dtype=jnp.float32, remat=False,
+                              num_layers=2)
+        tp = teacher.init(jax.random.PRNGKey(0), jnp.float32)
+        sp = student.init(jax.random.PRNGKey(1), jnp.float32)
+        out = student_initialization(sp, tp, layer_map=[1, 3])
+        np.testing.assert_array_equal(
+            np.asarray(out["blocks"]["q_proj"]["kernel"][0]),
+            np.asarray(tp["blocks"]["q_proj"]["kernel"][1]))
+        np.testing.assert_array_equal(
+            np.asarray(out["blocks"]["q_proj"]["kernel"][1]),
+            np.asarray(tp["blocks"]["q_proj"]["kernel"][3]))
+        # embeddings copied wholesale
+        np.testing.assert_array_equal(np.asarray(out["wte"]["embedding"]),
+                                      np.asarray(tp["wte"]["embedding"]))

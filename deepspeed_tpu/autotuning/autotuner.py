@@ -1,0 +1,137 @@
+"""Configuration autotuning.
+
+Counterpart of the reference ``autotuning/autotuner.py`` (``Autotuner`` :42,
+``tune`` :404, ``model_info_profile_run`` :663) + ``tuner/`` (grid/random/
+model-based): search the ZeRO-stage × micro-batch space by running short
+profiling experiments and keeping the best throughput.
+
+The reference launches each experiment as a separate multi-GPU job through
+the launcher and parses logs; on TPU an experiment is an in-process engine
+construction + a few timed ``train_batch`` calls (compilation cached per
+config). The model-based pruning step estimates per-chip memory from the
+ZeRO stage exactly like the reference's cost model and skips configs that
+cannot fit.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class Autotuner:
+
+    def __init__(self,
+                 model_fn: Callable[[], Any],
+                 base_config: Dict[str, Any],
+                 batch_fn: Callable[[int], Dict[str, np.ndarray]],
+                 zero_stages: Sequence[int] = (0, 1, 2, 3),
+                 micro_batch_sizes: Optional[Sequence[int]] = None,
+                 mode: str = "model_based",      # 'grid' | 'random' | 'model_based'
+                 max_trials: int = 16,
+                 warmup_steps: int = 1,
+                 measure_steps: int = 3,
+                 memory_budget_bytes: Optional[int] = None,
+                 seed: int = 0):
+        self.model_fn = model_fn
+        self.base_config = base_config
+        self.batch_fn = batch_fn
+        self.zero_stages = list(zero_stages)
+        self.micro_batch_sizes = list(micro_batch_sizes or [1, 2, 4, 8])
+        self.mode = mode
+        self.max_trials = max_trials
+        self.warmup_steps = warmup_steps
+        self.measure_steps = measure_steps
+        self.memory_budget_bytes = memory_budget_bytes
+        self._rng = np.random.default_rng(seed)
+        self.results: List[Dict[str, Any]] = []
+
+    # -- reference model_info_profile_run (autotuner.py:663) -----------------
+    def model_info_profile_run(self) -> Dict[str, Any]:
+        model = self.model_fn()
+        n_params = model.config.num_parameters()
+        return {"num_params": n_params,
+                "param_bytes_bf16": 2 * n_params,
+                "optimizer_bytes_fp32": 12 * n_params}  # master + m + v
+
+    def _estimated_bytes_per_chip(self, stage: int, micro_batch: int,
+                                  dp: int) -> int:
+        """Reference model-based tuner cost model: ZeRO stage decides which
+        state is divided by dp."""
+        info = self.model_info_profile_run()
+        p, o = info["param_bytes_bf16"], info["optimizer_bytes_fp32"]
+        grad = 2 * info["num_params"]
+        if stage == 0:
+            fixed = p + grad + o
+        elif stage == 1:
+            fixed = p + grad + o // dp
+        elif stage == 2:
+            fixed = p + (grad + o) // dp
+        else:
+            fixed = (p + grad + o) // dp
+        act = micro_batch * 4 * info["num_params"] // max(
+            getattr(self.model_fn(), "config").num_layers, 1) // 100
+        return fixed + act
+
+    def _candidates(self) -> List[Tuple[int, int]]:
+        grid = list(itertools.product(self.zero_stages, self.micro_batch_sizes))
+        if self.mode == "random":
+            self._rng.shuffle(grid)
+        elif self.mode == "model_based" and self.memory_budget_bytes:
+            import jax
+            dp = max(1, len(jax.devices()))
+            kept = [(s, b) for s, b in grid
+                    if self._estimated_bytes_per_chip(s, b, dp) <= self.memory_budget_bytes]
+            pruned = len(grid) - len(kept)
+            if pruned:
+                logger.info(f"autotuner: pruned {pruned} configs by memory model")
+            grid = kept
+        return grid[:self.max_trials]
+
+    def run_experiment(self, stage: int, micro_batch: int) -> Dict[str, Any]:
+        """One short profiling run (the reference's launched experiment)."""
+        import jax
+
+        import deepspeed_tpu
+        config = dict(self.base_config)
+        config["train_micro_batch_size_per_gpu"] = micro_batch
+        config.setdefault("zero_optimization", {})
+        config = {**config, "zero_optimization":
+                  {**config["zero_optimization"], "stage": stage}}
+        exp = {"zero_stage": stage, "micro_batch": micro_batch, "config": config}
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_fn(),
+                                                       config=config)
+            dp = engine.topology.data_parallel_size
+            batch = self.batch_fn(micro_batch * dp)
+            for _ in range(self.warmup_steps):
+                jax.block_until_ready(engine.train_batch(batch))
+            t0 = time.perf_counter()
+            for _ in range(self.measure_steps):
+                loss = engine.train_batch(batch)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            samples = micro_batch * dp * self.measure_steps \
+                * engine.gradient_accumulation_steps
+            exp.update({"status": "ok", "samples_per_sec": samples / dt,
+                        "loss": float(loss)})
+        except Exception as e:
+            exp.update({"status": f"error: {e}", "samples_per_sec": 0.0})
+        return exp
+
+    def tune(self) -> Dict[str, Any]:
+        """Search; returns the best experiment record (reference tune :404)."""
+        best = None
+        for stage, mb in self._candidates():
+            exp = self.run_experiment(stage, mb)
+            self.results.append(exp)
+            logger.info(f"autotuner: stage={stage} mb={mb} -> "
+                        f"{exp['samples_per_sec']:.1f} samples/s ({exp['status']})")
+            if best is None or exp["samples_per_sec"] > best["samples_per_sec"]:
+                best = exp
+        return best or {}

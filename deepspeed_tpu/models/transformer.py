@@ -28,12 +28,12 @@ from jax.sharding import PartitionSpec as P
 
 from ..nn import layers as nn
 from ..ops.transformer.attention import flash_attention
-from ..runtime.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..runtime.topology import BATCH_AXES, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 from ..sequence.layer import ulysses_attention
 
 Params = Dict[str, Any]
 
-ACT_SPEC = P(DATA_AXIS, SEQ_AXIS, None)  # [batch, seq, hidden]
+ACT_SPEC = P(BATCH_AXES, SEQ_AXIS, None)  # [batch, seq, hidden]
 
 
 def _c(x, spec):
@@ -214,15 +214,22 @@ class TransformerLM:
             out = self._block_layers["fc_out"](block["fc_out"], h2)
         return out, aux
 
-    def _block_fn(self, carry, block: Params):
+    def _block_fn(self, carry, block_and_keep):
+        block, keep = block_and_keep
         x, positions, aux_acc = carry
-        x = x + self._attn(block, x, positions)
+        # keep: per-layer stochastic-depth gate (progressive layer drop,
+        # reference runtime/progressive_layer_drop.py); 1.0 = layer active
+        x = x + keep * self._attn(block, x, positions)
         mlp_out, aux = self._mlp(block, x)
-        x = _c(x + mlp_out, ACT_SPEC)
-        return (x, positions, aux_acc + aux), None
+        x = _c(x + keep * mlp_out, ACT_SPEC)
+        return (x, positions, aux_acc + keep * aux), None
 
-    def apply(self, params: Params, input_ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
-        """Return (logits [B,S,V] in fp32, moe_aux_loss scalar)."""
+    def apply(self, params: Params, input_ids: jax.Array,
+              layer_mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+        """Return (logits [B,S,V] in fp32, moe_aux_loss scalar).
+
+        ``layer_mask`` [num_layers] gates each block (PLD stochastic depth).
+        """
         c = self.config
         positions = jnp.arange(input_ids.shape[1])[None, :]
         x = self._wte(params["wte"], input_ids)
@@ -237,8 +244,12 @@ class TransformerLM:
                 policy = getattr(jax.checkpoint_policies, c.remat_policy)
             block_fn = jax.checkpoint(block_fn, policy=policy)
 
+        if layer_mask is None:
+            keep = jnp.ones((c.num_layers,), c.dtype)
+        else:
+            keep = layer_mask.astype(c.dtype)
         (x, _, aux), _ = jax.lax.scan(block_fn, (x, positions, jnp.zeros((), jnp.float32)),
-                                      params["blocks"])
+                                      (params["blocks"], keep))
         x = self._ln_f(params["ln_f"], x)
         if c.tie_embeddings:
             logits = self._wte.attend(params["wte"], x)
@@ -253,7 +264,8 @@ class TransformerLM:
         labels = batch.get("labels")
         if labels is None:
             labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-        logits, aux = self.apply(params, input_ids)
+        logits, aux = self.apply(params, input_ids,
+                                 layer_mask=batch.get("layer_mask"))
         valid = labels >= 0
         safe_labels = jnp.where(valid, labels, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)

@@ -20,7 +20,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..runtime.topology import DATA_AXIS, EXPERT_AXIS
+from ..runtime.topology import BATCH_AXES, DATA_AXIS, EXPERT_AXIS
 from .sharded_moe import capacity as _capacity, top_k_gating
 
 Params = Dict[str, Any]
@@ -84,7 +84,7 @@ class MoE:
         # dispatch: [tokens, experts, cap] x [tokens, h] → [experts, cap, h]
         expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
         # all-to-all over ICI: expert dim sharded across the expert axis
-        expert_in = _c(expert_in, P(EXPERT_AXIS, DATA_AXIS, None))
+        expert_in = _c(expert_in, P(EXPERT_AXIS, BATCH_AXES, None))
 
         # expert FFN as batched einsum over the (sharded) expert dim
         if self.activation == "silu_gated":
@@ -98,6 +98,6 @@ class MoE:
         expert_out = jnp.einsum("ecf,efh->ech", mid, params["wo"].astype(x.dtype))
 
         # inverse all-to-all + combine back to tokens
-        expert_out = _c(expert_out, P(EXPERT_AXIS, DATA_AXIS, None))
+        expert_out = _c(expert_out, P(EXPERT_AXIS, BATCH_AXES, None))
         out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
         return out.reshape(b, s, h), aux

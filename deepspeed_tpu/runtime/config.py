@@ -113,6 +113,7 @@ class TopologyConfigModel(DeepSpeedConfigModel):
     implicitly from mpu/launcher world layout."""
     pipe: int = 1
     data: int = -1
+    mics: int = 1
     expert: int = 1
     seq: int = 1
     model: int = 1

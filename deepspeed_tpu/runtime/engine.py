@@ -46,10 +46,10 @@ from .fp16.loss_scaler import (dynamic_loss_scale_state, has_overflow, static_lo
                                update_scale)
 from .lr_schedules import build_lr_schedule
 from .optimizers import Optimizer, build_optimizer
-from .topology import DATA_AXIS, MeshTopology, TopologyConfig
+from .topology import BATCH_AXES, DATA_AXIS, MeshTopology, TopologyConfig
 from .zero.partition import ZeroPartitionPlan
 
-DATA_SPEC = P(DATA_AXIS)  # batches shard their leading dim over the data axis
+DATA_SPEC = P(BATCH_AXES)  # batches shard their leading dim over both dp axes
 
 
 class DeepSpeedEngine:
@@ -68,7 +68,8 @@ class DeepSpeedEngine:
             config = DeepSpeedConfig(config_dict or {}, mesh_topology=topology)
         self.config = config
         self.topology = topology or MeshTopology(TopologyConfig(
-            **{k: getattr(config.topology, k) for k in ("pipe", "data", "expert", "seq", "model")}))
+            **{k: getattr(config.topology, k, 1 if k == "mics" else None)
+               for k in ("pipe", "data", "mics", "expert", "seq", "model")}))
         self.model = model
         self.mesh = self.topology.mesh
 
@@ -101,7 +102,8 @@ class DeepSpeedEngine:
         if self.optimizer.name in ("onebit_adam", "onebit_lamb", "zero_one_adam"):
             t = self.topology
             if (t.model_parallel_size * t.sequence_parallel_size
-                    * t.pipe_parallel_size * t.expert_parallel_size) != 1:
+                    * t.pipe_parallel_size * t.expert_parallel_size
+                    * t.mics_shard_size) != 1:
                 raise ValueError("1-bit optimizers support pure data parallelism "
                                  "(the reference's supported regime)")
             self._onebit_opt = self._build_onebit_optimizer(config)
@@ -150,6 +152,16 @@ class DeepSpeedEngine:
             from .data_pipeline.curriculum_scheduler import CurriculumScheduler
             self.curriculum_scheduler = CurriculumScheduler(
                 config.curriculum_params_legacy)
+
+        # progressive layer drop (reference engine.py:339: PLD theta fed into
+        # forward kwargs; here a per-layer keep mask through the scan)
+        self.progressive_layer_drop = None
+        pld_cfg = getattr(config, "_param_dict", {}).get("progressive_layer_drop", {})
+        if pld_cfg.get("enabled"):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
+            self._pld_rng = np.random.default_rng(seed)
 
         from .. import comm as dist
         if config.comms_logger_enabled:
@@ -403,7 +415,8 @@ class DeepSpeedEngine:
             return gacc, jax.lax.pmean(loss, AX)
 
         def micro_step(state, batch):
-            batch_sp = jax.tree.map(lambda _: P(AX), batch)
+            batch_sp = {k: (P() if k in self._REPLICATED_BATCH_KEYS else P(AX))
+                        for k in batch}
             sm = shard_map(local_micro, mesh=mesh,
                            in_specs=(p_rep, gacc_sp, P(), batch_sp),
                            out_specs=(gacc_sp, P()), check_vma=False)
@@ -474,10 +487,9 @@ class DeepSpeedEngine:
         rep = NamedSharding(self.mesh, P())
         if self._onebit_opt is not None:
             micro_step, apply_step = self._build_onebit_jits(shardings, rep)
-            batch_sharding = NamedSharding(self.mesh, DATA_SPEC)
             self._jit_micro_step = jax.jit(
                 micro_step, donate_argnums=(0,),
-                in_shardings=(shardings, batch_sharding),
+                in_shardings=(shardings, None),
                 out_shardings=(shardings, rep))
             self._jit_apply_step = jax.jit(
                 apply_step, donate_argnums=(0,),
@@ -485,11 +497,13 @@ class DeepSpeedEngine:
                 out_shardings=(shardings, rep, rep))
             return
         if self._jit_micro_step is None:
-            batch_sharding = NamedSharding(self.mesh, DATA_SPEC)
+            # batch in_shardings None: inherit _device_batch placement (data
+            # leaves sharded over BATCH_AXES, aux leaves like layer_mask
+            # replicated)
             self._jit_micro_step = jax.jit(
                 self._micro_step_fn,
                 donate_argnums=(0,),
-                in_shardings=(shardings, batch_sharding),
+                in_shardings=(shardings, None),
                 out_shardings=(shardings, rep),
             )
         if self._jit_apply_step is None:
@@ -503,9 +517,14 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # public API (reference engine.py forward :1781 / backward :1922 / step :2120)
     # ------------------------------------------------------------------
+    _REPLICATED_BATCH_KEYS = ("layer_mask",)  # per-layer/global aux inputs
+
     def _device_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
         sharding = NamedSharding(self.mesh, DATA_SPEC)
-        return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding), batch)
+        rep = NamedSharding(self.mesh, P())
+        return {k: jax.device_put(jnp.asarray(v),
+                                  rep if k in self._REPLICATED_BATCH_KEYS else sharding)
+                for k, v in batch.items()}
 
     def _apply_curriculum(self, batch: Dict[str, Any]) -> Dict[str, Any]:
         """Truncate sequences to the scheduled difficulty (reference
@@ -525,6 +544,11 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
+        if self.progressive_layer_drop is not None and "layer_mask" not in batch:
+            self.progressive_layer_drop.update_state(self.global_steps)
+            batch = dict(batch)
+            batch["layer_mask"] = self.progressive_layer_drop.layer_mask(
+                self._pld_rng, self.model.config.num_layers)
         batch = self._device_batch(batch)
         with self.mesh:
             self.state, loss = self._jit_micro_step(self.state, batch)

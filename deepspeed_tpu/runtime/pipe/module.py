@@ -74,7 +74,7 @@ class PipelineModule:
     def _stage_fn(self, stage_blocks, x, positions):
         """Run this stage's layer slice (a scan like the dense model)."""
         def block_fn(carry, block):
-            return self._lm._block_fn(carry, block)
+            return self._lm._block_fn(carry, (block, jnp.asarray(1.0, self.config.dtype)))
         if self.config.remat:
             policy = None
             if self.config.remat_policy and self.config.remat_policy not in ("full", "nothing_saveable"):
@@ -84,7 +84,10 @@ class PipelineModule:
             block_fn, (x, positions, jnp.zeros((), jnp.float32)), stage_blocks)
         return x, aux
 
-    def apply(self, params: Dict[str, Any], input_ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def apply(self, params: Dict[str, Any], input_ids: jax.Array,
+              layer_mask=None) -> Tuple[jax.Array, jax.Array]:
+        assert layer_mask is None, \
+            "progressive layer drop is not supported under pipeline parallelism"
         c = self.config
         M, S = self.num_microbatches, input_ids.shape[1]
         B = input_ids.shape[0]

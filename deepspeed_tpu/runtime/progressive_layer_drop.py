@@ -1,0 +1,45 @@
+"""Progressive layer dropping.
+
+Counterpart of the reference ``runtime/progressive_layer_drop.py``
+(``ProgressiveLayerDrop``; engine wiring engine.py:339,1814): the keep
+probability theta(t) ramps from 1 down to ``theta`` with schedule
+``theta + (1-theta) * gamma_schedule``, and the model stochastically skips
+transformer blocks with prob 1-theta_t (stochastic depth). The model-side
+mechanism is a per-layer Bernoulli mask fed through the scan (see
+``TransformerLM.loss`` ``layer_mask`` support).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        """theta(t) = (1-theta)*exp(-gamma*t) + theta (reference's schedule)."""
+        self.current_theta = (1.0 - self.theta) * math.exp(
+            -self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def get_state(self) -> Dict[str, Any]:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def layer_mask(self, rng: np.random.Generator, num_layers: int) -> np.ndarray:
+        """Sample per-layer keep mask; layer i keeps with prob
+        theta_i interpolated from 1 (first layer) to theta_t (last) — the
+        depth-weighted keep schedule of stochastic depth that PLD uses."""
+        probs = 1.0 - (1.0 - self.current_theta) * (
+            np.arange(1, num_layers + 1) / num_layers)
+        return (rng.random(num_layers) < probs).astype(np.float32)

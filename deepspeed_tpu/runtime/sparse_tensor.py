@@ -1,0 +1,62 @@
+"""Sparse gradient representation.
+
+Counterpart of the reference ``runtime/sparse_tensor.py`` (``SparseTensor``)
++ the engine's ``sparse_allreduce`` (engine.py:2462): embedding-style
+gradients carried as (indices, values) and synchronized by gathering both
+across data-parallel ranks instead of all-reducing the dense form.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class SparseTensor:
+
+    def __init__(self, indices: jnp.ndarray, values: jnp.ndarray,
+                 dense_shape: Tuple[int, ...]):
+        self.indices = jnp.asarray(indices)
+        self.values = jnp.asarray(values)
+        self.dense_shape = tuple(dense_shape)
+
+    @classmethod
+    def from_dense(cls, x, size: int = None) -> "SparseTensor":
+        """Rows with any nonzero become (index, row) pairs (embedding-grad
+        pattern: a batch touches few vocabulary rows). Under jit/shard_map
+        ``size`` (max nonzero rows) must be given — the static-shape bound,
+        like the reference's bucket sizes; padding uses out-of-range indices
+        that ``to_dense`` drops."""
+        x = jnp.asarray(x)
+        nz = jnp.any(x != 0, axis=tuple(range(1, x.ndim)))
+        idx = jnp.nonzero(nz, size=size, fill_value=x.shape[0])[0]
+        vals = jnp.where((idx < x.shape[0])[(...,) + (None,) * (x.ndim - 1)],
+                         x[jnp.clip(idx, 0, x.shape[0] - 1)], 0)
+        return cls(idx, vals, x.shape)
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values, mode="drop")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.indices.shape[0])
+
+    def sparse_size(self) -> int:
+        return self.values.size + self.indices.size
+
+    def dense_size(self) -> int:
+        return int(np.prod(self.dense_shape))
+
+
+def sparse_allreduce(st: SparseTensor, axis: str) -> SparseTensor:
+    """Average sparse grads over a mesh axis by gathering indices+values
+    (reference ``sparse_allreduce_bucket``, engine.py:2462). Call inside
+    shard_map; duplicate indices resolve additively at densify time."""
+    n = jax.lax.axis_size(axis)
+    idx = jax.lax.all_gather(st.indices, axis, axis=0, tiled=True)
+    vals = jax.lax.all_gather(st.values / n, axis, axis=0, tiled=True)
+    return SparseTensor(idx, vals, st.dense_shape)

@@ -6,7 +6,7 @@ TPU-native counterpart of the reference's process-group machinery
 parallel dimension, we build ONE ``jax.sharding.Mesh`` whose named axes *are*
 the groups:
 
-    ('pipe', 'data', 'expert', 'seq', 'model')
+    ('pipe', 'data', 'mics', 'expert', 'seq', 'model')
 
 - ``model``  : tensor parallelism (reference: mpu model-parallel group) —
   innermost so TP collectives ride nearest-neighbor ICI links.
@@ -14,6 +14,11 @@ the groups:
 - ``expert`` : expert parallelism (reference ``_create_expert_and_data_parallel``
   ``groups.py:113``). Non-expert parameters treat it as extra data parallelism.
 - ``data``   : the outer data-parallel axis (expert-data-parallel in MoE terms).
+- ``mics``   : MiCS sub-group axis (reference ``zero/mics.py:62``): size 1
+  normally; with ``mics_shard_size`` ZeRO states shard over THIS axis only,
+  so shards stay inside a sub-group (intra-ICI) and are replicated across
+  ``data`` groups — the hierarchical-allgather layout of MiCS. Batches and
+  gradient sync always span ``('data','mics')``.
 - ``pipe``   : pipeline stages (reference ``PipelineParallelGrid``).
 
 The *effective* data-parallel group of a non-expert parameter is the compound
@@ -35,15 +40,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 PIPE_AXIS = "pipe"
 DATA_AXIS = "data"
+MICS_AXIS = "mics"
 EXPERT_AXIS = "expert"
 SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 
-MESH_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+MESH_AXES: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+# Batch leading-dim sharding spans both data-parallel axes.
+BATCH_AXES: Tuple[str, ...] = (DATA_AXIS, MICS_AXIS)
 
 # Compound axes used for gradient sync / ZeRO partitioning.
-DENSE_GRAD_AXES: Tuple[str, ...] = (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS)
-EXPERT_GRAD_AXES: Tuple[str, ...] = (DATA_AXIS, SEQ_AXIS)
+DENSE_GRAD_AXES: Tuple[str, ...] = (DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS)
+EXPERT_GRAD_AXES: Tuple[str, ...] = (DATA_AXIS, MICS_AXIS, SEQ_AXIS)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -52,18 +61,19 @@ class TopologyConfig:
     covers all available devices (only ``data`` may be inferred)."""
     pipe: int = 1
     data: int = -1
+    mics: int = 1
     expert: int = 1
     seq: int = 1
     model: int = 1
 
     def resolve(self, n_devices: int) -> "TopologyConfig":
-        known = self.pipe * self.expert * self.seq * self.model
+        known = self.pipe * self.mics * self.expert * self.seq * self.model
         data = self.data
         if data == -1:
             if n_devices % known != 0:
                 raise ValueError(
                     f"Cannot infer data-parallel degree: {n_devices} devices not divisible "
-                    f"by pipe*expert*seq*model={known}")
+                    f"by pipe*mics*expert*seq*model={known}")
             data = n_devices // known
         total = known * data
         if total != n_devices:
@@ -81,7 +91,8 @@ class MeshTopology:
         devices = list(devices) if devices is not None else jax.devices()
         config = (config or TopologyConfig()).resolve(len(devices))
         self.config = config
-        shape = (config.pipe, config.data, config.expert, config.seq, config.model)
+        shape = (config.pipe, config.data, config.mics, config.expert, config.seq,
+                 config.model)
         self._mesh = Mesh(self._device_grid(devices, shape), MESH_AXES)
 
     @staticmethod
@@ -121,6 +132,10 @@ class MeshTopology:
         return self.axis_size(DENSE_GRAD_AXES)
 
     @property
+    def mics_shard_size(self) -> int:
+        return self.axis_size(MICS_AXIS)
+
+    @property
     def expert_parallel_size(self) -> int:
         return self.axis_size(EXPERT_AXIS)
 
@@ -142,8 +157,8 @@ class MeshTopology:
 
     def __repr__(self) -> str:
         c = self.config
-        return (f"MeshTopology(pipe={c.pipe}, data={c.data}, expert={c.expert}, "
-                f"seq={c.seq}, model={c.model})")
+        return (f"MeshTopology(pipe={c.pipe}, data={c.data}, mics={c.mics}, "
+                f"expert={c.expert}, seq={c.seq}, model={c.model})")
 
 
 _TOPOLOGY: Optional[MeshTopology] = None

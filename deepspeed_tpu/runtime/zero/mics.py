@@ -1,0 +1,36 @@
+"""MiCS: Minimal-interference Communication Sharding.
+
+Counterpart of the reference ``runtime/zero/mics.py`` (``MiCS_Init`` :62,
+``MiCS_Optimizer`` :342, hierarchical all-gather ``MiCS_AllGatherCoalescedHandle``
+:32): ZeRO-3 with sharding confined to sub-groups of ``mics_shard_size``
+ranks, replicated across groups, so parameter all-gathers traverse only the
+fast intra-group fabric.
+
+TPU-native form: the sub-group IS the ``mics`` mesh axis
+(``runtime/topology.py``); :class:`ZeroPartitionPlan` confines partitioning
+specs to that axis when ``mics_shard_size`` is set, and XLA's SPMD
+partitioner emits intra-group all-gathers plus the cross-group gradient
+reduction — the two-level communication pattern the reference implements by
+hand. This module provides the reference-named entry points.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..topology import MeshTopology, TopologyConfig
+
+
+def mics_topology(shard_size: int, model: int = 1, seq: int = 1,
+                  expert: int = 1, pipe: int = 1) -> MeshTopology:
+    """Build a mesh whose ``mics`` axis is the MiCS sub-group
+    (reference ``MiCS_Init`` partition-group creation)."""
+    return MeshTopology(TopologyConfig(pipe=pipe, data=-1, mics=shard_size,
+                                       expert=expert, seq=seq, model=model))
+
+
+def MiCS_Init(shard_size: int, **kwargs) -> MeshTopology:
+    """Reference-parity alias: returns the topology to pass to
+    ``deepspeed_tpu.initialize`` together with ``zero_optimization.stage: 3``
+    and ``mics_shard_size`` in the config."""
+    return mics_topology(shard_size, **kwargs)

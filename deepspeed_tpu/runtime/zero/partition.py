@@ -34,7 +34,8 @@ import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..topology import (DENSE_GRAD_AXES, EXPERT_AXIS, EXPERT_GRAD_AXES, MeshTopology)
+from ..topology import (DENSE_GRAD_AXES, EXPERT_AXIS, EXPERT_GRAD_AXES, MICS_AXIS,
+                        MeshTopology)
 from .config import DeepSpeedZeroConfig
 
 
@@ -87,11 +88,24 @@ class ZeroPartitionPlan:
         self.param_specs = param_specs
         self.param_shapes = param_shapes
         self._axis_sizes = dict(topology.mesh.shape)
+        # MiCS (reference zero/mics.py:62): states shard within a sub-group
+        # (the 'mics' mesh axis), replicated across 'data' groups, so ZeRO
+        # collectives stay intra-group (hierarchical all-gather layout).
+        self.mics = zero_config.mics_shard_size > 0 and topology.mics_shard_size > 1
+        if zero_config.mics_shard_size > 0 and \
+                topology.mics_shard_size != zero_config.mics_shard_size:
+            raise ValueError(
+                f"mics_shard_size={zero_config.mics_shard_size} requires a mesh "
+                f"with mics axis of that degree (got {topology.mics_shard_size}); "
+                f"set topology mics={zero_config.mics_shard_size}")
 
     # -- helpers -------------------------------------------------------------
     def _grad_axes_for(self, spec: P) -> Tuple[str, ...]:
         """Expert-sharded params sync/partition over the expert-DP axes only
-        (reference ``_create_expert_data_and_model_parallel``, groups.py:239)."""
+        (reference ``_create_expert_data_and_model_parallel``, groups.py:239).
+        Under MiCS, partitioning is confined to the sub-group axis."""
+        if self.mics:
+            return (MICS_AXIS,)
         if EXPERT_AXIS in _flatten_spec_axes(spec):
             return EXPERT_GRAD_AXES
         return DENSE_GRAD_AXES

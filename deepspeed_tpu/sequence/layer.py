@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .. import comm
-from ..runtime.topology import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..runtime.topology import BATCH_AXES, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
 def _constraint(x: jax.Array, spec: P) -> jax.Array:
@@ -38,10 +38,10 @@ def _constraint(x: jax.Array, spec: P) -> jax.Array:
 
 
 # spec of activations [B, S, H, D] while sequence-sharded (outside attention)
-SEQ_SHARDED = P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None)
+SEQ_SHARDED = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
 # spec while head-sharded (inside attention): full sequence per device,
 # heads split over both model and seq axes
-HEAD_SHARDED = P(DATA_AXIS, None, (MODEL_AXIS, SEQ_AXIS), None)
+HEAD_SHARDED = P(BATCH_AXES, None, (MODEL_AXIS, SEQ_AXIS), None)
 
 
 def ulysses_attention(attn_fn: Callable, q: jax.Array, k: jax.Array, v: jax.Array,

@@ -63,7 +63,9 @@ def add_axes_to_spec(spec: Optional[P], shape: Tuple[int, ...], axes: Tuple[str,
     spec = spec if spec is not None else P(*([None] * len(shape)))
     entries = list(spec) + [None] * (len(shape) - len(spec))
     used = _flatten_spec_axes(spec)
-    axes = tuple(a for a in axes if a not in used)
+    # A size-1 mesh axis shards nothing; keep specs minimal so that e.g. the
+    # 'mics' axis only appears when MiCS is actually in play (mics > 1).
+    axes = tuple(a for a in axes if a not in used and axis_sizes[a] > 1)
     if not axes:
         return P(*entries)
     n = int(np.prod([axis_sizes[a] for a in axes]))

@@ -45,7 +45,9 @@ def test_stage_sharding_matrix(eight_devices, stage):
     grads = plan.grad_spec_tree()
     opts = plan.optimizer_spec_tree()
 
-    sharded = P(None, ("data", "expert", "seq"))
+    # Size-1 mesh axes (mics/expert/seq in the default topology) shard nothing
+    # and are dropped from specs; only the real data axis appears.
+    sharded = P(None, "data")
     dense_rep = P(None, None)
     assert params["w"] == (sharded if stage >= 3 else dense_rep)
     assert grads["w"] == (sharded if stage >= 2 else dense_rep)
@@ -57,7 +59,7 @@ def test_stage3_respects_tp_and_threshold(eight_devices):
     plan = make_plan(3, topo, threshold=100)
     params = plan.param_spec_tree()
     # TP dim untouched, zero axes go to the free dim
-    assert params["tp_w"] == P(("data", "expert", "seq"), "model")
+    assert params["tp_w"] == P("data", "model")
     # tiny leaf below persistence threshold stays replicated
     assert params["scale"] == P(None)
 
@@ -68,5 +70,6 @@ def test_expert_params_partition_over_expert_dp_only(eight_devices):
     shapes = {"expert_w": (4, 128, 256)}
     plan = ZeroPartitionPlan(topo, DeepSpeedZeroConfig(stage=3), specs, shapes)
     spec = plan.param_spec_tree()["expert_w"]
-    # expert axis already used; zero adds only (data, seq)
-    assert spec == P("expert", None, ("data", "seq"))
+    # expert axis already used; zero adds only the expert-DP axes that are
+    # actually >1 in this mesh (data=2, seq=1 dropped)
+    assert spec == P("expert", None, "data")

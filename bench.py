@@ -1,75 +1,275 @@
 #!/usr/bin/env python
-"""Benchmark driver entry: prints ONE JSON line.
+"""Benchmark driver: one JSON line per BASELINE config.
 
-Measures training throughput (tokens/sec) of GPT-2-125M under ZeRO-1 + bf16
-on the attached accelerator — BASELINE.json configs[0]. ``vs_baseline``
-converts achieved model FLOPs to TFLOPS/chip and divides by the reference's
-published DP-only figure (~30 TFLOPS/GPU, docs/_posts/2021-03-08-zero3-offload.md:65),
-the closest apples-to-apples published number for this config.
+Covers the BASELINE.json configs that are measurable on the attached
+hardware (single chip; multi-chip configs are scaled to fit, as noted per
+line):
+
+  [0] GPT-2 125M, ZeRO-1, bf16                 -> tokens/sec + MFU
+  [1] Llama-2-7B-dims (layer-scaled), ZeRO-2   -> tokens/sec + MFU
+  [3] Mixtral-style MoE (layer-scaled), ZeRO-2 -> tokens/sec + MFU
+  [4] Ragged continuous-batching serving       -> output tok/s + TTFT
+
+Honest accounting:
+- Timing is synced by FETCHING data (device_get), not block_until_ready:
+  through the remote-device tunnel used in this environment,
+  block_until_ready returns before the computation actually finishes, which
+  made earlier rounds' throughput numbers fictitious. A scalar fetch forces
+  completion of the whole donated-state chain.
+- >= 30 timed steps after compile/warmup (3 on the CPU smoke path).
+- MFU = achieved model FLOPs / chip's advertised bf16 peak, detected from
+  ``jax.devices()[0].device_kind``. Model FLOPs per token = 6*N_active +
+  6*L*H*S (causal attention term). For MoE, N_active counts top_k experts
+  per token, not all experts — useful FLOPs, not implementation FLOPs.
+- ``vs_baseline`` for training lines = achieved MFU / the reference's
+  closest published MFU on ITS hardware:
+    * config[0] anchor: DP-only baseline ~30 TFLOPS/V100 = 24% of the
+      V100's 125 TF fp16 peak (docs/_posts/2021-03-08-zero3-offload.md:65).
+    * configs[1],[3] anchor: ZeRO-3 Offload sustained 49.5 TFLOPS/V100 =
+      39.6% MFU (same doc, lines 14,65).
+  For the serving line, ``vs_baseline`` = prefill tok/s / 512, the
+  FastGen SLA prompt-throughput definition
+  (blogs/deepspeed-fastgen/README.md:133).
+- If the chip's peak is unknown (CPU smoke path), MFU is null and
+  vs_baseline is 0.0 — never a made-up denominator.
 """
 
+import gc
 import json
 import os
 import sys
 import time
 
+# bf16 dense peak TFLOPS per chip, by jax device_kind.
+PEAK_TFLOPS = {
+    "TPU v2": 46.0,
+    "TPU v3": 123.0,
+    "TPU v4": 275.0,
+    "TPU v4 lite": 138.0,
+    "TPU v5": 459.0,        # v5p
+    "TPU v5p": 459.0,
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5e": 197.0,
+    "TPU v6 lite": 918.0,   # v6e / Trillium
+    "TPU v6e": 918.0,
+}
 
-def main():
+REF_MFU_DP = 0.24       # 30 TF / 125 TF V100 fp16 peak
+REF_MFU_ZERO3 = 0.396   # 49.5 TF / 125 TF
+
+
+def _emit(line):
+    print(json.dumps(line), flush=True)
+
+
+def _flops_per_token(cfg, seq):
+    """6*N_active (fwd+bwd) + causal attention term 6*L*H*S."""
+    n_active = cfg.num_parameters()
+    if cfg.moe is not None:
+        # num_parameters() counts every expert; tokens only visit top_k.
+        h, ffn, L = cfg.hidden_size, cfg.ffn_size, cfg.num_layers
+        per_expert = 3 * h * ffn
+        n_active -= L * cfg.moe.num_experts * per_expert
+        n_active += L * cfg.moe.top_k * per_expert
+    return 6 * n_active + 6 * cfg.num_layers * cfg.hidden_size * seq
+
+
+def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
+                peak_tflops, note=""):
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    on_tpu = jax.default_backend() not in ("cpu",)
-    if not on_tpu:
-        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
-
     import deepspeed_tpu
-    from deepspeed_tpu.models import gpt2_model
+    from deepspeed_tpu.runtime import topology as topo_mod
 
-    if on_tpu:
-        preset, batch, seq, steps = "gpt2-125m", 8, 1024, 8
-    else:  # smoke path for hosts without a chip
-        preset, batch, seq, steps = "gpt2-tiny", 8, 128, 3
+    def sync(value):
+        """True completion barrier: a data fetch round-trips the device."""
+        return float(jax.device_get(value))
 
-    model = gpt2_model(preset, dtype=jnp.bfloat16, remat=True)
-    config = {
-        "train_micro_batch_size_per_gpu": batch,
-        "optimizer": {"type": "adamw", "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "zero_optimization": {"stage": 1},
-        "bf16": {"enabled": True},
-        "gradient_clipping": 1.0,
-    }
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
-
+    topo_mod.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=ds_config)
     rng = np.random.default_rng(0)
-    batch_data = {"input_ids": rng.integers(0, model.config.vocab_size, size=(batch, seq))}
+    batch = {"input_ids": rng.integers(0, model.config.vocab_size,
+                                       size=(batch_size, seq))}
 
-    # warmup / compile
-    jax.block_until_ready(engine.train_batch(batch_data))
-    jax.tree.map(lambda x: x.block_until_ready(), engine.state["params"])
+    for _ in range(2):  # compile + settle
+        sync(engine.train_batch(batch))
 
     t0 = time.perf_counter()
     for _ in range(steps):
-        loss = engine.train_batch(batch_data)
-    jax.block_until_ready(loss)
-    jax.tree.map(lambda x: x.block_until_ready(), engine.state["params"])
+        loss = engine.train_batch(batch)
+    loss_val = sync(loss)
+    # the final apply step's params are not on the loss's data path; fetch one
+    # element so the full step chain is complete before stopping the clock
+    leaf = jax.tree.leaves(engine.state["params"])[0]
+    sync(jnp.ravel(leaf)[0])
     dt = time.perf_counter() - t0
 
-    tokens = batch * seq * steps
-    tokens_per_sec = tokens / dt
-
-    # 6*N FLOPs per token (fwd+bwd) + attention term, per Kaplan convention
-    n_params = model.config.num_parameters()
-    flops_per_token = 6 * n_params + 6 * model.config.num_layers * model.config.hidden_size * seq
-    achieved_tflops = tokens_per_sec * flops_per_token / 1e12
-    ref_tflops = 30.0  # reference DP baseline, V100 (see module docstring)
-
-    print(json.dumps({
-        "metric": f"train tokens/sec ({preset}, ZeRO-1, bf16, {'tpu' if on_tpu else 'cpu-smoke'})",
+    tokens_per_sec = batch_size * seq * steps / dt
+    achieved_tflops = tokens_per_sec * _flops_per_token(model.config, seq) / 1e12
+    mfu = achieved_tflops / peak_tflops if peak_tflops else None
+    line = {
+        "metric": f"train tokens/sec ({label}{note})",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(achieved_tflops / ref_tflops, 3),
-    }))
+        "vs_baseline": round(mfu / ref_mfu, 3) if mfu is not None else 0.0,
+        "achieved_tflops": round(achieved_tflops, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "steps": steps,
+        "loss": round(loss_val, 4),
+    }
+    del engine
+    gc.collect()
+    return line
+
+
+def bench_serving(model, n_requests, prompt_len, max_new, token_budget, peak_tflops):
+    import numpy as np
+
+    from deepspeed_tpu.inference.v2.config_v2 import (
+        DeepSpeedTPStateManagerConfig, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.engine_v2 import build_engine
+    from deepspeed_tpu.inference.v2.scheduler import ContinuousBatchingScheduler
+    from deepspeed_tpu.runtime import topology as topo_mod
+
+    topo_mod.reset()
+    # size the KV pool to this workload (the default reserves for 512
+    # concurrent sequences at half max-context — far more HBM than needed)
+    block = 16
+    blocks_per_seq = -(-(prompt_len + max_new + token_budget) // block)
+    cfg = RaggedInferenceEngineConfig(
+        state_manager=DeepSpeedTPStateManagerConfig(
+            max_ragged_batch_size=max(token_budget, prompt_len),
+            max_ragged_sequence_count=max(64, n_requests + 2),
+            max_context=prompt_len + max_new + token_budget),
+        kv_block_size=block,
+        num_kv_blocks=(n_requests + 2) * blocks_per_seq + 8)
+    engine = build_engine(model, config=cfg)
+    sched = ContinuousBatchingScheduler(engine, token_budget=token_budget)
+    rng = np.random.default_rng(0)
+    vocab = model.config.vocab_size
+
+    # warmup/compile BEFORE submitting the timed requests: drive a throwaway
+    # workload of the same shape so every prefill-chunk bucket and the
+    # n_requests-wide decode bucket are compiled outside the timed window
+    warm = [sched.submit(rng.integers(0, vocab, size=(prompt_len,)),
+                         max_new_tokens=4) for _ in range(n_requests)]
+    while sched.has_work:
+        if sched.step() == 0:
+            break
+    assert all(w.done for w in warm)
+
+    reqs = [sched.submit(rng.integers(0, vocab, size=(prompt_len,)),
+                         max_new_tokens=max_new) for _ in range(n_requests)]
+
+    t0 = time.perf_counter()
+    ttft = {}
+    while sched.has_work:
+        if sched.step() == 0:
+            break
+        now = time.perf_counter()
+        for r in reqs:
+            if r.uid not in ttft and r.generated:
+                ttft[r.uid] = now - t0
+    dt = time.perf_counter() - t0
+
+    out_tokens = sum(len(r.generated) for r in reqs)
+    out_tok_s = out_tokens / dt
+    prefill_tok_s = n_requests * prompt_len / max(
+        max(ttft.values()) if ttft else dt, 1e-9)
+    mean_ttft = float(np.mean(list(ttft.values()))) if ttft else None
+    del engine, sched
+    gc.collect()
+    return {
+        "metric": "serving output tok/s (ragged continuous batching, "
+                  f"{n_requests} reqs x {prompt_len} prompt)",
+        "value": round(out_tok_s, 1),
+        "unit": "tokens/sec",
+        # FastGen SLA: prompt throughput 512 tok/s (deepspeed-fastgen README:133)
+        "vs_baseline": round(prefill_tok_s / 512.0, 3),
+        "mean_ttft_s": round(mean_ttft, 3) if mean_ttft is not None else None,
+        "prefill_tok_s": round(prefill_tok_s, 1),
+        "out_tokens": out_tokens,
+    }
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_TFLOPS.get(kind) if on_tpu else None
+
+    from deepspeed_tpu.models import gpt2_model, llama_model, mixtral_model
+
+    steps = 30 if on_tpu else 3
+
+    def zero_cfg(stage, micro, grad_bf16=True):
+        cfg = {
+            "train_micro_batch_size_per_gpu": micro,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-4, "weight_decay": 0.01}},
+            "zero_optimization": {"stage": stage},
+            "bf16": {"enabled": True},
+            "gradient_clipping": 1.0,
+        }
+        if grad_bf16:
+            cfg["data_types"] = {"grad_accum_dtype": "bf16"}
+        return cfg
+
+    runs = []
+    if on_tpu:
+        runs.append(lambda: bench_train(
+            "gpt2-125m ZeRO-1 bf16",
+            gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+            zero_cfg(1, 8, grad_bf16=False), 8, 1024, steps, REF_MFU_DP, peak))
+        runs.append(lambda: bench_train(
+            "llama2-7b-dims L2 ZeRO-2 bf16",
+            llama_model("llama2-7b", dtype=jnp.bfloat16, remat=True,
+                        num_layers=2, max_seq_len=2048),
+            zero_cfg(2, 4), 4, 2048, steps, REF_MFU_ZERO3, peak,
+            note=", 7B dims scaled to 2 layers for 1 chip"))
+        runs.append(lambda: bench_train(
+            "mixtral-style MoE 8e top2 ZeRO-2 bf16",
+            mixtral_model("mixtral-8x7b", dtype=jnp.bfloat16, remat=True,
+                          num_layers=4, hidden_size=1024, intermediate_size=3584,
+                          num_heads=16, num_kv_heads=8, max_seq_len=1024),
+            zero_cfg(2, 8), 8, 1024, steps, REF_MFU_ZERO3, peak,
+            note=", 8x7B dims scaled for 1 chip"))
+        runs.append(lambda: bench_serving(
+            llama_model("llama2-7b", dtype=jnp.bfloat16, remat=False,
+                        num_layers=4, max_seq_len=2048),
+            n_requests=16, prompt_len=512, max_new=64, token_budget=512,
+            peak_tflops=peak))
+    else:  # smoke path for hosts without a chip
+        runs.append(lambda: bench_train(
+            "gpt2-tiny ZeRO-1 cpu-smoke",
+            gpt2_model("gpt2-tiny", dtype=jnp.bfloat16, remat=True,
+                       max_seq_len=128),
+            zero_cfg(1, 8, grad_bf16=False), 8, 128, steps, REF_MFU_DP, None))
+        runs.append(lambda: bench_serving(
+            llama_model("llama2-tiny", dtype=jnp.bfloat16, remat=False),
+            n_requests=4, prompt_len=32, max_new=8, token_budget=64,
+            peak_tflops=None))
+
+    import traceback
+
+    for run in runs:
+        try:
+            _emit(run())
+        except Exception as e:  # one bad config must not hide the others
+            _emit({"metric": f"bench error: {type(e).__name__}",
+                   "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+                   "detail": str(e)[:300]})
+            # drop frame refs so the failed config's arrays don't pin HBM
+            # while later configs run
+            traceback.clear_frames(e.__traceback__)
+        jax.clear_caches()
+        gc.collect()
 
 
 if __name__ == "__main__":

@@ -269,7 +269,13 @@ class TransformerLM:
         valid = labels >= 0
         safe_labels = jnp.where(valid, labels, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)
-        token_loss = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        # one-hot contraction instead of take_along_axis: its transpose is a
+        # dense broadcast-multiply that GSPMD reshards freely, where the
+        # scatter-add transpose of a gather forces a full rematerialization
+        # when logits are vocab-sharded (TP lm_head). XLA fuses the one-hot
+        # into the reduction, so no [B,S,V] buffer is materialized.
+        onehot = jax.nn.one_hot(safe_labels, logits.shape[-1], dtype=logp.dtype)
+        token_loss = -jnp.sum(logp * onehot, axis=-1)
         mask = valid.astype(jnp.float32)
         if "loss_mask" in batch:
             mask = mask * batch["loss_mask"].astype(jnp.float32)

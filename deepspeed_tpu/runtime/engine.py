@@ -46,6 +46,7 @@ from .fp16.loss_scaler import (dynamic_loss_scale_state, has_overflow, static_lo
                                update_scale)
 from .lr_schedules import build_lr_schedule
 from .optimizers import Optimizer, build_optimizer
+from . import topology as topo_mod
 from .topology import BATCH_AXES, DATA_AXIS, MeshTopology, TopologyConfig
 from .zero.partition import ZeroPartitionPlan
 
@@ -72,6 +73,10 @@ class DeepSpeedEngine:
                for k in ("pipe", "data", "mics", "expert", "seq", "model")}))
         self.model = model
         self.mesh = self.topology.mesh
+        # Publish as the process-global topology so model-side code traced
+        # without an engine handle (ulysses_attention, MoE dispatch) sees the
+        # same mesh via get_topology().
+        topo_mod.set_topology(self.topology)
 
         # -- precision policy (reference _configure_distributed_model dtype
         #    casts, engine.py:1085) ------------------------------------------
@@ -540,6 +545,9 @@ class DeepSpeedEngine:
 
     def forward(self, batch: Dict[str, Any]):
         """Compute loss (and gradients — fused; see module docstring)."""
+        # retraces (new shapes) must see THIS engine's mesh, not whichever
+        # engine was constructed last
+        topo_mod.set_topology(self.topology)
         self._build_jits()
         self.timers(FORWARD_GLOBAL_TIMER).start()
         if self.curriculum_scheduler is not None:
@@ -693,6 +701,7 @@ class DeepSpeedEngine:
             return 0.0
 
     def eval_batch(self, batch: Dict[str, Any]) -> jax.Array:
+        topo_mod.set_topology(self.topology)
         if getattr(self, "_jit_eval", None) is None:
             self._jit_eval = jax.jit(self.model.loss)
         batch = self._device_batch(batch)

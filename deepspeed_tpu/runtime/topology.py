@@ -175,6 +175,19 @@ def initialize(config: Optional[TopologyConfig] = None, devices: Optional[Sequen
     return _TOPOLOGY
 
 
+def set_topology(topology: MeshTopology) -> MeshTopology:
+    """Publish ``topology`` as the process-global instance.
+
+    The engine calls this for whatever topology it resolves (including one
+    passed explicitly to ``deepspeed_tpu.initialize``) so that code without an
+    engine handle — e.g. ``ulysses_attention`` inside the traced model —
+    observes the same mesh through ``get_topology()``.
+    """
+    global _TOPOLOGY
+    _TOPOLOGY = topology
+    return topology
+
+
 def get_topology() -> MeshTopology:
     if _TOPOLOGY is None:
         return initialize()

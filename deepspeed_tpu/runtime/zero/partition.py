@@ -71,6 +71,20 @@ def add_axes_to_spec(spec: Optional[P], shape: Tuple[int, ...], axes: Tuple[str,
     n = int(np.prod([axis_sizes[a] for a in axes]))
     if n == 1 or int(np.prod(shape)) < max(min_size, 1):
         return P(*entries)
+    # Prefer extending a dim that is already sharded (the TP dim): the
+    # combined sharding then lives on one dim, so after the ZeRO all-gather
+    # consumers see exactly the TP-only layout and the partitioner never has
+    # to move shards across dims. (Sharding a second dim of a gather-consumed
+    # leaf — e.g. the embedding table's hidden dim — forces GSPMD into an
+    # "involuntary full rematerialization" of the gather output.)
+    for i, e in enumerate(entries):
+        if e is None:
+            continue
+        existing = e if isinstance(e, (tuple, list)) else (e,)
+        combined = n * int(np.prod([axis_sizes.get(a, 1) for a in existing]))
+        if shape[i] % combined == 0:
+            entries[i] = tuple(existing) + axes
+            return P(*entries)
     candidates = [i for i, e in enumerate(entries) if e is None and shape[i] % n == 0 and shape[i] >= n]
     if not candidates:
         return P(*entries)

@@ -7,11 +7,16 @@ scatter heads / gather sequence before attention, and the inverse after
 
 Two equivalent TPU implementations are provided:
 
-1. ``ulysses_attention`` — the **compiler-driven** form used inside ``jit``:
-   resharding constraints flip the sharded dimension from sequence to heads
-   and back; XLA's SPMD partitioner inserts the same two all-to-alls over the
-   ``seq`` ICI axis that the reference issues manually. This composes with TP
-   (heads stay additionally sharded over ``model``) and ZeRO for free.
+1. ``ulysses_attention`` — used inside ``jit``. When the mesh has a real
+   ``seq`` degree it wraps the local attention in a ``shard_map`` region with
+   two **explicit** ``lax.all_to_all`` collectives (scatter heads / gather
+   sequence before attention, the inverse after) — the literal TPU form of
+   the reference's ``_SeqAllToAll``. Explicit collectives matter here: the
+   seq→head sharding flip is a transition GSPMD cannot express without
+   "involuntary full rematerialization" (a full replicate + repartition), so
+   the constraint-driven form is kept only as a fallback for shapes the
+   all-to-all cannot split evenly. This composes with TP (heads stay
+   additionally sharded over ``model``) and ZeRO for free.
 
 2. ``DistributedAttention`` — the **explicit** form for ``shard_map`` users,
    API-compatible with the reference class: all-to-all via
@@ -24,10 +29,13 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import comm
+from ..runtime import topology as topo_mod
 from ..runtime.topology import BATCH_AXES, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..utils.logging import logger
 
 
 def _constraint(x: jax.Array, spec: P) -> jax.Array:
@@ -44,17 +52,59 @@ SEQ_SHARDED = P(BATCH_AXES, SEQ_AXIS, MODEL_AXIS, None)
 HEAD_SHARDED = P(BATCH_AXES, None, (MODEL_AXIS, SEQ_AXIS), None)
 
 
+def _constraint_form(attn_fn: Callable, q, k, v, kwargs):
+    """Compiler-driven fallback: reshard via constraints (may cost a full
+    rematerialization in GSPMD for the seq<->head flip)."""
+    q = _constraint(q, HEAD_SHARDED)
+    k = _constraint(k, HEAD_SHARDED)
+    v = _constraint(v, HEAD_SHARDED)
+    out = attn_fn(q, k, v, **kwargs)
+    return _constraint(out, SEQ_SHARDED)
+
+
+def _all_to_all_form(attn_fn: Callable, q, k, v, mesh, kwargs):
+    """Explicit Ulysses: two all-to-alls per tensor inside one shard_map
+    region (reference sequence/layer.py:15 ``single_all_to_all``)."""
+
+    def local_fn(q, k, v):
+        # per-shard [b, s/sp, h/tp, d] -> [b, s, h/(tp*sp), d]
+        gather_seq = lambda x: jax.lax.all_to_all(
+            x, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True)
+        out = attn_fn(gather_seq(q), gather_seq(k), gather_seq(v), **kwargs)
+        # inverse: scatter sequence, gather heads
+        return jax.lax.all_to_all(out, SEQ_AXIS, split_axis=1, concat_axis=2, tiled=True)
+
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(SEQ_SHARDED, SEQ_SHARDED, SEQ_SHARDED),
+                     out_specs=SEQ_SHARDED, check_vma=False)(q, k, v)
+
+
 def ulysses_attention(attn_fn: Callable, q: jax.Array, k: jax.Array, v: jax.Array,
                       **kwargs) -> jax.Array:
     """Run ``attn_fn(q, k, v, **kwargs)`` with Ulysses resharding around it.
 
     q/k/v: [batch, seq, heads, head_dim], sequence-sharded on entry.
     """
-    q = _constraint(q, HEAD_SHARDED)
-    k = _constraint(k, HEAD_SHARDED)
-    v = _constraint(v, HEAD_SHARDED)
-    out = attn_fn(q, k, v, **kwargs)
-    return _constraint(out, SEQ_SHARDED)
+    topo = topo_mod.get_topology() if topo_mod.is_initialized() else None
+    sp = topo.sequence_parallel_size if topo is not None else 1
+    if sp > 1 and kwargs.get("segment_ids") is None:
+        tp = topo.model_parallel_size
+        hq, hkv, s = q.shape[2], k.shape[2], q.shape[1]
+        if hq % (tp * sp) == 0 and hkv % (tp * sp) == 0 and s % sp == 0:
+            try:
+                return _all_to_all_form(attn_fn, q, k, v, topo.mesh, kwargs)
+            except Exception as e:  # e.g. shard_map under an outer vmap (pipeline)
+                global _FALLBACK_WARNED
+                if not _FALLBACK_WARNED:
+                    _FALLBACK_WARNED = True
+                    logger.warning(
+                        "ulysses_attention: explicit all-to-all form failed "
+                        f"({type(e).__name__}: {e}); using the constraint "
+                        "fallback — expect an SPMD rematerialization cliff")
+    return _constraint_form(attn_fn, q, k, v, kwargs)
+
+
+_FALLBACK_WARNED = False
 
 
 class DistributedAttention:

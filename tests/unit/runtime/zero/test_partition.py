@@ -23,12 +23,16 @@ def make_plan(stage, topo, threshold=0):
 
 
 def test_add_axes_picks_largest_free_dim(eight_devices):
-    sizes = {"data": 8}
+    sizes = {"data": 8, "model": 2}
     spec = add_axes_to_spec(P(None, None), (256, 512), ("data",), sizes)
     assert spec == P(None, "data")
-    # dim already sharded by TP: falls to the other dim
+    # dim already sharded by TP: extend THAT dim so the combined sharding
+    # stays on one dim (consumers see the TP layout after the zero gather)
     spec = add_axes_to_spec(P(None, "model"), (256, 512), ("data",), sizes)
-    assert spec == P("data", "model")
+    assert spec == P(None, ("model", "data"))
+    # TP dim not divisible by the combined degree: falls to the free dim
+    spec = add_axes_to_spec(P("model", None), (2, 512), ("data",), sizes)
+    assert spec == P("model", "data")
 
 
 def test_add_axes_indivisible_stays_replicated(eight_devices):
@@ -58,8 +62,8 @@ def test_stage3_respects_tp_and_threshold(eight_devices):
     topo = MeshTopology(TopologyConfig(model=2))
     plan = make_plan(3, topo, threshold=100)
     params = plan.param_spec_tree()
-    # TP dim untouched, zero axes go to the free dim
-    assert params["tp_w"] == P("data", "model")
+    # TP component preserved; zero axes extend the same dim
+    assert params["tp_w"] == P(None, ("model", "data"))
     # tiny leaf below persistence threshold stays replicated
     assert params["scale"] == P(None)
 

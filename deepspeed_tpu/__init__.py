@@ -85,7 +85,24 @@ def initialize(args=None,
     return engine, engine.optimizer, dataloader, engine.lr_scheduler
 
 
-def init_inference(model=None, config=None, **kwargs):
-    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:269``)."""
+def init_inference(model=None, config=None, model_path: Optional[str] = None, **kwargs):
+    """Reference ``deepspeed.init_inference`` (``deepspeed/__init__.py:269``).
+
+    ``model_path`` loads a real HF checkpoint directory (safetensors or
+    torch-bin, gpt2/llama/mistral/mixtral) and places the weights sharded
+    per the model's TP specs — the reference's checkpoint-loading path
+    (``inference/engine.py:254`` + ``module_inject/load_checkpoint.py``).
+    """
     from .inference.engine import InferenceEngine
+    if model_path is not None:
+        if model is not None:
+            raise ValueError("init_inference: pass either model or model_path, "
+                             "not both (which weights would win is ambiguous)")
+        if "params" in kwargs:
+            raise ValueError("init_inference: params cannot be combined with "
+                             "model_path (the checkpoint provides the params)")
+        from .inference.engine import InferenceConfig
+        from .runtime.state_dict_factory import load_hf_model
+        icfg = config if isinstance(config, InferenceConfig) else InferenceConfig(config, **kwargs)
+        model, kwargs["params"] = load_hf_model(model_path, dtype=icfg.dtype)
     return InferenceEngine(model=model, config=config, **kwargs)

@@ -230,5 +230,20 @@ def build_engine(model: TransformerLM,
                  config: Optional[RaggedInferenceEngineConfig] = None,
                  params: Optional[Any] = None,
                  **kwargs) -> InferenceEngineV2:
-    """Reference ``engine_factory.build_hf_engine`` (engine_factory.py:65)."""
+    """Engine from an in-memory model (reference ``engine_factory.py:28``)."""
+    return InferenceEngineV2(model, config=config, params=params, **kwargs)
+
+
+def build_hf_engine(model_path: str,
+                    config: Optional[RaggedInferenceEngineConfig] = None,
+                    dtype: Any = jnp.bfloat16,
+                    **kwargs) -> InferenceEngineV2:
+    """Serving engine directly from a real HF checkpoint directory
+    (reference ``engine_factory.build_hf_engine``, engine_factory.py:65).
+
+    ``dtype`` is the weight/compute dtype; the KV cache dtype is governed
+    separately by ``config.kv_cache_dtype``.
+    """
+    from ...runtime.state_dict_factory import load_hf_model
+    model, params = load_hf_model(model_path, dtype=dtype)
     return InferenceEngineV2(model, config=config, params=params, **kwargs)

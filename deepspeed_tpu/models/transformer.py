@@ -63,6 +63,7 @@ class TransformerConfig:
     intermediate_size: Optional[int] = None  # None => 4*hidden
     activation: str = "gelu"        # 'gelu' | 'silu_gated'
     norm: str = "layernorm"          # 'layernorm' | 'rmsnorm'
+    norm_eps: float = 1e-5           # HF config layer_norm_epsilon / rms_norm_eps
     position: str = "learned"        # 'learned' | 'rope'
     rope_theta: float = 10000.0
     tie_embeddings: bool = True
@@ -107,7 +108,8 @@ class TransformerLM:
         c = config
         self._wte = nn.Embedding(c.vocab_size, c.hidden_size, shard=True)
         self._wpe = nn.Embedding(c.max_seq_len, c.hidden_size) if c.position == "learned" else None
-        norm_cls = nn.LayerNorm if c.norm == "layernorm" else nn.RMSNorm
+        base_cls = nn.LayerNorm if c.norm == "layernorm" else nn.RMSNorm
+        norm_cls = lambda features: base_cls(features, eps=c.norm_eps)
         self._norm = norm_cls
         self._ln_f = norm_cls(c.hidden_size)
         if not c.tie_embeddings:

@@ -1,0 +1,294 @@
+"""External checkpoint ingestion: HF checkpoints → TPU param pytrees.
+
+Counterpart of the reference's weights-ingestion stack:
+- ``runtime/state_dict_factory.py:21`` ``SDLoaderFactory`` / ``:190``
+  ``MegatronSDLoader`` — load (possibly sharded) checkpoints and reshard
+  for a target TP degree;
+- ``module_inject/load_checkpoint.py`` — map HF module weights onto the
+  injected inference modules;
+- ``inference/v2/model_implementations/flat_model_helpers.py`` — flattened
+  parameter containers per architecture.
+
+TPU-first redesign: a checkpoint is read on the host into a numpy state
+dict (safetensors or torch ``.bin``, single-file or indexed shards), mapped
+by architecture into the ``TransformerLM`` scanned-layer pytree, and placed
+*sharded* by ``jax.device_put`` with the model's ``specs()`` /
+``AutoTP.build_specs`` NamedShardings — the SPMD equivalent of the
+reference's per-rank slice loading. Explicit per-rank slicing for
+multi-host loading is available via ``module_inject.auto_tp.shard_param_tree``.
+
+Supported architectures: gpt2, llama, mistral, mixtral.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..models.transformer import MoEConfig, TransformerConfig, TransformerLM
+from ..utils.logging import log_dist
+
+
+# ---------------------------------------------------------------------------
+# raw state-dict loading (reference SDLoaderFactory, state_dict_factory.py:21)
+# ---------------------------------------------------------------------------
+
+def _torch_to_numpy(t) -> np.ndarray:
+    """Convert preserving dtype: bf16 stays bf16 (ml_dtypes view), never an
+    fp32 upcast that would double host RAM for large checkpoints."""
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _safetensors_has_bf16(path: str) -> bool:
+    """Read only the file header: {tensor: {dtype, shape, offsets}}."""
+    with open(path, "rb") as f:
+        n = struct.unpack("<Q", f.read(8))[0]
+        header = json.loads(f.read(n))
+    return any(v.get("dtype") == "BF16"
+               for k, v in header.items() if k != "__metadata__")
+
+
+def _load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    out = {}
+    if _safetensors_has_bf16(path):  # numpy has no native bf16 dtype
+        with safe_open(path, framework="pt") as f:
+            for k in f.keys():
+                out[k] = _torch_to_numpy(f.get_tensor(k))
+    else:
+        with safe_open(path, framework="np") as f:
+            for k in f.keys():
+                out[k] = f.get_tensor(k)
+    return out
+
+
+def _load_torch_bin(path: str) -> Dict[str, np.ndarray]:
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: _torch_to_numpy(v) for k, v in sd.items()}
+
+
+class HFCheckpointLoader:
+    """Read an HF model directory: ``config.json`` + weights in safetensors
+    or torch-bin form, single-file or sharded with an ``*.index.json``."""
+
+    def __init__(self, model_path: str):
+        self.model_path = model_path
+        cfg_path = os.path.join(model_path, "config.json")
+        if not os.path.exists(cfg_path):
+            raise FileNotFoundError(f"no config.json under {model_path}")
+        with open(cfg_path) as f:
+            self.config: Dict[str, Any] = json.load(f)
+
+    def _weight_files(self):
+        mp = self.model_path
+        for index in ("model.safetensors.index.json", "pytorch_model.bin.index.json"):
+            ip = os.path.join(mp, index)
+            if os.path.exists(ip):
+                with open(ip) as f:
+                    files = sorted(set(json.load(f)["weight_map"].values()))
+                return [os.path.join(mp, f) for f in files]
+        for single in ("model.safetensors", "pytorch_model.bin"):
+            sp = os.path.join(mp, single)
+            if os.path.exists(sp):
+                return [sp]
+        raise FileNotFoundError(f"no model weights found under {mp}")
+
+    def load_state_dict(self) -> Dict[str, np.ndarray]:
+        sd: Dict[str, np.ndarray] = {}
+        for path in self._weight_files():
+            loader = _load_safetensors if path.endswith(".safetensors") else _load_torch_bin
+            sd.update(loader(path))
+        return sd
+
+
+class SDLoaderFactory:
+    @staticmethod
+    def get_sd_loader(model_path: str) -> HFCheckpointLoader:
+        return HFCheckpointLoader(model_path)
+
+
+# ---------------------------------------------------------------------------
+# HF config → TransformerConfig
+# ---------------------------------------------------------------------------
+
+def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerConfig:
+    import jax.numpy as jnp
+
+    dtype = dtype if dtype is not None else jnp.bfloat16
+    mt = hf.get("model_type", "gpt2")
+    if mt == "gpt2":
+        cfg = dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("n_positions", 1024),
+            num_layers=hf.get("n_layer", 12),
+            num_heads=hf.get("n_head", 12),
+            hidden_size=hf.get("n_embd", 768),
+            intermediate_size=hf.get("n_inner") or 4 * hf.get("n_embd", 768),
+            activation="gelu", norm="layernorm", position="learned",
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=True)
+    elif mt in ("llama", "mistral", "mixtral"):
+        cfg = dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads"),
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            activation="silu_gated", norm="rmsnorm", position="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            tie_embeddings=hf.get("tie_word_embeddings", False))
+        if mt == "mixtral":
+            cfg["moe"] = MoEConfig(
+                num_experts=hf.get("num_local_experts", 8),
+                top_k=hf.get("num_experts_per_tok", 2))
+    else:
+        raise ValueError(f"unsupported model_type {mt!r} "
+                         "(supported: gpt2, llama, mistral, mixtral)")
+    cfg["dtype"] = dtype
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+# ---------------------------------------------------------------------------
+# HF state dict → TransformerLM pytree
+# ---------------------------------------------------------------------------
+
+def _strip_prefix(sd: Dict[str, np.ndarray], prefix: str) -> Dict[str, np.ndarray]:
+    if any(k.startswith(prefix) for k in sd):
+        return {(k[len(prefix):] if k.startswith(prefix) else k): v for k, v in sd.items()}
+    return sd
+
+
+def _stack(sd, pattern: str, L: int, transform=None) -> np.ndarray:
+    layers = []
+    for i in range(L):
+        # pop: the per-layer tensor is dead once stacked — keeps peak host
+        # RAM near one model copy instead of two
+        w = sd.pop(pattern.format(i=i))
+        layers.append(transform(w) if transform else w)
+    return np.stack(layers)
+
+
+def _gpt2_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """GPT-2 Conv1D stores weights [in, out] — our Linear layout directly."""
+    sd = _strip_prefix(sd, "transformer.")
+    L, H = cfg.num_layers, cfg.hidden_size
+
+    def split_qkv(w):  # [in, 3H] (or [3H] bias) → 3 × [..., H]
+        return np.split(w, 3, axis=-1)
+
+    qs, ks, vs = zip(*(split_qkv(sd.pop(f"h.{i}.attn.c_attn.weight")) for i in range(L)))
+    qb, kb, vb = zip(*(split_qkv(sd.pop(f"h.{i}.attn.c_attn.bias")) for i in range(L)))
+    blocks = {
+        "ln_1": {"scale": _stack(sd, "h.{i}.ln_1.weight", L),
+                 "bias": _stack(sd, "h.{i}.ln_1.bias", L)},
+        "ln_2": {"scale": _stack(sd, "h.{i}.ln_2.weight", L),
+                 "bias": _stack(sd, "h.{i}.ln_2.bias", L)},
+        "q_proj": {"kernel": np.stack(qs), "bias": np.stack(qb)},
+        "k_proj": {"kernel": np.stack(ks), "bias": np.stack(kb)},
+        "v_proj": {"kernel": np.stack(vs), "bias": np.stack(vb)},
+        "o_proj": {"kernel": _stack(sd, "h.{i}.attn.c_proj.weight", L),
+                   "bias": _stack(sd, "h.{i}.attn.c_proj.bias", L)},
+        "fc_in": {"kernel": _stack(sd, "h.{i}.mlp.c_fc.weight", L),
+                  "bias": _stack(sd, "h.{i}.mlp.c_fc.bias", L)},
+        "fc_out": {"kernel": _stack(sd, "h.{i}.mlp.c_proj.weight", L),
+                   "bias": _stack(sd, "h.{i}.mlp.c_proj.bias", L)},
+    }
+    return {
+        "wte": {"embedding": sd["wte.weight"]},
+        "wpe": {"embedding": sd["wpe.weight"]},
+        "ln_f": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        "blocks": blocks,
+    }
+
+
+def _llama_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF Linear stores weights [out, in] — transpose into our [in, out]."""
+    L = cfg.num_layers
+    T = np.transpose
+    blocks = {
+        "ln_1": {"scale": _stack(sd, "model.layers.{i}.input_layernorm.weight", L)},
+        "ln_2": {"scale": _stack(sd, "model.layers.{i}.post_attention_layernorm.weight", L)},
+        "q_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.q_proj.weight", L, T)},
+        "k_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.k_proj.weight", L, T)},
+        "v_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, T)},
+        "o_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, T)},
+    }
+    if cfg.moe is not None:
+        E = cfg.moe.num_experts
+        blocks["moe"] = {
+            "gate": _stack(sd, "model.layers.{i}.block_sparse_moe.gate.weight", L, T),
+            "wi_gate": np.stack([np.stack(
+                [T(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight"])
+                 for e in range(E)]) for i in range(L)]),
+            "wi_up": np.stack([np.stack(
+                [T(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"])
+                 for e in range(E)]) for i in range(L)]),
+            "wo": np.stack([np.stack(
+                [T(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight"])
+                 for e in range(E)]) for i in range(L)]),
+        }
+    else:
+        blocks.update({
+            "gate_proj": {"kernel": _stack(sd, "model.layers.{i}.mlp.gate_proj.weight", L, T)},
+            "up_proj": {"kernel": _stack(sd, "model.layers.{i}.mlp.up_proj.weight", L, T)},
+            "down_proj": {"kernel": _stack(sd, "model.layers.{i}.mlp.down_proj.weight", L, T)},
+        })
+    params = {
+        "wte": {"embedding": sd["model.embed_tokens.weight"]},
+        "ln_f": {"scale": sd["model.norm.weight"]},
+        "blocks": blocks,
+    }
+    if not cfg.tie_embeddings:
+        lm_head = sd.get("lm_head.weight", sd["model.embed_tokens.weight"])
+        params["lm_head"] = {"kernel": T(lm_head)}
+    return params
+
+
+def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
+                            sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    if model_type == "gpt2":
+        return _gpt2_params(cfg, sd)
+    if model_type in ("llama", "mistral", "mixtral"):
+        return _llama_params(cfg, sd)
+    raise ValueError(f"unsupported model_type {model_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# front door
+# ---------------------------------------------------------------------------
+
+def load_hf_model(model_path: str, dtype=None,
+                  **config_overrides) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """HF model directory → (TransformerLM, host param pytree).
+
+    The returned params are numpy (host) arrays in the model's pytree
+    layout; hand them to ``init_inference(..)``/``initialize(
+    model_parameters=...)`` to get sharded device placement, or to
+    ``auto_tp.shard_param_tree`` for explicit per-rank slices.
+    """
+    loader = SDLoaderFactory.get_sd_loader(model_path)
+    mt = loader.config.get("model_type", "gpt2")
+    cfg = hf_to_transformer_config(loader.config, dtype=dtype, **config_overrides)
+    sd = loader.load_state_dict()
+    params = hf_state_dict_to_params(cfg, mt, sd)
+    n = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(params))
+    log_dist(f"loaded HF checkpoint {model_path} ({mt}, {n / 1e6:.1f}M params)",
+             ranks=[0])
+    return TransformerLM(cfg), params

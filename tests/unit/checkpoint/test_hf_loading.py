@@ -1,0 +1,141 @@
+"""External-weights ingestion tests (reference tests: inference
+test_inference.py HF model matrix + state_dict_factory/MegatronSDLoader TP
+resharding; here: real tiny HF checkpoints saved by ``transformers``,
+loaded into the TPU pytree, logits compared against the torch forward)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.module_inject.auto_tp import AutoTP, shard_param_tree
+from deepspeed_tpu.runtime.state_dict_factory import load_hf_model
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def gpt2_ckpt(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf_gpt2")
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4)
+    torch.manual_seed(0)
+    m = transformers.GPT2LMHeadModel(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def llama_ckpt(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf_llama")
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64)
+    torch.manual_seed(1)
+    m = transformers.LlamaForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+def _ref_logits(m, ids):
+    with torch.no_grad():
+        return m(torch.tensor(ids)).logits.float().numpy()
+
+
+def _our_logits(path, ids, **overrides):
+    model, params = load_hf_model(str(path), dtype=jnp.float32, **overrides)
+    logits, _ = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    return np.asarray(logits)
+
+
+@pytest.mark.parametrize("ckpt", ["gpt2_ckpt", "llama_ckpt"])
+def test_hf_logits_parity(request, eight_devices, ckpt):
+    """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
+    path, m = request.getfixturevalue(ckpt)
+    ids = np.random.default_rng(0).integers(0, 128, size=(2, 16))
+    np.testing.assert_allclose(_our_logits(path, ids), _ref_logits(m, ids),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_init_inference_from_model_path(eight_devices, llama_ckpt):
+    """init_inference(model_path=...) end to end, TP=2: sharded placement
+    and correct generation-path logits."""
+    path, m = llama_ckpt
+    engine = deepspeed_tpu.init_inference(
+        model_path=str(path), config={"tensor_parallel": {"tp_size": 2},
+                                      "dtype": jnp.float32})
+    assert engine.topology.model_parallel_size == 2
+    # column-parallel leaves must actually be sharded over the model axis
+    q_sharding = engine.params["blocks"]["q_proj"]["kernel"].sharding
+    assert "model" in str(q_sharding.spec)
+    ids = np.random.default_rng(1).integers(0, 128, size=(2, 12))
+    np.testing.assert_allclose(np.asarray(engine.forward(ids)),
+                               _ref_logits(m, ids), rtol=2e-4, atol=2e-4)
+
+
+def test_shard_param_tree_matches_device_slices(eight_devices, llama_ckpt):
+    """Explicit per-rank TP slicing (MegatronSDLoader equivalent) must agree
+    with what the SPMD placement puts on each device."""
+    path, _ = llama_ckpt
+    model, params = load_hf_model(str(path), dtype=jnp.float32)
+    specs = AutoTP(hidden_size=model.config.hidden_size).build_specs(params)
+    full = params["blocks"]["q_proj"]["kernel"]  # [L, in, out] column-parallel
+    for rank, tp in ((0, 2), (1, 2)):
+        shard = shard_param_tree(params, specs, rank, tp)["blocks"]["q_proj"]["kernel"]
+        k = full.shape[-1] // tp
+        np.testing.assert_array_equal(shard, full[..., rank * k:(rank + 1) * k])
+
+
+def test_build_hf_engine_v2_greedy_matches_hf(eight_devices, llama_ckpt):
+    """The ragged serving engine loaded from the checkpoint must greedy-decode
+    the same tokens as HF ``generate``."""
+    path, m = llama_ckpt
+    from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
+    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
+    from deepspeed_tpu.inference.v2.scheduler import generate
+
+    prompt = np.random.default_rng(3).integers(0, 128, size=(12,))
+    with torch.no_grad():
+        ref = m.generate(torch.tensor(prompt[None]), max_new_tokens=6,
+                         do_sample=False).numpy()[0, len(prompt):]
+    eng = build_hf_engine(str(path), dtype=jnp.float32,
+                          config=RaggedInferenceEngineConfig(
+                              kv_cache_dtype=jnp.float32, num_kv_blocks=64))
+    out = generate(eng, [prompt], max_new_tokens=6)[0]
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_bf16_checkpoint_loads_without_upcast(tmp_path, llama_ckpt):
+    """bf16 safetensors load through the torch path preserving dtype (no
+    fp32 host copy), and still produce close logits."""
+    import ml_dtypes
+    path, m = llama_ckpt
+    bf16_path = tmp_path / "bf16"
+    m.to(torch.bfloat16).save_pretrained(bf16_path)
+    m.to(torch.float32)  # restore the shared fixture
+    model, params = load_hf_model(str(bf16_path), dtype=jnp.float32)
+    assert params["blocks"]["q_proj"]["kernel"].dtype == ml_dtypes.bfloat16
+    ids = np.random.default_rng(4).integers(0, 128, size=(1, 8))
+    ours = model.apply(jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params),
+                       jnp.asarray(ids))[0]
+    np.testing.assert_allclose(np.asarray(ours), _ref_logits(m, ids),
+                               rtol=0.1, atol=0.15)
+
+
+def test_hf_weights_into_training_engine(eight_devices, gpt2_ckpt):
+    """Loaded weights feed deepspeed_tpu.initialize(model_parameters=...) and
+    train under ZeRO-2."""
+    path, _ = gpt2_ckpt
+    model, params = load_hf_model(str(path), dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    batch = {"input_ids": np.random.default_rng(2).integers(0, 128, size=(8, 16))}
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert losses[-1] < losses[0], losses

@@ -5,18 +5,22 @@ Counterpart of the reference ``InferenceEngineV2``
 UIDs and returns next-token logits, ``query``/``can_schedule`` expose KV
 budget for the scheduler, ``flush`` retires sequences.
 
-TPU-first structure: ``put`` decomposes the ragged work into the two
-bucketed static-shape programs of :class:`RaggedInferenceModel` — chunked
-prefill per new sequence and one batched paged decode for continuing
-sequences — each jitted once per bucket with the KV cache donated. This is
-the XLA expression of Dynamic SplitFuse: the scheduler (scheduler.py) still
-mixes prompt chunks and generation inside one token budget per engine step.
+TPU-first structure: ``put`` dispatches ONE compiled program
+(:meth:`RaggedInferenceModel.ragged_forward`) per engine step, mixing two
+atom classes — single-token decode rows (paged Pallas attention, never
+padded to chunk length) and prefill chunk rows (batched chunk attention) —
+with projections/MLP fused over the concatenated token stream and the KV
+cache donated. Shapes are bucketed so a serving loop reuses a handful of
+compiled programs. This is the XLA expression of Dynamic SplitFuse
+(reference atom_builder + flash_attn_by_atoms, ragged_ops.cpp:20-47); the
+scheduler (scheduler.py) mixes prompt chunks and generation inside one
+token budget per engine step.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,7 +34,7 @@ from .config_v2 import RaggedInferenceEngineConfig
 from .model import RaggedInferenceModel
 from .ragged.kv_cache import BlockedKVCache
 from .ragged.ragged_manager import DSStateManager
-from .ragged.ragged_wrapper import RaggedBatchWrapper, _next_bucket
+from .ragged.ragged_wrapper import _next_bucket
 
 
 class InferenceEngineV2:
@@ -60,9 +64,6 @@ class InferenceEngineV2:
             c.num_layers, c.kv_heads, c.head_dim, num_blocks, block_size,
             dtype=self.config.kv_cache_dtype)
         self.state_manager = DSStateManager(sm, self.kv_cache)
-        self.batch = RaggedBatchWrapper(sm.max_ragged_sequence_count,
-                                        self.max_blocks_per_seq)
-
         self._model = RaggedInferenceModel(model, block_size, self.max_blocks_per_seq)
         self.model = model
 
@@ -82,8 +83,6 @@ class InferenceEngineV2:
                 jax.device_put(self.kv_cache.k_pages, kv_spec),
                 jax.device_put(self.kv_cache.v_pages, kv_spec))
 
-        self._prefill_jits: Dict[int, Any] = {}
-        self._decode_jits: Dict[int, Any] = {}
         log_dist(
             f"InferenceEngineV2: {num_blocks} KV blocks × {block_size} tokens "
             f"({self.kv_cache.mem_bytes() / 2**20:.0f} MiB), "
@@ -102,21 +101,11 @@ class InferenceEngineV2:
                 out_shardings=shardings)(params)
 
     # ------------------------------------------------------------------
-    # compiled-program cache
+    # compiled-program cache (jax.jit retraces per (S, T, mp) bucket)
     # ------------------------------------------------------------------
-    def _prefill_fn(self, bucket: int):
-        fn = self._prefill_jits.get(bucket)
-        if fn is None:
-            fn = jax.jit(self._model.prefill_chunk, donate_argnums=(1, 2))
-            self._prefill_jits[bucket] = fn
-        return fn
-
-    def _decode_fn(self, bucket: int):
-        fn = self._decode_jits.get(bucket)
-        if fn is None:
-            fn = jax.jit(self._model.decode, donate_argnums=(1, 2))
-            self._decode_jits[bucket] = fn
-        return fn
+    @functools.cached_property
+    def _ragged_fn(self):
+        return jax.jit(self._model.ragged_forward, donate_argnums=(1, 2))
 
     # ------------------------------------------------------------------
     # scheduling queries (reference engine_v2.py:153,179)
@@ -128,6 +117,11 @@ class InferenceEngineV2:
             "cur_allocated_blocks": 0 if seq is None else seq.cur_allocated_blocks,
             "free_blocks": self.state_manager.free_blocks,
         }
+
+    @property
+    def max_context(self) -> int:
+        """Longest sequence the KV layout can hold (per sequence)."""
+        return self.max_blocks_per_seq * self.state_manager.block_size
 
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
         """Dry-run KV block budgeting (reference ``can_schedule``/
@@ -142,6 +136,10 @@ class InferenceEngineV2:
             seq = self.state_manager.get_sequence(uid)
             seen = 0 if seq is None else seq.seen_tokens
             have = 0 if seq is None else seq.cur_allocated_blocks
+            if seen + n > self.max_context:
+                # growing past the block-table capacity would silently
+                # overwrite the sequence's own live KV
+                return False
             total_blocks = -(-(seen + n) // self.state_manager.block_size)
             need += max(0, total_blocks - have)
         return need <= self.state_manager.free_blocks
@@ -154,76 +152,111 @@ class InferenceEngineV2:
     # ------------------------------------------------------------------
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[np.ndarray]) -> np.ndarray:
         """Schedule new tokens for each UID; returns last-token logits
-        [len(uids), vocab]."""
-        sm = self.config.state_manager
+        [len(uids), vocab].
+
+        ONE device dispatch serves the whole ragged batch — mixed prefill
+        chunks and decodes in a single compiled program (the SplitFuse
+        contract; reference atom_builder + flash_attn_by_atoms). Prompts
+        longer than ``max_prefill_chunk`` take one extra dispatch per extra
+        chunk wave.
+        """
         if not self.can_schedule(batch_uids, [len(t) for t in batch_tokens]):
             raise RuntimeError("batch does not fit KV/budget; call can_schedule first")
 
-        decode_uids, decode_tokens = [], []
-        out_logits: Dict[int, np.ndarray] = {}
+        work: List[Tuple[int, np.ndarray]] = []
         for uid, tokens in zip(batch_uids, batch_tokens):
             tokens = np.asarray(tokens, np.int32)
             seq = self.state_manager.get_or_create_sequence(uid)
             self.state_manager.allocate_blocks(seq, len(tokens))
-            if len(tokens) == 1 and seq.seen_tokens > 0:
-                decode_uids.append(uid)
-                decode_tokens.append(tokens)
-            else:
-                out_logits[uid] = self._run_prefill(seq, tokens)
+            work.append((uid, tokens))
 
-        if decode_uids:
-            for uid, logits in zip(decode_uids,
-                                   self._run_decode(decode_uids, decode_tokens)):
-                out_logits[uid] = logits
+        cap = self.config.max_prefill_chunk
+        out_logits: Dict[int, np.ndarray] = {}
+        offset = {uid: 0 for uid, _ in work}
+        while True:
+            wave = [(uid, toks[offset[uid]:offset[uid] + cap])
+                    for uid, toks in work if offset[uid] < len(toks)]
+            if not wave:
+                break
+            logits = self._run_ragged(wave)
+            for i, (uid, chunk) in enumerate(wave):
+                offset[uid] += len(chunk)
+                out_logits[uid] = logits[i]
         return np.stack([out_logits[u] for u in batch_uids])
 
-    def _run_prefill(self, seq, tokens: np.ndarray) -> np.ndarray:
-        """Chunked prefill of one sequence (SplitFuse chunks)."""
-        chunk_cap = self.config.max_prefill_chunk
-        logits = None
-        off = 0
-        while off < len(tokens):
-            chunk = tokens[off:off + chunk_cap]
-            n = len(chunk)
-            bucket = _next_bucket(n, lo=16)
-            padded = np.zeros((bucket,), np.int32)
-            padded[:n] = chunk
-            hist = seq.seen_tokens
-            positions = hist + np.arange(bucket, dtype=np.int32)
-            bt = np.zeros((self.max_blocks_per_seq,), np.int32)
-            bt[:len(seq.blocks)] = seq.blocks
-            fn = self._prefill_fn(bucket)
-            with self.mesh:
-                lg, k_pages, v_pages = fn(
-                    self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
-                    jnp.asarray(padded), jnp.asarray(positions), jnp.asarray(bt),
-                    jnp.asarray(hist, jnp.int32), jnp.asarray(n, jnp.int32))
-            self.kv_cache.update(k_pages, v_pages)
-            seq.post_forward(n)
-            logits = lg
-            off += n
-        return np.asarray(logits)
+    def _bucket_blocks(self, uids) -> int:
+        need = max((len(self.state_manager.get_sequence(u).blocks) for u in uids),
+                   default=1)
+        return min(self.max_blocks_per_seq, _next_bucket(max(need, 1), lo=4))
 
-    def _run_decode(self, uids: List[int], tokens: List[np.ndarray]) -> np.ndarray:
-        self.batch.clear()
-        for uid, toks in zip(uids, tokens):
-            seq = self.state_manager.get_sequence(uid)
-            self.batch.insert_sequence(uid, toks, seq.seen_tokens, seq.blocks)
-        meta = self.batch.finalize()
-        n = meta["num_seqs"]
-        # padded rows: context_len 1 against the null block (finite softmax)
-        ctx = meta["context_lens"]
-        ctx[n:] = 1
-        fn = self._decode_fn(len(meta["tokens"]))
+    def _run_ragged(self, wave: List[Tuple[int, np.ndarray]]) -> np.ndarray:
+        """One dispatch of the mixed ragged batch. wave: [(uid, chunk)].
+
+        Splits the wave into the two atom classes of ``ragged_forward`` —
+        decode rows (1 continuing token) and prefill chunk rows — builds
+        their padded metadata, and dispatches once.
+        """
+        sm = self.state_manager
+        decode = [(u, c) for u, c in wave
+                  if len(c) == 1 and sm.get_sequence(u).seen_tokens > 0]
+        prefill = [(u, c) for u, c in wave
+                   if not (len(c) == 1 and sm.get_sequence(u).seen_tokens > 0)]
+
+        # lo=16: padded decode rows are near-free (they attend 1 null-block
+        # token), while each distinct Bd bucket costs a full XLA compile —
+        # keep the program-shape space tiny for the serving loop
+        Bd = _next_bucket(len(decode), lo=16) if decode else 0
+        mpd = self._bucket_blocks([u for u, _ in decode]) if decode else 1
+        d_tokens = np.zeros((Bd,), np.int32)
+        d_positions = np.zeros((Bd,), np.int32)
+        d_context = np.ones((Bd,), np.int32)  # padded rows hit the null block
+        d_tables = np.zeros((Bd, mpd), np.int32)
+        for i, (uid, chunk) in enumerate(decode):
+            seq = sm.get_sequence(uid)
+            d_tokens[i] = chunk[0]
+            d_positions[i] = seq.seen_tokens
+            d_context[i] = seq.seen_tokens + 1
+            bt = seq.blocks[:mpd]
+            d_tables[i, :len(bt)] = bt
+
+        t_max = max((len(c) for _, c in prefill), default=0)
+        Sp = _next_bucket(len(prefill), lo=1) if prefill else 0
+        T = _next_bucket(t_max, lo=16) if prefill else 1
+        mpp = self._bucket_blocks([u for u, _ in prefill]) if prefill else 1
+        p_tokens = np.zeros((Sp, T), np.int32)
+        p_positions = np.zeros((Sp, T), np.int32)
+        p_valid = np.zeros((Sp,), np.int32)
+        p_history = np.zeros((Sp,), np.int32)
+        p_tables = np.zeros((Sp, mpp), np.int32)
+        for i, (uid, chunk) in enumerate(prefill):
+            seq = sm.get_sequence(uid)
+            k = len(chunk)
+            p_tokens[i, :k] = chunk
+            p_positions[i, :k] = seq.seen_tokens + np.arange(k, dtype=np.int32)
+            p_valid[i] = k
+            p_history[i] = seq.seen_tokens
+            bt = seq.blocks[:mpp]
+            p_tables[i, :len(bt)] = bt
+
         with self.mesh:
-            logits, k_pages, v_pages = fn(
+            logits, k_pages, v_pages = self._ragged_fn(
                 self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
-                jnp.asarray(meta["tokens"]), jnp.asarray(meta["positions"]),
-                jnp.asarray(ctx), jnp.asarray(meta["block_tables"]))
+                jnp.asarray(d_tokens), jnp.asarray(d_positions),
+                jnp.asarray(d_context), jnp.asarray(d_tables),
+                jnp.asarray(p_tokens), jnp.asarray(p_positions),
+                jnp.asarray(p_valid), jnp.asarray(p_history),
+                jnp.asarray(p_tables))
         self.kv_cache.update(k_pages, v_pages)
-        for uid in uids:
-            self.state_manager.get_sequence(uid).post_forward(1)
-        return np.asarray(logits)[:n]
+        for uid, chunk in wave:
+            sm.get_sequence(uid).post_forward(len(chunk))
+
+        logits = np.asarray(logits)
+        by_uid = {}
+        for i, (uid, _) in enumerate(decode):
+            by_uid[uid] = logits[i]
+        for i, (uid, _) in enumerate(prefill):
+            by_uid[uid] = logits[Bd + i]
+        return np.stack([by_uid[u] for u, _ in wave])
 
 
 def build_engine(model: TransformerLM,

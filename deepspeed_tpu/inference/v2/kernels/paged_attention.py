@@ -88,19 +88,67 @@ def paged_decode_attention(q: jax.Array,
     if use_pallas is None:
         use_pallas = _pallas_paged_available()
     if use_pallas:
-        from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention as pa
+        from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention as pa_kernel
         pages_per_block = min(8, block_tables.shape[1])
         while block_tables.shape[1] % pages_per_block:
             pages_per_block -= 1
         try:
-            return pa.paged_attention(
+            return pa_kernel(
                 (q * scale).astype(q.dtype),  # kernel applies no softmax scale itself
                 k_pages, v_pages,
                 lengths=context_lens, page_indices=block_tables,
                 pages_per_compute_block=pages_per_block)
-        except Exception:  # pragma: no cover - shape/backend constraint
-            pass
+        except (ValueError, TypeError, NotImplementedError) as e:
+            # shape/backend constraints the kernel cannot express; anything
+            # else (real bugs) propagates
+            global _KERNEL_FALLBACK_WARNED
+            if not _KERNEL_FALLBACK_WARNED:
+                _KERNEL_FALLBACK_WARNED = True
+                from ....utils.logging import logger
+                logger.warning(
+                    f"paged_decode_attention: Pallas kernel rejected shapes "
+                    f"q={q.shape} pages={k_pages.shape} "
+                    f"({type(e).__name__}: {e}); using XLA gather fallback")
     return _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables, scale)
+
+
+_KERNEL_FALLBACK_WARNED = False
+
+
+def ragged_chunk_attention(q: jax.Array,
+                           k_pages: jax.Array,
+                           v_pages: jax.Array,
+                           history_lens: jax.Array,
+                           block_tables: jax.Array,
+                           scale: Optional[float] = None) -> jax.Array:
+    """Batched SplitFuse attention: S sequences × T chunk tokens each.
+
+    The one-program form of the reference's ``build_atoms`` +
+    ``flash_attn_by_atoms`` (ragged_ops.cpp:20-47): every scheduled
+    sequence-chunk (prefill of any length and single-token decodes alike)
+    attends against its own blocked KV in a single dispatch.
+
+    q [S, T, H, D] — chunk queries; query t of sequence s sits at absolute
+    position ``history_lens[s] + t``. k_pages/v_pages [kvH, P, ps, D] with
+    this step's KV already written. block_tables [S, mp]; context length per
+    sequence is implied causally (ctx position c attends iff
+    ``c <= history + t``). Returns [S, T, H, D].
+    """
+    S, T, H, D = q.shape
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    k = _gather_pages(k_pages, block_tables)            # [S, kvH, C, D]
+    v = _gather_pages(v_pages, block_tables)
+    kvH, C = k.shape[1], k.shape[2]
+    group = H // kvH
+    qg = q.reshape(S, T, kvH, group, D)
+    logits = jnp.einsum("stkgd,skcd->stkgc", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    pos_q = history_lens[:, None] + jnp.arange(T)[None, :]        # [S, T]
+    allowed = jnp.arange(C)[None, None, :] <= pos_q[:, :, None]   # [S, T, C]
+    logits = jnp.where(allowed[:, :, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("stkgc,skcd->stkgd", probs, v)
+    return out.reshape(S, T, H, D)
 
 
 def chunk_prefill_attention(q: jax.Array,

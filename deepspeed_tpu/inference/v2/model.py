@@ -31,7 +31,8 @@ import jax.numpy as jnp
 
 from ...models.transformer import TransformerLM
 from ...nn import layers as nn
-from .kernels.paged_attention import chunk_prefill_attention, paged_decode_attention
+from .kernels.paged_attention import (chunk_prefill_attention, paged_decode_attention,
+                                      ragged_chunk_attention)
 
 Params = Dict[str, Any]
 
@@ -132,6 +133,72 @@ class RaggedInferenceModel:
         return x, k_pages, v_pages
 
     # -- programs -----------------------------------------------------------
+    def ragged_forward(self, params: Params, k_pages, v_pages,
+                       d_tokens, d_positions, d_context_lens, d_block_tables,
+                       p_tokens, p_positions, p_valid, p_history, p_block_tables):
+        """THE SplitFuse program: one dispatch over a ragged batch mixing two
+        atom classes (the reference's ``build_atoms``/``flash_attn_by_atoms``,
+        ragged_ops.cpp:20-47):
+
+        - decode atoms  — [Bd] single tokens, paged Pallas attention, NOT
+          padded to the prefill chunk length;
+        - prefill atoms — [Sp, T] chunk grid, batched chunk attention.
+
+        Projections / MLP / norms run fused over the concatenated token
+        stream [Bd + Sp*T] — the fixed-size forward composition that is the
+        point of Dynamic SplitFuse. Either class may be empty (static).
+        Returns (logits [Bd + Sp, V] — decode rows first, then each prefill
+        chunk's last valid token — k_pages, v_pages).
+        """
+        ps = self.block_size
+        Bd = d_tokens.shape[0]
+        Sp, T = p_tokens.shape
+        max_flat = k_pages.shape[2] * ps
+        max_pos = self.max_blocks_per_seq * ps - 1
+
+        tokens = jnp.concatenate([d_tokens, p_tokens.reshape(-1)])
+        positions = jnp.concatenate([d_positions, p_positions.reshape(-1)])
+        x = self._embed(params, tokens, positions)          # [N, hid]
+
+        # KV write targets. decode: one slot per row; prefill: grid slots,
+        # padded tokens land in the reserved null block 0.
+        d_pos = jnp.clip(d_positions, 0, max_pos)
+        d_pages = jnp.take_along_axis(
+            d_block_tables, jnp.clip(d_pos[:, None] // ps, 0,
+                                     d_block_tables.shape[1] - 1), axis=1)[:, 0]
+        d_write = d_pages * ps + d_pos % ps
+        p_pos = jnp.clip(p_positions, 0, max_pos)
+        p_pages = jnp.take_along_axis(
+            p_block_tables, jnp.clip(p_pos // ps, 0,
+                                     p_block_tables.shape[1] - 1), axis=1)
+        p_ok = jnp.arange(T)[None, :] < p_valid[:, None]
+        p_write = jnp.where(p_ok, p_pages * ps + p_pos % ps, 0)
+        write_idx = jnp.clip(
+            jnp.concatenate([d_write, p_write.reshape(-1)]), 0, max_flat - 1)
+
+        def attn(q, k_l, v_l):
+            outs = []
+            if Bd:
+                outs.append(paged_decode_attention(
+                    q[:Bd], k_l, v_l, d_context_lens, d_block_tables,
+                    use_pallas=self.use_pallas))
+            if Sp:
+                op = ragged_chunk_attention(
+                    q[Bd:].reshape(Sp, T, *q.shape[1:]), k_l, v_l,
+                    p_history, p_block_tables)
+                outs.append(op.reshape(Sp * T, *op.shape[2:]))
+            return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
+
+        x, k_pages, v_pages = self._layer_loop(
+            params, k_pages, v_pages, x, attn, write_idx, positions)
+
+        rows = [x[:Bd]]
+        if Sp:
+            last = jnp.clip(p_valid - 1, 0, T - 1)
+            rows.append(x[Bd:].reshape(Sp, T, -1)[jnp.arange(Sp), last])
+        logits = self._unembed(params, jnp.concatenate(rows) if Sp else rows[0])
+        return logits, k_pages, v_pages
+
     def prefill_chunk(self, params: Params, k_pages, v_pages, tokens, positions,
                       block_table, history_len, n_valid):
         """One sequence, T_pad chunk tokens. Returns (last_logits [V],

@@ -28,6 +28,8 @@ class Request:
     prompt_consumed: int = 0
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    # how many generated tokens have been folded into `prompt` by preemption
+    folded: int = 0
 
     @property
     def prefill_remaining(self) -> int:
@@ -47,6 +49,10 @@ class ContinuousBatchingScheduler:
     # -- client API ---------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int = 64,
                temperature: float = 0.0, eos_token_id: Optional[int] = None) -> Request:
+        max_ctx = getattr(self.engine, "max_context", None)
+        if max_ctx is not None and len(prompt) >= max_ctx:
+            raise ValueError(f"prompt of {len(prompt)} tokens cannot fit the "
+                             f"engine's max context of {max_ctx}")
         req = Request(uid=next(self._uid_gen), prompt=np.asarray(prompt, np.int32),
                       max_new_tokens=max_new_tokens, temperature=temperature,
                       eos_token_id=eos_token_id)
@@ -69,6 +75,27 @@ class ContinuousBatchingScheduler:
         req.done = True
         self.engine.flush(req.uid)
 
+    def _preempt(self, req: Request) -> None:
+        """KV pressure: drop the sequence's cache and requeue it for
+        re-prefill (prompt + everything generated so far), continuing
+        generation afterwards — the flush-and-recompute preemption the
+        reference leaves to the serving layer."""
+        self.engine.flush(req.uid)
+        self._running.remove(req)
+        # fold only the not-yet-folded tail: a second preemption must not
+        # duplicate tokens already moved into the prompt
+        fresh = req.generated[req.folded:]
+        req.prompt = np.concatenate([req.prompt, np.asarray(fresh, np.int32)])
+        req.folded = len(req.generated)
+        req.prompt_consumed = 0
+        max_ctx = getattr(self.engine, "max_context", None)
+        if max_ctx is not None and len(req.prompt) >= max_ctx:
+            # context capacity reached — generation ends here (its KV is
+            # already flushed); requeueing would head-of-line block forever
+            req.done = True
+            return
+        self._queue.insert(0, req)
+
     # -- one engine step ----------------------------------------------------
     def step(self) -> int:
         """Run one SplitFuse-composed forward; returns tokens processed."""
@@ -78,10 +105,16 @@ class ContinuousBatchingScheduler:
         budget = self.token_budget
 
         # 1. decode tokens for running sequences (highest priority — keeps
-        #    generation latency EMA stable, the reference's SLA framing)
+        #    generation latency EMA stable, the reference's SLA framing).
+        #    Decodes are budgeted through can_schedule too: crossing a KV
+        #    block boundary with no free blocks must preempt, not crash put()
         for req in list(self._running):
             if budget <= 0:
                 break
+            if not self.engine.can_schedule(uids + [req.uid],
+                                            [len(t) for t in tokens] + [1]):
+                self._preempt(req)
+                continue
             nxt = req.generated[-1]
             uids.append(req.uid)
             tokens.append(np.asarray([nxt], np.int32))
@@ -123,7 +156,10 @@ class ContinuousBatchingScheduler:
                 tok = self._sample(req, by_uid[req.uid])
                 req.generated.append(tok)
                 self._queue.remove(req)
-                if req.max_new_tokens <= 1:
+                # len() check, not ==1: a preempted request resumes prefill
+                # with part of its generation budget already spent
+                if ((req.eos_token_id is not None and tok == req.eos_token_id)
+                        or len(req.generated) >= req.max_new_tokens):
                     self._finish(req)
                 else:
                     self._running.append(req)

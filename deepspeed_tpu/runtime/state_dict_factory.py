@@ -235,13 +235,13 @@ def _llama_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str
         blocks["moe"] = {
             "gate": _stack(sd, "model.layers.{i}.block_sparse_moe.gate.weight", L, T),
             "wi_gate": np.stack([np.stack(
-                [T(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight"])
+                [T(sd.pop(f"model.layers.{i}.block_sparse_moe.experts.{e}.w1.weight"))
                  for e in range(E)]) for i in range(L)]),
             "wi_up": np.stack([np.stack(
-                [T(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"])
+                [T(sd.pop(f"model.layers.{i}.block_sparse_moe.experts.{e}.w3.weight"))
                  for e in range(E)]) for i in range(L)]),
             "wo": np.stack([np.stack(
-                [T(sd[f"model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight"])
+                [T(sd.pop(f"model.layers.{i}.block_sparse_moe.experts.{e}.w2.weight"))
                  for e in range(E)]) for i in range(L)]),
         }
     else:

@@ -113,6 +113,37 @@ class DeepSpeedEngine:
                                  "(the reference's supported regime)")
             self._onebit_opt = self._build_onebit_optimizer(config)
 
+        # -- ZeRO++ (reference stage3.py:119, partition_parameters.py:1551,
+        #    coalesced_collectives.py:31): quantized collectives need the
+        #    gradient/param comm EXPLICIT (shard_map), so the knobs select a
+        #    dedicated micro-step build. Reject unsupported compositions
+        #    loudly instead of silently ignoring the knobs. ----------------
+        zc = config.zero_config
+        self._zeropp = (zc.zero_quantized_gradients or zc.zero_quantized_weights
+                        or zc.zero_hpz_partition_size > 1)
+        if self._zeropp:
+            t = self.topology
+            if (t.model_parallel_size * t.sequence_parallel_size
+                    * t.pipe_parallel_size * t.expert_parallel_size) != 1:
+                raise ValueError(
+                    "ZeRO++ (zero_quantized_weights/gradients, hpZ) requires a "
+                    "pure data-parallel mesh (plus the mics axis for hpZ); got "
+                    f"{t}")
+            if zc.stage < 2:
+                raise ValueError("ZeRO++ requires zero stage >= 2")
+            if zc.zero_quantized_weights and zc.stage < 3:
+                raise ValueError("zero_quantized_weights requires zero stage 3 "
+                                 "(params must be sharded to gather)")
+            if zc.zero_hpz_partition_size > 1 and \
+                    t.mics_shard_size != zc.zero_hpz_partition_size:
+                raise ValueError(
+                    f"zero_hpz_partition_size={zc.zero_hpz_partition_size} needs "
+                    f"a mesh with mics={zc.zero_hpz_partition_size} (the "
+                    f"secondary-partition group); got mics={t.mics_shard_size}")
+            if self._onebit_opt is not None:
+                raise ValueError("ZeRO++ and 1-bit optimizers are mutually "
+                                 "exclusive compression schemes")
+
         # -- ZeRO plan -------------------------------------------------------
         param_specs = model.specs()
         shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), self.param_dtype))
@@ -483,6 +514,146 @@ class DeepSpeedEngine:
 
         return micro_step, apply_step
 
+    # ------------------------------------------------------------------
+    # ZeRO++ explicit micro step: qwZ int8 param all-gather, qgZ int8
+    # gradient reduce-scatter, hpZ secondary shard on the 'mics' axis
+    # (reference partition_parameters.py:1101/1551, coalesced_collectives.py:31)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _dp_axes_in(spec):
+        """(dim, dp_axes) of the ZeRO-sharded dim of ``spec`` (or (None, ()))."""
+        from .topology import EXPERT_AXIS, MICS_AXIS, SEQ_AXIS
+        dp_set = (DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS)
+        for dim, entry in enumerate(spec):
+            if entry is None:
+                continue
+            ax = entry if isinstance(entry, (tuple, list)) else (entry,)
+            dp = tuple(a for a in ax if a in dp_set)
+            if dp:
+                return dim, dp
+        return None, ()
+
+    def _build_zeropp_micro(self):
+        from jax import shard_map
+        from .topology import MICS_AXIS
+        from ..ops.quantizer.quantizer import (quantized_all_gather,
+                                               quantized_reduce_scatter)
+
+        zc = self.config.zero_config
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps
+        model = self.model
+        grad_dtype = self.grad_dtype
+        hpz = zc.zero_hpz_partition_size > 1
+        all_dp = tuple(a for a in (DATA_AXIS, MICS_AXIS)
+                       if self.topology.axis_size(a) > 1) or (DATA_AXIS,)
+        n_dp = self.topology.axis_size(all_dp)
+
+        param_specs = self.zero_plan.param_spec_tree()
+        grad_specs = self.zero_plan.grad_spec_tree()
+        # hpZ: the micro step reads from the SECONDARY partition — sharded
+        # over 'mics' only (intra-group gathers), refreshed from the primary
+        # once per optimizer step.
+        if hpz:
+            gather_src_specs = jax.tree.map(
+                lambda s: self._hpz_secondary_spec(s), param_specs,
+                is_leaf=lambda s: isinstance(s, P))
+        else:
+            gather_src_specs = param_specs
+
+        def gather_full(x, spec):
+            dim, axes = self._dp_axes_in(spec)
+            if dim is None:
+                return x
+            axes = tuple(a for a in axes if self.topology.axis_size(a) > 1)
+            if not axes:
+                return x
+            xm = jnp.moveaxis(x, dim, 0)
+            if zc.zero_quantized_weights:
+                g = quantized_all_gather(xm, axis=axes)
+            else:
+                g = jax.lax.all_gather(xm, axes, axis=0, tiled=True)
+            return jnp.moveaxis(g, 0, dim)
+
+        def scatter_grad(g, spec):
+            dim, axes = self._dp_axes_in(spec)
+            axes = tuple(a for a in axes if self.topology.axis_size(a) > 1)
+            if dim is None or not axes:
+                return jax.lax.psum(g, all_dp) / n_dp
+            gm = jnp.moveaxis(g.astype(jnp.float32), dim, 0)
+            if zc.zero_quantized_gradients:
+                r = quantized_reduce_scatter(gm, axis=axes)
+            else:
+                r = jax.lax.psum_scatter(gm, axes, scatter_dimension=0, tiled=True)
+            return jnp.moveaxis(r, 0, dim) / n_dp
+
+        batch_rep = self._REPLICATED_BATCH_KEYS
+
+        def local_micro(param_shards, gacc_shards, scale, batch):
+            full = jax.tree.map(gather_full, param_shards, gather_src_specs,
+                                is_leaf=lambda s: isinstance(s, P))
+
+            def scaled_loss(p):
+                loss = model.loss(p, batch)
+                return loss * (scale / gas), loss
+
+            grads, loss = jax.grad(scaled_loss, has_aux=True)(full)
+            gshard = jax.tree.map(scatter_grad, grads, grad_specs,
+                                  is_leaf=lambda s: isinstance(s, P))
+            gacc = jax.tree.map(lambda a, g: a + g.astype(grad_dtype),
+                                gacc_shards, gshard)
+            return gacc, jax.lax.pmean(loss, all_dp)
+
+        gacc_specs = grad_specs
+
+        def micro_step(state, secondary, batch):
+            batch_specs = {k: (P() if k in batch_rep else P(BATCH_AXES))
+                           for k in batch}
+            sm = shard_map(local_micro, mesh=mesh,
+                           in_specs=(gather_src_specs, gacc_specs, P(), batch_specs),
+                           out_specs=(gacc_specs, P()), check_vma=False)
+            gacc, loss = sm(secondary, state["grad_acc"],
+                            state["loss_scale"]["cur_scale"], batch)
+            state = dict(state)
+            state["grad_acc"] = gacc
+            return state, loss
+
+        return micro_step
+
+    @staticmethod
+    def _hpz_secondary_spec(spec: P) -> P:
+        """Replace the ZeRO dp-sharding of a leaf with 'mics'-only sharding
+        (the hpZ secondary partition, reference _partition_param_sec,
+        partition_parameters.py:1551)."""
+        from .topology import MICS_AXIS
+        dim, dp = DeepSpeedEngine._dp_axes_in(spec)
+        if dim is None:
+            return P(*spec)
+        entries = list(spec)
+        entry = entries[dim]
+        ax = entry if isinstance(entry, (tuple, list)) else (entry,)
+        keep = tuple(a for a in ax if a not in dp) + (MICS_AXIS,)
+        entries[dim] = keep if len(keep) > 1 else keep[0]
+        return P(*entries)
+
+    def _refresh_secondary(self):
+        """Rebuild the hpZ secondary partition from the primary params —
+        the once-per-optimizer-step inter-group all-gather."""
+        if not getattr(self, "_zeropp", False):
+            return
+        if self.config.zero_config.zero_hpz_partition_size > 1:
+            specs = jax.tree.map(self._hpz_secondary_spec,
+                                 self.zero_plan.param_spec_tree(),
+                                 is_leaf=lambda s: isinstance(s, P))
+            shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mesh, s), specs,
+                is_leaf=lambda s: isinstance(s, P))
+            with self.mesh:
+                self._secondary = jax.jit(
+                    lambda p: p, out_shardings=shardings)(self.state["params"])
+        else:
+            self._secondary = self.state["params"]
+
     def _build_jits(self):
         if self._jit_micro_step is not None and self._jit_apply_step is not None:
             return
@@ -500,6 +671,22 @@ class DeepSpeedEngine:
                 apply_step, donate_argnums=(0,),
                 in_shardings=(shardings, rep),
                 out_shardings=(shardings, rep, rep))
+            return
+        if self._zeropp:
+            if getattr(self, "_secondary", None) is None:
+                self._refresh_secondary()
+            if self._jit_micro_step is None:
+                # no donation: at hpz=1 the secondary IS state["params"], and
+                # donating buffers that are also live inputs is invalid
+                self._jit_micro_step = jax.jit(
+                    self._build_zeropp_micro(),
+                    in_shardings=(shardings, None, None),
+                    out_shardings=(shardings, rep))
+            if self._jit_apply_step is None:
+                self._jit_apply_step = jax.jit(
+                    self._apply_step_fn, donate_argnums=(0,),
+                    in_shardings=(shardings, rep),
+                    out_shardings=(shardings, rep, rep))
             return
         if self._jit_micro_step is None:
             # batch in_shardings None: inherit _device_batch placement (data
@@ -559,7 +746,11 @@ class DeepSpeedEngine:
                 self._pld_rng, self.model.config.num_layers)
         batch = self._device_batch(batch)
         with self.mesh:
-            self.state, loss = self._jit_micro_step(self.state, batch)
+            if self._zeropp:
+                self.state, loss = self._jit_micro_step(
+                    self.state, self._secondary, batch)
+            else:
+                self.state, loss = self._jit_micro_step(self.state, batch)
         self._cached_loss = loss
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
@@ -587,6 +778,8 @@ class DeepSpeedEngine:
         else:
             with self.mesh:
                 self.state, overflow, gnorm = self._jit_apply_step(self.state, lr)
+        if self._zeropp:
+            self._refresh_secondary()
         self.global_steps += 1
         if self.config.fp16.enabled and bool(overflow):
             # skipped update does not consume schedule (reference engine.py:2053)

@@ -1,0 +1,140 @@
+"""ZeRO++ tests (reference tests/unit/runtime/zero/test_zeropp.py): the
+quantized-collective knobs must actually change the communication — int8
+gathers/reduce-scatters on the wire — while training within quantization
+tolerance of the fp32-collective baseline."""
+
+import re
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2_model
+from deepspeed_tpu.runtime.topology import MeshTopology, TopologyConfig
+
+CFG = dict(max_seq_len=32, vocab_size=256, remat=False)
+
+
+def make_engine(zero_extra=None, topology=None, stage=3, seed=11):
+    model = gpt2_model("gpt2-tiny", dtype=jnp.float32, **CFG)
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": dict({"stage": stage,
+                                   "stage3_param_persistence_threshold": 0},
+                                  **(zero_extra or {})),
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config,
+                                               topology=topology, seed=seed)
+    return engine
+
+
+def train_losses(engine, steps=4, batch=8, seed=5):
+    data = {"input_ids": np.random.default_rng(seed).integers(0, 256, size=(batch, 16))}
+    return [float(engine.train_batch(data)) for _ in range(steps)]
+
+
+def micro_hlo(engine):
+    data = {"input_ids": np.random.default_rng(5).integers(0, 256, size=(8, 16))}
+    engine.train_batch(data)
+    args = (engine.state, engine._secondary, engine._device_batch(data)) \
+        if engine._zeropp else (engine.state, engine._device_batch(data))
+    return engine._jit_micro_step.lower(*args).compile().as_text()
+
+
+def collective_bytes(hlo: str, ops=("all-to-all", "all-gather", "all-reduce",
+                                    "reduce-scatter", "collective-permute")) -> int:
+    """Sum output-buffer bytes of communication ops in an HLO dump."""
+    sizes = {"s8": 1, "u8": 1, "bf16": 2, "f16": 2, "f32": 4, "s32": 4, "u32": 4}
+    total = 0
+    for m in re.finditer(r"(\w+)\[([\d,]*)\][^=]*= ([\w-]+)\(", hlo):
+        dtype, shape, op = m.groups()
+        if not any(op.startswith(o) for o in ops):
+            continue
+        if dtype not in sizes:
+            continue
+        n = 1
+        for d in shape.split(","):
+            if d:
+                n *= int(d)
+        total += n * sizes[dtype]
+    return total
+
+
+class TestZeroPlusPlus:
+
+    def test_qgz_int8_gradient_reduction(self, eight_devices):
+        """zero_quantized_gradients: int8 all-to-alls on the wire, fewer
+        collective bytes, and a training trajectory within quantization
+        tolerance of the fp32 baseline."""
+        base = make_engine()
+        base_losses = train_losses(base)
+        base_bytes = collective_bytes(micro_hlo(base))
+
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        qgz = make_engine({"zero_quantized_gradients": True})
+        qgz_losses = train_losses(qgz)
+        hlo = micro_hlo(qgz)
+        assert re.search(r"s8\[[\d,]*\][^=]*= all-to-all", hlo), \
+            "no int8 all-to-all in the compiled micro step"
+        qgz_bytes = collective_bytes(hlo)
+        assert qgz_bytes < base_bytes, (qgz_bytes, base_bytes)
+        np.testing.assert_allclose(qgz_losses, base_losses, rtol=0.05, atol=0.05)
+        assert qgz_losses[-1] < qgz_losses[0]
+
+    def test_qwz_int8_weight_gather(self, eight_devices):
+        """zero_quantized_weights: stage-3 param gathers become int8."""
+        base = make_engine()
+        base_losses = train_losses(base)
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        qwz = make_engine({"zero_quantized_weights": True})
+        qwz_losses = train_losses(qwz)
+        hlo = micro_hlo(qwz)
+        assert re.search(r"s8\[[\d,]*\][^=]*= all-gather", hlo), \
+            "no int8 all-gather in the compiled micro step"
+        np.testing.assert_allclose(qwz_losses, base_losses, rtol=0.1, atol=0.1)
+        assert qwz_losses[-1] < qwz_losses[0]
+
+    def test_hpz_secondary_partition(self, eight_devices):
+        """zero_hpz_partition_size: forward gathers ride the mics (intra
+        sub-group) axis from a secondary shard, losses track the baseline."""
+        base = make_engine()
+        base_losses = train_losses(base)
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        topo = MeshTopology(TopologyConfig(mics=2, data=-1))
+        hpz = make_engine({"zero_hpz_partition_size": 2}, topology=topo)
+        hpz_losses = train_losses(hpz)
+        np.testing.assert_allclose(hpz_losses, base_losses, rtol=0.05, atol=0.05)
+        # secondary is sharded over mics ONLY (replicated across data)
+        spec = jax.tree.leaves(
+            jax.tree.map(lambda x: x.sharding.spec,
+                         hpz._secondary["blocks"]["fc_in"]["kernel"]))[0]
+        assert "mics" in str(spec) and "'data'" not in str(spec)
+
+    def test_all_three_knobs_compose(self, eight_devices):
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        topo = MeshTopology(TopologyConfig(mics=2, data=-1))
+        eng = make_engine({"zero_hpz_partition_size": 2,
+                           "zero_quantized_weights": True,
+                           "zero_quantized_gradients": True}, topology=topo)
+        losses = train_losses(eng)
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+    def test_rejects_unsupported_compositions(self, eight_devices):
+        with pytest.raises(ValueError, match="pure data-parallel"):
+            make_engine({"zero_quantized_gradients": True},
+                        topology=MeshTopology(TopologyConfig(model=2, data=-1)))
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        with pytest.raises(ValueError, match="stage 3"):
+            make_engine({"zero_quantized_weights": True}, stage=2)
+        topo_mod.reset()
+        with pytest.raises(ValueError, match="mics"):
+            make_engine({"zero_hpz_partition_size": 2})  # default mesh mics=1

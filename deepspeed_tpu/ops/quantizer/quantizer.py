@@ -90,6 +90,12 @@ def quantized_all_gather(x: jax.Array, axis: str = "data", num_bits: int = 8,
     over the mesh axis, dequantize (reference quantized weights all-gather,
     ``partition_parameters.py:1101`` + quantizer kernels). Call inside
     shard_map; halves (int8) or quarters (int4) the gather bytes on ICI."""
+    # Effective group size: never pad a small shard up to a full group —
+    # the padding would travel the wire. int4 packs two values per byte, so
+    # its groups must stay even.
+    group_size = max(1, min(group_size, x.size))
+    if num_bits == 4:
+        group_size = max(2, group_size - group_size % 2)
     q, scale, zero = quantize_blockwise(x, num_bits, group_size)
     q_g = jax.lax.all_gather(q, axis, axis=0, tiled=True)
     s_g = jax.lax.all_gather(scale, axis, axis=0, tiled=True)
@@ -117,6 +123,12 @@ def quantized_reduce_scatter(x: jax.Array, axis: str = "data", num_bits: int = 8
     # (padding lives at each chunk's tail; zeros quantize exactly under
     # symmetric quant, so summed padding stays zero).
     chunk = x.size // n
+    # Effective group size: tiny chunks (biases, norms) must not pad up to a
+    # full group — at group_size=256 and dp=8 that is an 8-32x inflation of
+    # the bytes on the wire for small params.
+    group_size = max(1, min(group_size, chunk))
+    if num_bits == 4:
+        group_size = max(2, group_size - group_size % 2)
     xr = x.astype(jnp.float32).reshape(n, chunk)
     pad = (-chunk) % group_size
     if pad:

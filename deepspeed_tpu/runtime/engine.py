@@ -134,6 +134,10 @@ class DeepSpeedEngine:
             if zc.zero_quantized_weights and zc.stage < 3:
                 raise ValueError("zero_quantized_weights requires zero stage 3 "
                                  "(params must be sharded to gather)")
+            if zc.zero_hpz_partition_size > 1 and zc.stage < 3:
+                raise ValueError("zero_hpz_partition_size > 1 requires zero "
+                                 "stage 3 (params must be dp-sharded to have "
+                                 "a secondary partition)")
             if zc.zero_hpz_partition_size > 1 and \
                     t.mics_shard_size != zc.zero_hpz_partition_size:
                 raise ValueError(
@@ -337,26 +341,132 @@ class DeepSpeedEngine:
             self._init_offload_runner(state)
         return state
 
+    # elements per NVMe-paged optimizer-state chunk (each chunk's read
+    # overlaps the previous chunk's CPU step — double-buffered)
+    _OFFLOAD_CHUNK_ELEMS = 4 << 20
+
+    def _chunked(self, a: np.ndarray):
+        c = self._OFFLOAD_CHUNK_ELEMS
+        return [a[i:i + c] for i in range(0, max(a.size, 1), c)]
+
+    def _offload_ckpt_path(self, dirname: str) -> str:
+        """Per-process file: each host owns only its local master segment."""
+        if jax.process_count() == 1:
+            return os.path.join(dirname, "offload_optimizer.npz")
+        return os.path.join(dirname,
+                            f"offload_optimizer.rank{jax.process_index()}.npz")
+
+    def _leaf_flat_layouts(self, spec_tree):
+        """Per-leaf flat layout: (sharded_dim | None, dp_axes) from the
+        optimizer partitioning spec. The flat form moves the sharded dim to
+        the front before reshape(-1) — a LOCAL transpose, so the SPMD
+        partitioner never has to rematerialize (the concat-everything
+        layout forced a full replicate-and-reslice of every leaf)."""
+        layouts = []
+        for spec in jax.tree.leaves(spec_tree,
+                                    is_leaf=lambda s: isinstance(s, P)):
+            dim, axes = self._dp_axes_in(spec)
+            axes = tuple(a for a in axes if self.topology.axis_size(a) > 1)
+            layouts.append((dim if axes else None, axes))
+        return layouts
+
+    @staticmethod
+    def _to_flat(x, dim):
+        x = x.astype(jnp.float32)
+        if dim is not None:
+            x = jnp.moveaxis(x, dim, 0)
+        return x.reshape(-1)
+
+    @staticmethod
+    def _leaf_local_groups(arr):
+        """Host-local shards of a 1-D array grouped by global offset:
+        sorted [(start, [devices], np_data)] with replicated copies
+        deduplicated (every device in the group gets the same data back on
+        push)."""
+        groups = {}
+        for s in arr.addressable_shards:
+            start = (s.index[0].start or 0) if s.index else 0
+            groups.setdefault(start, []).append(s)
+        out = []
+        for start in sorted(groups):
+            shards = groups[start]
+            out.append((start, [s.device for s in shards],
+                        np.asarray(shards[0].data, np.float32).reshape(-1)))
+        return out
+
     def _init_offload_runner(self, state) -> None:
-        """Host master copy + CPU/NVMe optimizer (reference offload path)."""
+        """Host master copy + CPU/NVMe optimizer, PARTITIONED over devices.
+
+        Master/optimizer state lives in per-leaf flat fp32 vectors sharded
+        over the dp mesh axes (the reference's flat partitioned buffers,
+        stage_1_and_2.py:1771 — each DP rank owns 1/dp). Each host holds
+        only the segments of its addressable devices, so on a multi-host
+        mesh the per-host master memory, gradient fetch bytes, and CPU
+        optimizer work all scale as 1/n_hosts instead of being replicated.
+        """
         from .zero.offload_optimizer import OffloadedOptimizerRunner
         oc = self.config.zero_config.offload_optimizer
-        host_params = jax.device_get(
-            jax.tree.map(lambda p: p.astype(jnp.float32), state["params"]))
-        leaves, self._offload_treedef = jax.tree.flatten(host_params)
-        self._offload_shapes = [l.shape for l in leaves]
+        t = self.topology
+        if (t.model_parallel_size * t.sequence_parallel_size
+                * t.pipe_parallel_size * t.expert_parallel_size) != 1:
+            raise ValueError(
+                "offload_optimizer requires a pure data-parallel mesh "
+                f"(plus mics); got {t} — the flat host partitioning cannot "
+                "express additional tensor/sequence/pipe sharding")
+
+        leaves_paths, self._offload_treedef = \
+            jax.tree_util.tree_flatten_with_path(state["params"])
+        names, shapes, sizes = [], [], []
+        for path, leaf in leaves_paths:
+            names.append("/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                                  for p in path))
+            shapes.append(leaf.shape)
+            sizes.append(int(leaf.size))
+        self._offload_names = names
+        self._offload_shapes = shapes
+        self._offload_layouts = self._leaf_flat_layouts(
+            self.zero_plan.optimizer_spec_tree())
+        self._offload_layout = {"sizes": sizes, "total": sum(sizes)}
+        self._offload_flat_shardings = tuple(
+            NamedSharding(self.mesh, P(axes) if axes else P())
+            for _, axes in self._offload_layouts)
+
+        layouts = self._offload_layouts
+
+        def flatten_master(params):
+            return tuple(self._to_flat(l, dim) for l, (dim, _)
+                         in zip(jax.tree.leaves(params), layouts))
+
+        with self.mesh:
+            flat_leaves = jax.jit(
+                flatten_master,
+                out_shardings=self._offload_flat_shardings)(state["params"])
+        # spans: (leaf_idx, global_start, length, [devices]) in local
+        # processing order — THE layout contract for fetch/step/push/ckpt
+        self._offload_spans = []
+        pieces = []
+        for i, arr in enumerate(flat_leaves):
+            for start, devices, data in self._leaf_local_groups(arr):
+                self._offload_spans.append((i, start, data.size, devices))
+                pieces.append(data)
+        local_master = (np.concatenate(pieces) if pieces
+                        else np.zeros(0, np.float32))
+        # chunk the local segment so NVMe paging streams fixed-size blocks
+        # (chunk i+1's read overlaps chunk i's CPU step)
+        chunks = self._chunked(local_master)
+
         opt_cfg = self.config.optimizer
         self._offload = OffloadedOptimizerRunner(
             opt_type=opt_cfg.type if opt_cfg is not None else "adamw",
             opt_params=dict(opt_cfg.params) if opt_cfg is not None else {},
-            leaves=[np.asarray(l).reshape(-1) for l in leaves],
+            leaves=chunks,
             device=self._offload_device,
             nvme_path=oc.nvme_path,
             pipeline=oc.pipeline_read or oc.pipeline_write)
         log_dist(f"ZeRO-Offload: optimizer on {self._offload_device} "
-                 f"({len(leaves)} leaves, "
-                 f"{sum(l.size for l in leaves) / 1e6:.1f}M master params)",
-                 ranks=[0])
+                 f"(local {local_master.size / 1e6:.1f}M of "
+                 f"{self._offload_layout['total'] / 1e6:.1f}M master params, "
+                 f"{len(chunks)} chunks)", ranks=[0])
 
     # ------------------------------------------------------------------
     # jitted step functions
@@ -585,6 +695,13 @@ class DeepSpeedEngine:
                 r = quantized_reduce_scatter(gm, axis=axes)
             else:
                 r = jax.lax.psum_scatter(gm, axes, scatter_dimension=0, tiled=True)
+            # Batch is sharded over ALL dp axes but under MiCS the grad spec
+            # carries only the sub-group ('mics') axis — the sum over the
+            # remaining data groups must still happen (cheap: it runs on the
+            # 1/axes-sized shard, the reference's hierarchical reduction).
+            rest = tuple(a for a in all_dp if a not in axes)
+            if rest:
+                r = jax.lax.psum(r, rest)
             return jnp.moveaxis(r, 0, dim) / n_dp
 
         batch_rep = self._REPLICATED_BATCH_KEYS
@@ -606,17 +723,13 @@ class DeepSpeedEngine:
 
         gacc_specs = grad_specs
 
-        def micro_step(state, secondary, batch):
+        def micro_step(gacc_in, cur_scale, secondary, batch):
             batch_specs = {k: (P() if k in batch_rep else P(BATCH_AXES))
                            for k in batch}
             sm = shard_map(local_micro, mesh=mesh,
                            in_specs=(gather_src_specs, gacc_specs, P(), batch_specs),
                            out_specs=(gacc_specs, P()), check_vma=False)
-            gacc, loss = sm(secondary, state["grad_acc"],
-                            state["loss_scale"]["cur_scale"], batch)
-            state = dict(state)
-            state["grad_acc"] = gacc
-            return state, loss
+            return sm(secondary, gacc_in, cur_scale, batch)
 
         return micro_step
 
@@ -638,19 +751,22 @@ class DeepSpeedEngine:
 
     def _refresh_secondary(self):
         """Rebuild the hpZ secondary partition from the primary params —
-        the once-per-optimizer-step inter-group all-gather."""
+        the once-per-optimizer-step inter-group all-gather. The reshard jit
+        is cached: this runs on the per-step hot path."""
         if not getattr(self, "_zeropp", False):
             return
         if self.config.zero_config.zero_hpz_partition_size > 1:
-            specs = jax.tree.map(self._hpz_secondary_spec,
-                                 self.zero_plan.param_spec_tree(),
-                                 is_leaf=lambda s: isinstance(s, P))
-            shardings = jax.tree.map(
-                lambda s: NamedSharding(self.mesh, s), specs,
-                is_leaf=lambda s: isinstance(s, P))
+            if getattr(self, "_jit_hpz_reshard", None) is None:
+                specs = jax.tree.map(self._hpz_secondary_spec,
+                                     self.zero_plan.param_spec_tree(),
+                                     is_leaf=lambda s: isinstance(s, P))
+                shardings = jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s), specs,
+                    is_leaf=lambda s: isinstance(s, P))
+                self._jit_hpz_reshard = jax.jit(lambda p: p,
+                                                out_shardings=shardings)
             with self.mesh:
-                self._secondary = jax.jit(
-                    lambda p: p, out_shardings=shardings)(self.state["params"])
+                self._secondary = self._jit_hpz_reshard(self.state["params"])
         else:
             self._secondary = self.state["params"]
 
@@ -676,12 +792,14 @@ class DeepSpeedEngine:
             if getattr(self, "_secondary", None) is None:
                 self._refresh_secondary()
             if self._jit_micro_step is None:
-                # no donation: at hpz=1 the secondary IS state["params"], and
-                # donating buffers that are also live inputs is invalid
+                # Only grad_acc flows through the jit (donated) — passing the
+                # whole state would copy params + fp32 optimizer state every
+                # micro step. The secondary (params at hpz=1) is a plain
+                # non-donated input, so the aliasing stays valid.
                 self._jit_micro_step = jax.jit(
-                    self._build_zeropp_micro(),
-                    in_shardings=(shardings, None, None),
-                    out_shardings=(shardings, rep))
+                    self._build_zeropp_micro(), donate_argnums=(0,),
+                    in_shardings=(shardings["grad_acc"], rep, None, None),
+                    out_shardings=(shardings["grad_acc"], rep))
             if self._jit_apply_step is None:
                 self._jit_apply_step = jax.jit(
                     self._apply_step_fn, donate_argnums=(0,),
@@ -747,8 +865,11 @@ class DeepSpeedEngine:
         batch = self._device_batch(batch)
         with self.mesh:
             if self._zeropp:
-                self.state, loss = self._jit_micro_step(
-                    self.state, self._secondary, batch)
+                gacc, loss = self._jit_micro_step(
+                    self.state["grad_acc"],
+                    self.state["loss_scale"]["cur_scale"],
+                    self._secondary, batch)
+                self.state["grad_acc"] = gacc
             else:
                 self.state, loss = self._jit_micro_step(self.state, batch)
         self._cached_loss = loss
@@ -796,38 +917,80 @@ class DeepSpeedEngine:
             ])
 
     def _apply_step_offload(self, lr: float):
-        """Optimizer boundary on the host (ZeRO-Offload): pull grads, unscale
-        + clip in numpy, native CPU optimizer step on the master copy,
-        push re-cast params. The TPU is free during the host step — the
-        overlap window the reference fills with the next micro-batch."""
-        grads_host = jax.device_get(self.state["grad_acc"])
-        # np.array: force a writable copy (device_get can return read-only views)
-        leaves = [np.array(l, np.float32).reshape(-1)
-                  for l in jax.tree.leaves(grads_host)]
-        scale = float(jax.device_get(self.state["loss_scale"]["cur_scale"]))
+        """Optimizer boundary on the host (ZeRO-Offload): fetch the LOCAL
+        shard of the flat gradient (unscale/clip/norm run jitted on device;
+        each host reads only its addressable 1/n_hosts), native CPU
+        optimizer on the local master segment (NVMe chunks stream through
+        the pipelined swapper), then scatter the updated master back into
+        the sharded param tree in one jitted dispatch."""
+        if getattr(self, "_jit_offload_fetch", None) is None:
+            clip = self.gradient_clipping
+            fp16 = self.config.fp16.enabled
+            rep = NamedSharding(self.mesh, P())
+            layouts = self._offload_layouts
 
-        overflow = False
-        if self.config.fp16.enabled:
-            overflow = not all(np.isfinite(l).all() for l in leaves)
-        gnorm = 0.0
+            def fetch(grad_acc, scale):
+                flats = [self._to_flat(g, dim) for g, (dim, _)
+                         in zip(jax.tree.leaves(grad_acc), layouts)]
+                overflow = (~jnp.all(jnp.asarray(
+                    [jnp.all(jnp.isfinite(f)) for f in flats])) if fp16
+                    else jnp.asarray(False))
+                inv = jnp.where(overflow, 0.0, 1.0 / scale)
+                flats = [f * inv for f in flats]
+                gnorm = jnp.sqrt(sum(jnp.sum(f * f) for f in flats))
+                if clip > 0:
+                    factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                    flats = [f * factor for f in flats]
+                return tuple(flats), gnorm, overflow
+
+            self._jit_offload_fetch = jax.jit(
+                fetch,
+                out_shardings=(self._offload_flat_shardings, rep, rep))
+
+            shapes = self._offload_shapes
+            treedef, dtype = self._offload_treedef, self.param_dtype
+
+            def unflatten(flats):
+                outs = []
+                for f, (dim, _), shape in zip(flats, layouts, shapes):
+                    if dim is None:
+                        a = f.reshape(shape)
+                    else:
+                        moved = (shape[dim],) + shape[:dim] + shape[dim + 1:]
+                        a = jnp.moveaxis(f.reshape(moved), 0, dim)
+                    outs.append(a.astype(dtype))
+                return jax.tree.unflatten(treedef, outs)
+
+            self._jit_offload_unflatten = jax.jit(
+                unflatten, out_shardings=self._param_shardings)
+
+        with self.mesh:
+            flat_grads, gnorm_d, ovf_d = self._jit_offload_fetch(
+                self.state["grad_acc"], self.state["loss_scale"]["cur_scale"])
+        overflow, gnorm = bool(ovf_d), float(gnorm_d)
         if not overflow:
-            inv = 1.0 / scale
-            sq = 0.0
-            for l in leaves:
-                l *= inv
-                sq += float(np.dot(l.astype(np.float64), l.astype(np.float64)))
-            gnorm = float(np.sqrt(sq))
-            if self.gradient_clipping > 0 and gnorm > self.gradient_clipping:
-                factor = self.gradient_clipping / (gnorm + 1e-6)
-                for l in leaves:
-                    l *= factor
-            master = self._offload.step(leaves, lr=lr)
-            host_params = jax.tree.unflatten(
-                self._offload_treedef,
-                [m.reshape(s).astype(self.param_dtype)
-                 for m, s in zip(master, self._offload_shapes)])
+            local_grad = np.concatenate(
+                [data for i, arr in enumerate(flat_grads)
+                 for _, _, data in self._leaf_local_groups(arr)]
+                or [np.zeros(0, np.float32)])
+            master_chunks = self._offload.step(self._chunked(local_grad), lr=lr)
+            master = np.concatenate([m.reshape(-1) for m in master_chunks])
+            # split the updated master back per span and rebuild each leaf's
+            # flat global array from this host's device segments
+            per_leaf = [[] for _ in flat_grads]
+            off = 0
+            for leaf_idx, _, length, devices in self._offload_spans:
+                seg = master[off:off + length]
+                off += length
+                per_leaf[leaf_idx].extend(
+                    jax.device_put(seg, d) for d in devices)
+            flat_masters = tuple(
+                jax.make_array_from_single_device_arrays(
+                    (int(np.prod(self._offload_shapes[i])) or 0,),
+                    self._offload_flat_shardings[i], arrs)
+                for i, arrs in enumerate(per_leaf))
             with self.mesh:
-                self.state["params"] = jax.device_put(host_params, self._param_shardings)
+                self.state["params"] = self._jit_offload_unflatten(flat_masters)
 
         # zero the accumulator + update loss scale on device
         if getattr(self, "_jit_offload_epilogue", None) is None:
@@ -883,9 +1046,14 @@ class DeepSpeedEngine:
         """XLA's exact cost analysis of the compiled micro-step (the
         hook-based estimate of the reference's profiler.py:228)."""
         try:
+            if self._zeropp:
+                args = (self.state["grad_acc"],
+                        self.state["loss_scale"]["cur_scale"],
+                        self._secondary, self._device_batch(batch))
+            else:
+                args = (self.state, self._device_batch(batch))
             abstract = jax.tree.map(
-                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
-                (self.state, self._device_batch(batch)))
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
             cost = self._jit_micro_step.lower(*abstract).compile().cost_analysis()
             if isinstance(cost, list):
                 cost = cost[0] if cost else {}
@@ -949,11 +1117,32 @@ class DeepSpeedEngine:
         })
         _save(save_dir, tag, self.state, client_state, save_latest=save_latest)
         if self._offload is not None:
+            # Name-keyed flat layout: master/state are this host's local
+            # segments plus span metadata, so readers (zero_to_fp32) can
+            # slice params out by NAME instead of positional guessing.
             sd = self._offload.state_dict()
-            np.savez(os.path.join(save_dir, tag, "offload_optimizer.npz"),
+            lay = self._offload_layout
+            np.savez(self._offload_ckpt_path(os.path.join(save_dir, tag)),
                      step=sd["step"],
-                     **{f"master_{i}": m for i, m in enumerate(sd["master"])},
-                     **{f"state_{i}": s for i, s in enumerate(sd["state"])})
+                     master_flat=np.concatenate(
+                         [m.reshape(-1) for m in sd["master"]]),
+                     state_flat=np.concatenate(
+                         [s.reshape(-1) for s in sd["state"]]),
+                     names=np.array(self._offload_names),
+                     sizes=np.array(lay["sizes"], np.int64),
+                     total=lay["total"],
+                     chunk_elems=self._OFFLOAD_CHUNK_ELEMS,
+                     # per-leaf flat form: which dim was moved to front
+                     # (-1 = natural/replicated order)
+                     shard_dims=np.array(
+                         [-1 if d is None else d
+                          for d, _ in self._offload_layouts], np.int64),
+                     span_leaf=np.array(
+                         [i for i, _, _, _ in self._offload_spans], np.int64),
+                     span_starts=np.array(
+                         [s for _, s, _, _ in self._offload_spans], np.int64),
+                     span_lens=np.array(
+                         [l for _, _, l, _ in self._offload_spans], np.int64))
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
 
     def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.npz") -> None:
@@ -979,17 +1168,48 @@ class DeepSpeedEngine:
         if state is None:
             return None, {}
         self.state = state
+        # ZeRO++: the secondary partition caches (a resharding of) the
+        # params — a stale cache would train against pre-checkpoint weights
+        self._refresh_secondary()
         if self._offload is not None and load_optimizer_states:
-            path = os.path.join(load_dir, tag or "", "offload_optimizer.npz")
-            if not os.path.exists(path):  # resolve tag from store result below
-                path = None
-            if path:
+            path = self._offload_ckpt_path(os.path.join(load_dir, tag or ""))
+            if not os.path.exists(path):
+                raise ValueError(
+                    f"offload optimizer state not found at {path} — the "
+                    "checkpoint was saved without offload or on a different "
+                    "host count (files are per-process); pass "
+                    "load_optimizer_states=False to load weights only")
+            if os.path.exists(path):
                 z = np.load(path)
-                n = len(self._offload.master)
+                if "master_flat" not in z:
+                    raise ValueError(
+                        f"{path} is in the legacy per-leaf offload format "
+                        "(master_{i} keys); re-save the checkpoint with this "
+                        "version")
+                saved_chunk = int(z["chunk_elems"]) if "chunk_elems" in z else None
+                if saved_chunk != self._OFFLOAD_CHUNK_ELEMS:
+                    raise ValueError(
+                        f"offload checkpoint chunk size {saved_chunk} != "
+                        f"current {self._OFFLOAD_CHUNK_ELEMS}; the m/v state "
+                        "layout is chunked — load with the same chunk size")
+                saved = list(zip((int(x) for x in z["span_leaf"]),
+                                 (int(x) for x in z["span_starts"]),
+                                 (int(x) for x in z["span_lens"])))
+                cur = [(i, s, l) for i, s, l, _ in self._offload_spans]
+                if saved != cur:
+                    raise ValueError(
+                        "offload checkpoint was saved on a different "
+                        f"host/device layout (spans {saved[:3]}... vs "
+                        f"{cur[:3]}...); per-host segments must match")
+                master, state = z["master_flat"], z["state_flat"]
+                masters = self._chunked(master)
+                states, off = [], 0
+                slots = self._offload._slots
+                for m in masters:
+                    states.append(state[off:off + m.size * slots])
+                    off += m.size * slots
                 self._offload.load_state_dict({
-                    "step": int(z["step"]),
-                    "master": [z[f"master_{i}"] for i in range(n)],
-                    "state": [z[f"state_{i}"] for i in range(n)],
+                    "step": int(z["step"]), "master": masters, "state": states,
                 })
         self.global_steps = client_state.get("global_steps", 0)
         self.skipped_steps = client_state.get("skipped_steps", 0)

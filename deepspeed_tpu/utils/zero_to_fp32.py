@@ -40,13 +40,46 @@ def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
             out[name] = np.asarray(src, np.float32)
     # offloaded optimizers keep the master outside the state tree
     offload = os.path.join(path, "offload_optimizer.npz")
+    if not os.path.exists(offload):
+        import glob as _glob
+        ranked = _glob.glob(os.path.join(path, "offload_optimizer.rank*.npz"))
+        if ranked:
+            raise ValueError(
+                f"{path} holds per-host offload segments ({len(ranked)} "
+                "files); multi-host offload checkpoints must be "
+                "consolidated on the training topology before fp32 export")
     if os.path.exists(offload):
         z = np.load(offload)
-        names = sorted(out.keys())
-        masters = [z[f"master_{i}"] for i in range(len(names))]
-        if len(masters) == len(names):
-            for name, m in zip(names, masters):
-                out[name] = np.asarray(m, np.float32).reshape(out[name].shape)
+        # Name-keyed flat layout (engine save_checkpoint): slice each param
+        # out of the flat master by its recorded name/offset — positional
+        # matching against a sorted key list can silently mispair.
+        if "master_flat" not in z:
+            raise ValueError(
+                f"{offload} is in the legacy per-leaf offload format "
+                "(master_{i} keys, no name metadata); re-save the checkpoint "
+                "with this version")
+        flat = np.asarray(z["master_flat"], np.float32)
+        names = [str(n) for n in z["names"]]
+        sizes = [int(s) for s in z["sizes"]]
+        shard_dims = [int(d) for d in z["shard_dims"]]
+        if flat.size < int(z["total"]):
+            raise ValueError(
+                "offload_optimizer.npz holds only a partial (multi-host) "
+                "master segment; consolidate per-host segments first")
+        off = 0
+        for name, size, dim in zip(names, sizes, shard_dims):
+            seg = flat[off:off + size]
+            off += size
+            if name not in out:
+                continue
+            shape = out[name].shape
+            if dim < 0:
+                out[name] = seg.reshape(shape)
+            else:
+                # per-leaf flat form is shard-major: the dp-sharded dim was
+                # moved to the front before flattening — invert it
+                moved = (shape[dim],) + shape[:dim] + shape[dim + 1:]
+                out[name] = np.moveaxis(seg.reshape(moved), 0, dim)
     return out
 
 

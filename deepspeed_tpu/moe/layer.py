@@ -5,10 +5,13 @@ Counterpart of the reference ``deepspeed/moe/layer.py`` (``MoE`` :16) +
 [num_experts, ...] sharded over the ``expert`` mesh axis; dispatched tokens
 get a sharding constraint on the expert dimension so XLA emits the
 all-to-all over ICI that the reference performs with ``_AllToAll``
-(sharded_moe.py:95). Expert matmuls run as a single batched einsum over the
-expert dim — the grouped-GEMM the reference needs cutlass for
-(inference/v2/kernels/cutlass_ops/moe_gemm) is just a batched matmul on the
-MXU here.
+(sharded_moe.py:95). Dispatch/combine are index-based gather/scatter
+(O(tokens*k*hidden), the layout work the reference's cutlass
+moe_gather/moe_scatter kernels do) rather than dense one-hot einsums
+(O(tokens*experts*capacity*hidden) — quadratic in tokens); the expert FFN
+itself runs as a batched einsum over the (expert-sharded) expert dim,
+which IS the grouped-GEMM on the MXU (reference cutlass moe_gemm,
+inference/v2/kernels/cutlass_ops).
 """
 
 from __future__ import annotations
@@ -21,7 +24,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..runtime.topology import BATCH_AXES, DATA_AXIS, EXPERT_AXIS
-from .sharded_moe import capacity as _capacity, top_k_gating
+from .sharded_moe import capacity as _capacity, top_k_gating_indices
 
 Params = Dict[str, Any]
 
@@ -79,10 +82,24 @@ class MoE:
         cap = _capacity(n_tok, self.num_experts, self.capacity_factor, self.min_capacity)
 
         logits = tokens @ params["gate"].astype(x.dtype)
-        combine, dispatch, aux, _ = top_k_gating(logits, self.top_k, cap)
+        eidx, pos, keep, weight, aux, _ = top_k_gating_indices(
+            logits, self.top_k, cap)
+        e = self.num_experts
 
-        # dispatch: [tokens, experts, cap] x [tokens, h] → [experts, cap, h]
-        expert_in = jnp.einsum("tec,th->ech", dispatch.astype(x.dtype), tokens)
+        # Dispatch by GATHER, not by one-hot einsum: the reference's
+        # "tec,th->ech" dispatch matmul costs O(tokens*experts*cap*hidden)
+        # — quadratic in tokens (experts*cap ~ top_k*cf*tokens). Building
+        # the inverse slot→token map is an O(tokens*k) integer scatter and
+        # the row gather moves O(experts*cap*hidden) bytes with zero FLOPs
+        # (the grouped-GEMM data layout the reference needs cutlass
+        # moe_gather/moe_scatter kernels for, ragged_ops.cpp:20-47).
+        slot = jnp.where(keep, eidx * cap + pos, e * cap).reshape(-1)
+        src = jnp.zeros((e * cap + 1,), jnp.int32).at[slot].set(
+            jnp.repeat(jnp.arange(n_tok, dtype=jnp.int32), self.top_k) + 1,
+            mode="drop")[:e * cap]
+        expert_in = jnp.where((src > 0)[:, None],
+                              tokens[jnp.maximum(src - 1, 0)],
+                              jnp.zeros((), x.dtype)).reshape(e, cap, h)
         # all-to-all over ICI: expert dim sharded across the expert axis
         expert_in = _c(expert_in, P(EXPERT_AXIS, BATCH_AXES, None))
 
@@ -97,7 +114,11 @@ class MoE:
                                          params["wi"].astype(x.dtype)))
         expert_out = jnp.einsum("ecf,efh->ech", mid, params["wo"].astype(x.dtype))
 
-        # inverse all-to-all + combine back to tokens
+        # inverse all-to-all + combine back to tokens: per-token gather of
+        # its k slots, weighted sum — O(tokens*k*hidden)
         expert_out = _c(expert_out, P(EXPERT_AXIS, BATCH_AXES, None))
-        out = jnp.einsum("tec,ech->th", combine.astype(x.dtype), expert_out)
+        flat_out = expert_out.reshape(e * cap, h)
+        picked = flat_out[jnp.where(keep, eidx * cap + pos, 0)]  # [t, k, h]
+        w = (weight * keep).astype(x.dtype)
+        out = jnp.sum(picked * w[:, :, None], axis=1)
         return out.reshape(b, s, h), aux

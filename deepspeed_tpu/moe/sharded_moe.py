@@ -29,15 +29,22 @@ def capacity(num_tokens: int, num_experts: int, capacity_factor: float,
     return max(cap, min_capacity)
 
 
-def top_k_gating(logits: jax.Array, top_k: int, capacity_: int
-                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """Top-k gate with capacity.
+def top_k_gating_indices(logits: jax.Array, top_k: int, capacity_: int):
+    """Top-k gate with capacity, in INDEX form.
 
     logits: [tokens, experts]. Returns
-      combine   [tokens, experts, capacity]  — weights for gathering results
-      dispatch  [tokens, experts, capacity]  — boolean one-hot routing
-      aux_loss  scalar (GShard load-balancing loss, scaled by E)
-      me        [experts] mean gate probability (for monitoring)
+      expert_idx [tokens, k] int32 — chosen expert per (token, choice)
+      pos        [tokens, k] int32 — slot inside the expert's capacity bucket
+      keep       [tokens, k] bool  — False when the bucket overflowed
+      weight     [tokens, k] f32   — normalized combine weight (0 if dropped)
+      aux_loss   scalar (GShard load-balancing loss, scaled by E)
+      me         [experts] mean gate probability (for monitoring)
+
+    The index form is what the dispatch actually needs: building dense
+    one-hot [tokens, experts, capacity] masks and contracting them (the
+    reference's einsum dispatch, sharded_moe.py:425) costs
+    O(tokens*experts*capacity*hidden) FLOPs — quadratic in tokens; the
+    gather/scatter dispatch built from indices is O(tokens*k*hidden).
     """
     tokens, num_experts = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -51,14 +58,10 @@ def top_k_gating(logits: jax.Array, top_k: int, capacity_: int
     ce = jnp.mean(mask1, axis=0)
     aux_loss = jnp.sum(me * ce) * num_experts
 
-    # position of each (token, choice) inside its expert's capacity bucket
-    combine = jnp.zeros((tokens, num_experts, capacity_), dtype=jnp.float32)
-    dispatch = jnp.zeros((tokens, num_experts, capacity_), dtype=bool)
-
     # process the k choices sequentially so capacity counting is consistent
     counts = jnp.zeros((num_experts,), dtype=jnp.int32)
     gate_sum = jnp.zeros((tokens,), dtype=jnp.float32)
-    chosen = []
+    idxs, poss, keeps, gatews = [], [], [], []
     for k in range(top_k):
         idx_k = expert_idx[:, k]  # [tokens]
         mask_k = jax.nn.one_hot(idx_k, num_experts, dtype=jnp.int32)
@@ -67,17 +70,42 @@ def top_k_gating(logits: jax.Array, top_k: int, capacity_: int
         pos_k = jnp.sum(pos_in_expert * mask_k, axis=1) + counts[idx_k]
         keep = pos_k < capacity_
         gate_k = jnp.take_along_axis(gates, idx_k[:, None], axis=1)[:, 0] * keep
-        chosen.append((idx_k, pos_k, keep, gate_k))
+        idxs.append(idx_k)
+        poss.append(jnp.minimum(pos_k, capacity_ - 1))
+        keeps.append(keep)
+        gatews.append(gate_k)
         counts = counts + jnp.sum(mask_k * keep[:, None], axis=0)
         gate_sum = gate_sum + gate_k
 
     # normalize combine weights over kept choices (reference top2gating :341)
     denom = jnp.maximum(gate_sum, 1e-9)
-    token_ids = jnp.arange(tokens)
-    for idx_k, pos_k, keep, gate_k in chosen:
-        w = gate_k / denom
-        safe_pos = jnp.minimum(pos_k, capacity_ - 1)
-        combine = combine.at[token_ids, idx_k, safe_pos].add(jnp.where(keep, w, 0.0))
-        dispatch = dispatch.at[token_ids, idx_k, safe_pos].max(keep)
+    weight = jnp.stack(gatews, axis=1) / denom[:, None]
+    return (jnp.stack(idxs, axis=1).astype(jnp.int32),
+            jnp.stack(poss, axis=1).astype(jnp.int32),
+            jnp.stack(keeps, axis=1),
+            weight, aux_loss, me)
 
+
+def top_k_gating(logits: jax.Array, top_k: int, capacity_: int
+                 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Top-k gate with capacity, in DENSE one-hot form (API parity with the
+    reference's top1gating/top2gating tensors).
+
+    logits: [tokens, experts]. Returns
+      combine   [tokens, experts, capacity]  — weights for gathering results
+      dispatch  [tokens, experts, capacity]  — boolean one-hot routing
+      aux_loss  scalar (GShard load-balancing loss, scaled by E)
+      me        [experts] mean gate probability (for monitoring)
+    """
+    tokens, num_experts = logits.shape
+    expert_idx, pos, keep, weight, aux_loss, me = \
+        top_k_gating_indices(logits, top_k, capacity_)
+    combine = jnp.zeros((tokens, num_experts, capacity_), dtype=jnp.float32)
+    dispatch = jnp.zeros((tokens, num_experts, capacity_), dtype=bool)
+    token_ids = jnp.arange(tokens)
+    for k in range(expert_idx.shape[1]):
+        combine = combine.at[token_ids, expert_idx[:, k], pos[:, k]].add(
+            jnp.where(keep[:, k], weight[:, k], 0.0))
+        dispatch = dispatch.at[token_ids, expert_idx[:, k], pos[:, k]].max(
+            keep[:, k])
     return combine, dispatch, aux_loss, me

@@ -380,19 +380,17 @@ class DeepSpeedEngine:
     @staticmethod
     def _leaf_local_groups(arr):
         """Host-local shards of a 1-D array grouped by global offset:
-        sorted [(start, [devices], np_data)] with replicated copies
+        sorted [(start, [devices], device_data)] with replicated copies
         deduplicated (every device in the group gets the same data back on
-        push)."""
+        push). ``device_data`` stays on device — batch the D2H pull with
+        one ``jax.device_get`` over all groups, not per-shard copies."""
         groups = {}
         for s in arr.addressable_shards:
             start = (s.index[0].start or 0) if s.index else 0
             groups.setdefault(start, []).append(s)
-        out = []
-        for start in sorted(groups):
-            shards = groups[start]
-            out.append((start, [s.device for s in shards],
-                        np.asarray(shards[0].data, np.float32).reshape(-1)))
-        return out
+        return [(start, [s.device for s in groups[start]],
+                 groups[start][0].data)
+                for start in sorted(groups)]
 
     def _init_offload_runner(self, state) -> None:
         """Host master copy + CPU/NVMe optimizer, PARTITIONED over devices.
@@ -449,6 +447,8 @@ class DeepSpeedEngine:
             for start, devices, data in self._leaf_local_groups(arr):
                 self._offload_spans.append((i, start, data.size, devices))
                 pieces.append(data)
+        pieces = [np.asarray(p, np.float32).reshape(-1)
+                  for p in jax.device_get(pieces)]
         local_master = (np.concatenate(pieces) if pieces
                         else np.zeros(0, np.float32))
         # chunk the local segment so NVMe paging streams fixed-size blocks
@@ -969,10 +969,13 @@ class DeepSpeedEngine:
                 self.state["grad_acc"], self.state["loss_scale"]["cur_scale"])
         overflow, gnorm = bool(ovf_d), float(gnorm_d)
         if not overflow:
-            local_grad = np.concatenate(
-                [data for i, arr in enumerate(flat_grads)
-                 for _, _, data in self._leaf_local_groups(arr)]
-                or [np.zeros(0, np.float32)])
+            # one batched D2H pull over every local shard, not per-shard
+            pieces = [data for arr in flat_grads
+                      for _, _, data in self._leaf_local_groups(arr)]
+            pieces = [np.asarray(p, np.float32).reshape(-1)
+                      for p in jax.device_get(pieces)]
+            local_grad = (np.concatenate(pieces) if pieces
+                          else np.zeros(0, np.float32))
             master_chunks = self._offload.step(self._chunked(local_grad), lr=lr)
             master = np.concatenate([m.reshape(-1) for m in master_chunks])
             # split the updated master back per span and rebuild each leaf's
@@ -1179,38 +1182,38 @@ class DeepSpeedEngine:
                     "checkpoint was saved without offload or on a different "
                     "host count (files are per-process); pass "
                     "load_optimizer_states=False to load weights only")
-            if os.path.exists(path):
-                z = np.load(path)
-                if "master_flat" not in z:
-                    raise ValueError(
-                        f"{path} is in the legacy per-leaf offload format "
-                        "(master_{i} keys); re-save the checkpoint with this "
-                        "version")
-                saved_chunk = int(z["chunk_elems"]) if "chunk_elems" in z else None
-                if saved_chunk != self._OFFLOAD_CHUNK_ELEMS:
-                    raise ValueError(
-                        f"offload checkpoint chunk size {saved_chunk} != "
-                        f"current {self._OFFLOAD_CHUNK_ELEMS}; the m/v state "
-                        "layout is chunked — load with the same chunk size")
-                saved = list(zip((int(x) for x in z["span_leaf"]),
-                                 (int(x) for x in z["span_starts"]),
-                                 (int(x) for x in z["span_lens"])))
-                cur = [(i, s, l) for i, s, l, _ in self._offload_spans]
-                if saved != cur:
-                    raise ValueError(
-                        "offload checkpoint was saved on a different "
-                        f"host/device layout (spans {saved[:3]}... vs "
-                        f"{cur[:3]}...); per-host segments must match")
-                master, state = z["master_flat"], z["state_flat"]
-                masters = self._chunked(master)
-                states, off = [], 0
-                slots = self._offload._slots
-                for m in masters:
-                    states.append(state[off:off + m.size * slots])
-                    off += m.size * slots
-                self._offload.load_state_dict({
-                    "step": int(z["step"]), "master": masters, "state": states,
-                })
+            z = np.load(path)
+            if "master_flat" not in z:
+                raise ValueError(
+                    f"{path} is in the legacy per-leaf offload format "
+                    "(master_{i} keys); load weights only with "
+                    "load_optimizer_states=False, or extract fp32 weights "
+                    "with the version that wrote it")
+            saved_chunk = int(z["chunk_elems"]) if "chunk_elems" in z else None
+            if saved_chunk != self._OFFLOAD_CHUNK_ELEMS:
+                raise ValueError(
+                    f"offload checkpoint chunk size {saved_chunk} != "
+                    f"current {self._OFFLOAD_CHUNK_ELEMS}; the m/v state "
+                    "layout is chunked — load with the same chunk size")
+            saved = list(zip((int(x) for x in z["span_leaf"]),
+                             (int(x) for x in z["span_starts"]),
+                             (int(x) for x in z["span_lens"])))
+            cur = [(i, s, l) for i, s, l, _ in self._offload_spans]
+            if saved != cur:
+                raise ValueError(
+                    "offload checkpoint was saved on a different "
+                    f"host/device layout (spans {saved[:3]}... vs "
+                    f"{cur[:3]}...); per-host segments must match")
+            master, state = z["master_flat"], z["state_flat"]
+            masters = self._chunked(master)
+            states, off = [], 0
+            slots = self._offload._slots
+            for m in masters:
+                states.append(state[off:off + m.size * slots])
+                off += m.size * slots
+            self._offload.load_state_dict({
+                "step": int(z["step"]), "master": masters, "state": states,
+            })
         self.global_steps = client_state.get("global_steps", 0)
         self.skipped_steps = client_state.get("skipped_steps", 0)
         self.micro_steps = client_state.get("micro_steps", 0)

@@ -56,8 +56,9 @@ def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
         if "master_flat" not in z:
             raise ValueError(
                 f"{offload} is in the legacy per-leaf offload format "
-                "(master_{i} keys, no name metadata); re-save the checkpoint "
-                "with this version")
+                "(master_{i} keys, no name metadata); extract fp32 weights "
+                "with the version that wrote it — positional matching was "
+                "removed because it could silently mispair leaves")
         flat = np.asarray(z["master_flat"], np.float32)
         names = [str(n) for n in z["names"]]
         sizes = [int(s) for s in z["sizes"]]

@@ -27,9 +27,9 @@ from ..utils.logging import logger
 class Autotuner:
 
     def __init__(self,
-                 model_fn: Callable[[], Any],
-                 base_config: Dict[str, Any],
-                 batch_fn: Callable[[int], Dict[str, np.ndarray]],
+                 model_fn: Optional[Callable[[], Any]] = None,
+                 base_config: Dict[str, Any] = None,
+                 batch_fn: Optional[Callable[[int], Dict[str, np.ndarray]]] = None,
                  zero_stages: Sequence[int] = (0, 1, 2, 3),
                  micro_batch_sizes: Optional[Sequence[int]] = None,
                  mode: str = "model_based",      # 'grid' | 'random' | 'model_based'
@@ -37,9 +37,25 @@ class Autotuner:
                  warmup_steps: int = 1,
                  measure_steps: int = 3,
                  memory_budget_bytes: Optional[int] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 model_spec: Optional[Dict[str, Any]] = None,
+                 results_dir: Optional[str] = None,
+                 seq_len: int = 16):
+        """``model_spec`` + ``results_dir`` select LAUNCHED mode: every
+        experiment runs as its own process (reference autotuner.py:404 —
+        a config that OOMs/crashes is a failed data point, not a dead
+        search), results persist under ``results_dir`` and completed
+        experiments are skipped on re-run (the reference's resume)."""
+        if model_spec is not None:
+            from .experiment import build_model_from_spec
+            model_fn = lambda: build_model_from_spec(model_spec)  # noqa: E731
+        if model_fn is None:
+            raise ValueError("need model_fn or model_spec")
         self.model_fn = model_fn
-        self.base_config = base_config
+        self.model_spec = model_spec
+        self.results_dir = results_dir
+        self.seq_len = seq_len
+        self.base_config = base_config or {}
         self.batch_fn = batch_fn
         self.zero_stages = list(zero_stages)
         self.micro_batch_sizes = list(micro_batch_sizes or [1, 2, 4, 8])
@@ -98,17 +114,19 @@ class Autotuner:
         import jax
 
         import deepspeed_tpu
-        config = dict(self.base_config)
-        config["train_micro_batch_size_per_gpu"] = micro_batch
-        config.setdefault("zero_optimization", {})
-        config = {**config, "zero_optimization":
-                  {**config["zero_optimization"], "stage": stage}}
+        config = self._experiment_config(stage, micro_batch)
         exp = {"zero_stage": stage, "micro_batch": micro_batch, "config": config}
         try:
-            engine, _, _, _ = deepspeed_tpu.initialize(model=self.model_fn(),
+            model = self.model_fn()
+            engine, _, _, _ = deepspeed_tpu.initialize(model=model,
                                                        config=config)
             dp = engine.topology.data_parallel_size
-            batch = self.batch_fn(micro_batch * dp)
+            if self.batch_fn is not None:
+                batch = self.batch_fn(micro_batch * dp)
+            else:
+                batch = {"input_ids": np.random.default_rng(0).integers(
+                    0, model.config.vocab_size,
+                    size=(micro_batch * max(dp, 1), self.seq_len))}
             for _ in range(self.warmup_steps):
                 jax.block_until_ready(engine.train_batch(batch))
             t0 = time.perf_counter()
@@ -124,14 +142,89 @@ class Autotuner:
             exp.update({"status": f"error: {e}", "samples_per_sec": 0.0})
         return exp
 
+    def _experiment_config(self, stage: int, micro_batch: int) -> Dict[str, Any]:
+        config = dict(self.base_config)
+        config["train_micro_batch_size_per_gpu"] = micro_batch
+        config.setdefault("zero_optimization", {})
+        return {**config, "zero_optimization":
+                {**config["zero_optimization"], "stage": stage}}
+
+    def run_launched_experiment(self, stage: int, micro_batch: int) -> Dict[str, Any]:
+        """One experiment as its own process (reference scheduler.run_job):
+        config written to the experiment dir, result parsed from
+        result.json; an existing result is reused (resume)."""
+        import hashlib
+        import json
+        import os
+        import subprocess
+        import sys
+
+        config = self._experiment_config(stage, micro_batch)
+        exp_spec = {"model": self.model_spec, "config": config,
+                    "seq_len": self.seq_len,
+                    "warmup_steps": self.warmup_steps,
+                    "measure_steps": self.measure_steps}
+        # the dir is keyed by the FULL experiment content, not just
+        # (stage, mb) — a changed base_config/model must not silently
+        # reuse a stale measurement
+        digest = hashlib.sha256(
+            json.dumps(exp_spec, sort_keys=True).encode()).hexdigest()[:8]
+        exp_dir = os.path.join(self.results_dir,
+                               f"stage{stage}_mb{micro_batch}_{digest}")
+        os.makedirs(exp_dir, exist_ok=True)
+        record = {"zero_stage": stage, "micro_batch": micro_batch,
+                  "config": config, "exp_dir": exp_dir}
+        result_path = os.path.join(exp_dir, "result.json")
+
+        def read_result():
+            try:
+                with open(result_path) as f:
+                    return json.load(f)
+            except (OSError, json.JSONDecodeError):
+                return None  # missing or torn write → treat as not run
+
+        result = read_result()
+        if result is None:
+            with open(os.path.join(exp_dir, "exp.json"), "w") as f:
+                json.dump(exp_spec, f, indent=2)
+            proc = subprocess.run(
+                [sys.executable, "-m", "deepspeed_tpu.autotuning.experiment",
+                 exp_dir], capture_output=True, text=True)
+            result = read_result()
+            if result is None:
+                record.update({"status": "error: experiment process died: "
+                               + proc.stderr[-500:], "samples_per_sec": 0.0})
+                return record
+        else:
+            logger.info(f"autotuner: reusing persisted result for "
+                        f"stage={stage} mb={micro_batch} [{digest}]")
+        record.update(result)
+        return record
+
     def tune(self) -> Dict[str, Any]:
-        """Search; returns the best experiment record (reference tune :404)."""
+        """Search; returns the best experiment record (reference tune :404).
+
+        In launched mode, per-experiment results and the final summary
+        (``autotuning_results.json`` + ``best_config.json``) persist under
+        ``results_dir``."""
+        launched = self.results_dir is not None and self.model_spec is not None
         best = None
         for stage, mb in self._candidates():
-            exp = self.run_experiment(stage, mb)
+            exp = (self.run_launched_experiment(stage, mb) if launched
+                   else self.run_experiment(stage, mb))
             self.results.append(exp)
             logger.info(f"autotuner: stage={stage} mb={mb} -> "
                         f"{exp['samples_per_sec']:.1f} samples/s ({exp['status']})")
             if best is None or exp["samples_per_sec"] > best["samples_per_sec"]:
                 best = exp
+        if launched and self.results:
+            import json
+            import os
+            with open(os.path.join(self.results_dir,
+                                   "autotuning_results.json"), "w") as f:
+                json.dump(self.results, f, indent=2)
+            if best:
+                with open(os.path.join(self.results_dir,
+                                       "best_config.json"), "w") as f:
+                    json.dump(best["config"], f, indent=2)
         return best or {}

@@ -50,7 +50,11 @@ def parse_args(args=None):
                         help="coordinator address (defaults to first host)")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "local"], help="remote exec method")
+                        choices=["ssh", "local", "popen"],
+                        help="remote exec method ('popen' spawns one local "
+                             "process per hostfile entry — the reference "
+                             "launch.py per-rank spawner, for single-host "
+                             "multi-process runs)")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str, help="training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -138,12 +142,75 @@ def _run_local(args) -> int:
     return proc.wait()
 
 
+def _install_fan_out(procs: List[subprocess.Popen]) -> None:
+    """SIGINT/SIGTERM forward to every child. Installed BEFORE spawning so
+    an interrupt mid-spawn cannot orphan already-started ranks (the list
+    fills in as children start)."""
+    def fan_out(sig, frame):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(sig)
+
+    signal.signal(signal.SIGINT, fan_out)
+    signal.signal(signal.SIGTERM, fan_out)
+
+
+def _wait_all(procs: List[subprocess.Popen], poll_s: float = 0.2) -> int:
+    """Poll ALL children; on the first failure terminate the rest
+    (reference launch.py:313 kill-all-on-any-failure). A sequential
+    wait() would deadlock: surviving ranks block in rendezvous/collectives
+    for the dead peer and the first wait never returns."""
+    import time as _time
+    rc = 0
+    live = list(procs)
+    while live:
+        for p in list(live):
+            code = p.poll()
+            if code is None:
+                continue
+            live.remove(p)
+            if code and not rc:
+                rc = code  # FIRST failure's code, not peers' SIGTERM status
+                for q in procs:
+                    if q.poll() is None:
+                        q.terminate()
+        if live:
+            _time.sleep(poll_s)
+    return rc
+
+
+def _run_popen(args, active: Dict[str, List[int]]) -> int:
+    """Per-rank local spawner (reference launch.py:249: ``Popen`` per rank
+    with RANK/WORLD env + signal fan-out + kill-all-on-any-failure). One
+    process per SLOT across all hostfile entries ('localhost slots=8' →
+    8 ranks), rendezvous over localhost."""
+    ranks = [(host, slot) for host, slots in active.items() for slot in slots]
+    master = args.master_addr or "localhost"
+    world_info = encode_world_info(active)
+    procs: List[subprocess.Popen] = []
+    _install_fan_out(procs)
+    for idx, (host, slot) in enumerate(ranks):
+        env = dict(os.environ)
+        env.update({
+            "JAX_COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
+            "JAX_NUM_PROCESSES": str(len(ranks)),
+            "JAX_PROCESS_ID": str(idx),
+            "DSTPU_WORLD_INFO": world_info,
+        })
+        cmd = [sys.executable, args.user_script] + args.user_args
+        logger.info(f"launching local process {idx}/{len(ranks)} "
+                    f"({host} slot {slot})")
+        procs.append(subprocess.Popen(cmd, env=env))
+    return _wait_all(procs)
+
+
 def _run_ssh(args, active: Dict[str, List[int]]) -> int:
     """PDSH-style per-host ssh runner (reference multinode_runner.py:51)."""
     hosts = list(active.keys())
     master = args.master_addr or hosts[0]
     exports = _collect_env_exports()
-    procs = []
+    procs: List[subprocess.Popen] = []
+    _install_fan_out(procs)
     world_info = encode_world_info(active)
     for idx, host in enumerate(hosts):
         env_str = " ".join(f"{k}={shlex.quote(v)}" for k, v in exports.items())
@@ -155,23 +222,7 @@ def _run_ssh(args, active: Dict[str, List[int]]) -> int:
         cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host, remote]
         logger.info(f"launching on {host} (process {idx}/{len(hosts)})")
         procs.append(subprocess.Popen(cmd))
-
-    def fan_out(sig, frame):
-        for p in procs:
-            p.send_signal(sig)
-
-    signal.signal(signal.SIGINT, fan_out)
-    signal.signal(signal.SIGTERM, fan_out)
-    rc = 0
-    for p in procs:
-        code = p.wait()
-        if code and not rc:
-            rc = code  # keep the FIRST failure's code, not peers' SIGTERM status
-            # kill-all-on-any-failure (reference launch.py:313)
-            for q in procs:
-                if q.poll() is None:
-                    q.terminate()
-    return rc
+    return _wait_all(procs)
 
 
 def main(args=None) -> int:
@@ -182,6 +233,8 @@ def main(args=None) -> int:
     active = _parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
     if len(active) == 1 and not args.force_multi:
         return _run_local(args)
+    if args.launcher == "popen":
+        return _run_popen(args, active)
     return _run_ssh(args, active)
 
 

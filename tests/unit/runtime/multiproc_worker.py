@@ -1,0 +1,51 @@
+"""Worker script for the multi-process distributed test (NOT a pytest
+module). Launched by the `popen` launcher with JAX_COORDINATOR_ADDRESS /
+JAX_NUM_PROCESSES / JAX_PROCESS_ID set — each process brings 4 virtual CPU
+devices, rendezvous forms a 2-process x 4-device global mesh, and a ZeRO-2
+train step runs real cross-process collectives (the reference exercises
+this with forkserver ranks over localhost NCCL, tests/unit/common.py:105).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+os.environ["DSTPU_ACCELERATOR"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu import comm  # noqa: E402
+from deepspeed_tpu.models import gpt2_model  # noqa: E402
+
+
+def main(out_dir: str) -> int:
+    comm.init_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    model = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+    }, seed=3)
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+    losses = [float(engine.train_batch(batch)) for _ in range(2)]
+    assert all(np.isfinite(losses)), losses
+    assert losses[1] < losses[0], losses
+
+    with open(os.path.join(out_dir, f"loss_{jax.process_index()}.txt"), "w") as f:
+        f.write(repr(losses))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

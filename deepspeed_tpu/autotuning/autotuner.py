@@ -40,7 +40,8 @@ class Autotuner:
                  seed: int = 0,
                  model_spec: Optional[Dict[str, Any]] = None,
                  results_dir: Optional[str] = None,
-                 seq_len: int = 16):
+                 seq_len: int = 16,
+                 experiment_timeout_s: float = 3600.0):
         """``model_spec`` + ``results_dir`` select LAUNCHED mode: every
         experiment runs as its own process (reference autotuner.py:404 —
         a config that OOMs/crashes is a failed data point, not a dead
@@ -51,10 +52,16 @@ class Autotuner:
             model_fn = lambda: build_model_from_spec(model_spec)  # noqa: E731
         if model_fn is None:
             raise ValueError("need model_fn or model_spec")
+        if results_dir is not None and model_spec is None:
+            raise ValueError(
+                "results_dir (launched mode) requires model_spec — a "
+                "model_fn closure cannot be shipped to the experiment "
+                "processes")
         self.model_fn = model_fn
         self.model_spec = model_spec
         self.results_dir = results_dir
         self.seq_len = seq_len
+        self.experiment_timeout_s = experiment_timeout_s
         self.base_config = base_config or {}
         self.batch_fn = batch_fn
         self.zero_stages = list(zero_stages)
@@ -124,9 +131,8 @@ class Autotuner:
             if self.batch_fn is not None:
                 batch = self.batch_fn(micro_batch * dp)
             else:
-                batch = {"input_ids": np.random.default_rng(0).integers(
-                    0, model.config.vocab_size,
-                    size=(micro_batch * max(dp, 1), self.seq_len))}
+                from .experiment import synthetic_batch
+                batch = synthetic_batch(model, micro_batch, dp, self.seq_len)
             for _ in range(self.warmup_steps):
                 jax.block_until_ready(engine.train_batch(batch))
             t0 = time.perf_counter()
@@ -187,13 +193,20 @@ class Autotuner:
         if result is None:
             with open(os.path.join(exp_dir, "exp.json"), "w") as f:
                 json.dump(exp_spec, f, indent=2)
-            proc = subprocess.run(
-                [sys.executable, "-m", "deepspeed_tpu.autotuning.experiment",
-                 exp_dir], capture_output=True, text=True)
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-m",
+                     "deepspeed_tpu.autotuning.experiment", exp_dir],
+                    capture_output=True, text=True,
+                    timeout=self.experiment_timeout_s)
+                tail = proc.stderr[-500:]
+            except subprocess.TimeoutExpired:
+                # a wedged config is a failed data point, not a dead search
+                tail = f"timeout after {self.experiment_timeout_s}s"
             result = read_result()
             if result is None:
                 record.update({"status": "error: experiment process died: "
-                               + proc.stderr[-500:], "samples_per_sec": 0.0})
+                               + tail, "samples_per_sec": 0.0})
                 return record
         else:
             logger.info(f"autotuner: reusing persisted result for "
@@ -223,7 +236,8 @@ class Autotuner:
             with open(os.path.join(self.results_dir,
                                    "autotuning_results.json"), "w") as f:
                 json.dump(self.results, f, indent=2)
-            if best:
+            if best and best.get("status") == "ok":
+                # never persist a config that was measured to fail
                 with open(os.path.join(self.results_dir,
                                        "best_config.json"), "w") as f:
                     json.dump(best["config"], f, indent=2)

@@ -37,6 +37,15 @@ def build_model_from_spec(spec):
     return fn(preset, **kwargs) if preset else fn(**kwargs)
 
 
+def synthetic_batch(model, micro_batch: int, dp: int, seq_len: int) -> dict:
+    """The one batch builder both experiment modes measure with — the two
+    paths must stay comparable."""
+    import numpy as np
+    rng = np.random.default_rng(0)
+    return {"input_ids": rng.integers(0, model.config.vocab_size,
+                                      size=(micro_batch * max(dp, 1), seq_len))}
+
+
 def run_experiment_dir(exp_dir: str) -> dict:
     import jax
 
@@ -46,8 +55,6 @@ def run_experiment_dir(exp_dir: str) -> dict:
     # tests/conftest.py and __graft_entry__.dryrun_multichip).
     if os.environ.get("DSTPU_ACCELERATOR") == "cpu":
         jax.config.update("jax_platforms", "cpu")
-
-    import numpy as np
 
     import deepspeed_tpu
 
@@ -60,10 +67,7 @@ def run_experiment_dir(exp_dir: str) -> dict:
                                                    config=exp["config"])
         dp = engine.topology.data_parallel_size
         micro = exp["config"].get("train_micro_batch_size_per_gpu", 1)
-        rng = np.random.default_rng(0)
-        batch = {"input_ids": rng.integers(
-            0, model.config.vocab_size,
-            size=(max(dp, 1) * micro, exp.get("seq_len", 16)))}
+        batch = synthetic_batch(model, micro, dp, exp.get("seq_len", 16))
         for _ in range(exp.get("warmup_steps", 1)):
             jax.block_until_ready(engine.train_batch(batch))
         t0 = time.perf_counter()

@@ -68,11 +68,31 @@ class CompressionManager:
         self._masks: Dict[str, jax.Array] = {}
 
     # -- weight transforms ---------------------------------------------------
+    @staticmethod
+    def scheduled_bits(group_params: Dict, step: Optional[int]) -> int:
+        """Anneal start_bits → target_bits on the reference's doubling
+        schedule (runtime/quantize.py:135-140): each time the step crosses
+        the period the precision drops one bit and the period doubles, so
+        an 8→4 QAT with period p drops at steps p, 2p, 4p, 8p."""
+        start = int(group_params.get("start_bits", group_params.get("bits", 8)))
+        target = int(group_params.get("target_bits", start))
+        period = int(group_params.get("quantization_period",
+                                      group_params.get("quantize_period", 0)))
+        if step is None or period <= 0 or target >= start:
+            return start
+        bits, p = start, period
+        while bits > target and step >= p:
+            p <<= 1
+            bits -= 1
+        return bits
+
     def compress_params(self, params: Any, quant_enabled: bool = True,
-                        prune_enabled: bool = True) -> Any:
+                        prune_enabled: bool = True,
+                        step: Optional[int] = None) -> Any:
         """Differentiable compression pass for QAT training (fake-quant with
         STE + mask multiply). Use inside the loss: model.loss(cm.compress_
-        params(params), batch)."""
+        params(params), batch). ``step`` drives the start→target bits
+        annealing; None holds at start_bits."""
 
         def transform(path, leaf):
             name = _leaf_name(path)
@@ -84,8 +104,7 @@ class CompressionManager:
             if quant_enabled and self.weight_quant is not None:
                 for g in self.weight_quant["groups"]:
                     if _matches(name, g["modules"]):
-                        bits = g["params"].get("start_bits",
-                                               g["params"].get("bits", 8))
+                        bits = self.scheduled_bits(g["params"], step)
                         x = fake_quantize_ste(x, num_bits=int(bits))
                         break
             return x

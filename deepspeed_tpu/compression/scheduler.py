@@ -38,4 +38,7 @@ class CompressionScheduler:
         return self.manager.compress_params(
             params,
             quant_enabled=self.quant_enabled(step),
-            prune_enabled=self.prune_enabled(step))
+            prune_enabled=self.prune_enabled(step),
+            # bits annealing counts from when quantization switches on
+            # (reference qsteps, runtime/quantize.py:75)
+            step=max(0, step - self.quant_offset))

@@ -187,10 +187,12 @@ def _run_popen(args, active: Dict[str, List[int]]) -> int:
     ranks = [(host, slot) for host, slots in active.items() for slot in slots]
     master = args.master_addr or "localhost"
     world_info = encode_world_info(active)
+    exports = _collect_env_exports()  # .deepspeed_env parity with _run_ssh
     procs: List[subprocess.Popen] = []
     _install_fan_out(procs)
     for idx, (host, slot) in enumerate(ranks):
         env = dict(os.environ)
+        env.update(exports)
         env.update({
             "JAX_COORDINATOR_ADDRESS": f"{master}:{args.master_port}",
             "JAX_NUM_PROCESSES": str(len(ranks)),
@@ -231,10 +233,12 @@ def main(args=None) -> int:
     if not resource_pool or args.launcher == "local":
         return _run_local(args)
     active = _parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
+    if args.launcher == "popen":
+        # popen spawns per SLOT — a single-host 'localhost slots=8' entry
+        # is its primary use case, so no single-host short-circuit
+        return _run_popen(args, active)
     if len(active) == 1 and not args.force_multi:
         return _run_local(args)
-    if args.launcher == "popen":
-        return _run_popen(args, active)
     return _run_ssh(args, active)
 
 

@@ -30,7 +30,8 @@ def _free_port() -> int:
 
 def test_two_process_zero2_step(tmp_path):
     hostfile = tmp_path / "hostfile"
-    hostfile.write_text("proc0 slots=1\nproc1 slots=1\n")
+    # the canonical single-host form: popen spawns one rank per SLOT
+    hostfile.write_text("localhost slots=2\n")
     env = {k: v for k, v in os.environ.items()
            if not k.startswith(("JAX_", "XLA_"))}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")

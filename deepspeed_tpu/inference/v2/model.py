@@ -5,7 +5,8 @@ Counterpart of the reference per-arch inference models
 ``_forward_embed`` → per-layer attention/MLP over ragged batch →
 ``_forward_unembed``). One implementation covers the whole decoder family by
 reusing :class:`~deepspeed_tpu.models.transformer.TransformerLM`'s config and
-parameter layout (GPT-2 / Llama / Mistral / Mixtral presets).
+parameter layout (GPT-2 / Llama / Mistral / Mixtral / OPT / Phi / Falcon
+presets).
 
 Two static-shape programs replace the reference's ragged CUDA path
 (Dynamic SplitFuse is preserved at the scheduler level, see
@@ -29,7 +30,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...models.transformer import TransformerLM
+from ...models.transformer import ACTIVATIONS, TransformerLM
 from ...nn import layers as nn
 from .kernels.paged_attention import (chunk_prefill_attention, paged_decode_attention,
                                       ragged_chunk_attention)
@@ -56,7 +57,7 @@ class RaggedInferenceModel:
         x = m._wte(params["wte"], tokens)
         if m._wpe is not None:
             pos = jnp.clip(positions, 0, self.config.max_seq_len - 1)
-            x = x + m._wpe(params["wpe"], pos)
+            x = x + m._wpe(params["wpe"], pos + self.config.position_offset)
         return x.astype(self.config.dtype)
 
     def _unembed(self, params: Params, x: jax.Array) -> jax.Array:
@@ -70,22 +71,22 @@ class RaggedInferenceModel:
             logits = m._lm_head(params["lm_head"], x)
         return logits.astype(jnp.float32)
 
-    def _qkv(self, block: Params, x: jax.Array, positions: jax.Array):
-        """x [N, hidden] -> q [N, H, D], k/v [N, kvH, D] with rope applied."""
+    def _qkv(self, block: Params, h: jax.Array, positions: jax.Array):
+        """PRE-NORMED h [N, hidden] -> q [N, H, D], k/v [N, kvH, D] with rope
+        (possibly partial, phi) applied."""
         c, m = self.config, self.model
-        N = x.shape[0]
-        h = m._block_layers["ln_1"](block["ln_1"], x)
+        N = h.shape[0]
         q = m._block_layers["q_proj"](block["q_proj"], h).reshape(N, c.num_heads, c.head_dim)
         k = m._block_layers["k_proj"](block["k_proj"], h).reshape(N, c.kv_heads, c.head_dim)
         v = m._block_layers["v_proj"](block["v_proj"], h).reshape(N, c.kv_heads, c.head_dim)
         if c.position == "rope":
-            q = nn.rotary_embedding(q, positions, c.rope_theta)
-            k = nn.rotary_embedding(k, positions, c.rope_theta)
+            q = m._rotate(q, positions)
+            k = m._rotate(k, positions)
         return q, k, v
 
-    def _mlp(self, block: Params, x: jax.Array) -> jax.Array:
+    def _mlp(self, block: Params, h: jax.Array) -> jax.Array:
+        """MLP over the PRE-NORMED input h."""
         c, m = self.config, self.model
-        h = m._block_layers["ln_2"](block["ln_2"], x)
         if c.moe is not None:
             out, _ = m._moe(block["moe"], h[None, :, :])
             return out[0]
@@ -93,7 +94,7 @@ class RaggedInferenceModel:
             gate = nn.silu(m._block_layers["gate_proj"](block["gate_proj"], h))
             up = m._block_layers["up_proj"](block["up_proj"], h)
             return m._block_layers["down_proj"](block["down_proj"], gate * up)
-        h2 = nn.gelu(m._block_layers["fc_in"](block["fc_in"], h))
+        h2 = ACTIVATIONS[c.activation](m._block_layers["fc_in"](block["fc_in"], h))
         return m._block_layers["fc_out"](block["fc_out"], h2)
 
     def _write_kv(self, pages: jax.Array, new: jax.Array, flat_idx: jax.Array) -> jax.Array:
@@ -114,19 +115,30 @@ class RaggedInferenceModel:
         L = self.config.num_layers
         blocks = params["blocks"]
 
+        c, m = self.config, self.model
+
         def body(l, carry):
             x, k_pages, v_pages = carry
             block = jax.tree.map(lambda a: a[l], blocks)
-            q, k, v = self._qkv(block, x, positions)
+            h1 = m._block_layers["ln_1"](block["ln_1"], x)
+            q, k, v = self._qkv(block, h1, positions)
             k_l = self._write_kv(k_pages[l], k, write_idx)
             v_l = self._write_kv(v_pages[l], v, write_idx)
             k_pages = k_pages.at[l].set(k_l)
             v_pages = v_pages.at[l].set(v_l)
             attn_out = attn_fn(q, k_l, v_l)
-            o = self.model._block_layers["o_proj"](
+            o = m._block_layers["o_proj"](
                 block["o_proj"], attn_out.reshape(x.shape[0], -1))
-            x = x + o
-            x = x + self._mlp(block, x)
+            if c.parallel_block:
+                # falcon/phi: MLP reads the block INPUT through a shared
+                # (phi/falcon-7b) or per-branch (falcon-40b) norm
+                hm = (m._block_layers["ln_2"](block["ln_2"], x)
+                      if c.parallel_norms else h1)
+                x = x + o + self._mlp(block, hm)
+            else:
+                x = x + o
+                h2 = m._block_layers["ln_2"](block["ln_2"], x)
+                x = x + self._mlp(block, h2)
             return (x, k_pages, v_pages)
 
         x, k_pages, v_pages = jax.lax.fori_loop(0, L, body, (x, k_pages, v_pages))

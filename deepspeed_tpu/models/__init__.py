@@ -2,3 +2,5 @@ from .transformer import MoEConfig, TransformerConfig, TransformerLM  # noqa: F4
 from .gpt2 import gpt2_config, gpt2_model  # noqa: F401
 from .llama import llama_config, llama_model  # noqa: F401
 from .mixtral import mixtral_config, mixtral_model  # noqa: F401
+from .opt_phi_falcon import (falcon_config, falcon_model, opt_config,  # noqa: F401
+                             opt_model, phi_config, phi_model)

@@ -35,6 +35,16 @@ Params = Dict[str, Any]
 
 ACT_SPEC = P(BATCH_AXES, SEQ_AXIS, None)  # [batch, seq, hidden]
 
+# MLP activations by config name. HF's "gelu_new"/"gelu_pytorch_tanh"
+# (gpt2, phi) is the tanh approximation; HF's "gelu" (falcon, galactica)
+# is the exact erf form — they differ by up to ~5e-4 per neuron, which
+# compounds across layers, so checkpoint ingestion must distinguish them.
+ACTIVATIONS = {
+    "gelu": nn.gelu,  # tanh approximation
+    "gelu_exact": lambda x: jax.nn.gelu(x, approximate=False),
+    "relu": jax.nn.relu,
+}
+
 
 def _c(x, spec):
     try:
@@ -61,11 +71,17 @@ class TransformerConfig:
     num_kv_heads: Optional[int] = None  # None => MHA
     hidden_size: int = 768
     intermediate_size: Optional[int] = None  # None => 4*hidden
-    activation: str = "gelu"        # 'gelu' | 'silu_gated'
+    activation: str = "gelu"        # 'gelu' | 'gelu_exact' | 'relu' | 'silu_gated'
     norm: str = "layernorm"          # 'layernorm' | 'rmsnorm'
     norm_eps: float = 1e-5           # HF config layer_norm_epsilon / rms_norm_eps
     position: str = "learned"        # 'learned' | 'rope'
+    position_offset: int = 0         # OPT pads learned positions by 2
     rope_theta: float = 10000.0
+    rope_dim: Optional[int] = None   # partial rotary (phi); None => head_dim
+    parallel_block: bool = False     # falcon/phi: x + attn(ln(x)) + mlp(ln(x))
+    parallel_norms: bool = False     # falcon-40b: separate ln per parallel branch
+    linear_bias: Optional[bool] = None  # None => biases iff layernorm
+    lm_head_bias: bool = False       # phi's lm_head carries a bias
     tie_embeddings: bool = True
     dtype: Any = jnp.float32         # compute dtype (params kept by engine policy)
     remat: bool = True
@@ -96,7 +112,8 @@ class TransformerConfig:
             mlp = 2 * h * ffn
         if self.moe is not None:
             mlp = mlp * self.moe.num_experts + h * self.moe.num_experts
-        embed = v * h + (0 if self.position == "rope" else self.max_seq_len * h)
+        embed = v * h + (0 if self.position == "rope"
+                         else (self.max_seq_len + self.position_offset) * h)
         head = 0 if self.tie_embeddings else v * h
         return embed + head + L * (attn + mlp)
 
@@ -107,15 +124,20 @@ class TransformerLM:
         self.config = config
         c = config
         self._wte = nn.Embedding(c.vocab_size, c.hidden_size, shard=True)
-        self._wpe = nn.Embedding(c.max_seq_len, c.hidden_size) if c.position == "learned" else None
+        self._wpe = (nn.Embedding(c.max_seq_len + c.position_offset, c.hidden_size)
+                     if c.position == "learned" else None)
         base_cls = nn.LayerNorm if c.norm == "layernorm" else nn.RMSNorm
         norm_cls = lambda features: base_cls(features, eps=c.norm_eps)
         self._norm = norm_cls
         self._ln_f = norm_cls(c.hidden_size)
         if not c.tie_embeddings:
-            self._lm_head = nn.Linear(c.hidden_size, c.vocab_size, use_bias=False, shard="column")
+            self._lm_head = nn.Linear(c.hidden_size, c.vocab_size,
+                                      use_bias=c.lm_head_bias, shard="column")
 
-        use_bias = c.norm == "layernorm"  # gpt2-style models use biases
+        # gpt2-style models use biases; falcon keeps layernorm but bias-free
+        # linears (linear_bias overrides the norm-derived default)
+        use_bias = (c.linear_bias if c.linear_bias is not None
+                    else c.norm == "layernorm")
         kv_out = c.kv_heads * c.head_dim
         self._block_layers = {
             "ln_1": norm_cls(c.hidden_size),
@@ -123,8 +145,12 @@ class TransformerLM:
             "k_proj": nn.Linear(c.hidden_size, kv_out, use_bias=use_bias, shard="column"),
             "v_proj": nn.Linear(c.hidden_size, kv_out, use_bias=use_bias, shard="column"),
             "o_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=use_bias, shard="row"),
-            "ln_2": norm_cls(c.hidden_size),
         }
+        if not c.parallel_block or c.parallel_norms:
+            # parallel blocks (falcon-7b/phi) feed attention and MLP from the
+            # SAME normed input — no second norm exists in the checkpoint;
+            # falcon-40b's "new decoder" norms each parallel branch separately
+            self._block_layers["ln_2"] = norm_cls(c.hidden_size)
         if c.moe is not None:
             from ..moe.layer import MoE
             self._moe = MoE(
@@ -187,23 +213,33 @@ class TransformerLM:
         return specs
 
     # -- forward -------------------------------------------------------------
-    def _attn(self, block: Params, x: jax.Array, positions: jax.Array) -> jax.Array:
+    def _rotate(self, x: jax.Array, positions: jax.Array) -> jax.Array:
+        """Rotary embedding, possibly PARTIAL (phi applies rope to only the
+        first rope_dim of each head, passing the rest through)."""
         c = self.config
-        B, S, _ = x.shape
-        h = self._block_layers["ln_1"](block["ln_1"], x)
+        rd = c.rope_dim or c.head_dim
+        if rd >= c.head_dim:
+            return nn.rotary_embedding(x, positions, c.rope_theta)
+        rot = nn.rotary_embedding(x[..., :rd], positions, c.rope_theta)
+        return jnp.concatenate([rot, x[..., rd:]], axis=-1)
+
+    def _attn(self, block: Params, h: jax.Array, positions: jax.Array) -> jax.Array:
+        """Attention over the PRE-NORMED input h."""
+        c = self.config
+        B, S, _ = h.shape
         q = self._block_layers["q_proj"](block["q_proj"], h).reshape(B, S, c.num_heads, c.head_dim)
         k = self._block_layers["k_proj"](block["k_proj"], h).reshape(B, S, c.kv_heads, c.head_dim)
         v = self._block_layers["v_proj"](block["v_proj"], h).reshape(B, S, c.kv_heads, c.head_dim)
         if c.position == "rope":
-            q = nn.rotary_embedding(q, positions, c.rope_theta)
-            k = nn.rotary_embedding(k, positions, c.rope_theta)
+            q = self._rotate(q, positions)
+            k = self._rotate(k, positions)
         out = ulysses_attention(flash_attention, q, k, v, causal=True)
         out = out.reshape(B, S, c.num_heads * c.head_dim)
         return self._block_layers["o_proj"](block["o_proj"], out)
 
-    def _mlp(self, block: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def _mlp(self, block: Params, h: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """MLP over the PRE-NORMED input h."""
         c = self.config
-        h = self._block_layers["ln_2"](block["ln_2"], x)
         aux = jnp.zeros((), dtype=jnp.float32)
         if c.moe is not None:
             out, aux = self._moe(block["moe"], h)
@@ -212,18 +248,31 @@ class TransformerLM:
             up = self._block_layers["up_proj"](block["up_proj"], h)
             out = self._block_layers["down_proj"](block["down_proj"], gate * up)
         else:
-            h2 = nn.gelu(self._block_layers["fc_in"](block["fc_in"], h))
+            h2 = ACTIVATIONS[c.activation](self._block_layers["fc_in"](block["fc_in"], h))
             out = self._block_layers["fc_out"](block["fc_out"], h2)
         return out, aux
 
     def _block_fn(self, carry, block_and_keep):
         block, keep = block_and_keep
         x, positions, aux_acc = carry
+        c = self.config
         # keep: per-layer stochastic-depth gate (progressive layer drop,
         # reference runtime/progressive_layer_drop.py); 1.0 = layer active
-        x = x + keep * self._attn(block, x, positions)
-        mlp_out, aux = self._mlp(block, x)
-        x = _c(x + keep * mlp_out, ACT_SPEC)
+        h1 = self._block_layers["ln_1"](block["ln_1"], x)
+        if c.parallel_block:
+            # falcon/phi residual form: both branches read the block INPUT —
+            # through one shared norm (phi/falcon-7b) or per-branch norms
+            # (falcon-40b new decoder)
+            attn_out = self._attn(block, h1, positions)
+            hm = (self._block_layers["ln_2"](block["ln_2"], x)
+                  if c.parallel_norms else h1)
+            mlp_out, aux = self._mlp(block, hm)
+            x = _c(x + keep * (attn_out + mlp_out), ACT_SPEC)
+        else:
+            x = x + keep * self._attn(block, h1, positions)
+            h2 = self._block_layers["ln_2"](block["ln_2"], x)
+            mlp_out, aux = self._mlp(block, h2)
+            x = _c(x + keep * mlp_out, ACT_SPEC)
         return (x, positions, aux_acc + keep * aux), None
 
     def apply(self, params: Params, input_ids: jax.Array,
@@ -236,7 +285,7 @@ class TransformerLM:
         positions = jnp.arange(input_ids.shape[1])[None, :]
         x = self._wte(params["wte"], input_ids)
         if self._wpe is not None:
-            x = x + self._wpe(params["wpe"], positions)
+            x = x + self._wpe(params["wpe"], positions + c.position_offset)
         x = _c(x.astype(c.dtype), ACT_SPEC)
 
         block_fn = self._block_fn

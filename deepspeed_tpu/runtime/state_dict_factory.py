@@ -17,7 +17,7 @@ by architecture into the ``TransformerLM`` scanned-layer pytree, and placed
 reference's per-rank slice loading. Explicit per-rank slicing for
 multi-host loading is available via ``module_inject.auto_tp.shard_param_tree``.
 
-Supported architectures: gpt2, llama, mistral, mixtral.
+Supported architectures: gpt2, llama, mistral, mixtral, opt, phi, falcon.
 """
 
 from __future__ import annotations
@@ -157,9 +157,75 @@ def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> Tra
             cfg["moe"] = MoEConfig(
                 num_experts=hf.get("num_local_experts", 8),
                 top_k=hf.get("num_experts_per_tok", 2))
+    elif mt == "opt":
+        if not hf.get("do_layer_norm_before", True):
+            raise ValueError("post-LN OPT variants (opt-350m) are unsupported")
+        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+            raise ValueError("OPT with word_embed_proj_dim != hidden_size "
+                             "(project_in/out) is unsupported")
+        act = hf.get("activation_function", "relu")
+        cfg = dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf.get("ffn_dim", 4 * hf["hidden_size"]),
+            # HF "gelu" (galactica) is the exact erf form
+            activation="relu" if act == "relu" else
+            ("gelu" if act in ("gelu_new", "gelu_pytorch_tanh") else "gelu_exact"),
+            norm="layernorm", position="learned",
+            # HF OPTLearnedPositionalEmbedding offsets every position by 2
+            position_offset=2,
+            tie_embeddings=hf.get("tie_word_embeddings", True))
+    elif mt == "phi":
+        if hf.get("qk_layernorm", False):
+            raise ValueError("Phi variants with qk_layernorm are unsupported")
+        head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+        cfg = dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads"),
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            activation="gelu", norm="layernorm", position="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            rope_dim=int(head_dim * hf.get("partial_rotary_factor", 0.5)),
+            parallel_block=True, lm_head_bias=True,
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False))
+    elif mt == "falcon":
+        if not hf.get("parallel_attn", True) or hf.get("alibi", False):
+            raise ValueError("sequential/alibi Falcon variants unsupported")
+        new_decoder = hf.get("new_decoder_architecture", False)
+        if new_decoder:
+            kv = hf.get("num_kv_heads") or hf["num_attention_heads"]
+        else:
+            kv = 1 if hf.get("multi_query", True) else hf["num_attention_heads"]
+        # falcon2-11B: new decoder but ONE norm feeding both branches
+        # (HF gates ln_attn/ln_mlp on num_ln_in_parallel_attn == 2)
+        num_ln = hf.get("num_ln_in_parallel_attn") or 2
+        cfg = dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=kv,
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf.get("ffn_hidden_size",
+                                     4 * hf["hidden_size"]),
+            activation="gelu_exact", norm="layernorm", position="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            parallel_block=True, parallel_norms=new_decoder and num_ln == 2,
+            linear_bias=bool(hf.get("bias", False)),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", True))
     else:
         raise ValueError(f"unsupported model_type {mt!r} "
-                         "(supported: gpt2, llama, mistral, mixtral)")
+                         "(supported: gpt2, llama, mistral, mixtral, opt, "
+                         "phi, falcon)")
     cfg["dtype"] = dtype
     cfg.update(overrides)
     return TransformerConfig(**cfg)
@@ -261,12 +327,159 @@ def _llama_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str
     return params
 
 
+def _lin_stack(sd, pat: str, L: int, bias: bool = True) -> Dict[str, np.ndarray]:
+    """Stack L layers of an HF ``nn.Linear`` ([out, in] + optional bias)
+    into our [L, in, out] kernel layout."""
+    out = {"kernel": _stack(sd, pat + ".weight", L, np.transpose)}
+    if bias:
+        out["bias"] = _stack(sd, pat + ".bias", L)
+    return out
+
+
+def _ln_stack(sd, pat: str, L: int) -> Dict[str, np.ndarray]:
+    return {"scale": _stack(sd, pat + ".weight", L),
+            "bias": _stack(sd, pat + ".bias", L)}
+
+
+def _opt_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF OPT: decoder.* naming, [out, in] linears, fused nothing. The
+    position table keeps HF's 2-row offset (embed_positions includes it)."""
+    sd = _strip_prefix(sd, "model.")
+    L = cfg.num_layers
+
+    def lin(pat):
+        return _lin_stack(sd, pat, L)
+
+    def ln(pat):
+        return _ln_stack(sd, pat, L)
+
+    blocks = {
+        "ln_1": ln("decoder.layers.{i}.self_attn_layer_norm"),
+        "ln_2": ln("decoder.layers.{i}.final_layer_norm"),
+        "q_proj": lin("decoder.layers.{i}.self_attn.q_proj"),
+        "k_proj": lin("decoder.layers.{i}.self_attn.k_proj"),
+        "v_proj": lin("decoder.layers.{i}.self_attn.v_proj"),
+        "o_proj": lin("decoder.layers.{i}.self_attn.out_proj"),
+        "fc_in": lin("decoder.layers.{i}.fc1"),
+        "fc_out": lin("decoder.layers.{i}.fc2"),
+    }
+    params = {
+        "wte": {"embedding": sd["decoder.embed_tokens.weight"]},
+        "wpe": {"embedding": sd["decoder.embed_positions.weight"]},
+        "ln_f": {"scale": sd["decoder.final_layer_norm.weight"],
+                 "bias": sd["decoder.final_layer_norm.bias"]},
+        "blocks": blocks,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.transpose(
+            sd.get("lm_head.weight", sd["decoder.embed_tokens.weight"]))}
+    return params
+
+
+def _phi_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF Phi: parallel block with ONE input_layernorm, biased linears and
+    lm_head, q/k/v unfused, dense == o_proj."""
+    L = cfg.num_layers
+    T = np.transpose
+
+    def lin(pat):
+        return _lin_stack(sd, pat, L)
+
+    blocks = {
+        "ln_1": _ln_stack(sd, "model.layers.{i}.input_layernorm", L),
+        "q_proj": lin("model.layers.{i}.self_attn.q_proj"),
+        "k_proj": lin("model.layers.{i}.self_attn.k_proj"),
+        "v_proj": lin("model.layers.{i}.self_attn.v_proj"),
+        "o_proj": lin("model.layers.{i}.self_attn.dense"),
+        "fc_in": lin("model.layers.{i}.mlp.fc1"),
+        "fc_out": lin("model.layers.{i}.mlp.fc2"),
+    }
+    params = {
+        "wte": {"embedding": sd["model.embed_tokens.weight"]},
+        "ln_f": {"scale": sd["model.final_layernorm.weight"],
+                 "bias": sd["model.final_layernorm.bias"]},
+        "blocks": blocks,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": T(sd["lm_head.weight"])}
+        if cfg.lm_head_bias:
+            params["lm_head"]["bias"] = sd["lm_head.bias"]
+    return params
+
+
+def _falcon_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF Falcon: fused query_key_value laid out GROUPED — per kv group,
+    (heads_per_group q rows, 1 k row, 1 v row) x head_dim — split into our
+    separate q/k/v projections (kernels, and biases when config.bias)."""
+    L, H = cfg.num_layers, cfg.hidden_size
+    nh, nkv, hd = cfg.num_heads, cfg.kv_heads, cfg.head_dim
+    per = nh // nkv
+    T = np.transpose
+    use_bias = bool(cfg.linear_bias)
+
+    qkv = {"q_proj": {}, "k_proj": {}, "v_proj": {}}
+    parts = ["kernel", "bias"] if use_bias else ["kernel"]
+    for part in parts:
+        qs, ks, vs = [], [], []
+        for i in range(L):
+            suffix = "weight" if part == "kernel" else "bias"
+            w = sd.pop(f"transformer.h.{i}.self_attention.query_key_value.{suffix}")
+            # grouped rows: reshape to [nkv, per+2, hd, ...] then slice roles
+            g = w.reshape(nkv, per + 2, hd, *w.shape[1:])
+            q, k, v = g[:, :per], g[:, per], g[:, per + 1]
+            if part == "kernel":
+                qs.append(T(q.reshape(nh * hd, H)))
+                ks.append(T(k.reshape(nkv * hd, H)))
+                vs.append(T(v.reshape(nkv * hd, H)))
+            else:
+                qs.append(q.reshape(nh * hd))
+                ks.append(k.reshape(nkv * hd))
+                vs.append(v.reshape(nkv * hd))
+        qkv["q_proj"][part] = np.stack(qs)
+        qkv["k_proj"][part] = np.stack(ks)
+        qkv["v_proj"][part] = np.stack(vs)
+
+    if cfg.parallel_norms:
+        # falcon-40b "new decoder": per-branch norms ln_attn / ln_mlp
+        norms = {
+            "ln_1": _ln_stack(sd, "transformer.h.{i}.ln_attn", L),
+            "ln_2": _ln_stack(sd, "transformer.h.{i}.ln_mlp", L),
+        }
+    else:
+        norms = {
+            "ln_1": _ln_stack(sd, "transformer.h.{i}.input_layernorm", L),
+        }
+    blocks = {
+        **norms,
+        **qkv,
+        "o_proj": _lin_stack(sd, "transformer.h.{i}.self_attention.dense", L, bias=use_bias),
+        "fc_in": _lin_stack(sd, "transformer.h.{i}.mlp.dense_h_to_4h", L, bias=use_bias),
+        "fc_out": _lin_stack(sd, "transformer.h.{i}.mlp.dense_4h_to_h", L, bias=use_bias),
+    }
+    params = {
+        "wte": {"embedding": sd["transformer.word_embeddings.weight"]},
+        "ln_f": {"scale": sd["transformer.ln_f.weight"],
+                 "bias": sd["transformer.ln_f.bias"]},
+        "blocks": blocks,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": T(
+            sd.get("lm_head.weight", sd["transformer.word_embeddings.weight"]))}
+    return params
+
+
 def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
                             sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
     if model_type == "gpt2":
         return _gpt2_params(cfg, sd)
     if model_type in ("llama", "mistral", "mixtral"):
         return _llama_params(cfg, sd)
+    if model_type == "opt":
+        return _opt_params(cfg, sd)
+    if model_type == "phi":
+        return _phi_params(cfg, sd)
+    if model_type == "falcon":
+        return _falcon_params(cfg, sd)
     raise ValueError(f"unsupported model_type {model_type!r}")
 
 

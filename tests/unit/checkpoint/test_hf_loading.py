@@ -41,6 +41,74 @@ def llama_ckpt(tmp_path_factory):
     return path, m
 
 
+@pytest.fixture(scope="module")
+def opt_ckpt(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf_opt")
+    cfg = transformers.OPTConfig(
+        vocab_size=128, hidden_size=64, ffn_dim=256, num_hidden_layers=2,
+        num_attention_heads=4, max_position_embeddings=64,
+        word_embed_proj_dim=64, do_layer_norm_before=True)
+    torch.manual_seed(2)
+    m = transformers.OPTForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def phi_ckpt(tmp_path_factory):
+    path = tmp_path_factory.mktemp("hf_phi")
+    cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5)
+    torch.manual_seed(3)
+    m = transformers.PhiForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+_FALCON_COMMON = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                      num_attention_heads=4, parallel_attn=True, bias=False,
+                      alibi=False, max_position_embeddings=64)
+
+
+@pytest.fixture(scope="module")
+def falcon_mqa_ckpt(tmp_path_factory):
+    """falcon-7b-style: multi-query attention, old decoder, one shared norm."""
+    path = tmp_path_factory.mktemp("hf_falcon_mqa")
+    cfg = transformers.FalconConfig(
+        multi_query=True, new_decoder_architecture=False, **_FALCON_COMMON)
+    torch.manual_seed(4)
+    m = transformers.FalconForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def falcon_gqa_ckpt(tmp_path_factory):
+    """falcon-40b-style: grouped KV, new decoder, per-branch parallel norms."""
+    path = tmp_path_factory.mktemp("hf_falcon_gqa")
+    cfg = transformers.FalconConfig(
+        num_kv_heads=2, new_decoder_architecture=True, **_FALCON_COMMON)
+    torch.manual_seed(5)
+    m = transformers.FalconForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def falcon_bias_ckpt(tmp_path_factory):
+    """bias=True exercises the fused query_key_value BIAS split."""
+    path = tmp_path_factory.mktemp("hf_falcon_bias")
+    cfg = transformers.FalconConfig(
+        **{**_FALCON_COMMON, "bias": True},
+        multi_query=False, new_decoder_architecture=False)
+    torch.manual_seed(6)
+    m = transformers.FalconForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
 def _ref_logits(m, ids):
     with torch.no_grad():
         return m(torch.tensor(ids)).logits.float().numpy()
@@ -52,7 +120,9 @@ def _our_logits(path, ids, **overrides):
     return np.asarray(logits)
 
 
-@pytest.mark.parametrize("ckpt", ["gpt2_ckpt", "llama_ckpt"])
+@pytest.mark.parametrize("ckpt", ["gpt2_ckpt", "llama_ckpt", "opt_ckpt",
+                                  "phi_ckpt", "falcon_mqa_ckpt",
+                                  "falcon_gqa_ckpt", "falcon_bias_ckpt"])
 def test_hf_logits_parity(request, eight_devices, ckpt):
     """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
     path, m = request.getfixturevalue(ckpt)
@@ -90,10 +160,12 @@ def test_shard_param_tree_matches_device_slices(eight_devices, llama_ckpt):
         np.testing.assert_array_equal(shard, full[..., rank * k:(rank + 1) * k])
 
 
-def test_build_hf_engine_v2_greedy_matches_hf(eight_devices, llama_ckpt):
+@pytest.mark.parametrize("ckpt", ["llama_ckpt", "opt_ckpt", "phi_ckpt",
+                                  "falcon_gqa_ckpt"])
+def test_build_hf_engine_v2_greedy_matches_hf(request, eight_devices, ckpt):
     """The ragged serving engine loaded from the checkpoint must greedy-decode
-    the same tokens as HF ``generate``."""
-    path, m = llama_ckpt
+    the same tokens as HF ``generate`` — across the decoder family matrix."""
+    path, m = request.getfixturevalue(ckpt)
     from deepspeed_tpu.inference.v2.config_v2 import RaggedInferenceEngineConfig
     from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
     from deepspeed_tpu.inference.v2.scheduler import generate

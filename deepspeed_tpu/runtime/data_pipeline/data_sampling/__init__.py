@@ -1,0 +1,3 @@
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,  # noqa: F401
+                              data_file_path, index_file_path)
+from .data_analyzer import DataAnalyzer, metric_difficulty_fn  # noqa: F401

@@ -1,0 +1,201 @@
+"""Offline data analysis for curriculum learning.
+
+Counterpart of the reference's ``data_pipeline/data_sampling/data_analyzer.py``
+(``DataAnalyzer`` :20 — map over the dataset computing per-sample metrics,
+reduce into index files the curriculum sampler reads). The reference spreads
+the map over workers×threads×processes with csv intermediates; here the map
+is a sharded numpy pass (workers = hosts, one shard each) and the reduce
+merges shards with the mmap builder — the analyzer runs on CPU hosts, so the
+simple path is the fast path.
+
+Outputs under ``save_path/<metric>/`` (names match the reference so existing
+curriculum configs port over):
+- ``<metric>_sample_to_metric``   (.bin/.idx)  sample idx → metric value
+- ``<metric>_index_to_metric``    (.bin/.idx)  sorted unique metric values
+- ``<metric>_index_to_sample``    (.bin/.idx)  for each unique value, the
+  sample indices having it (one "sequence" per value)
+- ``<metric>_index_to_sample_percentile_merged`` (.bin/.idx) sample indices
+  sorted by metric — position/len(samples) is the percentile, which is what
+  difficulty-percentile curricula index into.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,
+                              best_fitting_int_dtype)
+
+SINGLE_VALUE = "single_value_per_sample"
+ACCUMULATE = "accumulate_value_over_samples"
+
+
+def _metric_dir(save_path: str, name: str) -> str:
+    d = os.path.join(save_path, name)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _shard_prefix(save_path: str, name: str, kind: str, worker_id: int) -> str:
+    return os.path.join(_metric_dir(save_path, name),
+                        f"worker{worker_id}_{name}_{kind}")
+
+
+def _merged_prefix(save_path: str, name: str, kind: str) -> str:
+    return os.path.join(_metric_dir(save_path, name), f"{name}_{kind}")
+
+
+class DataAnalyzer:
+    """Map/reduce per-sample metrics over an indexed dataset.
+
+    ``metric_functions`` take a batch (list of samples, or the output of
+    ``collate_fn``) and return one integer metric value per sample
+    (``single_value_per_sample``) or a running aggregate
+    (``accumulate_value_over_samples``, e.g. total token count).
+    """
+
+    def __init__(self,
+                 dataset,
+                 num_workers: int = 1,
+                 worker_id: int = 0,
+                 batch_size: int = 64,
+                 metric_names: Sequence[str] = (),
+                 metric_functions: Sequence[Callable] = (),
+                 metric_types: Sequence[str] = (),
+                 save_path: str = "./",
+                 collate_fn: Optional[Callable] = None):
+        assert len(metric_names) == len(metric_functions) == len(metric_types)
+        self.dataset = dataset
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types)
+        self.save_path = save_path
+        self.collate_fn = collate_fn
+
+    # -- map ----------------------------------------------------------------
+    def _worker_range(self) -> range:
+        n = len(self.dataset)
+        per = -(-n // self.num_workers)
+        lo = min(self.worker_id * per, n)
+        return range(lo, min(lo + per, n))
+
+    def run_map(self) -> None:
+        """Compute this worker's shard and write partial mmap files."""
+        idxs = self._worker_range()
+        values: Dict[str, List[int]] = {n: [] for n in self.metric_names}
+        accum: Dict[str, Any] = {}
+        for start in range(idxs.start, idxs.stop, self.batch_size):
+            batch_idx = list(range(start, min(start + self.batch_size, idxs.stop)))
+            batch = [self.dataset[i] for i in batch_idx]
+            if self.collate_fn is not None:
+                batch = self.collate_fn(batch)
+            for name, fn, mtype in zip(self.metric_names, self.metric_functions,
+                                       self.metric_types):
+                out = fn(batch)
+                if mtype == SINGLE_VALUE:
+                    out = np.asarray(out).reshape(-1)
+                    assert len(out) == len(batch_idx), (name, len(out), len(batch_idx))
+                    values[name].extend(int(v) for v in out)
+                elif mtype == ACCUMULATE:
+                    accum[name] = out if name not in accum else accum[name] + out
+                else:
+                    raise ValueError(f"unknown metric type {mtype!r}")
+
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            if mtype == SINGLE_VALUE:
+                vals = values[name]
+                dt = best_fitting_int_dtype(max(vals, default=0))
+                b = MMapIndexedDatasetBuilder(
+                    _shard_prefix(self.save_path, name, "sample_to_metric",
+                                  self.worker_id), dtype=dt)
+                for v in vals:
+                    b.add_item([v])
+                    b.end_document()
+                b.finalize()
+            else:
+                np.save(os.path.join(
+                    _metric_dir(self.save_path, name),
+                    f"worker{self.worker_id}_accumulate.npy"),
+                    np.asarray(accum.get(name, 0)))
+
+    # -- reduce -------------------------------------------------------------
+    def run_reduce(self) -> None:
+        """Merge all workers' shards into the global index files."""
+        for name, mtype in zip(self.metric_names, self.metric_types):
+            if mtype == ACCUMULATE:
+                total = sum(
+                    np.load(os.path.join(_metric_dir(self.save_path, name),
+                                         f"worker{w}_accumulate.npy"))
+                    for w in range(self.num_workers))
+                np.save(os.path.join(_metric_dir(self.save_path, name),
+                                     f"{name}_accumulate.npy"), total)
+                continue
+
+            shards = [MMapIndexedDataset(
+                _shard_prefix(self.save_path, name, "sample_to_metric", w))
+                for w in range(self.num_workers)]
+            sample_to_metric = np.concatenate(
+                [np.concatenate(list(s)) if len(s) else np.zeros(0, np.int64)
+                 for s in shards]).astype(np.int64)
+            n = len(sample_to_metric)
+
+            vdt = best_fitting_int_dtype(int(sample_to_metric.max(initial=0)))
+            b = MMapIndexedDatasetBuilder(
+                _merged_prefix(self.save_path, name, "sample_to_metric"), dtype=vdt)
+            for v in sample_to_metric:
+                b.add_item([int(v)])
+                b.end_document()
+            b.finalize()
+
+            sdt = best_fitting_int_dtype(max(n - 1, 0))
+            order = np.argsort(sample_to_metric, kind="stable")
+            uniq, starts = np.unique(sample_to_metric[order], return_index=True)
+
+            b = MMapIndexedDatasetBuilder(
+                _merged_prefix(self.save_path, name, "index_to_metric"), dtype=vdt)
+            for v in uniq:
+                b.add_item([int(v)])
+                b.end_document()
+            b.finalize()
+
+            bounds = list(starts) + [n]
+            b = MMapIndexedDatasetBuilder(
+                _merged_prefix(self.save_path, name, "index_to_sample"), dtype=sdt)
+            for i in range(len(uniq)):
+                b.add_item(order[bounds[i]:bounds[i + 1]])
+                b.end_document()
+            b.finalize()
+
+            b = MMapIndexedDatasetBuilder(
+                _merged_prefix(self.save_path, name,
+                               "index_to_sample_percentile_merged"), dtype=sdt)
+            b.add_item(order)
+            b.end_document()
+            b.finalize()
+
+    def run_map_reduce(self) -> None:
+        assert self.num_workers == 1 or self.worker_id == 0, \
+            "run_map_reduce is the single-process entry; multi-worker runs " \
+            "call run_map per worker then run_reduce once"
+        if self.num_workers == 1:
+            self.run_map()
+        else:
+            saved = self.worker_id
+            for w in range(self.num_workers):
+                self.worker_id = w
+                self.run_map()
+            self.worker_id = saved
+        self.run_reduce()
+
+
+def metric_difficulty_fn(save_path: str, metric_name: str) -> Callable[[int], int]:
+    """Adapter: analyzer output → ``difficulty_fn`` for
+    :class:`~deepspeed_tpu.runtime.data_pipeline.data_sampler.DeepSpeedDataSampler`."""
+    ds = MMapIndexedDataset(_merged_prefix(save_path, metric_name, "sample_to_metric"))
+    return lambda idx: int(ds[idx][0])

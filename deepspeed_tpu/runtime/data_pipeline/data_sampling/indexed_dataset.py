@@ -1,0 +1,185 @@
+"""Memory-mapped token datasets (.bin + .idx).
+
+Counterpart of the reference's ``data_pipeline/data_sampling/indexed_dataset.py``
+(``MMapIndexedDataset`` :369, builder :471) and ON-DISK COMPATIBLE with the
+Megatron/DeepSpeed ``MMIDIDX`` format, so corpora tokenized for the reference
+load here unchanged (and vice versa).
+
+TPU-first notes: reading is zero-copy ``np.memmap`` slices on the HOST —
+token streams feed the input pipeline, never live on device. There is no
+torch ``Dataset`` base; ``__getitem__``/``__len__`` duck-typing is all the
+``deepspeed_tpu`` dataloader and the analyzer need.
+
+Index layout (little-endian):
+  9s  magic  b'MMIDIDX\\x00\\x00'
+  Q   version (1)
+  B   dtype code (see DTYPES)
+  Q   number of sequences
+  Q   number of document boundaries
+  int32[n]  per-sequence lengths (in elements)
+  int64[n]  per-sequence byte offsets into the .bin
+  int64[d]  document boundary sequence indices
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+_HDR_MAGIC = b"MMIDIDX\x00\x00"
+
+DTYPES = {
+    1: np.uint8,
+    2: np.int8,
+    3: np.int16,
+    4: np.int32,
+    5: np.int64,
+    6: np.float64,
+    7: np.double,
+    8: np.uint16,
+    9: np.uint32,
+    10: np.uint64,
+}
+
+
+def _dtype_code(dtype) -> int:
+    for k, v in DTYPES.items():
+        if np.dtype(v) == np.dtype(dtype):
+            return k
+    raise ValueError(f"unsupported dtype {dtype!r}")
+
+
+def best_fitting_int_dtype(max_value: int):
+    """Smallest unsigned/signed dtype that can hold token ids / indices up
+    to ``max_value`` (reference ``__best_fitting_dtype`` / utils
+    ``find_fit_int_dtype``)."""
+    for dt in (np.uint8, np.uint16, np.uint32):
+        if max_value < np.iinfo(dt).max:
+            return dt
+    return np.int64
+
+
+def index_file_path(prefix: str) -> str:
+    return prefix + ".idx"
+
+
+def data_file_path(prefix: str) -> str:
+    return prefix + ".bin"
+
+
+class MMapIndexedDatasetBuilder:
+    """Append numpy sequences; ``finalize()`` writes the index."""
+
+    def __init__(self, prefix_or_bin: str, dtype=np.int32):
+        bin_path = (prefix_or_bin if prefix_or_bin.endswith(".bin")
+                    else data_file_path(prefix_or_bin))
+        self._bin_path = bin_path
+        self._dtype = np.dtype(dtype)
+        self._file = open(bin_path, "wb")
+        self._sizes: list = []
+        self._doc_idx: list = [0]
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def add_item(self, seq: Union[np.ndarray, Sequence[int]]) -> None:
+        arr = np.asarray(seq, dtype=self._dtype)
+        self._file.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def end_document(self) -> None:
+        self._doc_idx.append(len(self._sizes))
+
+    def merge_file_(self, other_prefix: str) -> None:
+        """Append another builder's finalized output (reference
+        ``merge_file_`` :293) — used by the analyzer's reduce step."""
+        other = MMapIndexedDataset(other_prefix)
+        assert other.dtype == self._dtype, (other.dtype, self._dtype)
+        offset = len(self._sizes)
+        self._sizes.extend(int(s) for s in other.sizes)
+        self._doc_idx.extend(offset + d for d in other.doc_idx[1:])
+        with open(data_file_path(other_prefix), "rb") as f:
+            while chunk := f.read(1 << 24):
+                self._file.write(chunk)
+
+    def finalize(self, index_path: Optional[str] = None) -> None:
+        self._file.close()
+        if index_path is None:
+            index_path = index_file_path(self._bin_path[:-len(".bin")])
+        sizes = np.asarray(self._sizes, dtype=np.int64)
+        pointers = np.zeros(len(sizes), dtype=np.int64)
+        if len(sizes):
+            np.cumsum(sizes[:-1] * self._dtype.itemsize, out=pointers[1:])
+        with open(index_path, "wb") as f:
+            f.write(_HDR_MAGIC)
+            f.write(struct.pack("<Q", 1))
+            f.write(struct.pack("<B", _dtype_code(self._dtype)))
+            f.write(struct.pack("<Q", len(sizes)))
+            f.write(struct.pack("<Q", len(self._doc_idx)))
+            f.write(sizes.astype(np.int32).tobytes(order="C"))
+            f.write(pointers.tobytes(order="C"))
+            f.write(np.asarray(self._doc_idx, dtype=np.int64).tobytes(order="C"))
+
+
+class MMapIndexedDataset:
+    """Read-only view over a finalized (.bin, .idx) pair."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+        with open(index_file_path(prefix), "rb") as f:
+            magic = f.read(9)
+            if magic != _HDR_MAGIC:
+                raise ValueError(f"{index_file_path(prefix)}: bad magic {magic!r}")
+            (version,) = struct.unpack("<Q", f.read(8))
+            assert version == 1, version
+            (code,) = struct.unpack("<B", f.read(1))
+            self._dtype = np.dtype(DTYPES[code])
+            (n,) = struct.unpack("<Q", f.read(8))
+            (d,) = struct.unpack("<Q", f.read(8))
+            header_end = f.tell()
+        idx = np.memmap(index_file_path(prefix), mode="r")
+        self._sizes = np.frombuffer(idx, np.int32, count=n, offset=header_end)
+        self._pointers = np.frombuffer(idx, np.int64, count=n,
+                                       offset=header_end + self._sizes.nbytes)
+        self._doc_idx = np.frombuffer(
+            idx, np.int64, count=d,
+            offset=header_end + self._sizes.nbytes + self._pointers.nbytes)
+        self._data = np.memmap(data_file_path(prefix), mode="r")
+
+    # -- container protocol --------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        ptr, size = int(self._pointers[idx]), int(self._sizes[idx])
+        return np.frombuffer(self._data, self._dtype, count=size, offset=ptr)
+
+    def get(self, idx: int, offset: int = 0, length: Optional[int] = None) -> np.ndarray:
+        """Partial read of one sequence without touching the rest of it."""
+        size = int(self._sizes[idx])
+        length = size - offset if length is None else length
+        ptr = int(self._pointers[idx]) + offset * self._dtype.itemsize
+        return np.frombuffer(self._data, self._dtype, count=length, offset=ptr)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    @property
+    def doc_idx(self) -> np.ndarray:
+        return self._doc_idx
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @staticmethod
+    def exists(prefix: str) -> bool:
+        return (os.path.exists(index_file_path(prefix))
+                and os.path.exists(data_file_path(prefix)))

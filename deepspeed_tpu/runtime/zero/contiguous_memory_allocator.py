@@ -1,0 +1,124 @@
+"""Contiguous host-memory arena with defragmentation.
+
+Counterpart of the reference's ``runtime/zero/contiguous_memory_allocator.py``
+(``ContiguousMemoryAllocator`` :16): hand out tensors carved from one large
+flat buffer so repeated allocate/release cycles cannot fragment memory, and
+compact live blocks when a request only fits after defragmentation.
+
+On TPU the *device* side needs none of this — XLA owns HBM and donation
+reuses buffers — so this arena serves the HOST paths that do churn buffers:
+optimizer-state swap staging (``swap_tensor/``), AIO read/write bounce
+buffers, and checkpoint shard assembly. Tensors are numpy views into the
+arena, so handing one to ``dstpu_aio`` pins a stable address for the C++
+thread pool.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+class ContiguousMemoryAllocator:
+
+    def __init__(self, size: int, dtype=np.float32):
+        self.size = int(size)
+        self.dtype = np.dtype(dtype)
+        self.buffer = np.zeros(self.size, dtype=self.dtype)
+        # address -> free block size
+        self.free_blocks: Dict[int, int] = {0: self.size}
+        # tensor_id -> (address, size)
+        self.allocated: Dict[int, tuple] = {}
+        self.tensor_map: Dict[int, np.ndarray] = {}
+        self._next_id = 0
+        self.total_free = self.size
+        self.max_in_use = 0
+
+    # -- public API (reference :51,:97,:133) --------------------------------
+    def allocate_tensor(self, size: int) -> np.ndarray:
+        """Return a flat view of ``size`` elements; defragments if no single
+        free block fits but the total free space does."""
+        size = int(size)
+        if size > self.total_free:
+            raise MemoryError(f"arena exhausted: want {size}, free {self.total_free}")
+        if self._largest_free() < size:
+            self._defragment()
+        addr = self._fit(size)
+        self._occupy(addr, size)
+        tid = self._next_id = self._next_id + 1
+        self.allocated[tid] = (addr, size)
+        view = self.buffer[addr:addr + size]
+        view[...] = 0
+        self.tensor_map[tid] = view
+        self.total_free -= size
+        self.max_in_use = max(self.max_in_use, self.size - self.total_free)
+        return view
+
+    def tensor_id(self, tensor: np.ndarray) -> int:
+        for tid, view in self.tensor_map.items():
+            if view.base is tensor.base and view.shape == tensor.shape and \
+                    np.shares_memory(view, tensor):
+                return tid
+        raise KeyError("tensor not from this arena")
+
+    def release_tensor(self, tensor: np.ndarray) -> None:
+        self.release_tensor_with_id(self.tensor_id(tensor))
+
+    def release_tensor_with_id(self, tid: int) -> None:
+        addr, size = self.allocated.pop(tid)
+        del self.tensor_map[tid]
+        self.free_blocks[addr] = size
+        self.total_free += size
+        self._coalesce()
+
+    def max_allocated(self) -> int:
+        return self.max_in_use
+
+    def get_tensor(self, tid: int) -> np.ndarray:
+        """Re-fetch a live view by id — REQUIRED after any allocate that may
+        have defragmented, since defrag re-points views (the reference
+        mutates ``param.data`` for the same reason, :83,:138)."""
+        return self.tensor_map[tid]
+
+    # -- internals ----------------------------------------------------------
+    def _largest_free(self) -> int:
+        return max(self.free_blocks.values(), default=0)
+
+    def _fit(self, size: int) -> int:
+        for addr in sorted(self.free_blocks):
+            if self.free_blocks[addr] >= size:
+                return addr
+        raise MemoryError(f"no contiguous block of {size} after defrag")
+
+    def _occupy(self, addr: int, size: int) -> None:
+        block = self.free_blocks.pop(addr)
+        if block > size:
+            self.free_blocks[addr + size] = block - size
+
+    def _coalesce(self) -> None:
+        merged: Dict[int, int] = {}
+        for addr in sorted(self.free_blocks):
+            size = self.free_blocks[addr]
+            if merged:
+                last = max(merged)
+                if last + merged[last] == addr:
+                    merged[last] += size
+                    continue
+            merged[addr] = size
+        self.free_blocks = merged
+
+    def _defragment(self) -> None:
+        """Slide live blocks left (ascending address) so free space becomes
+        one tail block (reference ``_defragment_memory`` :179). Views stay
+        valid because ids map to addresses, not objects — we re-point them."""
+        cursor = 0
+        for tid in sorted(self.allocated, key=lambda t: self.allocated[t][0]):
+            addr, size = self.allocated[tid]
+            if addr != cursor:
+                # overlapping-safe: moves are always leftward
+                self.buffer[cursor:cursor + size] = self.buffer[addr:addr + size]
+                self.allocated[tid] = (cursor, size)
+                self.tensor_map[tid] = self.buffer[cursor:cursor + size]
+            cursor += size
+        self.free_blocks = {cursor: self.size - cursor} if cursor < self.size else {}

@@ -1,0 +1,130 @@
+"""Indexed dataset + data analyzer tests (reference test model:
+``tests/unit/runtime/test_data_efficiency.py`` and Megatron mmap format
+round-trips)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+from deepspeed_tpu.runtime.data_pipeline.data_sampling import (
+    DataAnalyzer, MMapIndexedDataset, MMapIndexedDatasetBuilder,
+    metric_difficulty_fn)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.indexed_dataset import (
+    best_fitting_int_dtype)
+
+
+def _build(prefix, seqs, dtype=np.int32):
+    b = MMapIndexedDatasetBuilder(str(prefix), dtype=dtype)
+    for s in seqs:
+        b.add_item(s)
+        b.end_document()
+    b.finalize()
+    return MMapIndexedDataset(str(prefix))
+
+
+def test_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    seqs = [rng.integers(0, 1000, size=rng.integers(1, 50)) for _ in range(20)]
+    ds = _build(tmp_path / "corpus", seqs)
+    assert len(ds) == 20
+    for i, s in enumerate(seqs):
+        np.testing.assert_array_equal(ds[i], s)
+    # partial reads
+    np.testing.assert_array_equal(ds.get(3, offset=2, length=4), seqs[3][2:6])
+    assert ds.sizes.tolist() == [len(s) for s in seqs]
+
+
+def test_reference_format_compat(tmp_path):
+    """Byte-level check of the MMIDIDX header so reference-tokenized corpora
+    load unchanged (reference indexed_dataset.py:369 Index layout)."""
+    ds_prefix = tmp_path / "c"
+    _build(ds_prefix, [[1, 2, 3], [4, 5]], dtype=np.uint16)
+    raw = (ds_prefix.parent / "c.idx").read_bytes()
+    assert raw[:9] == b"MMIDIDX\x00\x00"
+    import struct
+    assert struct.unpack("<Q", raw[9:17])[0] == 1          # version
+    assert raw[17] == 8                                     # uint16 code
+    assert struct.unpack("<Q", raw[18:26])[0] == 2          # n sequences
+    bin_raw = (ds_prefix.parent / "c.bin").read_bytes()
+    np.testing.assert_array_equal(
+        np.frombuffer(bin_raw, np.uint16), [1, 2, 3, 4, 5])
+
+
+def test_merge_file(tmp_path):
+    a = _build(tmp_path / "a", [[1, 2], [3]])
+    _build(tmp_path / "b", [[4, 5, 6]])
+    m = MMapIndexedDatasetBuilder(str(tmp_path / "m"), dtype=np.int32)
+    m.merge_file_(str(tmp_path / "a"))
+    m.merge_file_(str(tmp_path / "b"))
+    m.finalize()
+    merged = MMapIndexedDataset(str(tmp_path / "m"))
+    assert [list(x) for x in merged] == [[1, 2], [3], [4, 5, 6]]
+
+
+def test_best_fitting_int_dtype():
+    assert best_fitting_int_dtype(10) == np.uint8
+    assert best_fitting_int_dtype(1000) == np.uint16
+    assert best_fitting_int_dtype(1 << 20) == np.uint32
+    assert best_fitting_int_dtype(1 << 40) == np.int64
+
+
+@pytest.mark.parametrize("num_workers", [1, 3])
+def test_analyzer_seqlen_metric(tmp_path, num_workers):
+    rng = np.random.default_rng(1)
+    seqs = [rng.integers(0, 100, size=rng.integers(1, 30)) for _ in range(17)]
+    ds = _build(tmp_path / "corpus", seqs)
+
+    an = DataAnalyzer(
+        ds, num_workers=num_workers, batch_size=4,
+        metric_names=["seqlen", "total_tokens"],
+        metric_functions=[lambda batch: [len(s) for s in batch],
+                          lambda batch: sum(len(s) for s in batch)],
+        metric_types=["single_value_per_sample", "accumulate_value_over_samples"],
+        save_path=str(tmp_path / "out"))
+    an.run_map_reduce()
+
+    s2m = MMapIndexedDataset(str(tmp_path / "out/seqlen/seqlen_sample_to_metric"))
+    assert [int(s2m[i][0]) for i in range(17)] == [len(s) for s in seqs]
+
+    i2m = MMapIndexedDataset(str(tmp_path / "out/seqlen/seqlen_index_to_metric"))
+    uniq = sorted(set(len(s) for s in seqs))
+    assert [int(i2m[i][0]) for i in range(len(i2m))] == uniq
+
+    i2s = MMapIndexedDataset(str(tmp_path / "out/seqlen/seqlen_index_to_sample"))
+    for vi, v in enumerate(uniq):
+        assert sorted(len(seqs[int(s)]) for s in i2s[vi]) == \
+            [v] * len(i2s[vi])
+
+    pm = MMapIndexedDataset(
+        str(tmp_path / "out/seqlen/seqlen_index_to_sample_percentile_merged"))
+    by_len = [len(seqs[int(i)]) for i in pm[0]]
+    assert by_len == sorted(by_len)
+
+    total = np.load(tmp_path / "out/total_tokens/total_tokens_accumulate.npy")
+    assert int(total) == sum(len(s) for s in seqs)
+
+
+def test_analyzer_feeds_curriculum_sampler(tmp_path):
+    """End to end: analyzer output → difficulty_fn → curriculum-filtered
+    batches (short sequences scheduled first)."""
+    rng = np.random.default_rng(2)
+    seqs = [rng.integers(0, 100, size=ln) for ln in
+            rng.integers(1, 64, size=64)]
+    ds = _build(tmp_path / "corpus", seqs)
+    an = DataAnalyzer(ds, metric_names=["seqlen"],
+                      metric_functions=[lambda b: [len(s) for s in b]],
+                      metric_types=["single_value_per_sample"],
+                      save_path=str(tmp_path / "out"))
+    an.run_map_reduce()
+
+    sched = CurriculumScheduler({
+        "curriculum_type": "fixed_linear", "min_difficulty": 8,
+        "max_difficulty": 64,
+        "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 8}})
+    sampler = DeepSpeedDataSampler(
+        total_samples=64, micro_batch_size=4, data_parallel_size=2,
+        curriculum=sched,
+        difficulty_fn=metric_difficulty_fn(str(tmp_path / "out"), "seqlen"))
+    first_batch = next(iter(sampler))
+    assert all(len(seqs[i]) <= 8 for i in first_batch)

@@ -1,0 +1,105 @@
+"""TiledLinear / checkpointed linear / contiguous allocator tests
+(reference tests/unit/runtime/zero/test_zero_tiled.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.nn.layers import Linear
+from deepspeed_tpu.runtime.zero.contiguous_memory_allocator import (
+    ContiguousMemoryAllocator)
+from deepspeed_tpu.runtime.zero.tiling import TiledLinear, checkpointed_linear
+
+
+@pytest.mark.parametrize("in_splits,out_splits", [(1, 1), (2, 4), (4, 2)])
+def test_tiled_matches_dense(eight_devices, in_splits, out_splits):
+    dense = Linear(32, 48, use_bias=True)
+    dp = dense.init(jax.random.PRNGKey(0))
+    tiled = TiledLinear(32, 48, in_splits=in_splits, out_splits=out_splits)
+    tp = tiled.from_linear(dp)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 5, 32))
+    np.testing.assert_allclose(np.asarray(tiled(tp, x)),
+                               np.asarray(dense(dp, x)), rtol=1e-5, atol=1e-5)
+    # round trip back to dense
+    back = tiled.to_linear(tp)
+    np.testing.assert_array_equal(np.asarray(back["kernel"]),
+                                  np.asarray(dp["kernel"]))
+    np.testing.assert_array_equal(np.asarray(back["bias"]),
+                                  np.asarray(dp["bias"]))
+
+
+def test_tiled_gradients_match(eight_devices):
+    dense = Linear(16, 24, use_bias=True)
+    dp = dense.init(jax.random.PRNGKey(0))
+    tiled = TiledLinear(16, 24, in_splits=2, out_splits=3)
+    tp = tiled.from_linear(dp)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 16))
+
+    gd = jax.grad(lambda p: jnp.sum(dense(p, x) ** 2))(dp)
+    gt = jax.grad(lambda p: jnp.sum(tiled(p, x) ** 2))(tp)
+    np.testing.assert_allclose(np.asarray(tiled.to_linear(gt)["kernel"]),
+                               np.asarray(gd["kernel"]), rtol=1e-4, atol=1e-5)
+
+
+def test_tiled_uneven_split_rejected():
+    with pytest.raises(AssertionError):
+        TiledLinear(30, 48, in_splits=4)
+
+
+def test_checkpointed_linear_grad(eight_devices):
+    dense = Linear(8, 8)
+    p = dense.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 8))
+    g1 = jax.grad(lambda p: jnp.sum(checkpointed_linear(p, x)))(p)
+    g2 = jax.grad(lambda p: jnp.sum(dense(p, x)))(p)
+    np.testing.assert_allclose(np.asarray(g1["kernel"]),
+                               np.asarray(g2["kernel"]), rtol=1e-6)
+
+
+class TestContiguousMemoryAllocator:
+
+    def test_allocate_release_reuse(self):
+        a = ContiguousMemoryAllocator(100)
+        t1 = a.allocate_tensor(40)
+        t2 = a.allocate_tensor(40)
+        t1[...] = 1.0
+        t2[...] = 2.0
+        a.release_tensor(t1)
+        t3 = a.allocate_tensor(30)  # fits in t1's freed block
+        assert a.total_free == 30
+        np.testing.assert_array_equal(t2, 2.0)
+        assert t3.size == 30
+
+    def test_defragment_preserves_contents(self):
+        a = ContiguousMemoryAllocator(100)
+        ids = []
+        tensors = []
+        for i in range(4):
+            t = a.allocate_tensor(25)
+            t[...] = float(i)
+            tensors.append(t)
+            ids.append(a.tensor_id(t))
+        # free blocks 0 and 2 -> two 25-elem holes, largest contiguous = 25
+        a.release_tensor(tensors[0])
+        a.release_tensor(tensors[2])
+        # 50 total free but fragmented: must defragment to satisfy
+        t = a.allocate_tensor(50)
+        assert t.size == 50
+        # surviving tensors kept their values at their NEW addresses
+        np.testing.assert_array_equal(a.get_tensor(ids[1]), 1.0)
+        np.testing.assert_array_equal(a.get_tensor(ids[3]), 3.0)
+
+    def test_exhaustion_raises(self):
+        a = ContiguousMemoryAllocator(10)
+        a.allocate_tensor(8)
+        with pytest.raises(MemoryError):
+            a.allocate_tensor(4)
+
+    def test_max_allocated(self):
+        a = ContiguousMemoryAllocator(100)
+        t1 = a.allocate_tensor(60)
+        a.release_tensor(t1)
+        a.allocate_tensor(20)
+        assert a.max_allocated() == 60

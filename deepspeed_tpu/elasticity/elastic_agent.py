@@ -1,0 +1,148 @@
+"""Elastic training agent: supervise, restart, reshape.
+
+Counterpart of the reference's ``elasticity/elastic_agent.py``
+(``DSElasticAgent`` :28, extending torch-elastic's ``LocalElasticAgent``):
+keep a training job alive across worker failures by restarting the world —
+possibly at a DIFFERENT size — while keeping the global batch schedule valid
+via the elasticity solver (``elasticity.py`` ``compute_elastic_config``).
+
+TPU-first shape: there is no c10d rendezvous store to re-seed — a JAX world
+is (coordinator address, num_processes, process_id) env vars, so a restart
+is simply re-spawning per-slot processes with a fresh
+``JAX_COORDINATOR_ADDRESS`` port and the re-solved world size exported as
+``DSTPU_ELASTIC`` (json: world_size / train_batch / micro_batch / gas).
+Workers read it before ``deepspeed_tpu.initialize`` to configure batches.
+
+Failure policy: on any worker failure the remaining world is torn down
+(collectives cannot survive a lost peer) and relaunched; with
+``shrink_on_failure`` each retry drops one slot, re-solving the batch
+config, until ``min_gpus`` — the reference's membership-change path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+from .elasticity import compute_elastic_config
+
+
+class DSElasticAgent:
+
+    def __init__(self,
+                 user_script: str,
+                 user_args: Optional[List[str]] = None,
+                 ds_config: Optional[Dict[str, Any]] = None,
+                 num_slots: int = 1,
+                 max_restarts: int = 3,
+                 shrink_on_failure: bool = True,
+                 master_addr: str = "localhost",
+                 master_port: int = 29555,
+                 extra_env: Optional[Dict[str, str]] = None,
+                 spawn_fn: Optional[Callable] = None):
+        self.user_script = user_script
+        self.user_args = list(user_args or [])
+        self.ds_config = ds_config or {}
+        self.num_slots = num_slots
+        self.max_restarts = max_restarts
+        self.shrink_on_failure = shrink_on_failure
+        self.master_addr = master_addr
+        self.master_port = master_port
+        self.extra_env = dict(extra_env or {})
+        self.restart_count = 0
+        self.world_history: List[int] = []
+        self._spawn = spawn_fn or self._default_spawn
+
+    # -- world solving ------------------------------------------------------
+    def _solve_world(self, slots: int) -> Dict[str, Any]:
+        """Largest elasticity-valid world size <= slots plus its batch
+        config; without an elastic config every size is valid."""
+        el = self.ds_config.get("elasticity")
+        if not el or not el.get("enabled", False):
+            mb = self.ds_config.get("train_micro_batch_size_per_gpu", 1)
+            return {"world_size": slots, "micro_batch": mb,
+                    "train_batch": mb * slots, "gas": 1}
+        final_batch, valid_gpus = compute_elastic_config(self.ds_config)
+        fit = [g for g in valid_gpus if g <= slots]
+        if not fit:
+            raise RuntimeError(
+                f"no elasticity-valid world size fits {slots} slots "
+                f"(valid: {valid_gpus})")
+        world = max(fit)
+        per_gpu = final_batch // world
+        micro = max(m for m in el.get("micro_batch_sizes", [2, 4, 6])
+                    if per_gpu % m == 0)
+        return {"world_size": world, "micro_batch": micro,
+                "train_batch": final_batch, "gas": per_gpu // micro}
+
+    # -- spawning -----------------------------------------------------------
+    def _default_spawn(self, world: Dict[str, Any], attempt: int) -> List[subprocess.Popen]:
+        procs = []
+        n = world["world_size"]
+        port = self.master_port + attempt  # stale coordinator never rejoins
+        for rank in range(n):
+            env = dict(os.environ)
+            env.update(self.extra_env)
+            env.update({
+                "JAX_COORDINATOR_ADDRESS": f"{self.master_addr}:{port}",
+                "JAX_NUM_PROCESSES": str(n),
+                "JAX_PROCESS_ID": str(rank),
+                "DSTPU_ELASTIC": json.dumps({**world, "restart_count": attempt}),
+            })
+            cmd = [sys.executable, self.user_script] + self.user_args
+            procs.append(subprocess.Popen(cmd, env=env))
+        return procs
+
+    @staticmethod
+    def _reap(procs: List[subprocess.Popen], poll_s: float = 0.1) -> int:
+        """First nonzero exit code (terminating peers), else 0."""
+        rc = 0
+        live = list(procs)
+        while live:
+            for p in list(live):
+                code = p.poll()
+                if code is None:
+                    continue
+                live.remove(p)
+                if code and not rc:
+                    rc = code
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+            if live:
+                time.sleep(poll_s)
+        return rc
+
+    # -- main loop ----------------------------------------------------------
+    def run(self) -> int:
+        """Supervise until clean exit or restart budget exhausted
+        (reference ``DSElasticAgent._invoke_run`` :106)."""
+        slots = self.num_slots
+        attempt = 0
+        while True:
+            world = self._solve_world(slots)
+            self.world_history.append(world["world_size"])
+            logger.info(
+                f"elastic agent: attempt {attempt}, world {world['world_size']} "
+                f"(batch {world['train_batch']} = {world['micro_batch']} "
+                f"x {world['world_size']} x gas {world['gas']})")
+            procs = self._spawn(world, attempt)
+            rc = self._reap(procs)
+            if rc == 0:
+                return 0
+            self.restart_count += 1
+            attempt += 1
+            if self.restart_count > self.max_restarts:
+                logger.error(f"elastic agent: restart budget exhausted (rc={rc})")
+                return rc
+            if self.shrink_on_failure and slots > 1:
+                slots -= 1
+            logger.warning(
+                f"elastic agent: worker failed (rc={rc}); restarting with "
+                f"{slots} slots ({self.restart_count}/{self.max_restarts})")

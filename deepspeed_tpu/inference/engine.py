@@ -36,6 +36,18 @@ class InferenceConfig:
         self.dtype = cfg.get("dtype", jnp.bfloat16)
         self.max_out_tokens = cfg.get("max_out_tokens", 256)
         self.replace_with_kernel_inject = cfg.get("replace_with_kernel_inject", False)
+        # weight-only quantization (reference config ``quant`` field):
+        # either quantization_mode='int8'/'int4' or
+        # quant={'enabled': True, 'bits': 4, 'group_size': 128}
+        from .quantization import QuantizationConfig
+        quant = cfg.get("quant", {})
+        if quant.get("enabled", False):
+            self.quantization = QuantizationConfig(
+                bits=quant.get("bits", quant.get("num_bits", 8)),
+                group_size=quant.get("group_size", 128))
+        else:
+            self.quantization = QuantizationConfig.from_mode(
+                cfg.get("quantization_mode"))
 
 
 class InferenceEngine:
@@ -61,8 +73,14 @@ class InferenceEngine:
                 self.params = jax.jit(
                     lambda rng: model.init(rng, self.dtype),
                     out_shardings=shardings)(jax.random.PRNGKey(seed))
+            if self._config.quantization is not None:
+                from .quantization import quantize_placed
+                self.params = quantize_placed(self.mesh, specs, self.params,
+                                              self._config.quantization)
         log_dist(f"InferenceEngine ready: tp={self.topology.model_parallel_size}, "
-                 f"dtype={self.dtype}", ranks=[0])
+                 f"dtype={self.dtype}"
+                 + (f", weight-quant int{self._config.quantization.bits}"
+                    if self._config.quantization else ""), ranks=[0])
         self._jit_forward = None
         self._jit_generate = {}
 

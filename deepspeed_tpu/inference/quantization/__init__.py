@@ -1,0 +1,3 @@
+from .quantization import (QuantizationConfig, dequantize_param_tree,  # noqa: F401
+                           quantize_param_tree, quantize_placed,
+                           quantized_matmul, quantized_tree_bytes)

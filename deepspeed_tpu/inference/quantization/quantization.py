@@ -1,0 +1,204 @@
+"""Weight-only quantized inference (int8 / int4).
+
+Counterpart of the reference's weight-only quantization for serving:
+``deepspeed/inference/quantization/quantization.py`` (``_init_group_wise_weight_quantization``)
+and the v2 ``quantization_mode`` plumbing (``inference/v2/config_v2.py:33``) —
+weights live in HBM at 8 or 4 bits and are expanded on the fly inside the
+matmul, halving/quartering the weight bandwidth that bounds decode.
+
+TPU-first form: SYMMETRIC groupwise quantization over the contraction dim,
+stored as ``jnp.int8``/``jnp.int4`` (int4 is a native TPU dtype — XLA
+converts it to bf16 in registers, no unpack kernel needed). The matmul
+factors the scale OUT of the contraction per group:
+
+    y = sum_g (x_g @ q_g) * scale[g]         # q int, x/scale bf16
+
+so the MXU consumes the int weights directly and no dequantized copy of the
+kernel ever materializes in HBM — the property the reference's fused
+dequant+GEMM CUDA kernels exist to provide.
+
+A quantized kernel leaf is the subtree ``{"q": int[G, in/G, out],
+"scale": f32[G, 1, out]}`` in place of ``{"kernel": [in, out]}``;
+``nn.Linear`` dispatches on the presence of ``"q"``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# block-tree kernel names eligible for WOQ (projections; embeddings, norms
+# and MoE expert banks are excluded — the reference likewise quantizes the
+# injected linear modules only)
+DEFAULT_TARGETS = ("q_proj", "k_proj", "v_proj", "o_proj", "fc_in", "fc_out",
+                   "gate_proj", "up_proj", "down_proj", "lm_head")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizationConfig:
+    """Reference ``quantization_config`` (inference config ``quant`` field /
+    v2 ``quantization_mode``): 'int8' | 'int4', groupwise over in-features."""
+    bits: int = 8               # 8 | 4
+    group_size: int = 128       # contraction elements sharing one scale
+    targets: Sequence[str] = DEFAULT_TARGETS
+
+    def __post_init__(self):
+        if self.bits not in (4, 8):
+            raise ValueError(f"weight-only quantization supports 4 or 8 bits, "
+                             f"got {self.bits}")
+        if self.group_size < 1:
+            raise ValueError(f"group_size must be >= 1, got {self.group_size}")
+
+    @staticmethod
+    def from_mode(mode: Optional[str]) -> Optional["QuantizationConfig"]:
+        if mode in (None, "none", False):
+            return None
+        if isinstance(mode, QuantizationConfig):
+            return mode
+        table = {"int8": 8, "wint8": 8, "int4": 4, "wint4": 4}
+        if mode not in table:
+            raise ValueError(f"unknown quantization_mode {mode!r} "
+                             f"(supported: {sorted(table)})")
+        return QuantizationConfig(bits=table[mode])
+
+
+def _qdtype(bits: int):
+    return {8: jnp.int8, 4: jnp.int4}[bits]
+
+
+def quantize_kernel(kernel: jax.Array, cfg: QuantizationConfig) -> Dict[str, jax.Array]:
+    """[..., in, out] -> {"q": int[..., G, gs, out], "scale": f32[..., G, 1, out]}.
+
+    Leading dims (the scanned layer axis) pass through untouched.
+    """
+    *lead, d_in, d_out = kernel.shape
+    gs = min(cfg.group_size, d_in)
+    while d_in % gs:  # shrink to a divisor (static shapes need exact tiling)
+        gs //= 2
+    G = d_in // gs
+    w = jnp.asarray(kernel, jnp.float32).reshape(*lead, G, gs, d_out)
+    qmax = float(2 ** (cfg.bits - 1) - 1)
+    absmax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)  # [..., G, 1, out]
+    scale = jnp.maximum(absmax, 1e-12) / qmax
+    q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax)
+    return {"q": q.astype(_qdtype(cfg.bits)), "scale": scale}
+
+
+def quantized_matmul(x: jax.Array, qp: Dict[str, jax.Array]) -> jax.Array:
+    """x [..., in] @ quantized kernel -> [..., out], scales factored out of
+    each group's contraction so the int weights feed the MXU directly."""
+    q, scale = qp["q"], qp["scale"]
+    G, gs, d_out = q.shape[-3:]
+    xg = x.reshape(*x.shape[:-1], G, gs)
+    # [..., G, out] partial products, scaled per group then summed
+    y = jnp.einsum("...gi,gio->...go", xg, q.astype(x.dtype),
+                   preferred_element_type=jnp.float32)
+    y = y * scale.reshape(G, d_out).astype(jnp.float32)
+    return jnp.sum(y, axis=-2).astype(x.dtype)
+
+
+def dequantize_kernel(qp: Dict[str, jax.Array], dtype=jnp.float32) -> jax.Array:
+    q, scale = qp["q"], qp["scale"]
+    *lead, G, gs, d_out = q.shape
+    w = q.astype(jnp.float32) * scale
+    return w.reshape(*lead, G * gs, d_out).astype(dtype)
+
+
+def quantize_param_tree(params: Dict[str, Any], cfg: QuantizationConfig) -> Dict[str, Any]:
+    """Replace each targeted ``{"kernel": ...}`` leaf with its quantized
+    subtree; biases/norms/embeddings stay in the compute dtype."""
+
+    def walk(tree, inside_target):
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                if k == "kernel" and inside_target:
+                    qp = quantize_kernel(v, cfg)
+                    out["q"] = qp["q"]
+                    out["scale"] = qp["scale"]
+                else:
+                    out[k] = walk(v, inside_target or k in cfg.targets)
+            return out
+        return tree
+
+    return walk(params, False)
+
+
+def dequantize_param_tree(params: Dict[str, Any], dtype=jnp.float32) -> Dict[str, Any]:
+    def walk(tree):
+        if isinstance(tree, dict):
+            if "q" in tree and "scale" in tree:
+                rest = {k: walk(v) for k, v in tree.items()
+                        if k not in ("q", "scale")}
+                return {"kernel": dequantize_kernel(
+                    {"q": tree["q"], "scale": tree["scale"]}, dtype), **rest}
+            return {k: walk(v) for k, v in tree.items()}
+        return tree
+
+    return walk(params)
+
+
+def quantize_specs(specs: Dict[str, Any], params_q: Dict[str, Any],
+                   mesh=None) -> Dict[str, Any]:
+    """Derive PartitionSpecs for a quantized tree from the dense specs:
+    kernel P(*lead, a, b) -> q P(*lead, None, a, b), scale P(*lead, None, None, b).
+
+    The contraction dim [in] becomes [G, gs]; a contraction sharding ``a``
+    lands on the WITHIN-GROUP axis gs (each device holds whole groups'
+    slices and computes partial group sums — group boundaries never
+    straddle shards, which they would on the G axis whenever G is not a
+    multiple of the axis size). If gs itself is not divisible by the axis
+    size, the leaf is replicated instead."""
+    from jax.sharding import PartitionSpec as P
+
+    def axis_size(name) -> int:
+        if mesh is None or name is None:
+            return 1
+        names = (name,) if isinstance(name, str) else tuple(name)
+        size = 1
+        for n in names:
+            size *= mesh.shape[n]
+        return size
+
+    def walk(spec_tree, q_tree):
+        if isinstance(q_tree, dict) and "q" in q_tree and "scale" in q_tree:
+            k = spec_tree["kernel"]
+            *lead, a, b = tuple(k)
+            gs = q_tree["q"].shape[-2]
+            if a is not None and gs % max(axis_size(a), 1):
+                a = None  # can't split within-group cleanly: replicate
+            out = {"q": P(*lead, None, a, b), "scale": P(*lead, None, None, b)}
+            for key, v in spec_tree.items():
+                if key != "kernel":
+                    out[key] = v
+            return out
+        if isinstance(q_tree, dict):
+            return {key: walk(spec_tree[key], q_tree[key]) for key in q_tree}
+        return spec_tree
+
+    return walk(specs, params_q)
+
+
+def quantize_placed(mesh, specs: Dict[str, Any], params: Dict[str, Any],
+                    cfg: QuantizationConfig) -> Dict[str, Any]:
+    """Quantize an already-placed param tree ON DEVICE, with output
+    shardings derived from the dense specs — the dense tree is freed after
+    the jit, so peak HBM is dense + quantized once, then quantized only."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    q_struct = jax.eval_shape(lambda p: quantize_param_tree(p, cfg), params)
+    qspecs = quantize_specs(specs, q_struct, mesh)
+    qshard = jax.tree.map(lambda s: NamedSharding(mesh, s), qspecs,
+                          is_leaf=lambda s: isinstance(s, P))
+    return jax.jit(lambda p: quantize_param_tree(p, cfg),
+                   out_shardings=qshard, donate_argnums=0)(params)
+
+
+def quantized_tree_bytes(params: Dict[str, Any]) -> int:
+    return sum(x.size * jnp.dtype(x.dtype).itemsize if x.dtype != jnp.int4
+               else (x.size + 1) // 2
+               for x in jax.tree.leaves(params))

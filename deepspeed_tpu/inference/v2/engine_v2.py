@@ -64,12 +64,25 @@ class InferenceEngineV2:
             c.num_layers, c.kv_heads, c.head_dim, num_blocks, block_size,
             dtype=self.config.kv_cache_dtype)
         self.state_manager = DSStateManager(sm, self.kv_cache)
-        self._model = RaggedInferenceModel(model, block_size, self.max_blocks_per_seq)
+        # module selection (reference modules/heuristics.py instantiate_*):
+        # resolved once here; the chosen names are logged below so kernel
+        # fallbacks are visible, never silent
+        from .modules import instantiate_attention, instantiate_linear
+        self._impls = instantiate_attention(self.config, c)
+        self._impls["linear"] = instantiate_linear(self.config, c)
+        self._model = RaggedInferenceModel(
+            model, block_size, self.max_blocks_per_seq,
+            use_pallas=self._impls["decode"].name == "pallas_paged")
         self.model = model
 
         specs = model.specs()
         shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
                                  is_leaf=lambda s: isinstance(s, P))
+        from ..quantization import QuantizationConfig, quantize_placed
+        # the LINEAR slot of the module registry decides dense vs WOQ; the
+        # chosen implementation's mode then drives the param transform
+        self._qcfg = (QuantizationConfig.from_mode(self.config.quantization_mode)
+                      if self._impls["linear"].name != "dense" else None)
         with self.mesh:
             if params is not None:
                 self.params = jax.jit(
@@ -78,6 +91,9 @@ class InferenceEngineV2:
             else:
                 self.params = jax.jit(lambda rng: model.init(rng, c.dtype),
                                       out_shardings=shardings)(jax.random.PRNGKey(seed))
+            if self._qcfg is not None:
+                self.params = quantize_placed(self.mesh, specs, self.params,
+                                               self._qcfg)
             kv_spec = NamedSharding(self.mesh, P(None, MODEL_AXIS))
             self.kv_cache.update(
                 jax.device_put(self.kv_cache.k_pages, kv_spec),
@@ -86,7 +102,9 @@ class InferenceEngineV2:
         log_dist(
             f"InferenceEngineV2: {num_blocks} KV blocks × {block_size} tokens "
             f"({self.kv_cache.mem_bytes() / 2**20:.0f} MiB), "
-            f"tp={self.topology.model_parallel_size}", ranks=[0])
+            f"tp={self.topology.model_parallel_size}, "
+            f"attn={self._impls['decode'].name}/{self._impls['prefill'].name}, "
+            f"linear={self._impls['linear'].name}", ranks=[0])
 
     def update_params(self, params: Any) -> None:
         """Rebind weights (hybrid-engine train->generate flip): cast into the
@@ -99,6 +117,9 @@ class InferenceEngineV2:
             self.params = jax.jit(
                 lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
                 out_shardings=shardings)(params)
+            if self._qcfg is not None:
+                self.params = quantize_placed(self.mesh, specs, self.params,
+                                               self._qcfg)
 
     # ------------------------------------------------------------------
     # compiled-program cache (jax.jit retraces per (S, T, mp) bucket)

@@ -1,0 +1,4 @@
+from .registry import (DSModuleRegistry, ModuleImplementation,  # noqa: F401
+                       ATTENTION_DECODE_REGISTRY, ATTENTION_PREFILL_REGISTRY,
+                       LINEAR_REGISTRY)
+from .heuristics import instantiate_attention, instantiate_linear  # noqa: F401

@@ -1,0 +1,41 @@
+"""Implementation selection heuristics.
+
+Counterpart of the reference's ``inference/v2/modules/heuristics.py``
+(``instantiate_attention`` etc. — map an engine config + model config to a
+concrete module implementation). Selection happens ONCE at engine build;
+the chosen names are also what the engine logs, replacing the silent
+fallback the round-1 review flagged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .registry import (ATTENTION_DECODE_REGISTRY, ATTENTION_PREFILL_REGISTRY,
+                       LINEAR_REGISTRY, ModuleImplementation)
+
+
+def _context(engine_config, model_config,
+             backend: Optional[str] = None) -> Dict[str, Any]:
+    import jax
+    return {
+        "backend": backend or jax.default_backend(),
+        "quantization_mode": getattr(engine_config, "quantization_mode", None),
+        "head_dim": getattr(model_config, "head_dim", None),
+        "kv_heads": getattr(model_config, "kv_heads", None),
+    }
+
+
+def instantiate_attention(engine_config, model_config,
+                          backend: Optional[str] = None) -> Dict[str, ModuleImplementation]:
+    """Pick (decode, prefill) attention implementations."""
+    ctx = _context(engine_config, model_config, backend)
+    return {
+        "decode": ATTENTION_DECODE_REGISTRY.choose(ctx),
+        "prefill": ATTENTION_PREFILL_REGISTRY.choose(ctx),
+    }
+
+
+def instantiate_linear(engine_config, model_config,
+                       backend: Optional[str] = None) -> ModuleImplementation:
+    return LINEAR_REGISTRY.choose(_context(engine_config, model_config, backend))

@@ -62,7 +62,13 @@ class Linear:
         return out
 
     def __call__(self, params: Params, x: jax.Array) -> jax.Array:
-        y = x @ params["kernel"].astype(x.dtype)
+        if "q" in params:
+            # weight-only-quantized kernel (inference/quantization): int
+            # weights feed the matmul directly, scales factored per group
+            from ..inference.quantization.quantization import quantized_matmul
+            y = quantized_matmul(x, params)
+        else:
+            y = x @ params["kernel"].astype(x.dtype)
         if self.use_bias:
             y = y + params["bias"].astype(x.dtype)
         return y

@@ -203,6 +203,24 @@ class DeepSpeedEngine:
                 theta=pld_cfg.get("theta", 0.5), gamma=pld_cfg.get("gamma", 0.001))
             self._pld_rng = np.random.default_rng(seed)
 
+        # MoQ: engine-scheduled quantization-aware training (reference
+        # engine.py quantizer + runtime/quantize.py:14)
+        self.quantizer = None
+        self.eigenvalue = None
+        qt_cfg = getattr(config, "_param_dict", {}).get("quantize_training", {})
+        if qt_cfg.get("enabled"):
+            from .quantize import MoQQuantizer
+            self.quantizer = MoQQuantizer(qt_cfg)
+            if self.quantizer.eigenvalue_enabled:
+                from .eigenvalue import Eigenvalue
+                eig = qt_cfg.get("eigenvalue", {})
+                self.eigenvalue = Eigenvalue(
+                    verbose=eig.get("verbose", False),
+                    max_iter=eig.get("max_iter", 10),
+                    tol=eig.get("tol", 1e-2),
+                    stability=eig.get("stability", 1e-6))
+        self._last_batch = None
+
         from .. import comm as dist
         if config.comms_logger_enabled:
             dist.configure(config=config)
@@ -863,6 +881,8 @@ class DeepSpeedEngine:
             batch["layer_mask"] = self.progressive_layer_drop.layer_mask(
                 self._pld_rng, self.model.config.num_layers)
         batch = self._device_batch(batch)
+        if self.quantizer is not None and self.quantizer.eigenvalue_enabled:
+            self._last_batch = batch  # MoQ eigenvalue pass reuses it
         with self.mesh:
             if self._zeropp:
                 gacc, loss = self._jit_micro_step(
@@ -899,9 +919,29 @@ class DeepSpeedEngine:
         else:
             with self.mesh:
                 self.state, overflow, gnorm = self._jit_apply_step(self.state, lr)
+        self.global_steps += 1
+        if self.quantizer is not None:
+            # MUST run before _refresh_secondary: quantize() donates the
+            # param buffers, and at hpz==1 the ZeRO++ secondary ALIASES
+            # them — refreshing afterwards re-points it at the quantized
+            # arrays (and makes the forward actually see the QAT weights)
+            eigenvalues = None
+            if (self.eigenvalue is not None and self._last_batch is not None
+                    and "blocks" in self.state["params"]
+                    and self.global_steps %
+                    self.quantizer.gas_boundary_resolution == 0):
+                L = int(jax.tree.leaves(
+                    self.state["params"]["blocks"])[0].shape[0])
+                with self.mesh:
+                    eigenvalues = self.eigenvalue.compute_layer_eigenvalues(
+                        self.model.loss, self.state["params"],
+                        self._last_batch,
+                        jax.random.PRNGKey(self.global_steps), L)
+            with self.mesh:
+                self.state["params"] = self.quantizer.quantize(
+                    self.state["params"], bool(overflow), eigenvalues)
         if self._zeropp:
             self._refresh_secondary()
-        self.global_steps += 1
         if self.config.fp16.enabled and bool(overflow):
             # skipped update does not consume schedule (reference engine.py:2053)
             self.skipped_steps += 1
@@ -1118,6 +1158,8 @@ class DeepSpeedEngine:
             "micro_steps": self.micro_steps,
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
+        if self.quantizer is not None:
+            client_state["moq_quantizer"] = self.quantizer.state_dict()
         _save(save_dir, tag, self.state, client_state, save_latest=save_latest)
         if self._offload is not None:
             # Name-keyed flat layout: master/state are this host's local
@@ -1219,4 +1261,6 @@ class DeepSpeedEngine:
         self.micro_steps = client_state.get("micro_steps", 0)
         if "lr_scheduler" in client_state:
             self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        if self.quantizer is not None and "moq_quantizer" in client_state:
+            self.quantizer.load_state_dict(client_state["moq_quantizer"])
         return tag, client_state

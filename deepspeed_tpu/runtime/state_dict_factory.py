@@ -125,12 +125,21 @@ class SDLoaderFactory:
 # ---------------------------------------------------------------------------
 
 def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerConfig:
+    """HF ``config.json`` dict → :class:`TransformerConfig`, dispatched
+    through the architecture registry (``models/registry.py``)."""
     import jax.numpy as jnp
 
+    from ..models.registry import get_architecture
+
     dtype = dtype if dtype is not None else jnp.bfloat16
-    mt = hf.get("model_type", "gpt2")
-    if mt == "gpt2":
-        cfg = dict(
+    cfg = get_architecture(hf.get("model_type", "gpt2")).config_fn(hf)
+    cfg["dtype"] = dtype
+    cfg.update(overrides)
+    return TransformerConfig(**cfg)
+
+
+def _gpt2_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    return dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("n_positions", 1024),
             num_layers=hf.get("n_layer", 12),
@@ -140,8 +149,10 @@ def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> Tra
             activation="gelu", norm="layernorm", position="learned",
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=True)
-    elif mt in ("llama", "mistral", "mixtral"):
-        cfg = dict(
+
+
+def _llama_family_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    cfg = dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 4096),
             num_layers=hf["num_hidden_layers"],
@@ -153,18 +164,21 @@ def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> Tra
             rope_theta=hf.get("rope_theta", 10000.0),
             norm_eps=hf.get("rms_norm_eps", 1e-6),
             tie_embeddings=hf.get("tie_word_embeddings", False))
-        if mt == "mixtral":
-            cfg["moe"] = MoEConfig(
-                num_experts=hf.get("num_local_experts", 8),
-                top_k=hf.get("num_experts_per_tok", 2))
-    elif mt == "opt":
-        if not hf.get("do_layer_norm_before", True):
-            raise ValueError("post-LN OPT variants (opt-350m) are unsupported")
-        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
-            raise ValueError("OPT with word_embed_proj_dim != hidden_size "
-                             "(project_in/out) is unsupported")
-        act = hf.get("activation_function", "relu")
-        cfg = dict(
+    if hf.get("model_type") == "mixtral":
+        cfg["moe"] = MoEConfig(
+            num_experts=hf.get("num_local_experts", 8),
+            top_k=hf.get("num_experts_per_tok", 2))
+    return cfg
+
+
+def _opt_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    if not hf.get("do_layer_norm_before", True):
+        raise ValueError("post-LN OPT variants (opt-350m) are unsupported")
+    if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+        raise ValueError("OPT with word_embed_proj_dim != hidden_size "
+                         "(project_in/out) is unsupported")
+    act = hf.get("activation_function", "relu")
+    return dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
             num_layers=hf["num_hidden_layers"],
@@ -178,11 +192,13 @@ def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> Tra
             # HF OPTLearnedPositionalEmbedding offsets every position by 2
             position_offset=2,
             tie_embeddings=hf.get("tie_word_embeddings", True))
-    elif mt == "phi":
-        if hf.get("qk_layernorm", False):
-            raise ValueError("Phi variants with qk_layernorm are unsupported")
-        head_dim = hf["hidden_size"] // hf["num_attention_heads"]
-        cfg = dict(
+
+
+def _phi_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    if hf.get("qk_layernorm", False):
+        raise ValueError("Phi variants with qk_layernorm are unsupported")
+    head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+    return dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
             num_layers=hf["num_hidden_layers"],
@@ -196,18 +212,20 @@ def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> Tra
             parallel_block=True, lm_head_bias=True,
             norm_eps=hf.get("layer_norm_eps", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", False))
-    elif mt == "falcon":
-        if not hf.get("parallel_attn", True) or hf.get("alibi", False):
-            raise ValueError("sequential/alibi Falcon variants unsupported")
-        new_decoder = hf.get("new_decoder_architecture", False)
-        if new_decoder:
-            kv = hf.get("num_kv_heads") or hf["num_attention_heads"]
-        else:
-            kv = 1 if hf.get("multi_query", True) else hf["num_attention_heads"]
-        # falcon2-11B: new decoder but ONE norm feeding both branches
-        # (HF gates ln_attn/ln_mlp on num_ln_in_parallel_attn == 2)
-        num_ln = hf.get("num_ln_in_parallel_attn") or 2
-        cfg = dict(
+
+
+def _falcon_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    if not hf.get("parallel_attn", True) or hf.get("alibi", False):
+        raise ValueError("sequential/alibi Falcon variants unsupported")
+    new_decoder = hf.get("new_decoder_architecture", False)
+    if new_decoder:
+        kv = hf.get("num_kv_heads") or hf["num_attention_heads"]
+    else:
+        kv = 1 if hf.get("multi_query", True) else hf["num_attention_heads"]
+    # falcon2-11B: new decoder but ONE norm feeding both branches
+    # (HF gates ln_attn/ln_mlp on num_ln_in_parallel_attn == 2)
+    num_ln = hf.get("num_ln_in_parallel_attn") or 2
+    return dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
             num_layers=hf["num_hidden_layers"],
@@ -222,13 +240,6 @@ def hf_to_transformer_config(hf: Dict[str, Any], dtype=None, **overrides) -> Tra
             linear_bias=bool(hf.get("bias", False)),
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", True))
-    else:
-        raise ValueError(f"unsupported model_type {mt!r} "
-                         "(supported: gpt2, llama, mistral, mixtral, opt, "
-                         "phi, falcon)")
-    cfg["dtype"] = dtype
-    cfg.update(overrides)
-    return TransformerConfig(**cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -470,17 +481,22 @@ def _falcon_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[st
 
 def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
                             sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
-    if model_type == "gpt2":
-        return _gpt2_params(cfg, sd)
-    if model_type in ("llama", "mistral", "mixtral"):
-        return _llama_params(cfg, sd)
-    if model_type == "opt":
-        return _opt_params(cfg, sd)
-    if model_type == "phi":
-        return _phi_params(cfg, sd)
-    if model_type == "falcon":
-        return _falcon_params(cfg, sd)
-    raise ValueError(f"unsupported model_type {model_type!r}")
+    from ..models.registry import get_architecture
+    return get_architecture(model_type).params_fn(cfg, sd)
+
+
+# built-in architecture registrations (models/registry.py dispatches here)
+def _register_builtins() -> None:
+    from ..models.registry import register_architecture
+    register_architecture("gpt2", _gpt2_config, _gpt2_params)
+    for mt in ("llama", "mistral", "mixtral"):
+        register_architecture(mt, _llama_family_config, _llama_params)
+    register_architecture("opt", _opt_config, _opt_params)
+    register_architecture("phi", _phi_config, _phi_params)
+    register_architecture("falcon", _falcon_config, _falcon_params)
+
+
+_register_builtins()
 
 
 # ---------------------------------------------------------------------------

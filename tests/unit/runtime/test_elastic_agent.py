@@ -1,0 +1,93 @@
+"""Elastic agent tests (reference tests/unit/elasticity/test_elastic.py +
+the DSElasticAgent restart path)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+ELASTIC_CFG = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 48,
+        "micro_batch_sizes": [2, 4],
+        "min_gpus": 1, "max_gpus": 8,
+        "version": 0.1,
+    }
+}
+
+
+def _write(tmp_path, name, body):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def test_restart_after_failure(tmp_path):
+    """Rank 1 dies on the first attempt; the agent relaunches and the job
+    completes. Workers see a fresh coordinator port per attempt."""
+    sentinel = tmp_path / "crashed_once"
+    script = _write(tmp_path, "worker.py", f"""
+        import json, os, sys
+        el = json.loads(os.environ["DSTPU_ELASTIC"])
+        rank = int(os.environ["JAX_PROCESS_ID"])
+        log = open(r"{tmp_path}/log_" + str(el["restart_count"]) + "_" + str(rank), "w")
+        log.write(os.environ["JAX_COORDINATOR_ADDRESS"]); log.close()
+        if rank == 1 and not os.path.exists(r"{sentinel}"):
+            open(r"{sentinel}", "w").close()
+            sys.exit(13)
+    """)
+    agent = DSElasticAgent(script, num_slots=2, max_restarts=2,
+                           shrink_on_failure=False, master_port=29610)
+    assert agent.run() == 0
+    assert agent.restart_count == 1
+    assert agent.world_history == [2, 2]
+    # coordinator port advanced between attempts (stale peers cannot rejoin)
+    addr0 = (tmp_path / "log_0_0").read_text()
+    addr1 = (tmp_path / "log_1_0").read_text()
+    assert addr0 != addr1
+
+
+def test_shrink_on_failure_resolves_batch(tmp_path):
+    """Workers refuse to run at world=4; the agent shrinks 4 -> 3 (invalid,
+    skipped by the solver to 2) and the batch config stays consistent."""
+    script = _write(tmp_path, "worker.py", """
+        import json, os, sys
+        el = json.loads(os.environ["DSTPU_ELASTIC"])
+        assert el["train_batch"] == el["micro_batch"] * el["world_size"] * el["gas"]
+        if el["world_size"] >= 4:
+            sys.exit(7)
+    """)
+    agent = DSElasticAgent(script, ds_config=ELASTIC_CFG, num_slots=4,
+                           max_restarts=3, master_port=29640)
+    assert agent.run() == 0
+    assert agent.world_history[0] == 4
+    assert agent.world_history[-1] < 4
+    assert agent.restart_count >= 1
+
+
+def test_restart_budget_exhausted(tmp_path):
+    script = _write(tmp_path, "worker.py", "import sys; sys.exit(5)\n")
+    agent = DSElasticAgent(script, num_slots=1, max_restarts=1,
+                           master_port=29670)
+    assert agent.run() == 5
+    assert agent.restart_count == 2  # initial + 1 allowed restart, both failed
+
+
+def test_solve_world_without_elastic_config(tmp_path):
+    agent = DSElasticAgent("x.py", ds_config={
+        "train_micro_batch_size_per_gpu": 3}, num_slots=5)
+    w = agent._solve_world(5)
+    assert w == {"world_size": 5, "micro_batch": 3, "train_batch": 15, "gas": 1}
+
+
+def test_solve_world_elastic(tmp_path):
+    agent = DSElasticAgent("x.py", ds_config=ELASTIC_CFG, num_slots=8)
+    w = agent._solve_world(8)
+    assert w["world_size"] <= 8
+    assert w["train_batch"] == w["micro_batch"] * w["world_size"] * w["gas"]
+    assert w["train_batch"] <= 48

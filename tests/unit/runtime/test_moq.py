@@ -1,0 +1,163 @@
+"""MoQ (quantize_training) tests — reference model:
+``tests/unit/runtime/half_precision/test_moq.py`` (TestQuantizedTraining)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models.gpt2 import gpt2_model
+from deepspeed_tpu.runtime.quantize import MoQQuantizer
+
+
+def _unique_count(arr):
+    return len(np.unique(np.round(np.asarray(arr, np.float64), 6)))
+
+
+class TestMoQQuantizer:
+
+    def test_bit_annealing_schedule(self):
+        q = MoQQuantizer({"enabled": True,
+                          "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                          "quantize_schedule": {"quantize_period": 2},
+                          "quantize_groups": 1})
+        w = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8))
+        params = {"blocks": {"fc_in": {"kernel": w}}}
+        bits_seen = []
+        for _ in range(30):
+            params = q.quantize(params, overflow=False)
+            bits_seen.append(q._bits.copy())
+        # anneal 8->4 with doubling periods: drops at steps 2, 6(2+4), 14(6+8), 30
+        assert bits_seen[1][0] == 7 and bits_seen[5][0] == 6
+        assert bits_seen[13][0] == 5 and bits_seen[29][0] == 4
+        # at 4 bits symmetric the kernel takes at most 16 distinct values/group
+        assert _unique_count(params["blocks"]["fc_in"]["kernel"][0]) <= 16
+
+    def test_ternary_and_binary_forms(self):
+        # annealing passes through ternary, which zeroes small weights, so
+        # the binary stage sees exact zeros and keeps them (sign(0) == 0):
+        # both end states are {-alpha, 0, +alpha}
+        for target in (2, 1):
+            q = MoQQuantizer({"enabled": True,
+                              "quantize_bits": {"start_bits": 3,
+                                                "target_bits": target},
+                              "quantize_schedule": {"quantize_period": 1},
+                              "quantize_groups": 1})
+            params = {"blocks": {"fc_in": {"kernel": jax.random.normal(
+                jax.random.PRNGKey(1), (1, 16, 16))}}}
+            for _ in range(20):
+                params = q.quantize(params)
+            assert int(q._bits[0]) == target
+            vals = np.unique(np.round(np.asarray(
+                params["blocks"]["fc_in"]["kernel"], np.float64), 8))
+            assert len(vals) <= 3
+            assert np.allclose(vals + vals[::-1], 0)  # symmetric around 0
+
+    def test_eigenvalue_stretches_period(self):
+        q = MoQQuantizer({"enabled": True,
+                          "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                          "quantize_schedule": {"quantize_period": 2},
+                          "eigenvalue": {"enabled": True}})
+        w = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 4))
+        params = {"blocks": {"fc": {"kernel": w}}}
+        params = q.quantize(params)  # step 1: nothing due
+        params = q.quantize(params, eigenvalues=np.array([0.0, 1.0]))
+        # layer 0: period doubles to 4; layer 1 (high curvature): 4 * 5 = 20
+        assert q._period.tolist() == [4, 20]
+        assert q._bits.tolist() == [7, 7]
+
+    def test_overflow_skips_without_eigenvalue(self):
+        q = MoQQuantizer({"enabled": True,
+                          "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                          "quantize_schedule": {"quantize_period": 1}})
+        params = {"blocks": {"fc": {"kernel": jnp.ones((1, 4, 4))}}}
+        out = q.quantize(params, overflow=True)
+        assert q.qsteps == 0 and out is params
+
+    def test_state_roundtrip(self):
+        q = MoQQuantizer({"enabled": True,
+                          "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                          "quantize_schedule": {"quantize_period": 2}})
+        params = {"blocks": {"fc": {"kernel": jnp.ones((2, 4, 4))}}}
+        for _ in range(5):
+            params = q.quantize(params)
+        q2 = MoQQuantizer({"enabled": True,
+                           "quantize_bits": {"start_bits": 8, "target_bits": 4},
+                           "quantize_schedule": {"quantize_period": 2}})
+        q2.load_state_dict(q.state_dict())
+        assert q2.qsteps == q.qsteps and q2._bits.tolist() == q._bits.tolist()
+
+
+def test_moq_through_engine(eight_devices):
+    """quantize_training in the engine config: training proceeds, loss
+    decreases, and the weights end up on the quantization grid."""
+    m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False,
+                   dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "quantize_training": {
+                    "enabled": True,
+                    "quantize_bits": {"start_bits": 8, "target_bits": 6},
+                    "quantize_schedule": {"quantize_period": 1},
+                    "quantize_groups": 4,
+                }})
+    batch = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 12))}
+    losses = [float(engine.train_batch(batch)) for _ in range(6)]
+    assert losses[-1] < losses[0], losses
+    assert engine.quantizer._bits.max() <= 7
+    kernel = np.asarray(engine.state["params"]["blocks"]["q_proj"]["kernel"][0])
+    # grouped 7-bit symmetric: far fewer distinct values than a dense fp kernel
+    assert _unique_count(kernel) < kernel.size // 2
+
+
+def test_moq_with_zeropp_secondary_aliasing(eight_devices):
+    """Regression: MoQ donates the param buffers, and at hpz==1 the ZeRO++
+    secondary ALIASES params — quantize must run before the secondary
+    refresh or the next forward reads deleted arrays."""
+    m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False,
+                   dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3,
+                                      "zero_quantized_weights": True,
+                                      "stage3_param_persistence_threshold": 0},
+                "quantize_training": {
+                    "enabled": True,
+                    "quantize_bits": {"start_bits": 8, "target_bits": 7},
+                    "quantize_schedule": {"quantize_period": 1},
+                }})
+    batch = {"input_ids": np.random.default_rng(2).integers(0, 128, size=(8, 12))}
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert all(np.isfinite(losses)), losses
+    assert engine.quantizer.qsteps == 3
+
+
+def test_moq_eigenvalue_through_engine(eight_devices):
+    """eigenvalue-scheduled MoQ end to end (engine computes per-layer
+    curvature at gas boundaries and stretches periods)."""
+    m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False,
+                   dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "quantize_training": {
+                    "enabled": True,
+                    "quantize_bits": {"start_bits": 8, "target_bits": 7},
+                    "quantize_schedule": {"quantize_period": 1},
+                    "eigenvalue": {"enabled": True, "max_iter": 3,
+                                   "gas_boundary_resolution": 1},
+                }})
+    batch = {"input_ids": np.random.default_rng(1).integers(0, 128, size=(8, 12))}
+    for _ in range(3):
+        engine.train_batch(batch)
+    assert engine.quantizer.qsteps == 3
+    # periods were eigenvalue-stretched: after the first drop they are >= 2x
+    assert (engine.quantizer._period >= 2).all()
+    assert engine.quantizer._bits.tolist() == [7, 7]

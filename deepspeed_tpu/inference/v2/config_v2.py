@@ -31,3 +31,11 @@ class RaggedInferenceEngineConfig:
     kv_cache_dtype: Any = jnp.bfloat16
     max_prefill_chunk: int = 256           # SplitFuse prefill chunk cap
     quantization_mode: Optional[str] = None
+    # decode-only engine steps fuse up to this many tokens per sequence in
+    # one compiled program (on-device sampling between steps); 1 disables.
+    # The scheduler falls back to single-token SplitFuse steps whenever
+    # prefill work is pending, so TTFT is unaffected. Sized against
+    # per-dispatch overhead (hundreds of ms through a remote-device
+    # tunnel): 32 amortizes it to ~3% per token while bounding how long a
+    # newly-arrived prompt waits behind a running burst.
+    decode_burst: int = 32

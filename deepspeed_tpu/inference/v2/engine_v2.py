@@ -99,6 +99,7 @@ class InferenceEngineV2:
                 jax.device_put(self.kv_cache.k_pages, kv_spec),
                 jax.device_put(self.kv_cache.v_pages, kv_spec))
 
+        self._burst_fns: Dict[Tuple[int, int, int], Any] = {}
         log_dist(
             f"InferenceEngineV2: {num_blocks} KV blocks × {block_size} tokens "
             f"({self.kv_cache.mem_bytes() / 2**20:.0f} MiB), "
@@ -204,6 +205,76 @@ class InferenceEngineV2:
                 offset[uid] += len(chunk)
                 out_logits[uid] = logits[i]
         return np.stack([out_logits[u] for u in batch_uids])
+
+    def can_burst(self, batch_uids: Sequence[int], num_steps: int) -> bool:
+        """Burst feasibility: the fused program runs len(uids) tokens PER
+        STEP (the ragged token budget applies per step, not to the k-fold
+        product), but allocates ``num_steps`` KV slots per sequence up
+        front."""
+        sm = self.config.state_manager
+        n = len(batch_uids)
+        if n > sm.max_ragged_sequence_count or n > sm.max_ragged_batch_size:
+            return False
+        need = 0
+        for uid in batch_uids:
+            seq = self.state_manager.get_sequence(uid)
+            if seq is None or seq.seen_tokens == 0:
+                return False
+            if seq.seen_tokens + num_steps > self.max_context:
+                return False
+            total = -(-(seq.seen_tokens + num_steps)
+                      // self.state_manager.block_size)
+            need += max(0, total - seq.cur_allocated_blocks)
+        return need <= self.state_manager.free_blocks
+
+    def decode_burst(self, batch_uids: Sequence[int],
+                     last_tokens: Sequence[int], num_steps: int,
+                     temperatures: Optional[Sequence[float]] = None,
+                     seed: int = 0) -> np.ndarray:
+        """Generate ``num_steps`` tokens for every (already-prefilled) UID
+        in one dispatch (see :meth:`RaggedInferenceModel.decode_burst`).
+        Returns sampled tokens ``[len(uids), num_steps]``.
+        """
+        if not self.can_burst(batch_uids, num_steps):
+            raise RuntimeError("burst does not fit KV budget; call can_burst")
+        sm = self.state_manager
+        seqs = []
+        for uid in batch_uids:
+            seq = sm.get_sequence(uid)
+            assert seq is not None and seq.seen_tokens > 0, \
+                f"decode_burst requires a prefilled sequence (uid {uid})"
+            sm.allocate_blocks(seq, num_steps)
+            seqs.append(seq)
+
+        B = _next_bucket(len(batch_uids), lo=16)
+        mp = self._bucket_blocks(batch_uids)
+        tokens = np.zeros((B,), np.int32)
+        positions = np.zeros((B,), np.int32)
+        tables = np.zeros((B, mp), np.int32)  # padded rows write null block 0
+        temps = np.zeros((B,), np.float32)
+        for i, (uid, seq) in enumerate(zip(batch_uids, seqs)):
+            tokens[i] = last_tokens[i]
+            positions[i] = seq.seen_tokens
+            bt = seq.blocks[:mp]
+            tables[i, :len(bt)] = bt
+            if temperatures is not None:
+                temps[i] = temperatures[i]
+
+        key = (B, mp, num_steps)
+        if key not in self._burst_fns:
+            self._burst_fns[key] = jax.jit(
+                functools.partial(self._model.decode_burst, num_steps=num_steps),
+                donate_argnums=(1, 2))
+        with self.mesh:
+            toks, k_pages, v_pages = self._burst_fns[key](
+                self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
+                jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(tables), jax.random.PRNGKey(seed),
+                jnp.asarray(temps))
+        self.kv_cache.update(k_pages, v_pages)
+        for seq in seqs:
+            seq.post_forward(num_steps)
+        return np.asarray(toks)[:len(batch_uids)]
 
     def _bucket_blocks(self, uids) -> int:
         need = max((len(self.state_manager.get_sequence(u).blocks) for u in uids),

@@ -242,6 +242,55 @@ class RaggedInferenceModel:
         logits = self._unembed(params, x[last][None, :])[0]
         return logits, k_pages, v_pages
 
+    def decode_burst(self, params: Params, k_pages, v_pages, tokens, positions,
+                     block_tables, rng, temperatures, num_steps: int):
+        """K decode steps for B sequences in ONE compiled program — sampling
+        happens ON DEVICE between steps (greedy when temperature <= 0, else
+        categorical), so a serving loop pays one dispatch+fetch round trip
+        per K tokens instead of per token. Through a remote-device tunnel
+        (hundreds of ms per round trip) this is the decode throughput lever.
+
+        Returns (tokens_out [B, K], k_pages, v_pages). ``positions[b]`` is
+        the position of the INPUT token (= seen_tokens); blocks for all K
+        steps must be pre-allocated in ``block_tables``.
+        """
+        ps = self.block_size
+        B = tokens.shape[0]
+        max_flat = k_pages.shape[2] * ps
+        max_pos = self.max_blocks_per_seq * ps - 1
+
+        def one(carry, _):
+            tokens, positions, k_pages, v_pages, rng = carry
+            x = self._embed(params, tokens, positions)
+            pos_c = jnp.clip(positions, 0, max_pos)
+            # clamp the gather index to the bucketed table width, like
+            # ragged_forward/prefill_chunk — never rely on XLA's implicit
+            # out-of-bounds clamp
+            page_slot = jnp.clip(pos_c // ps, 0, block_tables.shape[1] - 1)
+            pages_of = jnp.take_along_axis(block_tables, page_slot[:, None],
+                                           axis=1)[:, 0]
+            write_idx = jnp.clip(pages_of * ps + pos_c % ps, 0, max_flat - 1)
+
+            def attn(q, k_l, v_l):
+                return paged_decode_attention(q, k_l, v_l, pos_c + 1,
+                                              block_tables,
+                                              use_pallas=self.use_pallas)
+
+            x, k_pages, v_pages = self._layer_loop(
+                params, k_pages, v_pages, x, attn, write_idx, positions)
+            logits = self._unembed(params, x)              # [B, V]
+            rng, sub = jax.random.split(rng)
+            greedy = jnp.argmax(logits, axis=-1)
+            temp = jnp.maximum(temperatures, 1e-6)[:, None]
+            sampled = jax.random.categorical(sub, logits / temp, axis=-1)
+            nxt = jnp.where(temperatures <= 0.0, greedy, sampled).astype(jnp.int32)
+            return (nxt, positions + 1, k_pages, v_pages, rng), nxt
+
+        carry = (tokens, positions, k_pages, v_pages, rng)
+        (_, _, k_pages, v_pages, _), toks = jax.lax.scan(
+            one, carry, None, length=num_steps)
+        return toks.T, k_pages, v_pages                    # [B, K]
+
     def decode(self, params: Params, k_pages, v_pages, tokens, positions,
                context_lens, block_tables):
         """B sequences × 1 token. Returns (logits [B, V], k_pages, v_pages)."""

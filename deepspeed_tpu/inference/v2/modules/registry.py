@@ -65,16 +65,19 @@ class DSModuleRegistry:
 
 
 def _pallas_paged_supported(ctx: Dict[str, Any]) -> bool:
-    """TPU backend AND the stock kernel importable — losing the import
-    check would turn the engine's clean XLA fallback into an ImportError."""
+    """Opt-in (DSTPU_PALLAS_PAGED=1) + TPU backend + kernel importable —
+    ONE policy shared with the kernel layer (paged_attention.py helpers)
+    so the registry never selects an implementation the kernel dispatch
+    would not take; the ctx may override the backend for planning."""
     import jax
+
+    from ..kernels.paged_attention import (_paged_kernel_importable,
+                                           _paged_kernel_opted_in)
+    if not _paged_kernel_opted_in():
+        return False
     if ctx.get("backend", jax.default_backend()) != "tpu":
         return False
-    try:
-        from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention  # noqa: F401
-        return True
-    except ImportError:  # pragma: no cover
-        return False
+    return _paged_kernel_importable()
 
 
 ATTENTION_DECODE_REGISTRY = DSModuleRegistry("attention_decode")

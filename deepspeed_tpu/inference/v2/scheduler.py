@@ -97,8 +97,65 @@ class ContinuousBatchingScheduler:
         self._queue.insert(0, req)
 
     # -- one engine step ----------------------------------------------------
+    def _try_decode_burst(self) -> int:
+        """When ONLY decodes are pending, fuse K tokens per sequence into
+        one dispatch with on-device sampling (engine ``decode_burst``) —
+        the serving loop's answer to per-dispatch round-trip latency.
+        Prefill work pending disables bursting so TTFT never waits behind
+        a burst. Returns tokens processed (0 = not applicable)."""
+        k_cfg = getattr(self.engine.config, "decode_burst", 1)
+        if self._queue or not self._running or k_cfg <= 1:
+            return 0
+        # pick the burst depth k maximizing fused tokens k * |{remaining>=k}|
+        # and burst only that subset: a single nearly-done request must not
+        # force everyone down to single-token steps (the tail would pay a
+        # full dispatch round trip per token)
+        remaining = {r.uid: r.max_new_tokens - len(r.generated)
+                     for r in self._running}
+        # powers of two only: every distinct k is a separately compiled
+        # program, so the candidate set must stay tiny
+        candidates = []
+        k = 2
+        while k <= k_cfg:
+            n = sum(1 for v in remaining.values() if v >= k)
+            if n:
+                candidates.append((k * n, k))
+            k *= 2
+        # best fused-token count first; if KV cannot host that k, retry the
+        # next candidate rather than silently giving up bursting entirely
+        reqs, uids, k = [], [], 0
+        for _, cand_k in sorted(candidates, reverse=True):
+            cand_reqs = [r for r in self._running
+                         if remaining[r.uid] >= cand_k]
+            cand_uids = [r.uid for r in cand_reqs]
+            if self.engine.can_burst(cand_uids, cand_k):
+                reqs, uids, k = cand_reqs, cand_uids, cand_k
+                break
+        if k < 2:
+            # KV pressure (or nothing to fuse): let the single-token path
+            # run — it preempts one sequence at a time
+            return 0
+        toks = self.engine.decode_burst(
+            uids, [r.generated[-1] for r in reqs], k,
+            temperatures=[r.temperature for r in reqs],
+            seed=int(self._rng.integers(1 << 31)))
+        for r, row in zip(reqs, toks):
+            for tok in row:
+                r.generated.append(int(tok))
+                if ((r.eos_token_id is not None and tok == r.eos_token_id)
+                        or len(r.generated) >= r.max_new_tokens):
+                    # overshoot tokens past EOS are discarded here; the
+                    # sequence's KV is flushed with the request
+                    self._finish(r)
+                    self._running.remove(r)
+                    break
+        return len(reqs) * k
+
     def step(self) -> int:
         """Run one SplitFuse-composed forward; returns tokens processed."""
+        burst = self._try_decode_burst()
+        if burst:
+            return burst
         uids: List[int] = []
         tokens: List[np.ndarray] = []
         decode_reqs: List[Request] = []

@@ -83,6 +83,7 @@ class TransformerConfig:
     linear_bias: Optional[bool] = None  # None => biases iff layernorm
     lm_head_bias: bool = False       # phi's lm_head carries a bias
     tie_embeddings: bool = True
+    seq_parallel: str = "ulysses"    # 'ulysses' | 'ring' (long-context SP)
     dtype: Any = jnp.float32         # compute dtype (params kept by engine policy)
     remat: bool = True
     remat_policy: str = "nothing_saveable"
@@ -233,7 +234,11 @@ class TransformerLM:
         if c.position == "rope":
             q = self._rotate(q, positions)
             k = self._rotate(k, positions)
-        out = ulysses_attention(flash_attention, q, k, v, causal=True)
+        if c.seq_parallel == "ring":
+            from ..sequence.ring_attention import ring_attention
+            out = ring_attention(q, k, v, causal=True)
+        else:
+            out = ulysses_attention(flash_attention, q, k, v, causal=True)
         out = out.reshape(B, S, c.num_heads * c.head_dim)
         return self._block_layers["o_proj"](block["o_proj"], out)
 

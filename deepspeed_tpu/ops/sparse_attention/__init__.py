@@ -1,0 +1,4 @@
+from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,  # noqa: F401
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              SparsityConfig, VariableSparsityConfig)
+from .sparse_self_attention import SparseSelfAttention, sparse_attention  # noqa: F401

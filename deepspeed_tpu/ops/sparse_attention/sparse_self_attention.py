@@ -1,0 +1,100 @@
+"""Block-sparse self attention, gather-based.
+
+Counterpart of the reference ``ops/sparse_attention/sparse_self_attention.py``
+(``SparseSelfAttention`` :18) + its Triton block-sparse matmul/softmax
+(``matmul.py``/``softmax.py``). TPU-first form: instead of custom sparse
+GEMMs, each query block GATHERS its active key/value blocks (per the
+layout) and runs dense attention over just those tiles — compute and HBM
+traffic scale with ``nnz(layout)``, the tiles stay MXU-shaped, and XLA sees
+only static gathers/einsums. Padding rows (layouts are ragged per query
+block) are masked at softmax.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparsity_config import SparsityConfig
+
+
+def _layout_gather_plan(layout: np.ndarray):
+    """layout [H, n, n] -> (idx [H, n, A], mask [H, n, A]) with A = max
+    active key blocks over all (head, row)."""
+    H, n, _ = layout.shape
+    A = max(1, int(layout.sum(-1).max()))
+    idx = np.zeros((H, n, A), np.int32)
+    mask = np.zeros((H, n, A), bool)
+    for h in range(H):
+        for i in range(n):
+            cols = np.nonzero(layout[h, i])[0]
+            idx[h, i, :len(cols)] = cols
+            mask[h, i, :len(cols)] = True
+    return idx, mask
+
+
+def sparse_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                     layout: np.ndarray, block: int,
+                     causal: bool = False,
+                     scale: Optional[float] = None) -> jax.Array:
+    """q/k/v ``[B, S, H, D]``; layout ``[H, S/block, S/block]`` 0/1."""
+    B, S, H, D = q.shape
+    n = S // block
+    assert layout.shape == (H, n, n), (layout.shape, (H, n, n))
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    idx_np, amask_np = _layout_gather_plan(layout)
+    idx = jnp.asarray(idx_np)
+    amask = jnp.asarray(amask_np)
+
+    # [H, B, n, b, D]
+    qh = q.reshape(B, n, block, H, D).transpose(3, 0, 1, 2, 4)
+    kh = k.reshape(B, n, block, H, D).transpose(3, 0, 1, 2, 4)
+    vh = v.reshape(B, n, block, H, D).transpose(3, 0, 1, 2, 4)
+
+    q_pos = (jnp.arange(n)[:, None] * block + jnp.arange(block)[None, :])
+
+    def one_head(qh, kh, vh, idx, amask):
+        kg = kh[:, idx]                      # [B, n, A, b, D]
+        vg = vh[:, idx]
+        logits = jnp.einsum("bnqd,bnakd->bnqak", qh, kg,
+                            preferred_element_type=jnp.float32) * scale
+        neg = jnp.asarray(-1e30, logits.dtype)
+        logits = jnp.where(amask[None, :, None, :, None], logits, neg)
+        if causal:
+            k_pos = idx[:, :, None] * block + jnp.arange(block)[None, None, :]
+            ok = q_pos[:, :, None, None] >= k_pos[:, None, :, :]  # [n,b,A,b]
+            logits = jnp.where(ok[None], logits, neg)
+        flat = logits.reshape(*logits.shape[:3], -1)              # [B,n,b,A*b]
+        probs = jax.nn.softmax(flat, axis=-1).reshape(logits.shape).astype(qh.dtype)
+        return jnp.einsum("bnqak,bnakd->bnqd", probs, vg)
+
+    out = jax.vmap(one_head)(qh, kh, vh, idx, amask)   # [H, B, n, b, D]
+    return out.transpose(1, 2, 3, 0, 4).reshape(B, S, H, D)
+
+
+class SparseSelfAttention:
+    """Config-driven wrapper (reference ``SparseSelfAttention`` :18); caches
+    the gather plan per sequence length. The reference's ``attn_mask_mode``/
+    ``max_seq_length`` knobs are deliberately NOT accepted: external
+    attention masks are unsupported here, and silently ignoring the
+    arguments would be worse than a TypeError for code being ported."""
+
+    def __init__(self, sparsity_config: SparsityConfig):
+        self.sparsity_config = sparsity_config
+        self._layouts = {}
+
+    def layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, q: jax.Array, k: jax.Array, v: jax.Array,
+                 causal: Optional[bool] = None) -> jax.Array:
+        if causal is None:
+            causal = getattr(self.sparsity_config, "attention",
+                             "bidirectional") == "unidirectional"
+        return sparse_attention(q, k, v, self.layout(q.shape[1]),
+                                self.sparsity_config.block, causal=causal)

@@ -1,0 +1,196 @@
+"""Block-sparse attention layouts.
+
+Counterpart of the reference ``ops/sparse_attention/sparsity_config.py``
+(``SparsityConfig`` :10 and subclasses): each config produces a BLOCK
+LAYOUT — a ``[num_heads, S/block, S/block]`` 0/1 matrix saying which key
+blocks each query block attends. The reference feeds layouts to Triton
+block-sparse matmuls; here the layout drives a gather of active key blocks
+(``sparse_self_attention.py``), computing only the allowed tiles.
+
+Patterns (same semantics and knob names as the reference):
+- ``DenseSparsityConfig``  — all blocks (debug/fallback).
+- ``FixedSparsityConfig``  — local windows of ``num_local_blocks`` plus
+  attention to each window's trailing ``num_global_blocks`` summary blocks.
+- ``VariableSparsityConfig`` — explicit global block indices + local windows.
+- ``BigBirdSparsityConfig`` — random + sliding-window + global blocks.
+- ``BSLongformerSparsityConfig`` — sliding window + leading global blocks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SparsityConfig:
+
+    def __init__(self, num_heads: int, block: int = 16,
+                 different_layout_per_head: bool = False):
+        self.num_heads = num_heads
+        self.block = block
+        self.different_layout_per_head = different_layout_per_head
+
+    def setup_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len % self.block != 0:
+            raise ValueError(f"seq_len {seq_len} not divisible by block "
+                             f"{self.block}")
+        n = seq_len // self.block
+        return np.zeros((self.num_heads, n, n), dtype=np.int64)
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def _finalize(self, layout: np.ndarray, causal: bool = False) -> np.ndarray:
+        if causal:
+            n = layout.shape[1]
+            layout = layout * np.tril(np.ones((n, n), np.int64))[None]
+        return layout
+
+
+class DenseSparsityConfig(SparsityConfig):
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        layout[...] = 1
+        return layout
+
+
+class FixedSparsityConfig(SparsityConfig):
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_local_blocks=4, num_global_blocks=1,
+                 attention="bidirectional", horizontal_global_attention=False,
+                 num_different_global_patterns=1):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_local_blocks = num_local_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+        self.num_different_global_patterns = num_different_global_patterns
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        w, g = self.num_local_blocks, self.num_global_blocks
+        for h in range(self.num_heads):
+            pat = (h % self.num_different_global_patterns
+                   if self.different_layout_per_head else 0)
+            for qi in range(n):
+                win = qi // w
+                lo = win * w
+                layout[h, qi, lo:min(lo + w, n)] = 1        # local window
+                # global: the last g blocks of each PRECEDING window
+                # (reference: representative blocks carry summary info)
+                for pw in range(win):
+                    s = pw * w + max(w - g - pat, 0)
+                    layout[h, qi, s:pw * w + w] = 1
+            if self.horizontal_global_attention:
+                for pw in range(n // w):
+                    s = pw * w + max(w - g, 0)
+                    layout[h, :, s:pw * w + w] = 1
+        return self._finalize(layout, self.attention == "unidirectional")
+
+
+class VariableSparsityConfig(SparsityConfig):
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=0, local_window_blocks=None,
+                 global_block_indices=None, global_block_end_indices=None,
+                 attention="bidirectional", horizontal_global_attention=False):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.local_window_blocks = local_window_blocks or [4]
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+        self.horizontal_global_attention = horizontal_global_attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        rng = np.random.default_rng(0)
+        # local: consecutive windows of the configured sizes (last repeats)
+        sizes = list(self.local_window_blocks)
+        for h in range(self.num_heads):
+            qi = 0
+            i = 0
+            while qi < n:
+                w = sizes[min(i, len(sizes) - 1)]
+                lo, hi = qi, min(qi + w, n)
+                layout[h, lo:hi, lo:hi] = 1
+                qi = hi
+                i += 1
+            # globals: whole columns (and rows when horizontal)
+            if self.global_block_end_indices:
+                spans = zip(self.global_block_indices,
+                            self.global_block_end_indices)
+            else:
+                spans = ((g, g + 1) for g in self.global_block_indices)
+            for s, e in spans:
+                layout[h, :, s:e] = 1
+                if self.horizontal_global_attention:
+                    layout[h, s:e, :] = 1
+            for qi in range(n):
+                if self.num_random_blocks:
+                    cols = rng.choice(n, min(self.num_random_blocks, n),
+                                      replace=False)
+                    layout[h, qi, cols] = 1
+        return self._finalize(layout, self.attention == "unidirectional")
+
+
+class BigBirdSparsityConfig(SparsityConfig):
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_random_blocks=1, num_sliding_window_blocks=3,
+                 num_global_blocks=1, attention="bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_random_blocks = num_random_blocks
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.num_global_blocks = num_global_blocks
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        w = self.num_sliding_window_blocks
+        g = self.num_global_blocks
+        rng = np.random.default_rng(0)
+        for h in range(self.num_heads):
+            for qi in range(n):
+                lo = max(0, qi - w // 2)
+                layout[h, qi, lo:min(n, qi + w // 2 + 1)] = 1   # window
+                cols = rng.choice(n, min(self.num_random_blocks, n),
+                                  replace=False)
+                layout[h, qi, cols] = 1                          # random
+            layout[h, :, :g] = 1                                 # global cols
+            layout[h, :g, :] = 1                                 # global rows
+        return self._finalize(layout, self.attention == "unidirectional")
+
+
+class BSLongformerSparsityConfig(SparsityConfig):
+
+    def __init__(self, num_heads, block=16, different_layout_per_head=False,
+                 num_sliding_window_blocks=3, global_block_indices=None,
+                 global_block_end_indices=None, attention="bidirectional"):
+        super().__init__(num_heads, block, different_layout_per_head)
+        self.num_sliding_window_blocks = num_sliding_window_blocks
+        self.global_block_indices = global_block_indices or [0]
+        self.global_block_end_indices = global_block_end_indices
+        self.attention = attention
+
+    def make_layout(self, seq_len: int) -> np.ndarray:
+        layout = self.setup_layout(seq_len)
+        n = layout.shape[1]
+        w = self.num_sliding_window_blocks
+        for h in range(self.num_heads):
+            for qi in range(n):
+                lo = max(0, qi - w // 2)
+                layout[h, qi, lo:min(n, qi + w // 2 + 1)] = 1
+            if self.global_block_end_indices:
+                spans = zip(self.global_block_indices,
+                            self.global_block_end_indices)
+            else:
+                spans = ((g, g + 1) for g in self.global_block_indices)
+            for s, e in spans:
+                layout[h, :, s:e] = 1
+                layout[h, s:e, :] = 1
+        return self._finalize(layout, self.attention == "unidirectional")

@@ -18,31 +18,52 @@ import jax.numpy as jnp
 
 def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    scale: Optional[float], segment_ids: Optional[jax.Array]) -> jax.Array:
-    """Reference-semantics attention in pure XLA. q,k,v: [B, S, H, D]."""
-    head_dim = q.shape[-1]
-    scale = scale if scale is not None else 1.0 / (head_dim ** 0.5)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    """Reference-semantics attention in pure XLA, GQA-NATIVE: K/V keep
+    their kv_heads — the query heads are grouped ``[B, S, kvH, G, D]`` for
+    the contractions, so grouped-query models never materialize a
+    repeated KV (the memory point of GQA)."""
+    B, Sq, H, D = q.shape
+    kvH = k.shape[2]
+    G = H // kvH
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    qg = q.reshape(B, Sq, kvH, G, D)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                        preferred_element_type=jnp.float32) * scale
     if causal:
-        q_len, k_len = q.shape[1], k.shape[1]
-        q_pos = jnp.arange(q_len)[:, None] + (k_len - q_len)
+        k_len = k.shape[1]
+        q_pos = jnp.arange(Sq)[:, None] + (k_len - Sq)
         mask = q_pos >= jnp.arange(k_len)[None, :]
-        logits = jnp.where(mask[None, None, :, :], logits, -1e30)
+        logits = jnp.where(mask[None, None, None, :, :], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
-        logits = jnp.where(seg_mask[:, None, :, :], logits, -1e30)
+        logits = jnp.where(seg_mask[:, None, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(B, Sq, H, D)
 
 
 @functools.lru_cache(None)
-def _pallas_flash_available() -> bool:
-    if jax.default_backend() == "cpu":
-        return False
+def _flash_kernel_importable() -> bool:
     try:
         from jax.experimental.pallas.ops.tpu import flash_attention  # noqa: F401
         return True
     except ImportError:  # pragma: no cover
         return False
+
+
+def _pallas_flash_available() -> bool:
+    """Opt-IN via DSTPU_PALLAS_FLASH=1: measured on the attached v5e
+    (round 2), the stock Pallas flash kernel ran 5-14x SLOWER than XLA's
+    fused attention at both head_dim 64 and 128 (0.1-1.9 TF eff vs
+    2.2-9.2 TF), so the default hot path is XLA. The kernel stays one env
+    var away for hardware where it wins. Only the import probe is cached —
+    the env read stays live so toggling mid-process works."""
+    import os
+    if os.environ.get("DSTPU_PALLAS_FLASH", "0") != "1":
+        return False
+    if jax.default_backend() == "cpu":
+        return False
+    return _flash_kernel_importable()
 
 
 def flash_attention(q: jax.Array,
@@ -54,21 +75,35 @@ def flash_attention(q: jax.Array,
     """Multi-head attention, [B, S, H, D] layout, GQA-aware.
 
     Dispatches to the Pallas TPU flash kernel when shapes allow, else XLA.
+    The XLA path consumes GQA natively; the Pallas stock kernel needs
+    matched head counts, so only there K/V are broadcast up.
     """
-    num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
-    if num_kv_heads != num_q_heads:
-        assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
-        k = jnp.repeat(k, num_q_heads // num_kv_heads, axis=2)
-        v = jnp.repeat(v, num_q_heads // num_kv_heads, axis=2)
-
     head_dim = q.shape[-1]
-    if (_pallas_flash_available() and segment_ids is None and head_dim % 128 == 0
+    # head_dim 64 (gpt2) is supported by the stock kernel — Mosaic pads the
+    # lane dim; requiring %128 hid the Pallas path from the benched model
+    if (_pallas_flash_available() and segment_ids is None and head_dim % 64 == 0
             and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0):
+        num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
+        if num_kv_heads != num_q_heads:
+            assert num_q_heads % num_kv_heads == 0, (num_q_heads, num_kv_heads)
+            k = jnp.repeat(k, num_q_heads // num_kv_heads, axis=2)
+            v = jnp.repeat(v, num_q_heads // num_kv_heads, axis=2)
         from jax.experimental.pallas.ops.tpu import flash_attention as fa
         sm_scale = scale if scale is not None else 1.0 / (head_dim ** 0.5)
+        _log_path_once("pallas_flash")
         # pallas kernel uses [B, H, S, D]
         out = fa.flash_attention(
             q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
             causal=causal, sm_scale=sm_scale)
         return out.transpose(0, 2, 1, 3)
+    _log_path_once("xla")
     return _xla_attention(q, k, v, causal, scale, segment_ids)
+
+
+@functools.lru_cache(None)
+def _log_path_once(path: str) -> None:
+    """Perf regressions hide in silent fallbacks (round-1 review): say
+    which attention implementation this process is using, once per path."""
+    from ...utils.logging import logger
+    logger.info(f"flash_attention: using {path} path "
+                f"(backend={jax.default_backend()})")

@@ -1067,6 +1067,14 @@ class DeepSpeedEngine:
             self.flops_profiler.start_profile()
         self.tput_timer.start()
         if isinstance(data_iter_or_batch, dict):
+            if self.gradient_accumulation_steps > 1 and \
+                    not getattr(self, "_gas_replay_warned", False):
+                self._gas_replay_warned = True
+                log_dist(
+                    f"train_batch(dict) with gradient_accumulation_steps="
+                    f"{self.gradient_accumulation_steps} REPLAYS the same "
+                    "micro-batch for every accumulation step — pass an "
+                    "iterator for real training semantics", ranks=[0])
             batches = [data_iter_or_batch] * self.gradient_accumulation_steps
         else:
             batches = [next(data_iter_or_batch) for _ in range(self.gradient_accumulation_steps)]

@@ -1,0 +1,114 @@
+"""Ring attention: sequence parallelism for contexts longer than Ulysses
+can carry.
+
+Ulysses (``layer.py``) all-to-alls the FULL sequence onto every device and
+splits heads — its context ceiling is one device's memory for S×H/(sp·tp)
+activations, and sp cannot exceed the head count. Ring attention removes
+both limits: K/V stay sequence-sharded and ROTATE around the ``seq`` mesh
+axis via ``ppermute`` while each device's resident Q block accumulates
+online-softmax partial attention against every passing K/V block
+(blockwise attention over a ring; the technique of Liu et al., "Ring
+Attention with Blockwise Transformers" — reference DeepSpeed has no
+equivalent, its Ulysses is the only SP form).
+
+TPU form: one ``shard_map`` region; a static ``fori_loop`` of sp steps,
+each step = one [s_local × s_local] attention tile (MXU work) overlapped by
+XLA with the next ``ppermute`` hop over ICI. fp32 running max/denominator;
+GQA native (no KV repeat); exact causal masking by global block positions
+(blocks strictly in the future contribute nothing and their tile result is
+discarded via the mask — the classic unbalanced-causal-ring tradeoff,
+accepted for simplicity over zigzag scheduling).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+
+from ..runtime import topology as topo_mod
+from ..runtime.topology import SEQ_AXIS
+from .layer import SEQ_SHARDED
+
+NEG_INF = -1e30
+
+
+def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
+                causal: bool, scale: float) -> jax.Array:
+    """Per-device body. q/k/v local shards [B, s, H|kvH, D]."""
+    r = jax.lax.axis_index(SEQ_AXIS)
+    B, s, H, D = q.shape
+    kvH = k.shape[2]
+    G = H // kvH
+    qg = q.reshape(B, s, kvH, G, D)
+    q_pos = r * s + jnp.arange(s)
+
+    m0 = jnp.full((B, kvH, G, s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, kvH, G, s, 1), jnp.float32)
+    a0 = jnp.zeros((B, kvH, G, s, D), jnp.float32)
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def step(i, carry):
+        m, l, acc, k_cur, v_cur = carry
+        owner = (r - i) % sp                      # origin rank of k_cur
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cur,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = owner * s + jnp.arange(s)
+            ok = q_pos[:, None] >= k_pos[None, :]          # [s, s]
+            logits = jnp.where(ok[None, None, None], logits, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1, keepdims=True))
+        # fully-masked rows have m_new == NEG_INF; without a floor,
+        # exp(NEG_INF - NEG_INF) == 1 would count every masked key. The
+        # floor (10x above NEG_INF) keeps their exp() at exactly 0 while
+        # never touching rows with any real logit.
+        m_safe = jnp.maximum(m_new, NEG_INF / 10)
+        p = jnp.exp(logits - m_safe)
+        corr = jnp.exp(m - m_safe)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum(
+            "bhgqk,bkhd->bhgqd", p.astype(v_cur.dtype), v_cur)
+        k_cur = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
+        v_cur = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+        return m_new, l, acc, k_cur, v_cur
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, sp, step, (m0, l0, a0, k, v))
+    out = acc / jnp.maximum(l, 1e-37)
+    # [B, kvH, G, s, D] -> [B, s, H, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, s, H, D).astype(q.dtype)
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True,
+                   scale: Optional[float] = None) -> jax.Array:
+    """q/k/v ``[batch, seq, heads, head_dim]``, sequence-sharded on entry
+    (same calling convention as :func:`~deepspeed_tpu.sequence.layer.ulysses_attention`'s
+    inputs). Falls back to plain local attention when the mesh has no
+    sequence degree.
+    """
+    D = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (D ** 0.5)
+    topo = topo_mod.get_topology() if topo_mod.is_initialized() else None
+    sp = topo.sequence_parallel_size if topo is not None else 1
+    if sp <= 1 or q.shape[1] % sp:
+        from ..ops.transformer.attention import flash_attention
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.topology import BATCH_AXES, MODEL_AXIS
+
+    local = functools.partial(_ring_local, sp=sp, causal=causal, scale=scale)
+    batch_axes = BATCH_AXES if isinstance(BATCH_AXES, tuple) else (BATCH_AXES,)
+    batch_deg = 1
+    for a in batch_axes:
+        batch_deg *= topo.mesh.shape[a]
+    spec = (SEQ_SHARDED if q.shape[0] % max(batch_deg, 1) == 0
+            else P(None, SEQ_AXIS, MODEL_AXIS, None))
+    return shard_map(local, mesh=topo.mesh,
+                     in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

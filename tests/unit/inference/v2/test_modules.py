@@ -12,9 +12,13 @@ from deepspeed_tpu.models.registry import (get_architecture,
                                            supported_architectures)
 
 
-def test_attention_selection_by_backend():
+def test_attention_selection_by_backend(monkeypatch):
     cfg = RaggedInferenceEngineConfig()
     mcfg = gpt2_config("gpt2-tiny")
+    # the Pallas kernel is opt-in (measured slower through this runtime)
+    assert instantiate_attention(cfg, mcfg, backend="tpu")["decode"].name == \
+        "xla_gather"
+    monkeypatch.setenv("DSTPU_PALLAS_PAGED", "1")
     assert instantiate_attention(cfg, mcfg, backend="tpu")["decode"].name == \
         "pallas_paged"
     assert instantiate_attention(cfg, mcfg, backend="cpu")["decode"].name == \
@@ -33,7 +37,8 @@ def test_linear_selection_by_quant_mode():
         "woq_int4"
 
 
-def test_preference_override_and_unsupported():
+def test_preference_override_and_unsupported(monkeypatch):
+    monkeypatch.setenv("DSTPU_PALLAS_PAGED", "1")
     ctx = {"backend": "cpu"}
     assert ATTENTION_DECODE_REGISTRY.choose(ctx).name == "xla_gather"
     with pytest.raises(ValueError, match="does not support"):

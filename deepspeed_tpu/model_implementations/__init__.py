@@ -1,0 +1,2 @@
+from .diffusers.unet_2d_condition import (UNet2DConditionModel,  # noqa: F401
+                                          UNetConfig, load_diffusers_unet)

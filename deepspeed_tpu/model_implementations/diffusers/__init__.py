@@ -1,0 +1,2 @@
+from .unet_2d_condition import (UNet2DConditionModel, UNetConfig,  # noqa: F401
+                                load_diffusers_unet)

@@ -1,0 +1,184 @@
+"""Stable-Diffusion VAE decoder (AutoencoderKL) for TPU inference.
+
+Counterpart of the reference's ``model_implementations/diffusers/vae.py``
+(a CUDA-graph wrapper over the HF module): the latent→image decoder
+implemented directly in JAX/NHWC, loading real diffusers
+``AutoencoderKL`` checkpoints by their standard names (``decoder.*`` +
+``post_quant_conv``) without the diffusers library.
+
+Decoder topology (SD-1.x/2.x): conv_in → mid (resnet, single
+full-attention block, resnet) → 4 up blocks of (layers_per_block+1)
+time-embedding-free resnets with nearest-2x upsampling between → GroupNorm
+→ conv_out.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .unet_2d_condition import (_conv, _group_norm, _linear,
+                                _load_diffusers_weights, _nest)
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class VAEDecoderConfig:
+    """Fields follow diffusers AutoencoderKL config.json."""
+    latent_channels: int = 4
+    out_channels: int = 3
+    block_out_channels: Sequence[int] = (128, 256, 512, 512)
+    layers_per_block: int = 2
+    norm_num_groups: int = 32
+    scaling_factor: float = 0.18215
+    dtype: Any = jnp.float32
+
+
+class VAEDecoder:
+
+    def __init__(self, config: VAEDecoderConfig):
+        self.config = config
+
+    def _resnet(self, p: Params, x: jax.Array) -> jax.Array:
+        c = self.config
+        h = _group_norm(p["norm1"], x, c.norm_num_groups, eps=1e-6)
+        h = _conv(p["conv1"], jax.nn.silu(h))
+        h = _group_norm(p["norm2"], h, c.norm_num_groups, eps=1e-6)
+        h = _conv(p["conv2"], jax.nn.silu(h))
+        if "conv_shortcut" in p:
+            x = _conv(p["conv_shortcut"], x, padding=0)
+        return x + h
+
+    def _attn(self, p: Params, x: jax.Array) -> jax.Array:
+        """VAE mid attention: single-head full attention over H*W."""
+        c = self.config
+        B, H, W, C = x.shape
+        h = _group_norm(p["group_norm"], x, c.norm_num_groups, eps=1e-6)
+        h = h.reshape(B, H * W, C)
+        q = _linear(p["to_q"], h)
+        k = _linear(p["to_k"], h)
+        v = _linear(p["to_v"], h)
+        logits = jnp.einsum("bqc,bkc->bqk", q, k,
+                            preferred_element_type=jnp.float32) / math.sqrt(C)
+        probs = jax.nn.softmax(logits, -1).astype(x.dtype)
+        out = jnp.einsum("bqk,bkc->bqc", probs, v)
+        out = _linear(p["to_out"]["0"], out).reshape(B, H, W, C)
+        return x + out
+
+    def apply(self, params: Params, latents: jax.Array,
+              scale_input: bool = True) -> jax.Array:
+        """latents [B, h, w, latent_channels] (NHWC) → image
+        [B, 8h, 8w, out_channels] in [-1, 1]. ``scale_input`` divides by
+        the diffusion scaling_factor first (diffusers ``vae.decode``
+        convention)."""
+        c = self.config
+        x = latents.astype(c.dtype)
+        if scale_input:
+            x = x / c.scaling_factor
+        x = _conv(params["post_quant_conv"], x, padding=0)
+        d = params["decoder"]
+        h = _conv(d["conv_in"], x)
+
+        h = self._resnet(d["mid_block"]["resnets"]["0"], h)
+        h = self._attn(d["mid_block"]["attentions"]["0"], h)
+        h = self._resnet(d["mid_block"]["resnets"]["1"], h)
+
+        n = len(c.block_out_channels)
+        for bi in range(n):
+            bp = d["up_blocks"][str(bi)]
+            for li in range(c.layers_per_block + 1):
+                h = self._resnet(bp["resnets"][str(li)], h)
+            if bi < n - 1:
+                B, H, W, C = h.shape
+                h = jax.image.resize(h, (B, H * 2, W * 2, C), "nearest")
+                h = _conv(bp["upsamplers"]["0"]["conv"], h)
+
+        h = _group_norm(d["conv_norm_out"], h, c.norm_num_groups, eps=1e-6)
+        return _conv(d["conv_out"], jax.nn.silu(h))
+
+    __call__ = apply
+
+
+def init_vae_decoder_params(config: VAEDecoderConfig, seed: int = 0,
+                            scale: float = 0.02) -> Dict[str, np.ndarray]:
+    """Flat diffusers-named tree for the decoder half of AutoencoderKL —
+    also the loader's checkpoint schema."""
+    from .unet_2d_condition import _FlatInit
+
+    c = config
+    b = _FlatInit(seed, scale)
+    flat, conv, lin, norm = b.flat, b.conv, b.lin, b.norm
+
+    def resnet(name, ci, co):
+        norm(f"{name}.norm1", ci)
+        conv(f"{name}.conv1", ci, co)
+        norm(f"{name}.norm2", co)
+        conv(f"{name}.conv2", co, co)
+        if ci != co:
+            conv(f"{name}.conv_shortcut", ci, co, k=1)
+
+    conv("post_quant_conv", c.latent_channels, c.latent_channels, k=1)
+    top = c.block_out_channels[-1]
+    conv("decoder.conv_in", c.latent_channels, top)
+    resnet("decoder.mid_block.resnets.0", top, top)
+    a = "decoder.mid_block.attentions.0"
+    norm(f"{a}.group_norm", top)
+    for proj in ("to_q", "to_k", "to_v"):
+        lin(f"{a}.{proj}", top, top)
+    lin(f"{a}.to_out.0", top, top)
+    resnet("decoder.mid_block.resnets.1", top, top)
+
+    rc = list(reversed(c.block_out_channels))
+    prev = top
+    for bi, co in enumerate(rc):
+        for li in range(c.layers_per_block + 1):
+            resnet(f"decoder.up_blocks.{bi}.resnets.{li}",
+                   prev if li == 0 else co, co)
+        if bi < len(rc) - 1:
+            conv(f"decoder.up_blocks.{bi}.upsamplers.0.conv", co, co)
+        prev = co
+
+    norm("decoder.conv_norm_out", c.block_out_channels[0])
+    conv("decoder.conv_out", c.block_out_channels[0], c.out_channels)
+    return flat
+
+
+def load_diffusers_vae_decoder(model_path: str,
+                               dtype=jnp.float32) -> Tuple[VAEDecoder, Params]:
+    """AutoencoderKL directory → (VAEDecoder, params). Encoder tensors in
+    the checkpoint are ignored (decode-only serving path)."""
+    import json
+    import os
+
+    from ...runtime.state_dict_factory import (_load_safetensors,
+                                               _load_torch_bin)
+
+    with open(os.path.join(model_path, "config.json")) as f:
+        cfg = json.load(f)
+    config = VAEDecoderConfig(
+        latent_channels=cfg.get("latent_channels", 4),
+        out_channels=cfg.get("out_channels", 3),
+        block_out_channels=tuple(cfg.get("block_out_channels",
+                                         (128, 256, 512, 512))),
+        layers_per_block=cfg.get("layers_per_block", 2),
+        norm_num_groups=cfg.get("norm_num_groups", 32),
+        scaling_factor=cfg.get("scaling_factor", 0.18215),
+        dtype=dtype)
+
+    sd = {k: v for k, v in _load_diffusers_weights(model_path).items()
+          if k.startswith(("decoder.", "post_quant_conv."))}
+    expected = set(init_vae_decoder_params(config))
+    if expected != set(sd):
+        missing = sorted(expected - set(sd))[:5]
+        extra = sorted(set(sd) - expected)[:5]
+        raise ValueError(
+            f"checkpoint does not match the supported VAE decoder topology: "
+            f"{len(expected - set(sd))} missing (e.g. {missing}), "
+            f"{len(set(sd) - expected)} unsupported (e.g. {extra})")
+    return VAEDecoder(config), _nest(sd)

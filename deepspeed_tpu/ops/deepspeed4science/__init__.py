@@ -1,0 +1,1 @@
+from .evoformer_attn import DS4Sci_EvoformerAttention  # noqa: F401

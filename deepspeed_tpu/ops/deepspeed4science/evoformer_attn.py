@@ -1,0 +1,61 @@
+"""DS4Sci Evoformer attention (AlphaFold-style MSA/pair attention).
+
+Counterpart of the reference ``ops/deepspeed4science/evoformer_attn.py``
+(``DS4Sci_EvoformerAttention`` :88 — a CUTLASS fused kernel with a custom
+autograd Function): attention over 5-D activations with up to two additive
+biases —
+
+- ``bias1`` ``[B, N, 1, 1, S]``: per-key mask/bias (MSA row attention's
+  sequence mask), broadcast over heads and queries;
+- ``bias2`` ``[B, 1, H, S, S]``: pair bias (triangle/pair representation
+  injected into MSA attention), broadcast over the N dim.
+
+TPU-first form: one fused XLA computation in heads-major layout — the
+reference needs a handwritten kernel + manual backward because torch would
+materialize every intermediate; XLA fuses the bias adds and softmax into
+the matmul pipeline and autodiff provides the backward, so there is
+nothing left for a custom kernel to win (and fp32 logits accumulation is
+kept, matching the CUTLASS kernel's accumulator).
+
+Q/K/V: ``[B, N, S, H, D]`` (batch, group/row dim, sequence, heads, head
+dim) — the reference's ``[*, L, H, D]`` with two leading dims.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def DS4Sci_EvoformerAttention(Q: jax.Array, K: jax.Array, V: jax.Array,
+                              biases: List[Optional[jax.Array]]) -> jax.Array:
+    assert len(biases) <= 2, "at most two biases (mask bias + pair bias)"
+    biases = list(biases) + [None] * (2 - len(biases))
+    bias1, bias2 = biases
+
+    B, N, S, H, D = Q.shape
+    if bias1 is not None:
+        assert bias1.shape == (B, N, 1, 1, S), \
+            f"bias1 shape {bias1.shape} != {(B, N, 1, 1, S)}"
+    if bias2 is not None:
+        assert bias2.shape == (B, 1, H, S, S), \
+            f"bias2 shape {bias2.shape} != {(B, 1, H, S, S)}"
+
+    scale = 1.0 / (D ** 0.5)
+    # heads-major: [B, N, H, S, D]
+    q = Q.transpose(0, 1, 3, 2, 4)
+    k = K.transpose(0, 1, 3, 2, 4)
+    v = V.transpose(0, 1, 3, 2, 4)
+    logits = jnp.einsum("bnhqd,bnhkd->bnhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias1 is not None:
+        # [B, N, 1, 1, S] already broadcasts over (heads, queries)
+        logits = logits + bias1.astype(jnp.float32)
+    if bias2 is not None:
+        # [B, 1, H, S, S] broadcasts over N
+        logits = logits + bias2.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(Q.dtype)
+    out = jnp.einsum("bnhqk,bnhkd->bnhqd", probs, v)
+    return out.transpose(0, 1, 3, 2, 4)

@@ -1,0 +1,219 @@
+"""Diffusers UNet implementation tests (reference
+``tests/unit/inference/test_inference.py`` stable-diffusion path +
+``model_implementations/diffusers``): a checkpoint in diffusers' exact
+on-disk format (config.json + diffusion_pytorch_model.safetensors with
+the standard dotted names) must load and run."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.model_implementations import (UNet2DConditionModel,
+                                                 UNetConfig,
+                                                 load_diffusers_unet)
+from deepspeed_tpu.model_implementations.diffusers.unet_2d_condition import (
+    _nest, init_unet_params)
+
+TINY = UNetConfig(
+    in_channels=4, out_channels=4, sample_size=16,
+    block_out_channels=(32, 64), layers_per_block=1,
+    cross_attention_dim=24, attention_head_dim=4, norm_num_groups=8,
+    down_block_types=("CrossAttnDownBlock2D", "DownBlock2D"),
+    up_block_types=("UpBlock2D", "CrossAttnUpBlock2D"))
+
+TINY_DIFFUSERS_CONFIG = {
+    "in_channels": 4, "out_channels": 4, "sample_size": 16,
+    "block_out_channels": [32, 64], "layers_per_block": 1,
+    "cross_attention_dim": 24, "attention_head_dim": 4,
+    "norm_num_groups": 8,
+    "down_block_types": ["CrossAttnDownBlock2D", "DownBlock2D"],
+    "up_block_types": ["UpBlock2D", "CrossAttnUpBlock2D"],
+}
+
+
+def _forward(model, params, seed=0):
+    rng = np.random.default_rng(seed)
+    sample = jnp.asarray(rng.standard_normal((2, 16, 16, 4)), jnp.float32)
+    t = jnp.asarray([10, 500], jnp.int32)
+    ctx = jnp.asarray(rng.standard_normal((2, 7, 24)), jnp.float32)
+    return model.apply(params, sample, t, ctx)
+
+
+def test_expected_diffusers_key_names():
+    """The generated tree must use the REAL diffusers names — spot-check
+    the load-bearing ones (these exact strings appear in every SD-1.x
+    UNet safetensors index)."""
+    flat = init_unet_params(TINY)
+    for key in [
+        "conv_in.weight",
+        "time_embedding.linear_1.weight",
+        "down_blocks.0.resnets.0.time_emb_proj.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.attn1.to_q.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.attn2.to_k.weight",
+        "down_blocks.0.attentions.0.transformer_blocks.0.ff.net.0.proj.weight",
+        "down_blocks.0.downsamplers.0.conv.weight",
+        "mid_block.resnets.1.conv2.weight",
+        "up_blocks.0.resnets.0.conv_shortcut.weight",
+        "up_blocks.0.upsamplers.0.conv.weight",
+        "up_blocks.1.attentions.1.proj_out.weight",
+        "conv_norm_out.weight",
+        "conv_out.bias",
+    ]:
+        assert key in flat, key
+    # cross-attn k/v read the text encoding width
+    assert flat["down_blocks.0.attentions.0.transformer_blocks.0"
+                ".attn2.to_k.weight"].shape == (32, 24)
+
+
+def test_forward_shapes_and_finite():
+    model = UNet2DConditionModel(TINY)
+    params = _nest(init_unet_params(TINY, seed=1))
+    out = _forward(model, params)
+    assert out.shape == (2, 16, 16, 4)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_jit_matches_eager():
+    model = UNet2DConditionModel(TINY)
+    params = _nest(init_unet_params(TINY, seed=2))
+    eager = _forward(model, params)
+    rng = np.random.default_rng(0)
+    sample = jnp.asarray(rng.standard_normal((2, 16, 16, 4)), jnp.float32)
+    t = jnp.asarray([10, 500], jnp.int32)
+    ctx = jnp.asarray(rng.standard_normal((2, 7, 24)), jnp.float32)
+    jitted = jax.jit(model.apply)(params, sample, t, ctx)
+    np.testing.assert_allclose(np.asarray(jitted), np.asarray(eager),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_load_from_diffusers_directory(tmp_path):
+    """End to end through the real on-disk format."""
+    from safetensors.numpy import save_file
+
+    flat = init_unet_params(TINY, seed=3)
+    (tmp_path / "config.json").write_text(json.dumps(TINY_DIFFUSERS_CONFIG))
+    save_file(flat, tmp_path / "diffusion_pytorch_model.safetensors")
+
+    model, params = load_diffusers_unet(str(tmp_path))
+    assert model.config.block_out_channels == (32, 64)
+    out = _forward(model, params, seed=4)
+    assert out.shape == (2, 16, 16, 4)
+    # identical to using the in-memory tree directly
+    direct = _forward(UNet2DConditionModel(TINY), _nest(flat), seed=4)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(direct),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_timesteps_change_output():
+    model = UNet2DConditionModel(TINY)
+    params = _nest(init_unet_params(TINY, seed=5))
+    rng = np.random.default_rng(1)
+    sample = jnp.asarray(rng.standard_normal((1, 16, 16, 4)), jnp.float32)
+    ctx = jnp.asarray(rng.standard_normal((1, 7, 24)), jnp.float32)
+    o1 = model.apply(params, sample, jnp.asarray([1]), ctx)
+    o2 = model.apply(params, sample, jnp.asarray([900]), ctx)
+    assert float(jnp.abs(o1 - o2).max()) > 1e-6
+
+
+def test_cross_attention_sees_context():
+    model = UNet2DConditionModel(TINY)
+    params = _nest(init_unet_params(TINY, seed=6))
+    rng = np.random.default_rng(2)
+    sample = jnp.asarray(rng.standard_normal((1, 16, 16, 4)), jnp.float32)
+    t = jnp.asarray([50])
+    c1 = jnp.asarray(rng.standard_normal((1, 7, 24)), jnp.float32)
+    c2 = jnp.asarray(rng.standard_normal((1, 7, 24)), jnp.float32)
+    o1 = model.apply(params, sample, t, c1)
+    o2 = model.apply(params, sample, t, c2)
+    assert float(jnp.abs(o1 - o2).max()) > 1e-6
+
+
+class TestVAEDecoder:
+
+    CFG = None  # populated below
+
+    def _tiny(self):
+        from deepspeed_tpu.model_implementations.diffusers.vae import (
+            VAEDecoder, VAEDecoderConfig, init_vae_decoder_params)
+        cfg = VAEDecoderConfig(block_out_channels=(16, 32), layers_per_block=1,
+                               norm_num_groups=8)
+        return VAEDecoder(cfg), init_vae_decoder_params(cfg, seed=7), cfg
+
+    def test_decode_shape_and_upsampling(self):
+        from deepspeed_tpu.model_implementations.diffusers.unet_2d_condition import _nest
+        dec, flat, cfg = self._tiny()
+        lat = jnp.asarray(np.random.default_rng(0).standard_normal((1, 8, 8, 4)),
+                          jnp.float32)
+        img = dec.apply(_nest(flat), lat)
+        # one 2x upsample per non-final up block
+        assert img.shape == (1, 16, 16, 3)
+        assert bool(jnp.all(jnp.isfinite(img)))
+
+    def test_load_from_directory(self, tmp_path):
+        from safetensors.numpy import save_file
+        from deepspeed_tpu.model_implementations.diffusers.vae import (
+            load_diffusers_vae_decoder)
+        _, flat, _ = self._tiny()
+        # a real AutoencoderKL file also contains encoder tensors: add a
+        # decoy to prove the loader filters them
+        flat = dict(flat)
+        flat["encoder.conv_in.weight"] = np.zeros((16, 3, 3, 3), np.float32)
+        (tmp_path / "config.json").write_text(json.dumps({
+            "latent_channels": 4, "out_channels": 3,
+            "block_out_channels": [16, 32], "layers_per_block": 1,
+            "norm_num_groups": 8}))
+        save_file(flat, tmp_path / "diffusion_pytorch_model.safetensors")
+        dec, params = load_diffusers_vae_decoder(str(tmp_path))
+        assert "encoder" not in params
+        lat = jnp.asarray(np.random.default_rng(1).standard_normal((2, 4, 4, 4)),
+                          jnp.float32)
+        img = jax.jit(dec.apply)(params, lat)
+        assert img.shape == (2, 8, 8, 3)
+
+    def test_vae_key_names(self):
+        _, flat, _ = self._tiny()
+        for key in ["post_quant_conv.weight", "decoder.conv_in.weight",
+                    "decoder.mid_block.attentions.0.to_q.weight",
+                    "decoder.up_blocks.0.resnets.0.norm1.weight",
+                    "decoder.up_blocks.0.upsamplers.0.conv.weight",
+                    "decoder.conv_out.bias"]:
+            assert key in flat, key
+
+
+def test_sd2_style_linear_projection_and_head_dims(tmp_path):
+    """SD-2.x convention: use_linear_projection=True and a per-level
+    attention_head_dim list (head DIMS, not counts)."""
+    from safetensors.numpy import save_file
+    from deepspeed_tpu.model_implementations.diffusers.unet_2d_condition import (
+        init_unet_params, unet_config_from_diffusers)
+    cfg_json = dict(TINY_DIFFUSERS_CONFIG, use_linear_projection=True,
+                    attention_head_dim=[8, 16])
+    cfg = unet_config_from_diffusers(cfg_json)
+    assert cfg.heads_for_level(0) == 32 // 8 == 4
+    assert cfg.heads_for_level(1) == 64 // 16 == 4
+    flat = init_unet_params(cfg, seed=8)
+    # proj_in is a Linear [C, C], not a 1x1 conv
+    assert flat["down_blocks.0.attentions.0.proj_in.weight"].shape == (32, 32)
+    (tmp_path / "config.json").write_text(json.dumps(cfg_json))
+    save_file(flat, tmp_path / "diffusion_pytorch_model.safetensors")
+    model, params = load_diffusers_unet(str(tmp_path))
+    out = _forward(model, params, seed=9)
+    assert out.shape == (2, 16, 16, 4)
+    assert bool(jnp.all(jnp.isfinite(out)))
+
+
+def test_unsupported_checkpoint_rejected_loudly(tmp_path):
+    """Extra keys (e.g. SD-XL add_embedding / deeper transformer stacks)
+    must fail the schema check, not silently skip layers."""
+    from safetensors.numpy import save_file
+    flat = init_unet_params(TINY, seed=10)
+    flat = dict(flat)
+    flat["add_embedding.linear_1.weight"] = np.zeros((8, 8), np.float32)
+    (tmp_path / "config.json").write_text(json.dumps(TINY_DIFFUSERS_CONFIG))
+    save_file(flat, tmp_path / "diffusion_pytorch_model.safetensors")
+    with pytest.raises(ValueError, match="unsupported"):
+        load_diffusers_unet(str(tmp_path))

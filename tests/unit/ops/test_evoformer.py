@@ -1,0 +1,83 @@
+"""Evoformer attention tests (reference
+``tests/unit/ops/deepspeed4science/test_DS4Sci_EvoformerAttention.py`` —
+kernel output and grads vs a naive torch attention; here vs naive jnp)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.deepspeed4science import DS4Sci_EvoformerAttention
+
+
+def _naive(Q, K, V, bias1, bias2):
+    scale = 1.0 / (Q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bnqhd,bnkhd->bnhqk", Q, K).astype(jnp.float32) * scale
+    if bias1 is not None:
+        logits = logits + bias1
+    if bias2 is not None:
+        logits = logits + bias2
+    probs = jax.nn.softmax(logits, -1).astype(Q.dtype)
+    return jnp.einsum("bnhqk,bnkhd->bnqhd", probs, V)
+
+
+def _inputs(B=1, N=3, S=20, H=4, D=8, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+    Q, K, V = (jax.random.normal(k, (B, N, S, H, D), dtype) for k in ks[:3])
+    bias1 = jax.random.normal(ks[3], (B, N, 1, 1, S), dtype) * 2
+    bias2 = jax.random.normal(ks[4], (B, 1, H, S, S), dtype) * 2
+    return Q, K, V, bias1, bias2
+
+
+@pytest.mark.parametrize("use_b1,use_b2", [(False, False), (True, False),
+                                           (False, True), (True, True)])
+def test_matches_naive(use_b1, use_b2):
+    Q, K, V, b1, b2 = _inputs()
+    biases = []
+    if use_b1:
+        biases.append(b1)
+    if use_b2 and not use_b1:
+        # reference semantics: a single bias is bias1; bias2 alone must be
+        # passed as [None, bias2]
+        biases = [None, b2]
+    elif use_b2:
+        biases.append(b2)
+    out = DS4Sci_EvoformerAttention(Q, K, V, biases)
+    ref = _naive(Q, K, V, b1 if use_b1 else None, b2 if use_b2 else None)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gradients_flow_to_biases():
+    Q, K, V, b1, b2 = _inputs(seed=1)
+
+    def loss(q, b1, b2):
+        return jnp.sum(DS4Sci_EvoformerAttention(q, K, V, [b1, b2]) ** 2)
+
+    gq, g1, g2 = jax.grad(loss, argnums=(0, 1, 2))(Q, b1, b2)
+    assert gq.shape == Q.shape and g1.shape == b1.shape and g2.shape == b2.shape
+    assert float(jnp.abs(g1).sum()) > 0 and float(jnp.abs(g2).sum()) > 0
+
+    def nloss(q, b1, b2):
+        return jnp.sum(_naive(q, K, V, b1, b2) ** 2)
+
+    ngq, ng1, ng2 = jax.grad(nloss, argnums=(0, 1, 2))(Q, b1, b2)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(ngq), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(ng1), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(g2), np.asarray(ng2), rtol=1e-4, atol=1e-4)
+
+
+def test_bad_bias_shapes_rejected():
+    Q, K, V, b1, b2 = _inputs()
+    with pytest.raises(AssertionError, match="bias1 shape"):
+        DS4Sci_EvoformerAttention(Q, K, V, [b2])
+    with pytest.raises(AssertionError, match="bias2 shape"):
+        DS4Sci_EvoformerAttention(Q, K, V, [b1, b1])
+
+
+def test_bf16_runs():
+    Q, K, V, b1, b2 = _inputs(dtype=jnp.bfloat16)
+    out = DS4Sci_EvoformerAttention(Q, K, V, [b1, b2])
+    assert out.dtype == jnp.bfloat16
+    assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))

@@ -1,2 +1,5 @@
+from .clip import CLIPTextModel, CLIPTextConfig, load_clip_text_model  # noqa: F401
 from .diffusers.unet_2d_condition import (UNet2DConditionModel,  # noqa: F401
                                           UNetConfig, load_diffusers_unet)
+from .diffusers.vae import (VAEDecoder, VAEDecoderConfig,  # noqa: F401
+                            load_diffusers_vae_decoder)

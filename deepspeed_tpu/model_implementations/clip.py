@@ -1,0 +1,150 @@
+"""CLIP text encoder for TPU inference.
+
+Counterpart of the reference's CLIP container in the stable-diffusion
+injection path (``model_implementations/diffusers`` + the CLIP policy in
+``module_inject/containers/clip.py``): the prompt encoder of the SD
+pipeline, implemented directly in JAX and loading real HF
+``CLIPTextModel`` checkpoints (``text_model.*`` parameter names) — logits
+parity with the torch forward is asserted in tests.
+
+Architecture (openai/clip-vit-*/ SD text encoders): learned positions,
+pre-LN transformer with CAUSAL masking (CLIP text towers are causal),
+quick_gelu (SD-1.x) or gelu (SD-2.x) MLPs, final LayerNorm, and a pooled
+output taken at each sequence's EOS/argmax position.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    """Field names follow HF CLIPTextConfig."""
+    vocab_size: int = 49408
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 77
+    hidden_act: str = "quick_gelu"   # 'quick_gelu' (SD1) | 'gelu' (SD2)
+    layer_norm_eps: float = 1e-5
+    eos_token_id: int = 49407
+    dtype: Any = jnp.float32
+
+
+def _ln(p: Params, x: jax.Array, eps: float) -> jax.Array:
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    return out * p["weight"].astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _lin(p: Params, x: jax.Array) -> jax.Array:
+    return x @ jnp.transpose(p["weight"]).astype(x.dtype) + p["bias"].astype(x.dtype)
+
+
+def _act(name: str, x: jax.Array) -> jax.Array:
+    if name == "quick_gelu":
+        return x * jax.nn.sigmoid(1.702 * x)
+    if name == "gelu":
+        return jax.nn.gelu(x, approximate=False)  # HF 'gelu' is exact
+    raise ValueError(f"unsupported CLIP hidden_act {name!r} "
+                     "(supported: quick_gelu, gelu)")
+
+
+class CLIPTextModel:
+
+    def __init__(self, config: CLIPTextConfig):
+        self.config = config
+
+    def _attn(self, p: Params, x: jax.Array) -> jax.Array:
+        c = self.config
+        B, S, C = x.shape
+        H = c.num_attention_heads
+        D = C // H
+        q = _lin(p["q_proj"], x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        k = _lin(p["k_proj"], x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        v = _lin(p["v_proj"], x).reshape(B, S, H, D).transpose(0, 2, 1, 3)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) / (D ** 0.5)
+        mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, -1).astype(x.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+        return _lin(p["out_proj"], out.transpose(0, 2, 1, 3).reshape(B, S, C))
+
+    def apply(self, params: Params, input_ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        """input_ids [B, S] → (last_hidden_state [B, S, C],
+        pooled_output [B, C])."""
+        c = self.config
+        tm = params["text_model"]
+        x = jnp.take(tm["embeddings"]["token_embedding"]["weight"],
+                     input_ids, axis=0).astype(c.dtype)
+        pos = tm["embeddings"]["position_embedding"]["weight"][:input_ids.shape[1]]
+        x = x + pos.astype(c.dtype)
+
+        for li in range(c.num_hidden_layers):
+            lp = tm["encoder"]["layers"][str(li)]
+            x = x + self._attn(lp["self_attn"],
+                               _ln(lp["layer_norm1"], x, c.layer_norm_eps))
+            h = _ln(lp["layer_norm2"], x, c.layer_norm_eps)
+            h = _act(c.hidden_act, _lin(lp["mlp"]["fc1"], h))
+            x = x + _lin(lp["mlp"]["fc2"], h)
+
+        x = _ln(tm["final_layer_norm"], x, c.layer_norm_eps)
+        # pooled: hidden state at each sequence's EOS. HF special-cases the
+        # LEGACY configs that say eos_token_id=2 while the tokenizer's real
+        # EOS is 49407 (openai/clip-vit-*, SD-1.5 text encoders): there the
+        # EOS position is argmax over token ids (EOS is the largest id);
+        # modern configs match eos_token_id directly (first occurrence).
+        if c.eos_token_id == 2:
+            eos_pos = jnp.argmax(input_ids, axis=1)
+        else:
+            eos_pos = jnp.argmax((input_ids == c.eos_token_id).astype(jnp.int32),
+                                 axis=1)
+        pooled = x[jnp.arange(x.shape[0]), eos_pos]
+        return x, pooled
+
+    __call__ = apply
+
+
+from .diffusers.unet_2d_condition import _nest  # noqa: E402  (shared helper)
+
+
+def clip_config_from_hf(cfg: Dict[str, Any], dtype=jnp.float32) -> CLIPTextConfig:
+    return CLIPTextConfig(
+        vocab_size=cfg.get("vocab_size", 49408),
+        hidden_size=cfg.get("hidden_size", 768),
+        intermediate_size=cfg.get("intermediate_size", 3072),
+        num_hidden_layers=cfg.get("num_hidden_layers", 12),
+        num_attention_heads=cfg.get("num_attention_heads", 12),
+        max_position_embeddings=cfg.get("max_position_embeddings", 77),
+        hidden_act=cfg.get("hidden_act", "quick_gelu"),
+        layer_norm_eps=cfg.get("layer_norm_eps", 1e-5),
+        eos_token_id=cfg.get("eos_token_id", 49407),
+        dtype=dtype)
+
+
+def load_clip_text_model(model_path: str,
+                         dtype=jnp.float32) -> Tuple[CLIPTextModel, Params]:
+    """HF CLIPTextModel directory (config.json + model.safetensors /
+    pytorch_model.bin) → (model, params)."""
+    from ..runtime.state_dict_factory import HFCheckpointLoader
+
+    loader = HFCheckpointLoader(model_path)
+    cfg = loader.config
+    if "text_config" in cfg:  # full CLIPConfig: take the text tower
+        cfg = cfg["text_config"]
+    model = CLIPTextModel(clip_config_from_hf(cfg, dtype))
+    sd = loader.load_state_dict()
+    # drop the contrastive-projection head if present (text encoder only)
+    sd = {k: v for k, v in sd.items() if k.startswith("text_model.")}
+    return model, _nest(sd)

@@ -1,0 +1,95 @@
+"""CLIP text encoder parity tests — exact logits vs
+``transformers.CLIPTextModel`` (the SD prompt-encoder container)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.model_implementations.clip import load_clip_text_model
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module", params=["quick_gelu", "gelu"])
+def clip_ckpt(tmp_path_factory, request):
+    path = tmp_path_factory.mktemp(f"hf_clip_{request.param}")
+    cfg = transformers.CLIPTextConfig(
+        vocab_size=99, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=16, hidden_act=request.param,
+        eos_token_id=98, bos_token_id=97)
+    torch.manual_seed(0)
+    m = transformers.CLIPTextModel(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+def test_hidden_state_and_pooled_parity(clip_ckpt):
+    path, hf = clip_ckpt
+    model, params = load_clip_text_model(str(path))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 96, size=(2, 12))
+    ids[0, 7] = 98   # EOS mid-sequence: pooled must read position 7
+    ids[1, 11] = 98
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids))
+    hidden, pooled = jax.jit(model.apply)(
+        jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(hidden),
+                               ref.last_hidden_state.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(pooled),
+                               ref.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_legacy_eos_token_id_2_pooling(tmp_path_factory):
+    """SD-1.5 / openai CLIP configs say eos_token_id=2 while the real EOS
+    id is the vocabulary's largest token — HF pools at argmax(input_ids)
+    there, and so must we."""
+    path = tmp_path_factory.mktemp("hf_clip_legacy")
+    cfg = transformers.CLIPTextConfig(
+        vocab_size=99, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=16, eos_token_id=2, bos_token_id=0)
+    torch.manual_seed(1)
+    hf = transformers.CLIPTextModel(cfg).eval()
+    hf.save_pretrained(path)
+    model, params = load_clip_text_model(str(path))
+    rng = np.random.default_rng(3)
+    ids = rng.integers(3, 90, size=(2, 10))
+    ids[0, 6] = 98  # "real" EOS = largest id, mid-sequence
+    ids[1, 9] = 98
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids))
+    _, pooled = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(pooled), ref.pooler_output.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_unsupported_activation_rejected():
+    from deepspeed_tpu.model_implementations.clip import _act
+    with pytest.raises(ValueError, match="unsupported CLIP hidden_act"):
+        _act("gelu_new", jnp.ones((2, 2)))
+
+
+def test_text_config_nested_form(tmp_path, clip_ckpt):
+    """A full CLIPConfig (text_config + vision_config) directory must load
+    the text tower."""
+    import json
+    path, hf = clip_ckpt
+    cfg = json.loads((path / "config.json").read_text())
+    nested = {"model_type": "clip", "text_config": cfg}
+    (tmp_path / "config.json").write_text(json.dumps(nested))
+    import shutil
+    for f in path.iterdir():
+        if f.name != "config.json":
+            shutil.copy(f, tmp_path / f.name)
+    model, params = load_clip_text_model(str(tmp_path))
+    assert model.config.hidden_size == 32
+    ids = np.full((1, 5), 98)
+    hidden, _ = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    assert hidden.shape == (1, 5, 32)

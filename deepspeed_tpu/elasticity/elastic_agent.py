@@ -122,7 +122,26 @@ class DSElasticAgent:
     # -- main loop ----------------------------------------------------------
     def run(self) -> int:
         """Supervise until clean exit or restart budget exhausted
-        (reference ``DSElasticAgent._invoke_run`` :106)."""
+        (reference ``DSElasticAgent._invoke_run`` :106). SIGINT/SIGTERM to
+        the agent fan out to the live workers — a scheduler killing the
+        supervisor must not orphan the world."""
+        live_procs: List[subprocess.Popen] = []
+
+        def fan_out(sig, frame):
+            for p in live_procs:
+                if p.poll() is None:
+                    p.send_signal(sig)
+            raise SystemExit(128 + sig)
+
+        old_int = signal.signal(signal.SIGINT, fan_out)
+        old_term = signal.signal(signal.SIGTERM, fan_out)
+        try:
+            return self._run(live_procs)
+        finally:
+            signal.signal(signal.SIGINT, old_int)
+            signal.signal(signal.SIGTERM, old_term)
+
+    def _run(self, live_procs: List[subprocess.Popen]) -> int:
         slots = self.num_slots
         attempt = 0
         while True:
@@ -133,7 +152,9 @@ class DSElasticAgent:
                 f"(batch {world['train_batch']} = {world['micro_batch']} "
                 f"x {world['world_size']} x gas {world['gas']})")
             procs = self._spawn(world, attempt)
+            live_procs[:] = procs
             rc = self._reap(procs)
+            live_procs[:] = []
             if rc == 0:
                 return 0
             self.restart_count += 1

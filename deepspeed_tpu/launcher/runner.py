@@ -56,6 +56,14 @@ def parse_args(args=None):
                              "launch.py per-rank spawner, for single-host "
                              "multi-process runs)")
     parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--elastic_training", action="store_true",
+                        help="supervise workers through the elastic agent: "
+                             "restart (shrinking the world if needed) on "
+                             "failure instead of tearing the job down")
+    parser.add_argument("--max_elastic_restarts", type=int, default=3)
+    parser.add_argument("--deepspeed_config", type=str, default="",
+                        help="ds_config json (elastic agent reads its "
+                             "elasticity section)")
     parser.add_argument("user_script", type=str, help="training script")
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
     return parser.parse_args(args)
@@ -230,6 +238,8 @@ def _run_ssh(args, active: Dict[str, List[int]]) -> int:
 def main(args=None) -> int:
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
+    if args.elastic_training:
+        return _run_elastic(args, resource_pool)
     if not resource_pool or args.launcher == "local":
         return _run_local(args)
     active = _parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
@@ -240,6 +250,35 @@ def main(args=None) -> int:
     if len(active) == 1 and not args.force_multi:
         return _run_local(args)
     return _run_ssh(args, active)
+
+
+def _run_elastic(args, resource_pool: Optional[Dict[str, int]]) -> int:
+    """--elastic_training: local slots supervised by DSElasticAgent
+    (reference elastic_agent.py:28 via torch elastic; here restart +
+    batch-reshape through the elasticity solver). Honors the same
+    --include/--exclude filters and .deepspeed_env propagation as the
+    other launcher paths."""
+    import json as _json
+
+    from ..elasticity.elastic_agent import DSElasticAgent
+
+    ds_config = {}
+    if args.deepspeed_config:
+        with open(args.deepspeed_config) as f:
+            ds_config = _json.load(f)
+    if resource_pool:
+        active = _parse_inclusion_exclusion(resource_pool, args.include,
+                                            args.exclude)
+        slots = sum(len(s) for s in active.values())
+    else:
+        slots = 1
+    agent = DSElasticAgent(
+        args.user_script, args.user_args, ds_config=ds_config,
+        num_slots=slots, max_restarts=args.max_elastic_restarts,
+        master_addr=args.master_addr or "localhost",
+        master_port=args.master_port,
+        extra_env=_collect_env_exports())
+    return agent.run()
 
 
 if __name__ == "__main__":

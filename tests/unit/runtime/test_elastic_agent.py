@@ -78,6 +78,34 @@ def test_restart_budget_exhausted(tmp_path):
     assert agent.restart_count == 2  # initial + 1 allowed restart, both failed
 
 
+def test_launcher_elastic_flag(tmp_path):
+    """dstpu --elastic_training end to end through the runner CLI."""
+    import json
+
+    from deepspeed_tpu.launcher import runner
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("localhost slots=2\n")
+    cfg = tmp_path / "ds_config.json"
+    cfg.write_text(json.dumps(ELASTIC_CFG))
+    sentinel = tmp_path / "crashed_once"
+    script = _write(tmp_path, "worker.py", f"""
+        import json, os, sys
+        el = json.loads(os.environ["DSTPU_ELASTIC"])
+        assert el["train_batch"] <= 48
+        if int(os.environ["JAX_PROCESS_ID"]) == 0 and \\
+                not os.path.exists(r"{sentinel}"):
+            open(r"{sentinel}", "w").close()
+            sys.exit(9)
+    """)
+    rc = runner.main(["--hostfile", str(hostfile), "--elastic_training",
+                      "--max_elastic_restarts", "2",
+                      "--master_port", "29700",
+                      "--deepspeed_config", str(cfg), script])
+    assert rc == 0
+    assert sentinel.exists()
+
+
 def test_solve_world_without_elastic_config(tmp_path):
     agent = DSElasticAgent("x.py", ds_config={
         "train_micro_batch_size_per_gpu": 3}, num_slots=5)

@@ -263,7 +263,10 @@ def main():
         runs.append(lambda: bench_serving(
             llama_model("llama2-7b", dtype=jnp.bfloat16, remat=False,
                         num_layers=4, max_seq_len=2048),
-            n_requests=16, prompt_len=512, max_new=64, token_budget=512,
+            # 2048-token budget: 4 prompts per prefill dispatch — at ~200ms
+            # per-dispatch latency a 512 budget made TTFT 16 serial round
+            # trips, not compute
+            n_requests=16, prompt_len=512, max_new=64, token_budget=2048,
             peak_tflops=peak))
     else:  # smoke path for hosts without a chip
         runs.append(lambda: bench_train(

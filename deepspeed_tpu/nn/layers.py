@@ -89,7 +89,12 @@ class Embedding:
         return {"embedding": P(MODEL_AXIS, None) if self.shard else P(None, None)}
 
     def __call__(self, params: Params, ids: jax.Array) -> jax.Array:
-        return jnp.take(params["embedding"], ids, axis=0)
+        # mode="clip": jnp.take's default out-of-bounds mode is "fill",
+        # which yields NaN rows for any id >= vocab — a silent poison that
+        # surfaces steps later as a NaN loss. Clipping matches torch-side
+        # frameworks' observable behavior closely enough while the engine
+        # validates ids loudly on the host (engine._device_batch).
+        return jnp.take(params["embedding"], ids, axis=0, mode="clip")
 
     def attend(self, params: Params, x: jax.Array) -> jax.Array:
         """Tied-unembedding logits."""

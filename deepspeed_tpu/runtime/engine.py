@@ -847,6 +847,30 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     _REPLICATED_BATCH_KEYS = ("layer_mask",)  # per-layer/global aux inputs
 
+    def _validate_batch(self, batch: Dict[str, Any]) -> None:
+        """Host-side input_ids checks — an out-of-range id would CLIP
+        silently in the embedding lookup (nn/layers.py gather mode), so
+        blame the data here, with the offending values. One cheap pass
+        over small int arrays; device arrays are pulled back (tiny)."""
+        ids = batch.get("input_ids")
+        cfg = getattr(self.model, "config", None)
+        vocab = getattr(cfg, "vocab_size", None)
+        if ids is None or vocab is None:
+            return
+        arr = np.asarray(ids)
+        mn, mx = int(arr.min()), int(arr.max())
+        if mx >= vocab or mn < 0:
+            raise ValueError(
+                f"input_ids out of range for vocab_size={vocab}: "
+                f"min id {mn}, max id {mx} (negative masking ids belong in "
+                f"'labels', not input_ids)")
+        if getattr(cfg, "position", None) == "learned":
+            max_len = getattr(cfg, "max_seq_len", None)
+            if max_len is not None and arr.shape[-1] > max_len:
+                raise ValueError(
+                    f"sequence length {arr.shape[-1]} exceeds the learned "
+                    f"position table ({max_len}); positions would clip")
+
     def _device_batch(self, batch: Dict[str, Any]) -> Dict[str, jax.Array]:
         sharding = NamedSharding(self.mesh, DATA_SPEC)
         rep = NamedSharding(self.mesh, P())
@@ -872,6 +896,8 @@ class DeepSpeedEngine:
         # engine was constructed last
         topo_mod.set_topology(self.topology)
         self._build_jits()
+        self._validate_batch(batch)  # before the timer: a rejected batch
+        # must not leave FORWARD_GLOBAL_TIMER running into the next step
         self.timers(FORWARD_GLOBAL_TIMER).start()
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
@@ -1116,6 +1142,7 @@ class DeepSpeedEngine:
         topo_mod.set_topology(self.topology)
         if getattr(self, "_jit_eval", None) is None:
             self._jit_eval = jax.jit(self.model.loss)
+        self._validate_batch(batch)
         batch = self._device_batch(batch)
         with self.mesh:
             return self._jit_eval(self.state["params"], batch)

@@ -125,3 +125,40 @@ def test_checkpoint_roundtrip(eight_devices, tmp_path):
     assert engine2.global_steps == 2
     loss_after = float(engine2.eval_batch(batch))
     np.testing.assert_allclose(loss_before, loss_after, rtol=2e-5)
+
+
+def test_out_of_range_input_ids_rejected(eight_devices):
+    """An id >= vocab_size must raise with the offending value, not poison
+    training with NaN-filled embedding rows (jnp.take's OOB fill mode) —
+    regression for the silent-NaN quickstart."""
+    from deepspeed_tpu.models import llama_model
+    m = llama_model("llama2-tiny", dtype=jnp.float32, remat=False)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, config={"train_micro_batch_size_per_gpu": 1,
+                         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                         "zero_optimization": {"stage": 1}})
+    bad = np.full((8, 16), m.config.vocab_size + 7, np.int32)
+    with pytest.raises(ValueError, match="out of range"):
+        engine.train_batch({"input_ids": bad})
+    with pytest.raises(ValueError, match="min id -1"):
+        engine.train_batch({"input_ids": np.full((8, 16), -1, np.int32)})
+    # device arrays are validated too (np.asarray pulls them back)
+    with pytest.raises(ValueError, match="out of range"):
+        engine.train_batch({"input_ids": jnp.asarray(bad)})
+    ok = np.random.default_rng(0).integers(0, m.config.vocab_size, (8, 16))
+    assert np.isfinite(float(engine.train_batch({"input_ids": ok})))
+
+
+def test_overlength_learned_positions_rejected(eight_devices):
+    """seq > max_seq_len on a learned-position model must raise (positions
+    would silently clip to the last table row)."""
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False,
+                   dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=m, config={"train_micro_batch_size_per_gpu": 1,
+                         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                         "zero_optimization": {"stage": 1}})
+    long_ids = np.random.default_rng(1).integers(0, 128, (8, 32))
+    with pytest.raises(ValueError, match="exceeds the learned"):
+        engine.train_batch({"input_ids": long_ids})

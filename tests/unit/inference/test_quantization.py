@@ -102,3 +102,29 @@ def test_engine_v2_quantized_serving(eight_devices):
                                quantization_mode=mode))
         outs[mode] = list(generate(eng, [prompt], max_new_tokens=6)[0])
     assert outs["int8"] == outs[None], outs
+
+
+def test_quantized_tp2_row_parallel_sharding(eight_devices):
+    """TP=2 with WOQ: the contraction sharding of row-parallel layers must
+    land on the within-group axis (group boundaries never straddle
+    shards) — regression for the odd-group-count crash (down_proj with
+    G=43, tp=2)."""
+    m = llama_model("llama2-tiny", max_seq_len=32, vocab_size=128,
+                    intermediate_size=172,  # 172 = 4 * 43: non-2^k groups
+                    remat=False, dtype=jnp.float32)
+    params = m.init(jax.random.PRNGKey(7))
+    ids = np.random.default_rng(2).integers(0, 128, size=(2, 12))
+    ref = deepspeed_tpu.init_inference(
+        model=m, params=params,
+        config={"dtype": jnp.float32, "tensor_parallel": {"tp_size": 2}})
+    q = deepspeed_tpu.init_inference(
+        model=m, params=params,
+        config={"dtype": jnp.float32, "tensor_parallel": {"tp_size": 2},
+                "quantization_mode": "int8"})
+    # row-parallel down_proj shards the WITHIN-GROUP axis specifically
+    # ([layers, G, gs, out] -> spec position -2), not G or out
+    spec = q.params["blocks"]["down_proj"]["q"].sharding.spec
+    assert spec[-2] == "model", spec
+    out = np.asarray(q.forward(ids))
+    expect = np.asarray(ref.forward(ids))
+    assert np.max(np.abs(out - expect)) / np.max(np.abs(expect)) < 0.02

@@ -93,8 +93,8 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
     batch = {"input_ids": rng.integers(0, model.config.vocab_size,
                                        size=(batch_size, seq))}
 
-    for _ in range(2):  # compile + settle
-        sync(engine.train_batch(batch))
+    first_loss = sync(engine.train_batch(batch))  # compile + settle
+    sync(engine.train_batch(batch))
 
     # the attached chip's throughput fluctuates run to run (shared/remote
     # runtime); take the best of two timed windows so a transient stall
@@ -123,7 +123,10 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
         "achieved_tflops": round(achieved_tflops, 2),
         "mfu": round(mfu, 4) if mfu is not None else None,
         "steps": steps,
-        "loss": round(loss_val, 4),
+        # loss_first -> loss_last shows real learning on the (repeated)
+        # bench batch; a tiny last loss is memorization, not a bug
+        "loss_first": round(first_loss, 4),
+        "loss_last": round(loss_val, 6),
     }
     del engine
     gc.collect()

@@ -7,6 +7,8 @@ line):
 
   [0] GPT-2 125M, ZeRO-1, bf16                 -> tokens/sec + MFU
   [1] Llama-2-7B-dims (layer-scaled), ZeRO-2   -> tokens/sec + MFU
+  [2] Llama dims (layer-scaled), ZeRO-3 + NVMe -> tokens/sec + MFU
+      optimizer offload paging through dstpu_aio
   [3] Mixtral-style MoE (layer-scaled), ZeRO-2 -> tokens/sec + MFU
   [4] Ragged continuous-batching serving       -> output tok/s + TTFT
 
@@ -16,7 +18,9 @@ Honest accounting:
   block_until_ready returns before the computation actually finishes, which
   made earlier rounds' throughput numbers fictitious. A scalar fetch forces
   completion of the whole donated-state chain.
-- >= 30 timed steps after compile/warmup (3 on the CPU smoke path).
+- >= 30 timed steps after compile/warmup (3 on the CPU smoke path; 6 for
+  the NVMe-offload line, whose steps are tunnel-bandwidth-bound here and
+  would otherwise dominate bench wall-clock).
 - MFU = achieved model FLOPs / chip's advertised bf16 peak, detected from
   ``jax.devices()[0].device_kind``. Model FLOPs per token = 6*N_active +
   6*L*H*S (causal attention term). For MoE, N_active counts top_k experts
@@ -256,6 +260,35 @@ def main():
                         num_layers=2, max_seq_len=2048),
             zero_cfg(2, 4), 4, 2048, steps, REF_MFU_ZERO3, peak,
             note=", 7B dims scaled to 2 layers for 1 chip"))
+        def offload_run():
+            import tempfile
+            # ignore_cleanup_errors: if a step raises while async AIO writes
+            # are in flight, rmtree during unwinding can race the worker
+            # threads and mask the real error with ENOTEMPTY
+            with tempfile.TemporaryDirectory(prefix="dstpu_nvme_",
+                                             ignore_cleanup_errors=True) as nvme:
+                cfg = zero_cfg(3, 4)
+                cfg["zero_optimization"]["offload_optimizer"] = {
+                    "device": "nvme", "nvme_path": nvme}
+                return bench_train(
+                    "llama-arch ZeRO-3 NVMe-offload bf16",
+                    # Sized to ~20M params: this environment reaches its chip
+                    # through a remote-device tunnel moving ~13 MB/s
+                    # device->host (measured), so the grad fetch - PCIe-speed
+                    # on a real TPU VM - bounds every offload step here. The
+                    # line demonstrates the full path (host-partitioned
+                    # optimizer, fp32 masters + moments paged through
+                    # dstpu_aio per step); its MFU is a tunnel artifact, not
+                    # the design's.
+                    llama_model("llama2-7b", dtype=jnp.bfloat16, remat=True,
+                                num_layers=2, hidden_size=768,
+                                intermediate_size=2048, num_heads=12,
+                                num_kv_heads=4, vocab_size=4096,
+                                max_seq_len=512),
+                    cfg, 4, 512,
+                    max(6, steps // 5), REF_MFU_ZERO3, peak,
+                    note=", optimizer state paged via dstpu_aio")
+        runs.append(offload_run)
         runs.append(lambda: bench_train(
             "mixtral-style MoE 8e top2 ZeRO-2 bf16",
             mixtral_model("mixtral-8x7b", dtype=jnp.bfloat16, remat=False,

@@ -35,6 +35,11 @@ class AsyncIOHandle:
         self._handle = None
         self._pool: Optional[ThreadPoolExecutor] = None
         self._futures: List[Future] = []
+        # The native engine reads/writes the buffer from worker threads via a
+        # raw pointer; callers routinely pass temporaries
+        # (np.ascontiguousarray(...).reshape(-1)), so the handle must keep
+        # them alive until wait() or the C++ side reads freed memory.
+        self._inflight: List[np.ndarray] = []
         if self._lib is not None:
             self._handle = self._lib.aio_create(block_size, queue_depth, num_threads)
         else:  # pure-python fallback
@@ -49,7 +54,9 @@ class AsyncIOHandle:
     # -- async ops -----------------------------------------------------------
     def async_pwrite(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> None:
         if self._handle is not None:
-            self._lib.aio_pwrite(self._handle, self._buf(buffer),
+            ptr = self._buf(buffer)  # may reject; don't pin a rejected buffer
+            self._inflight.append(buffer)
+            self._lib.aio_pwrite(self._handle, ptr,
                                  path.encode(), buffer.nbytes, file_offset)
         else:
             def write(b=buffer, p=path, off=file_offset):
@@ -60,7 +67,9 @@ class AsyncIOHandle:
 
     def async_pread(self, buffer: np.ndarray, path: str, file_offset: int = 0) -> None:
         if self._handle is not None:
-            self._lib.aio_pread(self._handle, self._buf(buffer),
+            ptr = self._buf(buffer)  # may reject; don't pin a rejected buffer
+            self._inflight.append(buffer)
+            self._lib.aio_pread(self._handle, ptr,
                                 path.encode(), buffer.nbytes, file_offset)
         else:
             def read(b=buffer, p=path, off=file_offset):
@@ -75,14 +84,25 @@ class AsyncIOHandle:
         Raises OSError on any IO failure (reference: negative return)."""
         if self._handle is not None:
             rc = self._lib.aio_wait(self._handle)
+            self._inflight.clear()
             if rc < 0:
                 raise OSError(-rc, os.strerror(-rc))
             return int(rc)
-        n = 0
+        # Drain EVERY future before raising (the native engine also waits
+        # for completed == submitted before reporting an error): clearing on
+        # the first failure would leave ops still running in the pool while
+        # the caller believes the handle is idle and reuses their buffers.
+        n, first_err = 0, None
         for f in self._futures:
-            f.result()  # propagate exceptions
-            n += 1
+            try:
+                f.result()
+                n += 1
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first_err is None:
+                    first_err = e
         self._futures.clear()
+        if first_err is not None:
+            raise first_err
         return n
 
     def pending(self) -> int:
@@ -101,8 +121,9 @@ class AsyncIOHandle:
 
     def close(self) -> None:
         if self._handle is not None:
-            self._lib.aio_destroy(self._handle)
+            self._lib.aio_destroy(self._handle)  # joins worker threads
             self._handle = None
+            self._inflight.clear()
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None

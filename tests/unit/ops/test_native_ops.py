@@ -123,6 +123,30 @@ class TestAIO:
         np.testing.assert_array_equal(out, np.arange(512, dtype=np.float32))
         h.close()
 
+    def test_inflight_buffers_survive_caller_drop(self, tmp_path):
+        """Callers pass temporaries (ascontiguousarray(...).reshape(-1)) to
+        async_pwrite; the handle must keep them alive until wait() or the
+        native worker threads read freed memory (round-1 advisor finding)."""
+        import gc
+
+        h = AsyncIOHandle(block_size=1 << 10, num_threads=2)
+        rng = np.random.default_rng(2)
+        golden = rng.normal(size=200_000).astype(np.float32)
+        path = str(tmp_path / "temp.bin")
+        # hand over a fresh copy with no caller-side reference — a view of
+        # `golden` would be kept alive by the test itself and not exercise
+        # the lifetime bug
+        h.async_pwrite(golden.copy().reshape(-1), path)
+        if h._handle is not None:
+            assert len(h._inflight) == 1  # the handle pins the temporary
+        gc.collect()
+        h.wait()
+        assert not h._inflight
+        out = np.empty_like(golden)
+        h.sync_pread(out, path)
+        np.testing.assert_array_equal(out, golden)
+        h.close()
+
     def test_read_missing_file_raises(self, tmp_path):
         h = AsyncIOHandle()
         buf = np.empty(16, np.float32)

@@ -48,6 +48,11 @@ class RaggedInferenceModel:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.use_pallas = use_pallas
         c = self.config
+        if c.position == "alibi":
+            raise ValueError(
+                "alibi positional bias (bloom) is not supported by the "
+                "ragged paged-attention path yet; use inference v1 "
+                "(init_inference) for alibi models")
         assert c.max_seq_len <= max_blocks_per_seq * block_size or True
 
     # -- shared pieces ------------------------------------------------------
@@ -58,6 +63,8 @@ class RaggedInferenceModel:
         if m._wpe is not None:
             pos = jnp.clip(positions, 0, self.config.max_seq_len - 1)
             x = x + m._wpe(params["wpe"], pos + self.config.position_offset)
+        if m._ln_emb is not None:
+            x = m._ln_emb(params["ln_emb"], x)
         return x.astype(self.config.dtype)
 
     def _unembed(self, params: Params, x: jax.Array) -> jax.Array:

@@ -1,0 +1,97 @@
+"""BLOOM / GPT-NeoX / GPT-J presets.
+
+Counterpart of the reference's kernel-injection policies for these
+architectures (``module_inject/containers/{bloom,gptneox,gptj}.py``), which
+the v2 model_implementations never covered — expressed through
+``TransformerConfig`` knobs:
+
+- **BLOOM** (containers/bloom.py): ALiBi attention bias instead of position
+  embeddings, LayerNorm directly after the word embeddings
+  (``word_embeddings_layernorm``), sequential residual blocks, tied head.
+- **GPT-NeoX** (containers/gptneox.py): parallel attention+MLP fed by TWO
+  norms (``use_parallel_residual``), partial rotary (``rotary_pct``),
+  untied ``embed_out`` head.
+- **GPT-J** (containers/gptj.py): parallel block from ONE norm, partial
+  INTERLEAVED rotary (rotate-every-two over ``rotary_dim``), bias-free
+  attention with biased MLP, untied biased lm_head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig, TransformerLM
+
+_BLOOM_PRESETS = {
+    "bloom-tiny": dict(num_layers=2, num_heads=4, hidden_size=64,
+                       max_seq_len=64, vocab_size=256),
+    "bloom-560m": dict(num_layers=24, num_heads=16, hidden_size=1024),
+    "bloom-7b1": dict(num_layers=30, num_heads=32, hidden_size=4096),
+    "bloom-176b": dict(num_layers=70, num_heads=112, hidden_size=14336),
+}
+
+_NEOX_PRESETS = {
+    "gpt-neox-tiny": dict(num_layers=2, num_heads=4, hidden_size=64,
+                          intermediate_size=256, max_seq_len=64,
+                          vocab_size=256, rope_dim=4),
+    "pythia-1b": dict(num_layers=16, num_heads=8, hidden_size=2048,
+                      intermediate_size=8192, max_seq_len=2048,
+                      vocab_size=50304, rope_dim=64),
+    "gpt-neox-20b": dict(num_layers=44, num_heads=64, hidden_size=6144,
+                         intermediate_size=24576, max_seq_len=2048,
+                         vocab_size=50432, rope_dim=24),
+}
+
+_GPTJ_PRESETS = {
+    "gptj-tiny": dict(num_layers=2, num_heads=4, hidden_size=64,
+                      intermediate_size=256, max_seq_len=64, vocab_size=256,
+                      rope_dim=8),
+    "gpt-j-6b": dict(num_layers=28, num_heads=16, hidden_size=4096,
+                     intermediate_size=16384, max_seq_len=2048,
+                     vocab_size=50400, rope_dim=64),
+}
+
+
+def bloom_config(preset: str = "bloom-7b1", dtype=jnp.bfloat16,
+                 **overrides) -> TransformerConfig:
+    base = dict(vocab_size=250880, max_seq_len=2048, activation="gelu",
+                norm="layernorm", position="alibi", embedding_norm=True,
+                tie_embeddings=True, dtype=dtype)
+    base.update(_BLOOM_PRESETS[preset])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def bloom_model(preset: str = "bloom-7b1", **overrides) -> TransformerLM:
+    return TransformerLM(bloom_config(preset, **overrides))
+
+
+def gpt_neox_config(preset: str = "gpt-neox-20b", dtype=jnp.bfloat16,
+                    **overrides) -> TransformerConfig:
+    # HF default hidden_act "gelu" is the exact erf form (ACT2FN), not the
+    # gpt2 tanh approximation
+    base = dict(activation="gelu_exact", norm="layernorm", position="rope",
+                parallel_block=True, parallel_norms=True,
+                tie_embeddings=False, dtype=dtype)
+    base.update(_NEOX_PRESETS[preset])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gpt_neox_model(preset: str = "gpt-neox-20b", **overrides) -> TransformerLM:
+    return TransformerLM(gpt_neox_config(preset, **overrides))
+
+
+def gptj_config(preset: str = "gpt-j-6b", dtype=jnp.bfloat16,
+                **overrides) -> TransformerConfig:
+    base = dict(activation="gelu", norm="layernorm", position="rope",
+                rope_style="interleaved", parallel_block=True,
+                attn_bias=False, tie_embeddings=False, lm_head_bias=True,
+                dtype=dtype)
+    base.update(_GPTJ_PRESETS[preset])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gptj_model(preset: str = "gpt-j-6b", **overrides) -> TransformerLM:
+    return TransformerLM(gptj_config(preset, **overrides))

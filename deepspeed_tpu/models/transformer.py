@@ -1,4 +1,5 @@
-"""Decoder-only transformer family (GPT-2 / Llama / Mistral / Mixtral).
+"""Decoder-only transformer family (GPT-2 / Llama / Mistral / Mixtral / OPT /
+Phi / Falcon / BLOOM / GPT-NeoX / GPT-J).
 
 The reference ships models two ways — HF models patched by kernel injection
 (``module_inject/replace_module.py``) and per-arch inference impls
@@ -74,14 +75,17 @@ class TransformerConfig:
     activation: str = "gelu"        # 'gelu' | 'gelu_exact' | 'relu' | 'silu_gated'
     norm: str = "layernorm"          # 'layernorm' | 'rmsnorm'
     norm_eps: float = 1e-5           # HF config layer_norm_epsilon / rms_norm_eps
-    position: str = "learned"        # 'learned' | 'rope'
+    position: str = "learned"        # 'learned' | 'rope' | 'alibi'
     position_offset: int = 0         # OPT pads learned positions by 2
     rope_theta: float = 10000.0
-    rope_dim: Optional[int] = None   # partial rotary (phi); None => head_dim
+    rope_dim: Optional[int] = None   # partial rotary (phi/neox/gpt-j); None => head_dim
+    rope_style: str = "half"         # 'half' (llama/neox) | 'interleaved' (gpt-j)
+    embedding_norm: bool = False     # bloom: LayerNorm right after wte
     parallel_block: bool = False     # falcon/phi: x + attn(ln(x)) + mlp(ln(x))
-    parallel_norms: bool = False     # falcon-40b: separate ln per parallel branch
+    parallel_norms: bool = False     # falcon-40b/neox: separate ln per parallel branch
     linear_bias: Optional[bool] = None  # None => biases iff layernorm
-    lm_head_bias: bool = False       # phi's lm_head carries a bias
+    attn_bias: Optional[bool] = None    # gpt-j: bias-free attn, biased MLP
+    lm_head_bias: bool = False       # phi/gpt-j lm_head carries a bias
     tie_embeddings: bool = True
     seq_parallel: str = "ulysses"    # 'ulysses' | 'ring' (long-context SP)
     dtype: Any = jnp.float32         # compute dtype (params kept by engine policy)
@@ -113,8 +117,8 @@ class TransformerConfig:
             mlp = 2 * h * ffn
         if self.moe is not None:
             mlp = mlp * self.moe.num_experts + h * self.moe.num_experts
-        embed = v * h + (0 if self.position == "rope"
-                         else (self.max_seq_len + self.position_offset) * h)
+        embed = v * h + ((self.max_seq_len + self.position_offset) * h
+                         if self.position == "learned" else 0)
         head = 0 if self.tie_embeddings else v * h
         return embed + head + L * (attn + mlp)
 
@@ -131,6 +135,17 @@ class TransformerLM:
         norm_cls = lambda features: base_cls(features, eps=c.norm_eps)
         self._norm = norm_cls
         self._ln_f = norm_cls(c.hidden_size)
+        # bloom normalizes embeddings before the first block
+        self._ln_emb = norm_cls(c.hidden_size) if c.embedding_norm else None
+        if c.position == "alibi":
+            if c.seq_parallel == "ring":
+                raise ValueError("alibi positions are not supported with "
+                                 "ring sequence parallelism (K/V rotation "
+                                 "loses absolute key positions)")
+            from ..ops.transformer.attention import alibi_slopes
+            self._alibi_slopes = alibi_slopes(c.num_heads)
+        else:
+            self._alibi_slopes = None
         if not c.tie_embeddings:
             self._lm_head = nn.Linear(c.hidden_size, c.vocab_size,
                                       use_bias=c.lm_head_bias, shard="column")
@@ -139,13 +154,16 @@ class TransformerLM:
         # linears (linear_bias overrides the norm-derived default)
         use_bias = (c.linear_bias if c.linear_bias is not None
                     else c.norm == "layernorm")
+        # gpt-j: attention projections are bias-free while the MLP keeps
+        # biases — attn_bias overrides the block-wide default for attn only
+        attn_bias = c.attn_bias if c.attn_bias is not None else use_bias
         kv_out = c.kv_heads * c.head_dim
         self._block_layers = {
             "ln_1": norm_cls(c.hidden_size),
-            "q_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=use_bias, shard="column"),
-            "k_proj": nn.Linear(c.hidden_size, kv_out, use_bias=use_bias, shard="column"),
-            "v_proj": nn.Linear(c.hidden_size, kv_out, use_bias=use_bias, shard="column"),
-            "o_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=use_bias, shard="row"),
+            "q_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=attn_bias, shard="column"),
+            "k_proj": nn.Linear(c.hidden_size, kv_out, use_bias=attn_bias, shard="column"),
+            "v_proj": nn.Linear(c.hidden_size, kv_out, use_bias=attn_bias, shard="column"),
+            "o_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=attn_bias, shard="row"),
         }
         if not c.parallel_block or c.parallel_norms:
             # parallel blocks (falcon-7b/phi) feed attention and MLP from the
@@ -182,6 +200,8 @@ class TransformerLM:
         params: Params = {"wte": self._wte.init(rng_embed, dtype)}
         if self._wpe is not None:
             params["wpe"] = self._wpe.init(jax.random.fold_in(rng_embed, 1), dtype)
+        if self._ln_emb is not None:
+            params["ln_emb"] = self._ln_emb.init(jax.random.fold_in(rng_embed, 2), dtype)
         params["ln_f"] = self._ln_f.init(rng_head, dtype)
         if not c.tie_embeddings:
             params["lm_head"] = self._lm_head.init(rng_head, dtype)
@@ -200,6 +220,8 @@ class TransformerLM:
         specs: Params = {"wte": self._wte.specs()}
         if self._wpe is not None:
             specs["wpe"] = self._wpe.specs()
+        if self._ln_emb is not None:
+            specs["ln_emb"] = self._ln_emb.specs()
         specs["ln_f"] = self._ln_f.specs()
         if not c.tie_embeddings:
             specs["lm_head"] = self._lm_head.specs()
@@ -220,8 +242,8 @@ class TransformerLM:
         c = self.config
         rd = c.rope_dim or c.head_dim
         if rd >= c.head_dim:
-            return nn.rotary_embedding(x, positions, c.rope_theta)
-        rot = nn.rotary_embedding(x[..., :rd], positions, c.rope_theta)
+            return nn.rotary_embedding(x, positions, c.rope_theta, c.rope_style)
+        rot = nn.rotary_embedding(x[..., :rd], positions, c.rope_theta, c.rope_style)
         return jnp.concatenate([rot, x[..., rd:]], axis=-1)
 
     def _attn(self, block: Params, h: jax.Array, positions: jax.Array) -> jax.Array:
@@ -237,6 +259,9 @@ class TransformerLM:
         if c.seq_parallel == "ring":
             from ..sequence.ring_attention import ring_attention
             out = ring_attention(q, k, v, causal=True)
+        elif self._alibi_slopes is not None:
+            out = ulysses_attention(flash_attention, q, k, v, causal=True,
+                                    alibi_slopes=jnp.asarray(self._alibi_slopes))
         else:
             out = ulysses_attention(flash_attention, q, k, v, causal=True)
         out = out.reshape(B, S, c.num_heads * c.head_dim)
@@ -291,6 +316,8 @@ class TransformerLM:
         x = self._wte(params["wte"], input_ids)
         if self._wpe is not None:
             x = x + self._wpe(params["wpe"], positions + c.position_offset)
+        if self._ln_emb is not None:
+            x = self._ln_emb(params["ln_emb"], x)
         x = _c(x.astype(c.dtype), ACT_SPEC)
 
         block_fn = self._block_fn

@@ -158,12 +158,16 @@ def silu(x: jax.Array) -> jax.Array:
     return jax.nn.silu(x)
 
 
-def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float = 10000.0) -> jax.Array:
+def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float = 10000.0,
+                     style: str = "half") -> jax.Array:
     """Apply rotary position embeddings.
 
     x: [..., seq, heads, head_dim]; positions: [..., seq].
-    TPU-native equivalent of the reference's ``apply_rotary_pos_emb.cu``; left
-    to XLA fusion (elementwise, fuses into the surrounding matmuls).
+    ``style='half'`` pairs dim i with dim i+half (llama/gpt-neox "rotate
+    half"); ``style='interleaved'`` pairs adjacent dims (2i, 2i+1) — gpt-j's
+    "rotate every two". TPU-native equivalent of the reference's
+    ``apply_rotary_pos_emb.cu``; left to XLA fusion (elementwise, fuses into
+    the surrounding matmuls).
     """
     head_dim = x.shape[-1]
     half = head_dim // 2
@@ -171,6 +175,12 @@ def rotary_embedding(x: jax.Array, positions: jax.Array, theta: float = 10000.0)
     angles = positions[..., :, None].astype(jnp.float32) * freqs  # [..., seq, half]
     cos = jnp.cos(angles)[..., :, None, :]  # broadcast over heads
     sin = jnp.sin(angles)[..., :, None, :]
+    if style == "interleaved":
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        y1 = x1 * cos - x2 * sin
+        y2 = x2 * cos + x1 * sin
+        # re-interleave: [..., half, 2] -> [..., head_dim]
+        return jnp.stack([y1, y2], axis=-1).reshape(x.shape).astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     y1 = x1 * cos - x2 * sin
     y2 = x2 * cos + x1 * sin

@@ -16,8 +16,27 @@ import jax
 import jax.numpy as jnp
 
 
+def alibi_slopes(num_heads: int):
+    """Per-head ALiBi slopes (Press et al.; matches HF BLOOM's
+    ``build_alibi_tensor`` closest-power-of-2 construction)."""
+    import math
+
+    import numpy as np
+
+    closest = 2 ** math.floor(math.log2(num_heads))
+    base = 2.0 ** (-(2.0 ** -(math.log2(closest) - 3)))
+    slopes = base ** np.arange(1, closest + 1, dtype=np.float32)
+    if closest != num_heads:
+        extra_base = 2.0 ** (-(2.0 ** -(math.log2(2 * closest) - 3)))
+        n_extra = min(closest, num_heads - closest)
+        extra = extra_base ** np.arange(1, 1 + 2 * n_extra, 2, dtype=np.float32)
+        slopes = np.concatenate([slopes, extra])
+    return slopes.astype(np.float32)
+
+
 def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
-                   scale: Optional[float], segment_ids: Optional[jax.Array]) -> jax.Array:
+                   scale: Optional[float], segment_ids: Optional[jax.Array],
+                   alibi: Optional[jax.Array] = None) -> jax.Array:
     """Reference-semantics attention in pure XLA, GQA-NATIVE: K/V keep
     their kv_heads — query heads are grouped for the contractions, so
     grouped-query models never materialize a repeated KV.
@@ -38,9 +57,15 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     vt = v.transpose(0, 2, 1, 3)
     logits = jnp.einsum("bhgqd,bhkd->bhgqk", qt, kt,
                         preferred_element_type=jnp.float32) * scale
+    q_pos = jnp.arange(Sq)[:, None] + (k_len - Sq)
+    k_pos = jnp.arange(k_len)[None, :]
+    if alibi is not None:
+        # bias = slope * (key_pos - query_pos): row-shifted form of HF
+        # BLOOM's slope * key_pos (softmax is shift-invariant per row)
+        rel = (k_pos - q_pos).astype(jnp.float32)  # [Sq, K]
+        logits = logits + alibi.reshape(kvH, G)[None, :, :, None, None] * rel
     if causal:
-        q_pos = jnp.arange(Sq)[:, None] + (k_len - Sq)
-        mask = q_pos >= jnp.arange(k_len)[None, :]
+        mask = q_pos >= k_pos
         logits = jnp.where(mask[None, None, None], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -79,17 +104,21 @@ def flash_attention(q: jax.Array,
                     v: jax.Array,
                     causal: bool = True,
                     scale: Optional[float] = None,
-                    segment_ids: Optional[jax.Array] = None) -> jax.Array:
+                    segment_ids: Optional[jax.Array] = None,
+                    alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
     """Multi-head attention, [B, S, H, D] layout, GQA-aware.
 
     Dispatches to the Pallas TPU flash kernel when shapes allow, else XLA.
     The XLA path consumes GQA natively; the Pallas stock kernel needs
     matched head counts, so only there K/V are broadcast up.
+    ``alibi_slopes`` [num_heads] adds the ALiBi positional bias (bloom) —
+    XLA path only.
     """
     head_dim = q.shape[-1]
     # head_dim 64 (gpt2) is supported by the stock kernel — Mosaic pads the
     # lane dim; requiring %128 hid the Pallas path from the benched model
-    if (_pallas_flash_available() and segment_ids is None and head_dim % 64 == 0
+    if (_pallas_flash_available() and segment_ids is None
+            and alibi_slopes is None and head_dim % 64 == 0
             and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0):
         num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
         if num_kv_heads != num_q_heads:
@@ -105,7 +134,7 @@ def flash_attention(q: jax.Array,
             causal=causal, sm_scale=sm_scale)
         return out.transpose(0, 2, 1, 3)
     _log_path_once("xla")
-    return _xla_attention(q, k, v, causal, scale, segment_ids)
+    return _xla_attention(q, k, v, causal, scale, segment_ids, alibi_slopes)
 
 
 @functools.lru_cache(None)

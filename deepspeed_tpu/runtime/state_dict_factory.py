@@ -17,7 +17,8 @@ by architecture into the ``TransformerLM`` scanned-layer pytree, and placed
 reference's per-rank slice loading. Explicit per-rank slicing for
 multi-host loading is available via ``module_inject.auto_tp.shard_param_tree``.
 
-Supported architectures: gpt2, llama, mistral, mixtral, opt, phi, falcon.
+Supported architectures: gpt2, llama, mistral, mixtral, opt, phi, falcon,
+bloom, gpt_neox, gptj.
 """
 
 from __future__ import annotations
@@ -240,6 +241,80 @@ def _falcon_config(hf: Dict[str, Any]) -> Dict[str, Any]:
             linear_bias=bool(hf.get("bias", False)),
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             tie_embeddings=hf.get("tie_word_embeddings", True))
+
+
+def _bloom_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    h = hf.get("hidden_size") or hf["n_embed"]
+    return dict(
+            vocab_size=hf["vocab_size"],
+            # ALiBi extrapolates; max_seq_len only sizes KV/serving buffers
+            max_seq_len=hf.get("seq_length", 2048),
+            num_layers=hf.get("n_layer") or hf["num_hidden_layers"],
+            num_heads=hf.get("n_head") or hf["num_attention_heads"],
+            hidden_size=h,
+            intermediate_size=4 * h,
+            # BloomGelu is the tanh approximation
+            activation="gelu", norm="layernorm", position="alibi",
+            embedding_norm=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", True))
+
+
+def _map_activation(name: str) -> str:
+    """HF activation name → ours; raise on anything we'd silently get wrong.
+    HF ACT2FN "gelu" is the exact erf form; "gelu_new"/tanh variants are the
+    approximation (see models/transformer.py ACTIVATIONS)."""
+    table = {"gelu": "gelu_exact", "gelu_new": "gelu",
+             "gelu_pytorch_tanh": "gelu", "gelu_fast": "gelu",
+             "relu": "relu"}
+    if name not in table:
+        raise ValueError(f"unsupported activation {name!r} "
+                         f"(supported: {sorted(table)})")
+    return table[name]
+
+
+def _gpt_neox_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    head_dim = hf["hidden_size"] // hf["num_attention_heads"]
+    parallel = hf.get("use_parallel_residual", True)
+    return dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            activation=_map_activation(hf.get("hidden_act", "gelu")),
+            norm="layernorm", position="rope",
+            rope_theta=hf.get("rotary_emb_base", 10000.0),
+            rope_dim=int(head_dim * hf.get("rotary_pct", 0.25)),
+            # both norms exist in the checkpoint either way; when parallel,
+            # they feed the two branches from the block input (our
+            # parallel_norms form)
+            parallel_block=parallel, parallel_norms=parallel,
+            # attention_bias only strips the attn projections' biases — HF
+            # GPTNeoXMLP keeps its biases unconditionally
+            attn_bias=bool(hf.get("attention_bias", True)),
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False))
+
+
+def _gptj_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    return dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("n_positions", 2048),
+            num_layers=hf["n_layer"],
+            num_heads=hf["n_head"],
+            hidden_size=hf["n_embd"],
+            intermediate_size=hf.get("n_inner") or 4 * hf["n_embd"],
+            activation=_map_activation(hf.get("activation_function", "gelu_new")),
+            norm="layernorm", position="rope",
+            # config.json may omit keys equal to HF defaults; GPTJConfig's
+            # rotary_dim default is 64, NOT full-head
+            rope_dim=hf.get("rotary_dim", 64) or 64,
+            rope_style="interleaved",
+            parallel_block=True, attn_bias=False, lm_head_bias=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", False))
 
 
 # ---------------------------------------------------------------------------
@@ -479,6 +554,114 @@ def _falcon_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[st
     return params
 
 
+def _split_interleaved_qkv(sd, pattern: str, cfg: TransformerConfig,
+                           bias: bool) -> Dict[str, Dict[str, np.ndarray]]:
+    """Split a fused ``query_key_value`` whose rows are laid out
+    [num_heads, 3, head_dim] — the BLOOM/GPT-NeoX per-head interleave (HF
+    reshapes the fused output to [..., nh, 3*hd] before slicing roles) —
+    into separate q/k/v projections in our [in, out] layout."""
+    L, H = cfg.num_layers, cfg.hidden_size
+    nh, hd = cfg.num_heads, cfg.head_dim
+    T = np.transpose
+    out = {"q_proj": {}, "k_proj": {}, "v_proj": {}}
+    parts = ["kernel", "bias"] if bias else ["kernel"]
+    for part in parts:
+        suffix = "weight" if part == "kernel" else "bias"
+        qs, ks, vs = [], [], []
+        for i in range(L):
+            w = sd.pop(pattern.format(i=i) + "." + suffix)
+            g = w.reshape(nh, 3, hd, *w.shape[1:])  # rows: [nh, 3, hd]
+            q, k, v = g[:, 0], g[:, 1], g[:, 2]
+            if part == "kernel":
+                qs.append(T(q.reshape(nh * hd, H)))
+                ks.append(T(k.reshape(nh * hd, H)))
+                vs.append(T(v.reshape(nh * hd, H)))
+            else:
+                qs.append(q.reshape(nh * hd))
+                ks.append(k.reshape(nh * hd))
+                vs.append(v.reshape(nh * hd))
+        out["q_proj"][part] = np.stack(qs)
+        out["k_proj"][part] = np.stack(ks)
+        out["v_proj"][part] = np.stack(vs)
+    return out
+
+
+def _bloom_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF BLOOM: transformer.* naming, fused per-head-interleaved QKV,
+    word_embeddings_layernorm after the embedding, biases everywhere."""
+    sd = _strip_prefix(sd, "transformer.")
+    L = cfg.num_layers
+    blocks = {
+        "ln_1": _ln_stack(sd, "h.{i}.input_layernorm", L),
+        "ln_2": _ln_stack(sd, "h.{i}.post_attention_layernorm", L),
+        **_split_interleaved_qkv(sd, "h.{i}.self_attention.query_key_value",
+                                 cfg, bias=True),
+        "o_proj": _lin_stack(sd, "h.{i}.self_attention.dense", L),
+        "fc_in": _lin_stack(sd, "h.{i}.mlp.dense_h_to_4h", L),
+        "fc_out": _lin_stack(sd, "h.{i}.mlp.dense_4h_to_h", L),
+    }
+    return {
+        "wte": {"embedding": sd["word_embeddings.weight"]},
+        "ln_emb": {"scale": sd["word_embeddings_layernorm.weight"],
+                   "bias": sd["word_embeddings_layernorm.bias"]},
+        "ln_f": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        "blocks": blocks,
+    }
+
+
+def _gpt_neox_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF GPT-NeoX: gpt_neox.* naming, fused per-head-interleaved QKV, two
+    norms per block, untied embed_out head."""
+    sd = _strip_prefix(sd, "gpt_neox.")
+    L = cfg.num_layers
+    use_bias = bool(cfg.attn_bias if cfg.attn_bias is not None else True)
+    blocks = {
+        "ln_1": _ln_stack(sd, "layers.{i}.input_layernorm", L),
+        "ln_2": _ln_stack(sd, "layers.{i}.post_attention_layernorm", L),
+        **_split_interleaved_qkv(sd, "layers.{i}.attention.query_key_value",
+                                 cfg, bias=use_bias),
+        "o_proj": _lin_stack(sd, "layers.{i}.attention.dense", L, bias=use_bias),
+        "fc_in": _lin_stack(sd, "layers.{i}.mlp.dense_h_to_4h", L),
+        "fc_out": _lin_stack(sd, "layers.{i}.mlp.dense_4h_to_h", L),
+    }
+    params = {
+        "wte": {"embedding": sd["embed_in.weight"]},
+        "ln_f": {"scale": sd["final_layer_norm.weight"],
+                 "bias": sd["final_layer_norm.bias"]},
+        "blocks": blocks,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.transpose(
+            sd.get("embed_out.weight", sd["embed_in.weight"]))}
+    return params
+
+
+def _gptj_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF GPT-J: transformer.* naming, unfused BIAS-FREE attention linears,
+    biased MLP, untied lm_head WITH bias."""
+    sd = _strip_prefix(sd, "transformer.")
+    L = cfg.num_layers
+    blocks = {
+        "ln_1": _ln_stack(sd, "h.{i}.ln_1", L),
+        "q_proj": _lin_stack(sd, "h.{i}.attn.q_proj", L, bias=False),
+        "k_proj": _lin_stack(sd, "h.{i}.attn.k_proj", L, bias=False),
+        "v_proj": _lin_stack(sd, "h.{i}.attn.v_proj", L, bias=False),
+        "o_proj": _lin_stack(sd, "h.{i}.attn.out_proj", L, bias=False),
+        "fc_in": _lin_stack(sd, "h.{i}.mlp.fc_in", L),
+        "fc_out": _lin_stack(sd, "h.{i}.mlp.fc_out", L),
+    }
+    params = {
+        "wte": {"embedding": sd["wte.weight"]},
+        "ln_f": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        "blocks": blocks,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": np.transpose(sd["lm_head.weight"])}
+        if cfg.lm_head_bias:
+            params["lm_head"]["bias"] = sd["lm_head.bias"]
+    return params
+
+
 def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
                             sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
     from ..models.registry import get_architecture
@@ -494,6 +677,9 @@ def _register_builtins() -> None:
     register_architecture("opt", _opt_config, _opt_params)
     register_architecture("phi", _phi_config, _phi_params)
     register_architecture("falcon", _falcon_config, _falcon_params)
+    register_architecture("bloom", _bloom_config, _bloom_params)
+    register_architecture("gpt_neox", _gpt_neox_config, _gpt_neox_params)
+    register_architecture("gptj", _gptj_config, _gptj_params)
 
 
 _register_builtins()

@@ -87,7 +87,11 @@ def ulysses_attention(attn_fn: Callable, q: jax.Array, k: jax.Array, v: jax.Arra
     """
     topo = topo_mod.get_topology() if topo_mod.is_initialized() else None
     sp = topo.sequence_parallel_size if topo is not None else 1
-    if sp > 1 and kwargs.get("segment_ids") is None:
+    # alibi_slopes is per-GLOBAL-head; inside the shard_map form it would be
+    # closure-captured whole while heads are scattered, biasing every shard
+    # with the wrong slope slice — use the constraint form (like segment_ids)
+    if (sp > 1 and kwargs.get("segment_ids") is None
+            and kwargs.get("alibi_slopes") is None):
         tp = topo.model_parallel_size
         hq, hkv, s = q.shape[2], k.shape[2], q.shape[1]
         if hq % (tp * sp) == 0 and hkv % (tp * sp) == 0 and s % sp == 0:

@@ -109,6 +109,74 @@ def falcon_bias_ckpt(tmp_path_factory):
     return path, m
 
 
+@pytest.fixture(scope="module")
+def bloom_ckpt(tmp_path_factory):
+    """alibi bias + word_embeddings_layernorm + per-head-interleaved QKV."""
+    path = tmp_path_factory.mktemp("hf_bloom")
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4)
+    torch.manual_seed(7)
+    m = transformers.BloomForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def gpt_neox_ckpt(tmp_path_factory):
+    """parallel residual with two norms + partial rotary + untied embed_out."""
+    path = tmp_path_factory.mktemp("hf_neox")
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+        max_position_embeddings=64, use_parallel_residual=True)
+    torch.manual_seed(8)
+    m = transformers.GPTNeoXForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def gpt_neox_seq_ckpt(tmp_path_factory):
+    """pythia-70m-style sequential residual (use_parallel_residual=False)."""
+    path = tmp_path_factory.mktemp("hf_neox_seq")
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+        max_position_embeddings=64, use_parallel_residual=False)
+    torch.manual_seed(9)
+    m = transformers.GPTNeoXForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def gpt_neox_nobias_ckpt(tmp_path_factory):
+    """attention_bias=False strips ONLY the attn projections' biases — the
+    MLP keeps its biases (HF GPTNeoXMLP is unconditionally biased)."""
+    path = tmp_path_factory.mktemp("hf_neox_nobias")
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=256,
+        num_hidden_layers=2, num_attention_heads=4, rotary_pct=0.25,
+        max_position_embeddings=64, attention_bias=False)
+    torch.manual_seed(11)
+    m = transformers.GPTNeoXForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def gptj_ckpt(tmp_path_factory):
+    """interleaved partial rotary + bias-free attention + biased lm_head."""
+    path = tmp_path_factory.mktemp("hf_gptj")
+    cfg = transformers.GPTJConfig(
+        vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+        rotary_dim=8)
+    torch.manual_seed(10)
+    m = transformers.GPTJForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
 def _ref_logits(m, ids):
     with torch.no_grad():
         return m(torch.tensor(ids)).logits.float().numpy()
@@ -122,7 +190,10 @@ def _our_logits(path, ids, **overrides):
 
 @pytest.mark.parametrize("ckpt", ["gpt2_ckpt", "llama_ckpt", "opt_ckpt",
                                   "phi_ckpt", "falcon_mqa_ckpt",
-                                  "falcon_gqa_ckpt", "falcon_bias_ckpt"])
+                                  "falcon_gqa_ckpt", "falcon_bias_ckpt",
+                                  "bloom_ckpt", "gpt_neox_ckpt",
+                                  "gpt_neox_seq_ckpt", "gpt_neox_nobias_ckpt",
+                                  "gptj_ckpt"])
 def test_hf_logits_parity(request, eight_devices, ckpt):
     """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
     path, m = request.getfixturevalue(ckpt)
@@ -179,6 +250,21 @@ def test_build_hf_engine_v2_greedy_matches_hf(request, eight_devices, ckpt):
                               kv_cache_dtype=jnp.float32, num_kv_blocks=64))
     out = generate(eng, [prompt], max_new_tokens=6)[0]
     np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_v2_engine_rejects_alibi_cleanly(eight_devices, bloom_ckpt):
+    """The ragged paged path has no ALiBi bias yet — building it for a bloom
+    checkpoint must fail loudly (not silently mis-serve), while v1
+    init_inference works."""
+    path, m = bloom_ckpt
+    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
+    with pytest.raises(ValueError, match="alibi"):
+        build_hf_engine(str(path))
+    engine = deepspeed_tpu.init_inference(
+        model_path=str(path), config={"dtype": jnp.float32})
+    ids = np.random.default_rng(5).integers(0, 128, size=(1, 12))
+    np.testing.assert_allclose(np.asarray(engine.forward(ids)),
+                               _ref_logits(m, ids), rtol=2e-4, atol=2e-4)
 
 
 def test_bf16_checkpoint_loads_without_upcast(tmp_path, llama_ckpt):

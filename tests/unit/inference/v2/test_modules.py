@@ -60,11 +60,12 @@ def test_custom_registration():
 
 def test_architecture_registry_builtin():
     assert supported_architectures() == \
-        ["falcon", "gpt2", "llama", "mistral", "mixtral", "opt", "phi"]
+        ["bloom", "falcon", "gpt2", "gpt_neox", "gptj", "llama", "mistral",
+         "mixtral", "opt", "phi"]
     spec = get_architecture("falcon")
     cfg = spec.config_fn({"model_type": "falcon", "vocab_size": 128,
                           "hidden_size": 64, "num_hidden_layers": 2,
                           "num_attention_heads": 4})
     assert cfg["parallel_block"] is True
     with pytest.raises(ValueError, match="unsupported model_type"):
-        get_architecture("bloom")
+        get_architecture("mamba")

@@ -9,7 +9,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models import (falcon_model, gpt2_model, llama_model,
+from deepspeed_tpu.models import (bloom_model, falcon_model, gpt2_model,
+                                  gpt_neox_model, gptj_model, llama_model,
                                   mixtral_model, opt_model, phi_model)
 
 TINY = dict(max_seq_len=32, vocab_size=128, remat=False, dtype=jnp.float32)
@@ -24,6 +25,12 @@ FAMILIES = {
     # falcon-40b "new decoder": per-branch parallel norms + grouped KV
     "falcon-new": lambda: falcon_model("falcon-tiny", num_kv_heads=2,
                                        parallel_norms=True, **TINY),
+    # alibi bias + embedding layernorm
+    "bloom": lambda: bloom_model("bloom-tiny", **TINY),
+    # two-norm parallel residual + partial rotary
+    "gpt-neox": lambda: gpt_neox_model("gpt-neox-tiny", **TINY),
+    # interleaved partial rotary + bias-free attention
+    "gptj": lambda: gptj_model("gptj-tiny", **TINY),
 }
 
 

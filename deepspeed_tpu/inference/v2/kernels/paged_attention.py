@@ -72,12 +72,17 @@ def _gqa_logits(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
 
 
 def _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables,
-                      scale: float) -> jax.Array:
+                      scale: float, alibi_slopes=None) -> jax.Array:
     k = _gather_pages(k_pages, block_tables)
     v = _gather_pages(v_pages, block_tables)
     B, kvH, C, D = k.shape
     H = q.shape[1]
     logits = _gqa_logits(q, k, scale)                   # [B, H, C]
+    if alibi_slopes is not None:
+        # decode query sits at absolute position context_lens-1; keys at c
+        rel = (jnp.arange(C)[None, :]
+               - (context_lens[:, None] - 1)).astype(jnp.float32)  # [B, C]
+        logits = logits + alibi_slopes[None, :, None] * rel[:, None, :]
     mask = jnp.arange(C)[None, :] < context_lens[:, None]
     logits = jnp.where(mask[:, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -92,16 +97,20 @@ def paged_decode_attention(q: jax.Array,
                            context_lens: jax.Array,
                            block_tables: jax.Array,
                            scale: Optional[float] = None,
-                           use_pallas: Optional[bool] = None) -> jax.Array:
+                           use_pallas: Optional[bool] = None,
+                           alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
     """q [B, H, D]; returns [B, H, D].
 
     ``context_lens[b]`` counts tokens *including* the one just written at
-    position ``context_lens[b]-1``.
+    position ``context_lens[b]-1``. ``alibi_slopes`` [H] adds the ALiBi
+    bias (bloom) — XLA path only.
     """
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     if use_pallas is None:
         use_pallas = _pallas_paged_available()
+    if alibi_slopes is not None:
+        use_pallas = False  # stock kernel has no bias input
     if use_pallas:
         from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention as pa_kernel
         pages_per_block = min(8, block_tables.shape[1])
@@ -124,7 +133,8 @@ def paged_decode_attention(q: jax.Array,
                     f"paged_decode_attention: Pallas kernel rejected shapes "
                     f"q={q.shape} pages={k_pages.shape} "
                     f"({type(e).__name__}: {e}); using XLA gather fallback")
-    return _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables, scale)
+    return _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables,
+                             scale, alibi_slopes)
 
 
 _KERNEL_FALLBACK_WARNED = False
@@ -135,7 +145,8 @@ def ragged_chunk_attention(q: jax.Array,
                            v_pages: jax.Array,
                            history_lens: jax.Array,
                            block_tables: jax.Array,
-                           scale: Optional[float] = None) -> jax.Array:
+                           scale: Optional[float] = None,
+                           alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
     """Batched SplitFuse attention: S sequences × T chunk tokens each.
 
     The one-program form of the reference's ``build_atoms`` +
@@ -161,6 +172,11 @@ def ragged_chunk_attention(q: jax.Array,
     logits = jnp.einsum("skgtd,skcd->skgtc", qg, k,
                         preferred_element_type=jnp.float32) * scale
     pos_q = history_lens[:, None] + jnp.arange(T)[None, :]        # [S, T]
+    if alibi_slopes is not None:
+        rel = (jnp.arange(C)[None, None, :]
+               - pos_q[:, :, None]).astype(jnp.float32)           # [S, T, C]
+        logits = logits + (alibi_slopes.reshape(kvH, group)[None, :, :, None, None]
+                           * rel[:, None, None])
     allowed = jnp.arange(C)[None, None, :] <= pos_q[:, :, None]   # [S, T, C]
     logits = jnp.where(allowed[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -172,7 +188,8 @@ def chunk_prefill_attention(q: jax.Array,
                             k_ctx: jax.Array,
                             v_ctx: jax.Array,
                             history_len: jax.Array,
-                            scale: Optional[float] = None) -> jax.Array:
+                            scale: Optional[float] = None,
+                            alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
     """SplitFuse prefill-chunk attention for ONE sequence.
 
     q [T, H, D] — chunk queries at absolute positions history_len + i.
@@ -187,7 +204,12 @@ def chunk_prefill_attention(q: jax.Array,
     qg = q.reshape(T, kvH, group, D).transpose(1, 2, 0, 3)   # [kvH, g, T, D]
     logits = jnp.einsum("kgtd,kcd->kgtc", qg, k_ctx,
                         preferred_element_type=jnp.float32) * scale
-    allowed = jnp.arange(C)[None, :] <= (history_len + jnp.arange(T))[:, None]
+    pos_q = history_len + jnp.arange(T)                          # [T]
+    if alibi_slopes is not None:
+        rel = (jnp.arange(C)[None, :] - pos_q[:, None]).astype(jnp.float32)
+        logits = logits + (alibi_slopes.reshape(kvH, group)[:, :, None, None]
+                           * rel[None, None])
+    allowed = jnp.arange(C)[None, :] <= pos_q[:, None]
     logits = jnp.where(allowed[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("kgtc,kcd->kgtd", probs, v_ctx)

@@ -48,12 +48,10 @@ class RaggedInferenceModel:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.use_pallas = use_pallas
         c = self.config
-        if c.position == "alibi":
-            raise ValueError(
-                "alibi positional bias (bloom) is not supported by the "
-                "ragged paged-attention path yet; use inference v1 "
-                "(init_inference) for alibi models")
-        assert c.max_seq_len <= max_blocks_per_seq * block_size or True
+        # bloom: per-head ALiBi bias threaded into every paged-attention
+        # program (forces the XLA path; the stock Pallas kernel has no bias)
+        self._alibi = (jnp.asarray(model._alibi_slopes)
+                       if model._alibi_slopes is not None else None)
 
     # -- shared pieces ------------------------------------------------------
     def _embed(self, params: Params, tokens: jax.Array, positions: jax.Array) -> jax.Array:
@@ -200,11 +198,11 @@ class RaggedInferenceModel:
             if Bd:
                 outs.append(paged_decode_attention(
                     q[:Bd], k_l, v_l, d_context_lens, d_block_tables,
-                    use_pallas=self.use_pallas))
+                    use_pallas=self.use_pallas, alibi_slopes=self._alibi))
             if Sp:
                 op = ragged_chunk_attention(
                     q[Bd:].reshape(Sp, T, *q.shape[1:]), k_l, v_l,
-                    p_history, p_block_tables)
+                    p_history, p_block_tables, alibi_slopes=self._alibi)
                 outs.append(op.reshape(Sp * T, *op.shape[2:]))
             return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
 
@@ -241,7 +239,8 @@ class RaggedInferenceModel:
             k_ctx = kf[:, ctx_idx, :]
             vf = v_l.reshape(v_l.shape[0], -1, v_l.shape[-1])
             v_ctx = vf[:, ctx_idx, :]
-            return chunk_prefill_attention(q, k_ctx, v_ctx, history_len)
+            return chunk_prefill_attention(q, k_ctx, v_ctx, history_len,
+                                           alibi_slopes=self._alibi)
 
         x, k_pages, v_pages = self._layer_loop(
             params, k_pages, v_pages, x, attn, write_idx, positions)
@@ -281,7 +280,8 @@ class RaggedInferenceModel:
             def attn(q, k_l, v_l):
                 return paged_decode_attention(q, k_l, v_l, pos_c + 1,
                                               block_tables,
-                                              use_pallas=self.use_pallas)
+                                              use_pallas=self.use_pallas,
+                                              alibi_slopes=self._alibi)
 
             x, k_pages, v_pages = self._layer_loop(
                 params, k_pages, v_pages, x, attn, write_idx, positions)
@@ -314,7 +314,8 @@ class RaggedInferenceModel:
 
         def attn(q, k_l, v_l):
             return paged_decode_attention(q, k_l, v_l, context_lens, block_tables,
-                                          use_pallas=self.use_pallas)
+                                          use_pallas=self.use_pallas,
+                                          alibi_slopes=self._alibi)
 
         x, k_pages, v_pages = self._layer_loop(
             params, k_pages, v_pages, x, attn, write_idx, positions)

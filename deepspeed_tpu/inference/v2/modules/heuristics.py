@@ -23,6 +23,7 @@ def _context(engine_config, model_config,
         "quantization_mode": getattr(engine_config, "quantization_mode", None),
         "head_dim": getattr(model_config, "head_dim", None),
         "kv_heads": getattr(model_config, "kv_heads", None),
+        "position": getattr(model_config, "position", None),
     }
 
 

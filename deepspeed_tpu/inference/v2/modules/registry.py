@@ -77,6 +77,8 @@ def _pallas_paged_supported(ctx: Dict[str, Any]) -> bool:
         return False
     if ctx.get("backend", jax.default_backend()) != "tpu":
         return False
+    if ctx.get("position") == "alibi":
+        return False  # stock kernel has no bias input (bloom → XLA path)
     return _paged_kernel_importable()
 
 

@@ -232,7 +232,8 @@ def test_shard_param_tree_matches_device_slices(eight_devices, llama_ckpt):
 
 
 @pytest.mark.parametrize("ckpt", ["llama_ckpt", "opt_ckpt", "phi_ckpt",
-                                  "falcon_gqa_ckpt"])
+                                  "falcon_gqa_ckpt", "bloom_ckpt",
+                                  "gpt_neox_ckpt", "gptj_ckpt"])
 def test_build_hf_engine_v2_greedy_matches_hf(request, eight_devices, ckpt):
     """The ragged serving engine loaded from the checkpoint must greedy-decode
     the same tokens as HF ``generate`` — across the decoder family matrix."""
@@ -252,14 +253,9 @@ def test_build_hf_engine_v2_greedy_matches_hf(request, eight_devices, ckpt):
     np.testing.assert_array_equal(np.asarray(out), ref)
 
 
-def test_v2_engine_rejects_alibi_cleanly(eight_devices, bloom_ckpt):
-    """The ragged paged path has no ALiBi bias yet — building it for a bloom
-    checkpoint must fail loudly (not silently mis-serve), while v1
-    init_inference works."""
+def test_v1_inference_alibi(eight_devices, bloom_ckpt):
+    """v1 init_inference on an ALiBi model reproduces the HF forward."""
     path, m = bloom_ckpt
-    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
-    with pytest.raises(ValueError, match="alibi"):
-        build_hf_engine(str(path))
     engine = deepspeed_tpu.init_inference(
         model_path=str(path), config={"dtype": jnp.float32})
     ids = np.random.default_rng(5).integers(0, 128, size=(1, 12))

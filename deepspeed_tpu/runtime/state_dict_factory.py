@@ -23,6 +23,7 @@ bloom, gpt_neox, gptj.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import struct
@@ -666,6 +667,181 @@ def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
                             sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
     from ..models.registry import get_architecture
     return get_architecture(model_type).params_fn(cfg, sd)
+
+
+# ---------------------------------------------------------------------------
+# Megatron sharded checkpoints (reference MegatronSDLoader,
+# state_dict_factory.py:190)
+# ---------------------------------------------------------------------------
+
+class MegatronSDLoader:
+    """Merge TP-sharded Megatron GPT checkpoints into one full state dict.
+
+    Counterpart of the reference ``MegatronSDLoader``: given the ``mp_rank_XX``
+    shard files of a Megatron-style GPT checkpoint, reassemble the full
+    (tp=1) flat state dict — column-parallel weights (``query_key_value``,
+    ``dense_h_to_4h``, ``word_embeddings``) concatenate on axis 0 with the
+    three historical Q/K/V row layouts handled per ``checkpoint_version``
+    (0: ``[3, np, hn]``; 1.0: ``[np, hn, 3]``; 2.0: ``[np, 3, hn]``), and
+    row-parallel weights (``attention.dense``, ``dense_4h_to_h``) on axis 1.
+    The reference also re-splits for a target TP degree; here resharding is
+    the placement layer's job (``AutoTP.build_specs`` /
+    ``module_inject.auto_tp.shard_param_tree``), so merge is enough.
+    """
+
+    COLUMN_PARALLEL = ("attention.query_key_value", "mlp.dense_h_to_4h")
+    ROW_PARALLEL = ("attention.dense.weight", "mlp.dense_4h_to_h.weight")
+
+    def __init__(self, ckpt_list, version: Optional[float] = None):
+        if isinstance(ckpt_list, (str, os.PathLike)):
+            import glob
+            root = ckpt_list
+            files = sorted(glob.glob(os.path.join(root, "mp_rank_*")))
+            ckpt_list = [os.path.join(f, "model_optim_rng.pt")
+                         if os.path.isdir(f) else f for f in files]
+            if not ckpt_list:
+                raise FileNotFoundError(f"no mp_rank_* shards under {root!r}")
+        self.ckpt_list = list(ckpt_list)
+        self.version = version
+
+    @staticmethod
+    def _flatten(sd) -> Dict[str, np.ndarray]:
+        """Accept the flat DeepSpeed-Megatron layout or one nested under
+        'model'; drop non-tensor bookkeeping entries."""
+        if "model" in sd and isinstance(sd["model"], dict):
+            sd = sd["model"]
+        return {k: v for k, v in sd.items()
+                if hasattr(v, "shape")}  # skip rng states / iteration etc.
+
+    def _load_shards(self):
+        import torch
+
+        shards, version = [], self.version
+        for path in self.ckpt_list:
+            raw = torch.load(path, map_location="cpu", weights_only=False)
+            if version is None:
+                version = raw.get("checkpoint_version")
+            shards.append({k: _torch_to_numpy(v)
+                           for k, v in self._flatten(raw).items()})
+        return shards, (version if version is not None else 2.0)
+
+    @staticmethod
+    def merge_query_key_value(params, version: float) -> np.ndarray:
+        """Merge per-partition fused QKV (reference ``merge_query_key_value``):
+        version 0 is role-major per shard, so roles concatenate across
+        shards; 1.0/2.0 are head-major, a plain concat."""
+        if version == 0:
+            parts = [np.split(p, 3, axis=0) for p in params]
+            return np.concatenate(
+                [np.concatenate([p[i] for p in parts], axis=0)
+                 for i in range(3)], axis=0)
+        if version in (1.0, 2.0):
+            return np.concatenate(params, axis=0)
+        raise ValueError(f"unsupported Megatron checkpoint version {version}")
+
+    def merge_state_dict(self) -> Tuple[Dict[str, np.ndarray], float]:
+        shards, version = self._load_shards()
+        if len(shards) == 1:
+            return dict(shards[0]), version
+        out: Dict[str, np.ndarray] = {}
+        for key in shards[0]:
+            vals = [s[key] for s in shards]
+            if any(p in key for p in self.COLUMN_PARALLEL):
+                if "query_key_value" in key:
+                    out[key] = self.merge_query_key_value(vals, version)
+                else:
+                    out[key] = np.concatenate(vals, axis=0)
+            elif any(p in key for p in self.ROW_PARALLEL):
+                out[key] = np.concatenate(vals, axis=1)
+            elif "word_embeddings.weight" in key:
+                out[key] = np.concatenate(vals, axis=0)  # vocab-parallel
+            else:
+                out[key] = vals[0]  # replicated
+        return out, version
+
+
+def _megatron_split_qkv(w: np.ndarray, cfg: TransformerConfig,
+                        version: float):
+    """Full merged fused-QKV rows → (q, k, v) each [nh*hn(, h)] rows."""
+    nh, hn = cfg.num_heads, cfg.head_dim
+    tail = w.shape[1:]
+    if version == 0:         # [3, nh, hn]
+        g = w.reshape(3, nh, hn, *tail)
+        q, k, v = g[0], g[1], g[2]
+    elif version == 1.0:     # [nh, hn, 3]
+        g = w.reshape(nh, hn, 3, *tail)
+        q = np.ascontiguousarray(np.take(g, 0, axis=2))
+        k = np.ascontiguousarray(np.take(g, 1, axis=2))
+        v = np.ascontiguousarray(np.take(g, 2, axis=2))
+    else:                    # 2.0: [nh, 3, hn]
+        g = w.reshape(nh, 3, hn, *tail)
+        q, k, v = g[:, 0], g[:, 1], g[:, 2]
+    return (x.reshape(nh * hn, *tail) for x in (q, k, v))
+
+
+def load_megatron_model(ckpt, config: TransformerConfig,
+                        version: Optional[float] = None,
+                        dtype=None) -> Tuple[TransformerLM, Dict[str, Any]]:
+    """Megatron GPT shard files (dir with ``mp_rank_*`` or explicit list) +
+    a :class:`TransformerConfig` → (TransformerLM, host param pytree).
+
+    The model dims come from ``config`` (Megatron checkpoints don't carry a
+    portable config.json); the checkpoint supplies the weights. Megatron
+    pads the vocab-parallel embedding — rows beyond ``config.vocab_size``
+    are trimmed, mirroring the reference loader.
+    """
+    loader = MegatronSDLoader(ckpt, version)
+    sd, ver = loader.merge_state_dict()
+    cfg = config if dtype is None else dataclasses.replace(config, dtype=dtype)
+    L = cfg.num_layers
+    T = np.transpose
+
+    qkv = {"q_proj": {}, "k_proj": {}, "v_proj": {}}
+    for part, suffix in (("kernel", "weight"), ("bias", "bias")):
+        qs, ks, vs = [], [], []
+        for i in range(L):
+            w = sd.pop(f"transformer.layers.{i}.attention.query_key_value.{suffix}")
+            q, k, v = _megatron_split_qkv(w, cfg, ver)
+            qs.append(T(q) if part == "kernel" else q)
+            ks.append(T(k) if part == "kernel" else k)
+            vs.append(T(v) if part == "kernel" else v)
+        qkv["q_proj"][part] = np.stack(qs)
+        qkv["k_proj"][part] = np.stack(ks)
+        qkv["v_proj"][part] = np.stack(vs)
+
+    blocks = {
+        "ln_1": _ln_stack(sd, "transformer.layers.{i}.input_layernorm", L),
+        "ln_2": _ln_stack(sd, "transformer.layers.{i}.post_attention_layernorm", L),
+        **qkv,
+        "o_proj": _lin_stack(sd, "transformer.layers.{i}.attention.dense", L),
+        "fc_in": _lin_stack(sd, "transformer.layers.{i}.mlp.dense_h_to_4h", L),
+        "fc_out": _lin_stack(sd, "transformer.layers.{i}.mlp.dense_4h_to_h", L),
+    }
+    wte = sd["word_embeddings.weight"]
+    wpe = sd["position_embeddings.weight"]
+    # the config is hand-authored (no config.json in Megatron checkpoints):
+    # an undersized table would silently clamp lookups, so fail loudly
+    if wte.shape[0] < cfg.vocab_size:
+        raise ValueError(
+            f"checkpoint embedding has {wte.shape[0]} rows < config "
+            f"vocab_size {cfg.vocab_size} — wrong config for this checkpoint")
+    if wpe.shape[0] < cfg.max_seq_len:
+        raise ValueError(
+            f"checkpoint position table has {wpe.shape[0]} rows < config "
+            f"max_seq_len {cfg.max_seq_len} — wrong config for this checkpoint")
+    if wte.shape[0] > cfg.vocab_size:  # vocab-parallel padding
+        wte = wte[:cfg.vocab_size]
+    params = {
+        "wte": {"embedding": wte},
+        "wpe": {"embedding": wpe},
+        "ln_f": {"scale": sd["transformer.final_layernorm.weight"],
+                 "bias": sd["transformer.final_layernorm.bias"]},
+        "blocks": blocks,
+    }
+    n = sum(int(np.prod(np.shape(l))) for l in jax.tree.leaves(params))
+    log_dist(f"loaded Megatron checkpoint ({len(loader.ckpt_list)} TP shards, "
+             f"version {ver}, {n / 1e6:.1f}M params)", ranks=[0])
+    return TransformerLM(cfg), params
 
 
 # built-in architecture registrations (models/registry.py dispatches here)

@@ -128,6 +128,10 @@ class InferenceEngine:
     def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
                  seed: int = 0) -> np.ndarray:
         """Reference ``engine._generate`` (engine.py:613)."""
+        if not getattr(self.model.config, "causal", True):
+            raise ValueError(
+                "bidirectional encoders (bert/roberta) cannot generate "
+                "autoregressively — use forward() for MLM/fill-mask scoring")
         input_ids = jnp.asarray(input_ids)
         if input_ids.ndim == 1:
             input_ids = input_ids[None, :]

@@ -48,6 +48,11 @@ class RaggedInferenceModel:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.use_pallas = use_pallas
         c = self.config
+        if not c.causal:
+            raise ValueError(
+                "the ragged serving engine generates autoregressively; "
+                "bidirectional encoders (bert/roberta) have no decode "
+                "semantics — use the model's apply() for MLM scoring")
         # bloom: per-head ALiBi bias threaded into every paged-attention
         # program (forces the XLA path; the stock Pallas kernel has no bias)
         self._alibi = (jnp.asarray(model._alibi_slopes)

@@ -6,3 +6,5 @@ from .opt_phi_falcon import (falcon_config, falcon_model, opt_config,  # noqa: F
                              opt_model, phi_config, phi_model)
 from .bloom_neox_gptj import (bloom_config, bloom_model, gpt_neox_config,  # noqa: F401
                               gpt_neox_model, gptj_config, gptj_model)
+from .bert import (bert_config, bert_model, roberta_config,  # noqa: F401
+                   roberta_model)

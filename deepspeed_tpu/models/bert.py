@@ -1,0 +1,64 @@
+"""BERT-family encoder presets (BERT / RoBERTa).
+
+Counterpart of the reference's encoder kernel-injection policies
+(``module_inject/containers/bert.py``, ``distil_bert.py``; HF bert/roberta
+dominate the reference inference test matrix, ``tests/unit/inference/
+test_inference.py:62``) and its "fastest BERT training" kernel stack
+(``csrc/transformer``, ``docs/_posts/2020-05-28-fastest-bert-training.md``).
+
+Expressed through ``TransformerConfig``: bidirectional attention
+(``causal=False``), post-LN blocks, learned positions + segment embeddings
+with an embedding LayerNorm, and the MLM prediction head (dense → act → LN →
+tied decoder + bias). RoBERTa is the same body with its +2 position-padding
+offset.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig, TransformerLM
+
+_BERT_PRESETS = {
+    "bert-tiny": dict(num_layers=2, num_heads=4, hidden_size=64,
+                      intermediate_size=256, max_seq_len=64, vocab_size=256),
+    "bert-base": dict(num_layers=12, num_heads=12, hidden_size=768,
+                      intermediate_size=3072),
+    "bert-large": dict(num_layers=24, num_heads=16, hidden_size=1024,
+                       intermediate_size=4096),
+}
+
+
+def bert_config(preset: str = "bert-base", dtype=jnp.bfloat16,
+                **overrides) -> TransformerConfig:
+    base = dict(vocab_size=30522, max_seq_len=512, activation="gelu_exact",
+                norm="layernorm", position="learned", causal=False,
+                norm_style="post", embedding_norm=True, type_vocab_size=2,
+                mlm_head=True, tie_embeddings=True, dtype=dtype)
+    base.update(_BERT_PRESETS[preset])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def bert_model(preset: str = "bert-base", **overrides) -> TransformerLM:
+    return TransformerLM(bert_config(preset, **overrides))
+
+
+def roberta_config(preset: str = "bert-base", dtype=jnp.bfloat16,
+                   **overrides) -> TransformerConfig:
+    """RoBERTa: bert body, vocab 50265, ONE token type, and HF's pad-aware
+    position ids (cumsum over non-pad tokens + padding_idx, so padded
+    batches match ``create_position_ids_from_input_ids`` exactly)."""
+    base = dict(vocab_size=50265, max_seq_len=512, activation="gelu_exact",
+                norm="layernorm", position="learned", position_offset=2,
+                pad_based_positions=True, pad_token_id=1,
+                causal=False, norm_style="post", embedding_norm=True,
+                type_vocab_size=1, mlm_head=True, tie_embeddings=True,
+                dtype=dtype)
+    base.update(_BERT_PRESETS[preset])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def roberta_model(preset: str = "bert-base", **overrides) -> TransformerLM:
+    return TransformerLM(roberta_config(preset, **overrides))

@@ -21,6 +21,7 @@ TPU-first structure:
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -87,6 +88,16 @@ class TransformerConfig:
     attn_bias: Optional[bool] = None    # gpt-j: bias-free attn, biased MLP
     lm_head_bias: bool = False       # phi/gpt-j lm_head carries a bias
     tie_embeddings: bool = True
+    causal: bool = True              # False: bidirectional encoder (bert)
+    norm_style: str = "pre"          # 'pre' | 'post' (bert-era encoders)
+    type_vocab_size: int = 0         # bert segment (token-type) embeddings
+    mlm_head: bool = False           # bert cls.predictions transform + bias
+    # roberta: position ids are a cumsum over non-pad tokens offset by
+    # padding_idx (HF create_position_ids_from_input_ids) — pads land on
+    # the padding_idx row, real tokens on padding_idx+1..; requires
+    # pad_token_id. position_offset still sizes the table (+2 rows).
+    pad_based_positions: bool = False
+    pad_token_id: Optional[int] = None
     seq_parallel: str = "ulysses"    # 'ulysses' | 'ring' (long-context SP)
     dtype: Any = jnp.float32         # compute dtype (params kept by engine policy)
     remat: bool = True
@@ -119,7 +130,10 @@ class TransformerConfig:
             mlp = mlp * self.moe.num_experts + h * self.moe.num_experts
         embed = v * h + ((self.max_seq_len + self.position_offset) * h
                          if self.position == "learned" else 0)
+        embed += self.type_vocab_size * h
         head = 0 if self.tie_embeddings else v * h
+        if self.mlm_head:
+            head += h * h + v  # prediction transform + decoder bias
         return embed + head + L * (attn + mlp)
 
 
@@ -134,9 +148,23 @@ class TransformerLM:
         base_cls = nn.LayerNorm if c.norm == "layernorm" else nn.RMSNorm
         norm_cls = lambda features: base_cls(features, eps=c.norm_eps)
         self._norm = norm_cls
-        self._ln_f = norm_cls(c.hidden_size)
-        # bloom normalizes embeddings before the first block
+        # post-LN (bert): the last block's output LN already normalizes the
+        # final hidden states — there is no separate final norm
+        self._ln_f = norm_cls(c.hidden_size) if c.norm_style == "pre" else None
+        # bloom normalizes embeddings before the first block; bert-era
+        # encoders do the same (embeddings.LayerNorm)
         self._ln_emb = norm_cls(c.hidden_size) if c.embedding_norm else None
+        # bert segment embeddings + MLM prediction head (dense→act→LN, then
+        # the tied decoder with its own bias)
+        self._wtt = (nn.Embedding(c.type_vocab_size, c.hidden_size)
+                     if c.type_vocab_size else None)
+        if c.mlm_head:
+            self._mlm_dense = nn.Linear(c.hidden_size, c.hidden_size)
+            self._mlm_ln = norm_cls(c.hidden_size)
+        if not c.causal and c.position not in ("learned",):
+            raise ValueError("bidirectional encoders use learned positions")
+        if not c.causal and c.seq_parallel == "ring":
+            raise ValueError("ring attention is causal-only")
         if c.position == "alibi":
             if c.seq_parallel == "ring":
                 raise ValueError("alibi positions are not supported with "
@@ -202,9 +230,19 @@ class TransformerLM:
             params["wpe"] = self._wpe.init(jax.random.fold_in(rng_embed, 1), dtype)
         if self._ln_emb is not None:
             params["ln_emb"] = self._ln_emb.init(jax.random.fold_in(rng_embed, 2), dtype)
-        params["ln_f"] = self._ln_f.init(rng_head, dtype)
+        if self._wtt is not None:
+            params["wtt"] = self._wtt.init(jax.random.fold_in(rng_embed, 3), dtype)
+        if self._ln_f is not None:
+            params["ln_f"] = self._ln_f.init(rng_head, dtype)
         if not c.tie_embeddings:
             params["lm_head"] = self._lm_head.init(rng_head, dtype)
+        if c.mlm_head:
+            r = jax.random.fold_in(rng_head, 4)
+            params["mlm"] = {
+                "dense": self._mlm_dense.init(r, dtype),
+                "ln": self._mlm_ln.init(jax.random.fold_in(r, 1), dtype),
+                "bias": jnp.zeros((c.vocab_size,), dtype),
+            }
 
         def init_block(r):
             block, _ = nn.init_tree(self._block_layers, r, dtype)
@@ -222,9 +260,16 @@ class TransformerLM:
             specs["wpe"] = self._wpe.specs()
         if self._ln_emb is not None:
             specs["ln_emb"] = self._ln_emb.specs()
-        specs["ln_f"] = self._ln_f.specs()
+        if self._wtt is not None:
+            specs["wtt"] = self._wtt.specs()
+        if self._ln_f is not None:
+            specs["ln_f"] = self._ln_f.specs()
         if not c.tie_embeddings:
             specs["lm_head"] = self._lm_head.specs()
+        if c.mlm_head:
+            specs["mlm"] = {"dense": self._mlm_dense.specs(),
+                            "ln": self._mlm_ln.specs(),
+                            "bias": P(None)}
         block_specs = {name: layer.specs() for name, layer in self._block_layers.items()}
         if c.moe is not None:
             block_specs["moe"] = self._moe.specs()
@@ -246,8 +291,11 @@ class TransformerLM:
         rot = nn.rotary_embedding(x[..., :rd], positions, c.rope_theta, c.rope_style)
         return jnp.concatenate([rot, x[..., rd:]], axis=-1)
 
-    def _attn(self, block: Params, h: jax.Array, positions: jax.Array) -> jax.Array:
-        """Attention over the PRE-NORMED input h."""
+    def _attn(self, block: Params, h: jax.Array, positions: jax.Array,
+              attn_mask: Optional[jax.Array] = None) -> jax.Array:
+        """Attention over the (pre-normed, or raw for post-LN) input h.
+        ``attn_mask`` [B, S] (1 = real token) masks padding bidirectionally
+        via the segment-ids mechanism (encoders)."""
         c = self.config
         B, S, _ = h.shape
         q = self._block_layers["q_proj"](block["q_proj"], h).reshape(B, S, c.num_heads, c.head_dim)
@@ -256,14 +304,16 @@ class TransformerLM:
         if c.position == "rope":
             q = self._rotate(q, positions)
             k = self._rotate(k, positions)
+        seg = attn_mask.astype(jnp.int32) if attn_mask is not None else None
         if c.seq_parallel == "ring":
             from ..sequence.ring_attention import ring_attention
             out = ring_attention(q, k, v, causal=True)
         elif self._alibi_slopes is not None:
-            out = ulysses_attention(flash_attention, q, k, v, causal=True,
+            out = ulysses_attention(flash_attention, q, k, v, causal=c.causal,
                                     alibi_slopes=jnp.asarray(self._alibi_slopes))
         else:
-            out = ulysses_attention(flash_attention, q, k, v, causal=True)
+            out = ulysses_attention(flash_attention, q, k, v, causal=c.causal,
+                                    segment_ids=seg)
         out = out.reshape(B, S, c.num_heads * c.head_dim)
         return self._block_layers["o_proj"](block["o_proj"], out)
 
@@ -282,45 +332,70 @@ class TransformerLM:
             out = self._block_layers["fc_out"](block["fc_out"], h2)
         return out, aux
 
-    def _block_fn(self, carry, block_and_keep):
+    def _block_fn(self, attn_mask, carry, block_and_keep):
         block, keep = block_and_keep
         x, positions, aux_acc = carry
         c = self.config
         # keep: per-layer stochastic-depth gate (progressive layer drop,
         # reference runtime/progressive_layer_drop.py); 1.0 = layer active
+        if c.norm_style == "post":
+            # bert-era encoder block: LN AFTER each residual add. The PLD
+            # gate mixes OUTSIDE the norms (keep*block(x) + (1-keep)*x) so a
+            # dropped layer (keep=0, gates are binary draws) is a true
+            # identity — gating inside would still double-normalize x.
+            h = self._block_layers["ln_1"](
+                block["ln_1"], x + self._attn(block, x, positions, attn_mask))
+            mlp_out, aux = self._mlp(block, h)
+            y = self._block_layers["ln_2"](block["ln_2"], h + mlp_out)
+            x = _c(keep * y + (1 - keep) * x, ACT_SPEC)
+            return (x, positions, aux_acc + keep * aux), None
         h1 = self._block_layers["ln_1"](block["ln_1"], x)
         if c.parallel_block:
             # falcon/phi residual form: both branches read the block INPUT —
             # through one shared norm (phi/falcon-7b) or per-branch norms
             # (falcon-40b new decoder)
-            attn_out = self._attn(block, h1, positions)
+            attn_out = self._attn(block, h1, positions, attn_mask)
             hm = (self._block_layers["ln_2"](block["ln_2"], x)
                   if c.parallel_norms else h1)
             mlp_out, aux = self._mlp(block, hm)
             x = _c(x + keep * (attn_out + mlp_out), ACT_SPEC)
         else:
-            x = x + keep * self._attn(block, h1, positions)
+            x = x + keep * self._attn(block, h1, positions, attn_mask)
             h2 = self._block_layers["ln_2"](block["ln_2"], x)
             mlp_out, aux = self._mlp(block, h2)
             x = _c(x + keep * mlp_out, ACT_SPEC)
         return (x, positions, aux_acc + keep * aux), None
 
     def apply(self, params: Params, input_ids: jax.Array,
-              layer_mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+              layer_mask: Optional[jax.Array] = None,
+              token_type_ids: Optional[jax.Array] = None,
+              attention_mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
         """Return (logits [B,S,V] in fp32, moe_aux_loss scalar).
 
         ``layer_mask`` [num_layers] gates each block (PLD stochastic depth).
+        ``token_type_ids`` [B,S] selects bert segment embeddings;
+        ``attention_mask`` [B,S] (1 = real) masks padding in encoders.
         """
         c = self.config
         positions = jnp.arange(input_ids.shape[1])[None, :]
         x = self._wte(params["wte"], input_ids)
         if self._wpe is not None:
-            x = x + self._wpe(params["wpe"], positions + c.position_offset)
+            if c.pad_based_positions:
+                pad = c.pad_token_id if c.pad_token_id is not None else 1
+                real = (input_ids != pad).astype(jnp.int32)
+                pos_ids = jnp.cumsum(real, axis=1) * real + pad
+                x = x + self._wpe(params["wpe"], pos_ids)
+            else:
+                x = x + self._wpe(params["wpe"], positions + c.position_offset)
+        if self._wtt is not None:
+            tt = (token_type_ids if token_type_ids is not None
+                  else jnp.zeros_like(input_ids))
+            x = x + self._wtt(params["wtt"], tt)
         if self._ln_emb is not None:
             x = self._ln_emb(params["ln_emb"], x)
         x = _c(x.astype(c.dtype), ACT_SPEC)
 
-        block_fn = self._block_fn
+        block_fn = functools.partial(self._block_fn, attention_mask)
         if c.remat:
             policy = None
             if c.remat_policy and c.remat_policy not in ("full", "nothing_saveable"):
@@ -333,22 +408,38 @@ class TransformerLM:
             keep = layer_mask.astype(c.dtype)
         (x, _, aux), _ = jax.lax.scan(block_fn, (x, positions, jnp.zeros((), jnp.float32)),
                                       (params["blocks"], keep))
-        x = self._ln_f(params["ln_f"], x)
+        if self._ln_f is not None:
+            x = self._ln_f(params["ln_f"], x)
+        if c.mlm_head:
+            # bert cls.predictions: dense → act → LN → tied decoder + bias
+            x = ACTIVATIONS[c.activation](
+                self._mlm_dense(params["mlm"]["dense"], x))
+            x = self._mlm_ln(params["mlm"]["ln"], x)
         if c.tie_embeddings:
             logits = self._wte.attend(params["wte"], x)
         else:
             logits = self._lm_head(params["lm_head"], x)
+        if c.mlm_head:
+            logits = logits + params["mlm"]["bias"].astype(logits.dtype)
         return logits.astype(jnp.float32), aux
 
     def loss(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
-        """Next-token cross-entropy. batch: input_ids [B,S], optional labels,
-        optional loss_mask."""
+        """Cross-entropy: next-token for causal LMs (labels derived by shift
+        when absent), masked-LM for encoders (labels required, -100 = ignore).
+        batch: input_ids [B,S], optional labels/loss_mask/token_type_ids/
+        attention_mask."""
         input_ids = batch["input_ids"]
         labels = batch.get("labels")
         if labels is None:
+            if not self.config.causal:
+                raise ValueError("encoder (MLM) training requires explicit "
+                                 "labels — next-token shift is meaningless "
+                                 "bidirectionally")
             labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
         logits, aux = self.apply(params, input_ids,
-                                 layer_mask=batch.get("layer_mask"))
+                                 layer_mask=batch.get("layer_mask"),
+                                 token_type_ids=batch.get("token_type_ids"),
+                                 attention_mask=batch.get("attention_mask"))
         valid = labels >= 0
         safe_labels = jnp.where(valid, labels, 0)
         logp = jax.nn.log_softmax(logits, axis=-1)

@@ -49,6 +49,10 @@ class PipelineModule:
                  num_microbatches: int = None):
         assert config.num_layers % num_stages == 0, (
             f"num_layers {config.num_layers} not divisible by num_stages {num_stages}")
+        if not config.causal or config.norm_style != "pre" or config.mlm_head:
+            raise ValueError(
+                "PipelineModule supports causal pre-LN decoders; encoder "
+                "configs (bidirectional/post-LN/MLM head) are not pipelined")
         self.config = config
         self.num_stages = num_stages
         self.layers_per_stage = config.num_layers // num_stages
@@ -74,7 +78,10 @@ class PipelineModule:
     def _stage_fn(self, stage_blocks, x, positions):
         """Run this stage's layer slice (a scan like the dense model)."""
         def block_fn(carry, block):
-            return self._lm._block_fn(carry, (block, jnp.asarray(1.0, self.config.dtype)))
+            # attn_mask=None: PP drives causal decoder stages (encoders with
+            # padding masks aren't pipelined)
+            return self._lm._block_fn(
+                None, carry, (block, jnp.asarray(1.0, self.config.dtype)))
         if self.config.remat:
             policy = None
             if self.config.remat_policy and self.config.remat_policy not in ("full", "nothing_saveable"):
@@ -85,9 +92,12 @@ class PipelineModule:
         return x, aux
 
     def apply(self, params: Dict[str, Any], input_ids: jax.Array,
-              layer_mask=None) -> Tuple[jax.Array, jax.Array]:
+              layer_mask=None, token_type_ids=None,
+              attention_mask=None) -> Tuple[jax.Array, jax.Array]:
         assert layer_mask is None, \
             "progressive layer drop is not supported under pipeline parallelism"
+        assert token_type_ids is None and attention_mask is None, \
+            "encoder inputs are not supported under pipeline parallelism"
         c = self.config
         M, S = self.num_microbatches, input_ids.shape[1]
         B = input_ids.shape[0]
@@ -97,7 +107,11 @@ class PipelineModule:
 
         x = self._lm._wte(params["wte"], input_ids)
         if self._lm._wpe is not None:
-            x = x + self._lm._wpe(params["wpe"], positions)
+            # same offset as TransformerLM.apply — OPT's learned table is
+            # padded by 2
+            x = x + self._lm._wpe(params["wpe"], positions + c.position_offset)
+        if self._lm._ln_emb is not None:  # bloom's embedding LayerNorm
+            x = self._lm._ln_emb(params["ln_emb"], x)
         x = x.astype(c.dtype)
 
         # microbatch major: [M, mb, S, D]

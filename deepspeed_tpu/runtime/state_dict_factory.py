@@ -18,7 +18,7 @@ reference's per-rank slice loading. Explicit per-rank slicing for
 multi-host loading is available via ``module_inject.auto_tp.shard_param_tree``.
 
 Supported architectures: gpt2, llama, mistral, mixtral, opt, phi, falcon,
-bloom, gpt_neox, gptj.
+bloom, gpt_neox, gptj, bert, roberta.
 """
 
 from __future__ import annotations
@@ -669,6 +669,82 @@ def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
     return get_architecture(model_type).params_fn(cfg, sd)
 
 
+def _bert_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    return dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            activation=_map_activation(hf.get("hidden_act", "gelu")),
+            norm="layernorm", position="learned", causal=False,
+            norm_style="post", embedding_norm=True,
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            mlm_head=True, tie_embeddings=True,
+            norm_eps=hf.get("layer_norm_eps", 1e-12))
+
+
+def _roberta_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    cfg = _bert_config(hf)
+    # HF roberta position ids come from create_position_ids_from_input_ids:
+    # cumsum over non-pad tokens + padding_idx (pads land on padding_idx);
+    # its 514-row table is 512 usable positions + padding_idx + 1
+    pad = hf.get("pad_token_id")
+    pad = 1 if pad is None else pad  # 0 is a legal pad id — no `or`
+    cfg["pad_based_positions"] = True
+    cfg["pad_token_id"] = pad
+    cfg["position_offset"] = pad + 1
+    cfg["max_seq_len"] = hf.get("max_position_embeddings", 514) - (pad + 1)
+    return cfg
+
+
+def _bert_params_for(prefix: str, head: str):
+    """bert. vs roberta. naming differ only in prefix and MLM-head keys."""
+
+    def params_fn(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+        sd = _strip_prefix(sd, prefix)
+        L = cfg.num_layers
+        blocks = {
+            # post-LN: ln_1 = the LN after the attention residual
+            "ln_1": _ln_stack(sd, "encoder.layer.{i}.attention.output.LayerNorm", L),
+            "ln_2": _ln_stack(sd, "encoder.layer.{i}.output.LayerNorm", L),
+            "q_proj": _lin_stack(sd, "encoder.layer.{i}.attention.self.query", L),
+            "k_proj": _lin_stack(sd, "encoder.layer.{i}.attention.self.key", L),
+            "v_proj": _lin_stack(sd, "encoder.layer.{i}.attention.self.value", L),
+            "o_proj": _lin_stack(sd, "encoder.layer.{i}.attention.output.dense", L),
+            "fc_in": _lin_stack(sd, "encoder.layer.{i}.intermediate.dense", L),
+            "fc_out": _lin_stack(sd, "encoder.layer.{i}.output.dense", L),
+        }
+        if head == "cls":  # bert: cls.predictions.*
+            mlm = {
+                "dense": {"kernel": np.transpose(sd["cls.predictions.transform.dense.weight"]),
+                          "bias": sd["cls.predictions.transform.dense.bias"]},
+                "ln": {"scale": sd["cls.predictions.transform.LayerNorm.weight"],
+                       "bias": sd["cls.predictions.transform.LayerNorm.bias"]},
+                "bias": sd["cls.predictions.bias"],
+            }
+        else:              # roberta: lm_head.*
+            mlm = {
+                "dense": {"kernel": np.transpose(sd["lm_head.dense.weight"]),
+                          "bias": sd["lm_head.dense.bias"]},
+                "ln": {"scale": sd["lm_head.layer_norm.weight"],
+                       "bias": sd["lm_head.layer_norm.bias"]},
+                "bias": sd["lm_head.bias"],
+            }
+        return {
+            "wte": {"embedding": sd["embeddings.word_embeddings.weight"]},
+            "wpe": {"embedding": sd["embeddings.position_embeddings.weight"]},
+            "wtt": {"embedding": sd["embeddings.token_type_embeddings.weight"]},
+            "ln_emb": {"scale": sd["embeddings.LayerNorm.weight"],
+                       "bias": sd["embeddings.LayerNorm.bias"]},
+            "mlm": mlm,
+            "blocks": blocks,
+        }
+
+    return params_fn
+
+
 # ---------------------------------------------------------------------------
 # Megatron sharded checkpoints (reference MegatronSDLoader,
 # state_dict_factory.py:190)
@@ -856,6 +932,9 @@ def _register_builtins() -> None:
     register_architecture("bloom", _bloom_config, _bloom_params)
     register_architecture("gpt_neox", _gpt_neox_config, _gpt_neox_params)
     register_architecture("gptj", _gptj_config, _gptj_params)
+    register_architecture("bert", _bert_config, _bert_params_for("bert.", "cls"))
+    register_architecture("roberta", _roberta_config,
+                          _bert_params_for("roberta.", "lm_head"))
 
 
 _register_builtins()

@@ -177,6 +177,34 @@ def gptj_ckpt(tmp_path_factory):
     return path, m
 
 
+@pytest.fixture(scope="module")
+def bert_ckpt(tmp_path_factory):
+    """post-LN bidirectional encoder + segment embeddings + cls MLM head."""
+    path = tmp_path_factory.mktemp("hf_bert")
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=64)
+    torch.manual_seed(12)
+    m = transformers.BertForMaskedLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def roberta_ckpt(tmp_path_factory):
+    """bert body with lm_head naming and +2 position padding offset."""
+    path = tmp_path_factory.mktemp("hf_roberta")
+    cfg = transformers.RobertaConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=66, type_vocab_size=1)
+    torch.manual_seed(13)
+    m = transformers.RobertaForMaskedLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
 def _ref_logits(m, ids):
     with torch.no_grad():
         return m(torch.tensor(ids)).logits.float().numpy()
@@ -193,7 +221,7 @@ def _our_logits(path, ids, **overrides):
                                   "falcon_gqa_ckpt", "falcon_bias_ckpt",
                                   "bloom_ckpt", "gpt_neox_ckpt",
                                   "gpt_neox_seq_ckpt", "gpt_neox_nobias_ckpt",
-                                  "gptj_ckpt"])
+                                  "gptj_ckpt", "bert_ckpt", "roberta_ckpt"])
 def test_hf_logits_parity(request, eight_devices, ckpt):
     """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
     path, m = request.getfixturevalue(ckpt)
@@ -251,6 +279,81 @@ def test_build_hf_engine_v2_greedy_matches_hf(request, eight_devices, ckpt):
                               kv_cache_dtype=jnp.float32, num_kv_blocks=64))
     out = generate(eng, [prompt], max_new_tokens=6)[0]
     np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_bert_padded_attention_mask_parity(eight_devices, bert_ckpt):
+    """Right-padded batches with attention_mask + token_type_ids must match
+    HF on the REAL (non-pad) positions."""
+    path, m = bert_ckpt
+    model, params = load_hf_model(str(path), dtype=jnp.float32)
+    rng = np.random.default_rng(6)
+    ids = rng.integers(5, 128, size=(2, 16))
+    mask = np.ones((2, 16), np.int32)
+    ids[0, 12:] = 0; mask[0, 12:] = 0           # ragged batch, right-padded
+    tt = np.zeros((2, 16), np.int32); tt[:, 8:] = 1   # segment B
+    with torch.no_grad():
+        ref = m(torch.tensor(ids), attention_mask=torch.tensor(mask),
+                token_type_ids=torch.tensor(tt)).logits.float().numpy()
+    ours, _ = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids),
+                          token_type_ids=jnp.asarray(tt),
+                          attention_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(ours)[mask == 1], ref[mask == 1],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_roberta_padded_position_ids_parity(eight_devices, roberta_ckpt):
+    """HF roberta derives position ids from pad structure (cumsum over
+    non-pad tokens); batches CONTAINING the pad id must still match."""
+    path, m = roberta_ckpt
+    model, params = load_hf_model(str(path), dtype=jnp.float32)
+    rng = np.random.default_rng(8)
+    ids = rng.integers(2, 128, size=(2, 16))
+    mask = np.ones((2, 16), np.int32)
+    ids[0, 11:] = 1; mask[0, 11:] = 0            # right padding with pad id 1
+    with torch.no_grad():
+        ref = m(torch.tensor(ids),
+                attention_mask=torch.tensor(mask)).logits.float().numpy()
+    ours, _ = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids),
+                          attention_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(ours)[mask == 1], ref[mask == 1],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_encoders_rejected_by_generation_paths(eight_devices, bert_ckpt):
+    """Autoregressive surfaces must refuse encoders loudly: v2 build and v1
+    generate raise; v1 forward (MLM scoring) still works."""
+    path, m = bert_ckpt
+    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
+    with pytest.raises(ValueError, match="bidirectional|encoder"):
+        build_hf_engine(str(path))
+    engine = deepspeed_tpu.init_inference(
+        model_path=str(path), config={"dtype": jnp.float32})
+    with pytest.raises(ValueError, match="bidirectional"):
+        engine.generate(np.zeros((1, 8), np.int32), max_new_tokens=2)
+    ids = np.random.default_rng(9).integers(5, 128, size=(1, 12))
+    np.testing.assert_allclose(np.asarray(engine.forward(ids)),
+                               _ref_logits(m, ids), rtol=2e-4, atol=2e-4)
+
+
+def test_bert_mlm_trains_under_zero(eight_devices, bert_ckpt):
+    """Loaded encoder weights train on masked-LM labels under ZeRO-2."""
+    import deepspeed_tpu as ds
+    path, _ = bert_ckpt
+    model, params = load_hf_model(str(path), dtype=jnp.float32)
+    engine, _, _, _ = ds.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(7)
+    ids = rng.integers(5, 128, size=(8, 16))
+    labels = np.full_like(ids, -100)
+    mask_pos = rng.random(ids.shape) < 0.15
+    labels[mask_pos] = ids[mask_pos]
+    masked = ids.copy(); masked[mask_pos] = 3   # [MASK]-style corruption
+    batch = {"input_ids": masked, "labels": labels}
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert losses[-1] < losses[0], losses
 
 
 def test_v1_inference_alibi(eight_devices, bloom_ckpt):

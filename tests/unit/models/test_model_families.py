@@ -9,9 +9,10 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from deepspeed_tpu.models import (bloom_model, falcon_model, gpt2_model,
-                                  gpt_neox_model, gptj_model, llama_model,
-                                  mixtral_model, opt_model, phi_model)
+from deepspeed_tpu.models import (bert_model, bloom_model, falcon_model,
+                                  gpt2_model, gpt_neox_model, gptj_model,
+                                  llama_model, mixtral_model, opt_model,
+                                  phi_model, roberta_model)
 
 TINY = dict(max_seq_len=32, vocab_size=128, remat=False, dtype=jnp.float32)
 
@@ -31,6 +32,9 @@ FAMILIES = {
     "gpt-neox": lambda: gpt_neox_model("gpt-neox-tiny", **TINY),
     # interleaved partial rotary + bias-free attention
     "gptj": lambda: gptj_model("gptj-tiny", **TINY),
+    # bidirectional post-LN encoder + segment embeddings + MLM head
+    "bert": lambda: bert_model("bert-tiny", **TINY),
+    "roberta": lambda: roberta_model("bert-tiny", **TINY),
 }
 
 
@@ -42,11 +46,48 @@ def test_family_forward_and_grad(eight_devices, family):
     logits, _ = model.apply(params, ids)
     assert logits.shape == (2, 16, model.config.vocab_size)
     assert bool(jnp.all(jnp.isfinite(logits)))
-    loss, grads = jax.value_and_grad(model.loss)(params, {"input_ids": ids})
+    batch = {"input_ids": ids}
+    if not model.config.causal:  # encoders train on explicit MLM labels
+        labels = np.full(ids.shape, -100)
+        labels[:, ::4] = np.asarray(ids)[:, ::4]
+        batch["labels"] = jnp.asarray(labels)
+    loss, grads = jax.value_and_grad(model.loss)(params, batch)
     assert bool(jnp.isfinite(loss))
     gnorm = jax.tree.reduce(
         lambda a, g: a + jnp.sum(jnp.square(g)), grads, jnp.zeros(()))
     assert float(gnorm) > 0.0
+
+
+def test_post_ln_layer_drop_is_identity(eight_devices):
+    """PLD gate at keep=0 must be a true identity in post-LN encoder blocks
+    (the gate mixes outside the norms; gating inside would still
+    double-normalize)."""
+    model = FAMILIES["bert"]()
+    params = model.init(jax.random.PRNGKey(1))
+    ids = jnp.asarray(np.random.default_rng(3).integers(0, 128, size=(2, 16)))
+    L = model.config.num_layers
+    drop_all, _ = model.apply(params, ids, layer_mask=jnp.zeros((L,)))
+    # all layers dropped => logits come from the (normed) embeddings through
+    # the MLM head alone; recompute that reference path directly
+    x = model._wte(params["wte"], ids)
+    pos = jnp.arange(ids.shape[1])[None, :]
+    x = x + model._wpe(params["wpe"], pos)
+    x = x + model._wtt(params["wtt"], jnp.zeros_like(ids))
+    x = model._ln_emb(params["ln_emb"], x)
+    from deepspeed_tpu.models.transformer import ACTIVATIONS
+    x = ACTIVATIONS[model.config.activation](
+        model._mlm_dense(params["mlm"]["dense"], x))
+    x = model._mlm_ln(params["mlm"]["ln"], x)
+    ref = model._wte.attend(params["wte"], x) + params["mlm"]["bias"]
+    np.testing.assert_allclose(np.asarray(drop_all), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_encoder_configs_rejected_by_pipeline(eight_devices):
+    from deepspeed_tpu.models import bert_config
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    with pytest.raises(ValueError, match="decoder"):
+        PipelineModule(bert_config("bert-tiny", **TINY), num_stages=2)
 
 
 @pytest.mark.parametrize("family", sorted(FAMILIES))

@@ -32,6 +32,25 @@ def test_pipeline_matches_dense_forward(eight_devices):
     np.testing.assert_allclose(l_dense, l_pipe, rtol=2e-5)
 
 
+@pytest.mark.parametrize("family", ["opt", "bloom"])
+def test_pipeline_embed_path_matches_dense(eight_devices, family):
+    """The pipe forward shares TransformerLM's embedding semantics: OPT's
+    +2 learned-position offset and bloom's embedding LayerNorm (regression:
+    the pipe path once skipped both)."""
+    from deepspeed_tpu.models import bloom_config, opt_config
+    mk = {"opt": lambda: opt_config("opt-tiny", num_layers=4, **CFG),
+          "bloom": lambda: bloom_config("bloom-tiny", num_layers=4, **CFG)}
+    cfg = mk[family]()
+    batch = {"input_ids": np.random.default_rng(1).integers(0, 256, size=(8, 16))}
+    dense, _, _, _ = deepspeed_tpu.initialize(model=TransformerLM(cfg),
+                                              config=dict(BASE), seed=22)
+    pipe, _, _, _ = deepspeed_tpu.initialize(
+        model=PipelineModule(cfg, num_stages=2, num_microbatches=4),
+        config=dict(BASE, topology={"pipe": 2}), seed=22)
+    np.testing.assert_allclose(float(dense.forward(batch)),
+                               float(pipe.forward(batch)), rtol=2e-5)
+
+
 def test_pipeline_trains(eight_devices):
     cfg = gpt2_config("gpt2-tiny", num_layers=4, **CFG)
     pipe_model = PipelineModule(cfg, num_stages=4, num_microbatches=4)

@@ -165,6 +165,8 @@ class TransformerLM:
             raise ValueError("bidirectional encoders use learned positions")
         if not c.causal and c.seq_parallel == "ring":
             raise ValueError("ring attention is causal-only")
+        if c.pad_based_positions and c.pad_token_id is None:
+            raise ValueError("pad_based_positions requires pad_token_id")
         if c.position == "alibi":
             if c.seq_parallel == "ring":
                 raise ValueError("alibi positions are not supported with "
@@ -306,10 +308,14 @@ class TransformerLM:
             k = self._rotate(k, positions)
         seg = attn_mask.astype(jnp.int32) if attn_mask is not None else None
         if c.seq_parallel == "ring":
+            if seg is not None:
+                raise ValueError("ring attention does not support padding "
+                                 "masks (attention_mask)")
             from ..sequence.ring_attention import ring_attention
             out = ring_attention(q, k, v, causal=True)
         elif self._alibi_slopes is not None:
             out = ulysses_attention(flash_attention, q, k, v, causal=c.causal,
+                                    segment_ids=seg,
                                     alibi_slopes=jnp.asarray(self._alibi_slopes))
         else:
             out = ulysses_attention(flash_attention, q, k, v, causal=c.causal,
@@ -381,7 +387,7 @@ class TransformerLM:
         x = self._wte(params["wte"], input_ids)
         if self._wpe is not None:
             if c.pad_based_positions:
-                pad = c.pad_token_id if c.pad_token_id is not None else 1
+                pad = c.pad_token_id  # __init__ rejects None
                 real = (input_ids != pad).astype(jnp.int32)
                 pos_ids = jnp.cumsum(real, axis=1) * real + pad
                 x = x + self._wpe(params["wpe"], pos_ids)

@@ -18,7 +18,7 @@ reference's per-rank slice loading. Explicit per-rank slicing for
 multi-host loading is available via ``module_inject.auto_tp.shard_param_tree``.
 
 Supported architectures: gpt2, llama, mistral, mixtral, opt, phi, falcon,
-bloom, gpt_neox, gptj, bert, roberta.
+bloom, gpt_neox, gptj, bert, roberta, distilbert.
 """
 
 from __future__ import annotations
@@ -670,6 +670,12 @@ def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
 
 
 def _bert_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    if not hf.get("tie_word_embeddings", True):
+        # the params fns read only cls.predictions.bias / lm_head.bias and
+        # score against the word embeddings — an untied fine-tuned decoder
+        # matrix would be silently ignored
+        raise ValueError("untied-embedding MLM checkpoints "
+                         "(tie_word_embeddings=false) are unsupported")
     return dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 512),
@@ -743,6 +749,59 @@ def _bert_params_for(prefix: str, head: str):
         }
 
     return params_fn
+
+
+def _distilbert_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    if hf.get("sinusoidal_pos_embds", False):
+        raise ValueError("sinusoidal-position DistilBERT variants are "
+                         "unsupported (learned positions only)")
+    if not hf.get("tie_word_embeddings", True):
+        raise ValueError("untied-embedding MLM checkpoints "
+                         "(tie_word_embeddings=false) are unsupported")
+    return dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            num_layers=hf["n_layers"],
+            num_heads=hf["n_heads"],
+            hidden_size=hf["dim"],
+            intermediate_size=hf["hidden_dim"],
+            activation=_map_activation(hf.get("activation", "gelu")),
+            norm="layernorm", position="learned", causal=False,
+            norm_style="post", embedding_norm=True, type_vocab_size=0,
+            mlm_head=True, tie_embeddings=True,
+            norm_eps=1e-12)  # hardcoded in HF modeling_distilbert
+
+
+def _distilbert_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF DistilBERT: distilbert.* naming, q_lin/k_lin/v_lin/out_lin attn,
+    ffn.lin1/lin2 MLP, vocab_transform/vocab_layer_norm/vocab_projector MLM
+    head (projector tied to the word embeddings)."""
+    sd = _strip_prefix(sd, "distilbert.")
+    L = cfg.num_layers
+    blocks = {
+        "ln_1": _ln_stack(sd, "transformer.layer.{i}.sa_layer_norm", L),
+        "ln_2": _ln_stack(sd, "transformer.layer.{i}.output_layer_norm", L),
+        "q_proj": _lin_stack(sd, "transformer.layer.{i}.attention.q_lin", L),
+        "k_proj": _lin_stack(sd, "transformer.layer.{i}.attention.k_lin", L),
+        "v_proj": _lin_stack(sd, "transformer.layer.{i}.attention.v_lin", L),
+        "o_proj": _lin_stack(sd, "transformer.layer.{i}.attention.out_lin", L),
+        "fc_in": _lin_stack(sd, "transformer.layer.{i}.ffn.lin1", L),
+        "fc_out": _lin_stack(sd, "transformer.layer.{i}.ffn.lin2", L),
+    }
+    return {
+        "wte": {"embedding": sd["embeddings.word_embeddings.weight"]},
+        "wpe": {"embedding": sd["embeddings.position_embeddings.weight"]},
+        "ln_emb": {"scale": sd["embeddings.LayerNorm.weight"],
+                   "bias": sd["embeddings.LayerNorm.bias"]},
+        "mlm": {
+            "dense": {"kernel": np.transpose(sd["vocab_transform.weight"]),
+                      "bias": sd["vocab_transform.bias"]},
+            "ln": {"scale": sd["vocab_layer_norm.weight"],
+                   "bias": sd["vocab_layer_norm.bias"]},
+            "bias": sd["vocab_projector.bias"],
+        },
+        "blocks": blocks,
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -935,6 +994,7 @@ def _register_builtins() -> None:
     register_architecture("bert", _bert_config, _bert_params_for("bert.", "cls"))
     register_architecture("roberta", _roberta_config,
                           _bert_params_for("roberta.", "lm_head"))
+    register_architecture("distilbert", _distilbert_config, _distilbert_params)
 
 
 _register_builtins()

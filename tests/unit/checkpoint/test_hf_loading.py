@@ -205,6 +205,19 @@ def roberta_ckpt(tmp_path_factory):
     return path, m
 
 
+@pytest.fixture(scope="module")
+def distilbert_ckpt(tmp_path_factory):
+    """no token types, q_lin/k_lin naming, vocab_transform MLM head."""
+    path = tmp_path_factory.mktemp("hf_distilbert")
+    cfg = transformers.DistilBertConfig(
+        vocab_size=128, dim=64, n_layers=2, n_heads=4, hidden_dim=256,
+        max_position_embeddings=64)
+    torch.manual_seed(14)
+    m = transformers.DistilBertForMaskedLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
 def _ref_logits(m, ids):
     with torch.no_grad():
         return m(torch.tensor(ids)).logits.float().numpy()
@@ -221,7 +234,8 @@ def _our_logits(path, ids, **overrides):
                                   "falcon_gqa_ckpt", "falcon_bias_ckpt",
                                   "bloom_ckpt", "gpt_neox_ckpt",
                                   "gpt_neox_seq_ckpt", "gpt_neox_nobias_ckpt",
-                                  "gptj_ckpt", "bert_ckpt", "roberta_ckpt"])
+                                  "gptj_ckpt", "bert_ckpt", "roberta_ckpt",
+                                  "distilbert_ckpt"])
 def test_hf_logits_parity(request, eight_devices, ckpt):
     """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
     path, m = request.getfixturevalue(ckpt)
@@ -296,6 +310,25 @@ def test_bert_padded_attention_mask_parity(eight_devices, bert_ckpt):
                 token_type_ids=torch.tensor(tt)).logits.float().numpy()
     ours, _ = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids),
                           token_type_ids=jnp.asarray(tt),
+                          attention_mask=jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(ours)[mask == 1], ref[mask == 1],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bloom_padded_attention_mask_parity(eight_devices, bloom_ckpt):
+    """attention_mask must also mask padding on the ALiBi branch (it was
+    once silently dropped there): right-padded bloom batches match HF on
+    real positions."""
+    path, m = bloom_ckpt
+    model, params = load_hf_model(str(path), dtype=jnp.float32)
+    rng = np.random.default_rng(10)
+    ids = rng.integers(5, 128, size=(2, 16))
+    mask = np.ones((2, 16), np.int32)
+    ids[0, 10:] = 0; mask[0, 10:] = 0
+    with torch.no_grad():
+        ref = m(torch.tensor(ids),
+                attention_mask=torch.tensor(mask)).logits.float().numpy()
+    ours, _ = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids),
                           attention_mask=jnp.asarray(mask))
     np.testing.assert_allclose(np.asarray(ours)[mask == 1], ref[mask == 1],
                                rtol=2e-4, atol=2e-4)

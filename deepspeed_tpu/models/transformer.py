@@ -55,6 +55,25 @@ def _c(x, spec):
         return x
 
 
+def masked_cross_entropy(logits: jax.Array, labels: jax.Array,
+                         extra_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Mean cross-entropy over positions where ``labels >= 0`` (−100 = HF
+    ignore). One-hot contraction instead of take_along_axis: its transpose
+    is a dense broadcast-multiply that GSPMD reshards freely, where the
+    scatter-add transpose of a gather forces a full rematerialization when
+    logits are vocab-sharded (TP lm_head). XLA fuses the one-hot into the
+    reduction, so no [..., V] buffer is materialized."""
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    onehot = jax.nn.one_hot(safe, logits.shape[-1], dtype=logp.dtype)
+    nll = -jnp.sum(logp * onehot, axis=-1)
+    mask = valid.astype(jnp.float32)
+    if extra_mask is not None:
+        mask = mask * extra_mask.astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 @dataclasses.dataclass(frozen=True)
 class MoEConfig:
     num_experts: int = 8
@@ -375,12 +394,16 @@ class TransformerLM:
     def apply(self, params: Params, input_ids: jax.Array,
               layer_mask: Optional[jax.Array] = None,
               token_type_ids: Optional[jax.Array] = None,
-              attention_mask: Optional[jax.Array] = None) -> Tuple[jax.Array, jax.Array]:
+              attention_mask: Optional[jax.Array] = None,
+              return_hidden: bool = False) -> Tuple[jax.Array, jax.Array]:
         """Return (logits [B,S,V] in fp32, moe_aux_loss scalar).
 
         ``layer_mask`` [num_layers] gates each block (PLD stochastic depth).
         ``token_type_ids`` [B,S] selects bert segment embeddings;
         ``attention_mask`` [B,S] (1 = real) masks padding in encoders.
+        ``return_hidden`` short-circuits before the LM/MLM head, returning
+        the final hidden states [B,S,H] (post final-norm) — the hook task
+        heads (models/heads.py) build on.
         """
         c = self.config
         positions = jnp.arange(input_ids.shape[1])[None, :]
@@ -416,6 +439,8 @@ class TransformerLM:
                                       (params["blocks"], keep))
         if self._ln_f is not None:
             x = self._ln_f(params["ln_f"], x)
+        if return_hidden:
+            return x, aux
         if c.mlm_head:
             # bert cls.predictions: dense → act → LN → tied decoder + bias
             x = ACTIVATIONS[c.activation](
@@ -446,20 +471,8 @@ class TransformerLM:
                                  layer_mask=batch.get("layer_mask"),
                                  token_type_ids=batch.get("token_type_ids"),
                                  attention_mask=batch.get("attention_mask"))
-        valid = labels >= 0
-        safe_labels = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        # one-hot contraction instead of take_along_axis: its transpose is a
-        # dense broadcast-multiply that GSPMD reshards freely, where the
-        # scatter-add transpose of a gather forces a full rematerialization
-        # when logits are vocab-sharded (TP lm_head). XLA fuses the one-hot
-        # into the reduction, so no [B,S,V] buffer is materialized.
-        onehot = jax.nn.one_hot(safe_labels, logits.shape[-1], dtype=logp.dtype)
-        token_loss = -jnp.sum(logp * onehot, axis=-1)
-        mask = valid.astype(jnp.float32)
-        if "loss_mask" in batch:
-            mask = mask * batch["loss_mask"].astype(jnp.float32)
-        loss = jnp.sum(token_loss * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        loss = masked_cross_entropy(logits, labels,
+                                    extra_mask=batch.get("loss_mask"))
         if self.config.moe is not None:
             loss = loss + self.config.moe.aux_loss_coef * aux / self.config.num_layers
         return loss

@@ -670,12 +670,6 @@ def hf_state_dict_to_params(cfg: TransformerConfig, model_type: str,
 
 
 def _bert_config(hf: Dict[str, Any]) -> Dict[str, Any]:
-    if not hf.get("tie_word_embeddings", True):
-        # the params fns read only cls.predictions.bias / lm_head.bias and
-        # score against the word embeddings — an untied fine-tuned decoder
-        # matrix would be silently ignored
-        raise ValueError("untied-embedding MLM checkpoints "
-                         "(tie_word_embeddings=false) are unsupported")
     return dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 512),
@@ -722,8 +716,30 @@ def _bert_params_for(prefix: str, head: str):
             "fc_in": _lin_stack(sd, "encoder.layer.{i}.intermediate.dense", L),
             "fc_out": _lin_stack(sd, "encoder.layer.{i}.output.dense", L),
         }
+        params = {
+            "wte": {"embedding": sd["embeddings.word_embeddings.weight"]},
+            "wpe": {"embedding": sd["embeddings.position_embeddings.weight"]},
+            "wtt": {"embedding": sd["embeddings.token_type_embeddings.weight"]},
+            "ln_emb": {"scale": sd["embeddings.LayerNorm.weight"],
+                       "bias": sd["embeddings.LayerNorm.bias"]},
+            "blocks": blocks,
+        }
+        if not cfg.mlm_head:   # task checkpoints carry no MLM head
+            return params
+        # the MLM decoder is scored against the word embeddings; a separate
+        # (untied, fine-tuned) decoder matrix in the checkpoint would be
+        # silently ignored — detect from the weights, not the config flag
+        # (task loads with mlm_head=False never reach here)
+        dec_key = ("cls.predictions.decoder.weight" if head == "cls"
+                   else "lm_head.decoder.weight")
+        dec = sd.get(dec_key)
+        if dec is not None and not np.array_equal(
+                dec, sd["embeddings.word_embeddings.weight"]):
+            raise ValueError("untied-embedding MLM checkpoints (decoder "
+                             "weight differs from word embeddings) are "
+                             "unsupported")
         if head == "cls":  # bert: cls.predictions.*
-            mlm = {
+            params["mlm"] = {
                 "dense": {"kernel": np.transpose(sd["cls.predictions.transform.dense.weight"]),
                           "bias": sd["cls.predictions.transform.dense.bias"]},
                 "ln": {"scale": sd["cls.predictions.transform.LayerNorm.weight"],
@@ -731,22 +747,14 @@ def _bert_params_for(prefix: str, head: str):
                 "bias": sd["cls.predictions.bias"],
             }
         else:              # roberta: lm_head.*
-            mlm = {
+            params["mlm"] = {
                 "dense": {"kernel": np.transpose(sd["lm_head.dense.weight"]),
                           "bias": sd["lm_head.dense.bias"]},
                 "ln": {"scale": sd["lm_head.layer_norm.weight"],
                        "bias": sd["lm_head.layer_norm.bias"]},
                 "bias": sd["lm_head.bias"],
             }
-        return {
-            "wte": {"embedding": sd["embeddings.word_embeddings.weight"]},
-            "wpe": {"embedding": sd["embeddings.position_embeddings.weight"]},
-            "wtt": {"embedding": sd["embeddings.token_type_embeddings.weight"]},
-            "ln_emb": {"scale": sd["embeddings.LayerNorm.weight"],
-                       "bias": sd["embeddings.LayerNorm.bias"]},
-            "mlm": mlm,
-            "blocks": blocks,
-        }
+        return params
 
     return params_fn
 
@@ -755,9 +763,6 @@ def _distilbert_config(hf: Dict[str, Any]) -> Dict[str, Any]:
     if hf.get("sinusoidal_pos_embds", False):
         raise ValueError("sinusoidal-position DistilBERT variants are "
                          "unsupported (learned positions only)")
-    if not hf.get("tie_word_embeddings", True):
-        raise ValueError("untied-embedding MLM checkpoints "
-                         "(tie_word_embeddings=false) are unsupported")
     return dict(
             vocab_size=hf["vocab_size"],
             max_seq_len=hf.get("max_position_embeddings", 512),
@@ -788,20 +793,28 @@ def _distilbert_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dic
         "fc_in": _lin_stack(sd, "transformer.layer.{i}.ffn.lin1", L),
         "fc_out": _lin_stack(sd, "transformer.layer.{i}.ffn.lin2", L),
     }
-    return {
+    params = {
         "wte": {"embedding": sd["embeddings.word_embeddings.weight"]},
         "wpe": {"embedding": sd["embeddings.position_embeddings.weight"]},
         "ln_emb": {"scale": sd["embeddings.LayerNorm.weight"],
                    "bias": sd["embeddings.LayerNorm.bias"]},
-        "mlm": {
+        "blocks": blocks,
+    }
+    if cfg.mlm_head:
+        proj = sd.get("vocab_projector.weight")
+        if proj is not None and not np.array_equal(
+                proj, sd["embeddings.word_embeddings.weight"]):
+            raise ValueError("untied-embedding MLM checkpoints (projector "
+                             "weight differs from word embeddings) are "
+                             "unsupported")
+        params["mlm"] = {
             "dense": {"kernel": np.transpose(sd["vocab_transform.weight"]),
                       "bias": sd["vocab_transform.bias"]},
             "ln": {"scale": sd["vocab_layer_norm.weight"],
                    "bias": sd["vocab_layer_norm.bias"]},
             "bias": sd["vocab_projector.bias"],
-        },
-        "blocks": blocks,
-    }
+        }
+    return params
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,185 @@
+"""Encoder task heads vs HF (reference: the inference test matrix drives
+bert/roberta through text-classification / token-classification /
+question-answering pipelines, ``tests/unit/inference/test_inference.py:62``)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.heads import EncoderTaskModel, load_hf_task_model
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+_DIMS = dict(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+             num_attention_heads=4, intermediate_size=256,
+             max_position_embeddings=64)
+
+
+def _save(tmp_path, model):
+    model.eval().save_pretrained(tmp_path)
+    return tmp_path
+
+
+@pytest.fixture()
+def ids():
+    return np.random.default_rng(0).integers(5, 128, size=(2, 16))
+
+
+def test_bert_sequence_classification_parity(eight_devices, tmp_path, ids):
+    cfg = transformers.BertConfig(num_labels=3, **_DIMS)
+    torch.manual_seed(20)
+    hf = transformers.BertForSequenceClassification(cfg)
+    _save(tmp_path, hf)
+    model, params = load_hf_task_model(str(tmp_path), "sequence_classification",
+                                       dtype=jnp.float32)
+    assert model.num_labels == 3
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_roberta_sequence_classification_parity(eight_devices, tmp_path, ids):
+    cfg = transformers.RobertaConfig(num_labels=2, type_vocab_size=1,
+                                     **{**_DIMS, "max_position_embeddings": 66})
+    torch.manual_seed(21)
+    hf = transformers.RobertaForSequenceClassification(cfg)
+    _save(tmp_path, hf)
+    model, params = load_hf_task_model(str(tmp_path), "sequence_classification",
+                                       dtype=jnp.float32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_distilbert_sequence_classification_parity(eight_devices, tmp_path, ids):
+    cfg = transformers.DistilBertConfig(
+        num_labels=4, vocab_size=128, dim=64, n_layers=2, n_heads=4,
+        hidden_dim=256, max_position_embeddings=64, seq_classif_dropout=0.0)
+    torch.manual_seed(22)
+    hf = transformers.DistilBertForSequenceClassification(cfg)
+    _save(tmp_path, hf)
+    model, params = load_hf_task_model(str(tmp_path), "sequence_classification",
+                                       dtype=jnp.float32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_token_classification_parity(eight_devices, tmp_path, ids):
+    cfg = transformers.BertConfig(num_labels=5, **_DIMS)
+    torch.manual_seed(23)
+    hf = transformers.BertForTokenClassification(cfg)
+    _save(tmp_path, hf)
+    model, params = load_hf_task_model(str(tmp_path), "token_classification",
+                                       dtype=jnp.float32)
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids)).logits.numpy()
+    ours = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(ours), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_question_answering_parity(eight_devices, tmp_path, ids):
+    cfg = transformers.BertConfig(**_DIMS)
+    torch.manual_seed(24)
+    hf = transformers.BertForQuestionAnswering(cfg)
+    _save(tmp_path, hf)
+    model, params = load_hf_task_model(str(tmp_path), "question_answering",
+                                       dtype=jnp.float32)
+    with torch.no_grad():
+        out = hf(torch.tensor(ids))
+        ref_s, ref_e = out.start_logits.numpy(), out.end_logits.numpy()
+    start, end = model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids))
+    np.testing.assert_allclose(np.asarray(start), ref_s, rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(end), ref_e, rtol=2e-4, atol=2e-4)
+
+
+def test_task_model_trains_under_zero(eight_devices, tmp_path, ids):
+    """A loaded classification model fine-tunes through the engine."""
+    import deepspeed_tpu
+    cfg = transformers.BertConfig(num_labels=3, **_DIMS)
+    torch.manual_seed(25)
+    _save(tmp_path, transformers.BertForSequenceClassification(cfg))
+    model, params = load_hf_task_model(str(tmp_path), "sequence_classification",
+                                       dtype=jnp.float32)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(1)
+    batch = {"input_ids": rng.integers(5, 128, size=(8, 16)),
+             "labels": rng.integers(0, 3, size=(8,))}
+    losses = [float(engine.train_batch(batch)) for _ in range(3)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_qa_loss_ignores_out_of_range_positions(eight_devices, tmp_path, ids):
+    """HF convention: positions clamped to [0, S]; S (e.g. truncated answer
+    spans) is the ignore index and contributes no loss — it must NOT be
+    clipped onto the last token."""
+    cfg = transformers.BertConfig(**_DIMS)
+    torch.manual_seed(27)
+    hf = transformers.BertForQuestionAnswering(cfg)
+    _save(tmp_path, hf)
+    model, params = load_hf_task_model(str(tmp_path), "question_answering",
+                                       dtype=jnp.float32)
+    params = jax.tree.map(jnp.asarray, params)
+    S = ids.shape[1]
+    base = {"input_ids": jnp.asarray(ids)}
+    in_range = {**base, "start_positions": jnp.asarray([2, 3]),
+                "end_positions": jnp.asarray([4, 5])}
+    # second example out of range => only the first contributes
+    half_ignored = {**base, "start_positions": jnp.asarray([2, S + 7]),
+                    "end_positions": jnp.asarray([4, S])}
+    only_first = {**base, "start_positions": jnp.asarray([2, 2]),
+                  "end_positions": jnp.asarray([4, 4])}
+    l_half = float(model.loss(params, half_ignored))
+    # reference: HF loss with the same inputs
+    with torch.no_grad():
+        ref = hf(torch.tensor(ids), start_positions=torch.tensor([2, S + 7]),
+                 end_positions=torch.tensor([4, S])).loss.item()
+    np.testing.assert_allclose(l_half, ref, rtol=1e-4)
+    assert l_half != pytest.approx(float(model.loss(params, in_range)))
+
+
+def test_untied_mlm_checkpoint_rejected(eight_devices, tmp_path):
+    """Untied MLM decoders are detected from the WEIGHTS and rejected; a
+    task checkpoint with the same untied config flag loads fine because its
+    head never touches the decoder."""
+    from deepspeed_tpu.runtime.state_dict_factory import load_hf_model
+    mlm_dir = tmp_path / "mlm"
+    cls_dir = tmp_path / "cls"
+    torch.manual_seed(28)
+    _save(mlm_dir, transformers.BertForMaskedLM(
+        transformers.BertConfig(tie_word_embeddings=False, **_DIMS)))
+    _save(cls_dir, transformers.BertForTokenClassification(
+        transformers.BertConfig(tie_word_embeddings=False, num_labels=2, **_DIMS)))
+    with pytest.raises(ValueError, match="untied"):
+        load_hf_model(str(mlm_dir), dtype=jnp.float32)
+    _, params = load_hf_task_model(str(cls_dir), "token_classification",
+                                   dtype=jnp.float32)
+    assert "mlm" not in params
+
+
+def test_qa_loss_and_grads(eight_devices, tmp_path, ids):
+    cfg = transformers.BertConfig(**_DIMS)
+    torch.manual_seed(26)
+    _save(tmp_path, transformers.BertForQuestionAnswering(cfg))
+    model, params = load_hf_task_model(str(tmp_path), "question_answering",
+                                       dtype=jnp.float32)
+    params = jax.tree.map(jnp.asarray, params)
+    rng = np.random.default_rng(2)
+    batch = {"input_ids": jnp.asarray(ids),
+             "start_positions": jnp.asarray(rng.integers(0, 16, size=(2,))),
+             "end_positions": jnp.asarray(rng.integers(0, 16, size=(2,)))}
+    loss, grads = jax.value_and_grad(model.loss)(params, batch)
+    assert bool(jnp.isfinite(loss))
+    gnorm = jax.tree.reduce(lambda a, g: a + jnp.sum(jnp.square(g)), grads,
+                            jnp.zeros(()))
+    assert float(gnorm) > 0.0

@@ -94,7 +94,22 @@ class InferenceEngineV2:
             if self._qcfg is not None:
                 self.params = quantize_placed(self.mesh, specs, self.params,
                                                self._qcfg)
-            kv_spec = NamedSharding(self.mesh, P(None, MODEL_AXIS))
+            # pages layout [L, kvH, P, ps, D]: shard the HEAD dim over the
+            # model axis when it divides — attention is then fully local per
+            # head (k/v projections are already head-column-sharded, so the
+            # per-step KV write lands on the owning rank with no reshard),
+            # matching the reference's TP serving layout. MQA/odd head
+            # counts (kvH % tp != 0 would be a device_put ERROR, not a slow
+            # path) fall back to page-dim sharding: even memory split, XLA
+            # inserts the gathers.
+            tp = self.topology.model_parallel_size
+            if c.kv_heads % tp == 0:
+                spec = P(None, MODEL_AXIS)
+            elif self.kv_cache.num_blocks % tp == 0:
+                spec = P(None, None, MODEL_AXIS)
+            else:  # MQA + indivisible block count: replicate (still correct)
+                spec = P()
+            kv_spec = NamedSharding(self.mesh, spec)
             self.kv_cache.update(
                 jax.device_put(self.kv_cache.k_pages, kv_spec),
                 jax.device_put(self.kv_cache.v_pages, kv_spec))

@@ -53,6 +53,21 @@ class RaggedInferenceModel:
                 "the ragged serving engine generates autoregressively; "
                 "bidirectional encoders (bert/roberta) have no decode "
                 "semantics — use the model's apply() for MLM scoring")
+        if model._windows is not None:
+            # a window >= the serving context is a no-op and safe to ignore;
+            # a smaller one would change logits silently (paged attention
+            # has no sliding-window mask yet)
+            ctx = max_blocks_per_seq * block_size
+            live = [w for w in model._windows if 0 < w < ctx]
+            if live:
+                raise ValueError(
+                    f"sliding-window attention (window {min(live)} < serving "
+                    f"context {ctx}) is not supported by the ragged paged "
+                    f"path yet; shrink max_context below the window or use "
+                    f"inference v1")
+        # gpt-neo's unscaled attention: thread the config's scale override
+        # into every paged program (None → the kernels' 1/sqrt(D) default)
+        self._scale = c.attn_scale
         # bloom: per-head ALiBi bias threaded into every paged-attention
         # program (forces the XLA path; the stock Pallas kernel has no bias)
         self._alibi = (jnp.asarray(model._alibi_slopes)
@@ -203,11 +218,13 @@ class RaggedInferenceModel:
             if Bd:
                 outs.append(paged_decode_attention(
                     q[:Bd], k_l, v_l, d_context_lens, d_block_tables,
-                    use_pallas=self.use_pallas, alibi_slopes=self._alibi))
+                    scale=self._scale, use_pallas=self.use_pallas,
+                    alibi_slopes=self._alibi))
             if Sp:
                 op = ragged_chunk_attention(
                     q[Bd:].reshape(Sp, T, *q.shape[1:]), k_l, v_l,
-                    p_history, p_block_tables, alibi_slopes=self._alibi)
+                    p_history, p_block_tables, scale=self._scale,
+                    alibi_slopes=self._alibi)
                 outs.append(op.reshape(Sp * T, *op.shape[2:]))
             return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
 
@@ -245,6 +262,7 @@ class RaggedInferenceModel:
             vf = v_l.reshape(v_l.shape[0], -1, v_l.shape[-1])
             v_ctx = vf[:, ctx_idx, :]
             return chunk_prefill_attention(q, k_ctx, v_ctx, history_len,
+                                           scale=self._scale,
                                            alibi_slopes=self._alibi)
 
         x, k_pages, v_pages = self._layer_loop(
@@ -284,7 +302,7 @@ class RaggedInferenceModel:
 
             def attn(q, k_l, v_l):
                 return paged_decode_attention(q, k_l, v_l, pos_c + 1,
-                                              block_tables,
+                                              block_tables, scale=self._scale,
                                               use_pallas=self.use_pallas,
                                               alibi_slopes=self._alibi)
 
@@ -319,6 +337,7 @@ class RaggedInferenceModel:
 
         def attn(q, k_l, v_l):
             return paged_decode_attention(q, k_l, v_l, context_lens, block_tables,
+                                          scale=self._scale,
                                           use_pallas=self.use_pallas,
                                           alibi_slopes=self._alibi)
 

@@ -4,7 +4,8 @@ from .llama import llama_config, llama_model  # noqa: F401
 from .mixtral import mixtral_config, mixtral_model  # noqa: F401
 from .opt_phi_falcon import (falcon_config, falcon_model, opt_config,  # noqa: F401
                              opt_model, phi_config, phi_model)
-from .bloom_neox_gptj import (bloom_config, bloom_model, gpt_neox_config,  # noqa: F401
-                              gpt_neox_model, gptj_config, gptj_model)
+from .bloom_neox_gptj import (bloom_config, bloom_model, gpt_neo_config,  # noqa: F401
+                              gpt_neo_model, gpt_neox_config, gpt_neox_model,
+                              gptj_config, gptj_model)
 from .bert import (bert_config, bert_model, roberta_config,  # noqa: F401
                    roberta_model)

@@ -82,6 +82,37 @@ def gpt_neox_model(preset: str = "gpt-neox-20b", **overrides) -> TransformerLM:
     return TransformerLM(gpt_neox_config(preset, **overrides))
 
 
+_GPT_NEO_PRESETS = {
+    "gpt-neo-tiny": dict(num_layers=2, num_heads=4, hidden_size=64,
+                         intermediate_size=256, max_seq_len=64,
+                         vocab_size=256, attn_windows=(0, 8)),
+    "gpt-neo-1.3b": dict(num_layers=24, num_heads=16, hidden_size=2048,
+                         intermediate_size=8192, max_seq_len=2048,
+                         attn_windows=tuple(0 if i % 2 == 0 else 256
+                                            for i in range(24))),
+    "gpt-neo-2.7b": dict(num_layers=32, num_heads=20, hidden_size=2560,
+                         intermediate_size=10240, max_seq_len=2048,
+                         attn_windows=tuple(0 if i % 2 == 0 else 256
+                                            for i in range(32))),
+}
+
+
+def gpt_neo_config(preset: str = "gpt-neo-1.3b", dtype=jnp.bfloat16,
+                   **overrides) -> TransformerConfig:
+    """GPT-Neo: alternating global/local (windowed) attention layers,
+    UNSCALED attention logits, bias-free q/k/v with biased out_proj."""
+    base = dict(vocab_size=50257, activation="gelu", norm="layernorm",
+                position="learned", attn_scale=1.0, attn_bias=False,
+                attn_out_bias=True, tie_embeddings=True, dtype=dtype)
+    base.update(_GPT_NEO_PRESETS[preset])
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gpt_neo_model(preset: str = "gpt-neo-1.3b", **overrides) -> TransformerLM:
+    return TransformerLM(gpt_neo_config(preset, **overrides))
+
+
 def gptj_config(preset: str = "gpt-j-6b", dtype=jnp.bfloat16,
                 **overrides) -> TransformerConfig:
     base = dict(activation="gelu", norm="layernorm", position="rope",

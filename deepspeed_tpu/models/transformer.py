@@ -100,11 +100,17 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     rope_dim: Optional[int] = None   # partial rotary (phi/neox/gpt-j); None => head_dim
     rope_style: str = "half"         # 'half' (llama/neox) | 'interleaved' (gpt-j)
+    # per-layer causal attention windows (mistral sliding_window; gpt-neo
+    # alternating global/local): 0 = global, w > 0 = attend the last w keys.
+    # A single int applies to every layer.
+    attn_windows: Any = None         # Optional[int | Tuple[int, ...]]
+    attn_scale: Optional[float] = None  # gpt-neo: 1.0 (unscaled); None => 1/sqrt(hd)
     embedding_norm: bool = False     # bloom: LayerNorm right after wte
     parallel_block: bool = False     # falcon/phi: x + attn(ln(x)) + mlp(ln(x))
     parallel_norms: bool = False     # falcon-40b/neox: separate ln per parallel branch
     linear_bias: Optional[bool] = None  # None => biases iff layernorm
     attn_bias: Optional[bool] = None    # gpt-j: bias-free attn, biased MLP
+    attn_out_bias: Optional[bool] = None  # gpt-neo: bias-free qkv, biased out_proj
     lm_head_bias: bool = False       # phi/gpt-j lm_head carries a bias
     tie_embeddings: bool = True
     causal: bool = True              # False: bidirectional encoder (bert)
@@ -186,6 +192,24 @@ class TransformerLM:
             raise ValueError("ring attention is causal-only")
         if c.pad_based_positions and c.pad_token_id is None:
             raise ValueError("pad_based_positions requires pad_token_id")
+        if c.attn_windows is not None:
+            if not c.causal:
+                raise ValueError("attention windows are causal-only")
+            if c.seq_parallel == "ring":
+                raise ValueError("attention windows are not supported with "
+                                 "ring sequence parallelism")
+            w = c.attn_windows
+            self._windows = tuple([int(w)] * c.num_layers
+                                  if isinstance(w, int) else map(int, w))
+            if len(self._windows) != c.num_layers:
+                raise ValueError(f"attn_windows has {len(self._windows)} "
+                                 f"entries for {c.num_layers} layers")
+            if not any(self._windows):
+                # all-global (e.g. gpt-neo attention_types [['global'], N]):
+                # treat as windowless so PP and the Pallas gate stay open
+                self._windows = None
+        else:
+            self._windows = None
         if c.position == "alibi":
             if c.seq_parallel == "ring":
                 raise ValueError("alibi positions are not supported with "
@@ -206,13 +230,15 @@ class TransformerLM:
         # gpt-j: attention projections are bias-free while the MLP keeps
         # biases — attn_bias overrides the block-wide default for attn only
         attn_bias = c.attn_bias if c.attn_bias is not None else use_bias
+        attn_out_bias = (c.attn_out_bias if c.attn_out_bias is not None
+                         else attn_bias)
         kv_out = c.kv_heads * c.head_dim
         self._block_layers = {
             "ln_1": norm_cls(c.hidden_size),
             "q_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=attn_bias, shard="column"),
             "k_proj": nn.Linear(c.hidden_size, kv_out, use_bias=attn_bias, shard="column"),
             "v_proj": nn.Linear(c.hidden_size, kv_out, use_bias=attn_bias, shard="column"),
-            "o_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=attn_bias, shard="row"),
+            "o_proj": nn.Linear(c.hidden_size, c.hidden_size, use_bias=attn_out_bias, shard="row"),
         }
         if not c.parallel_block or c.parallel_norms:
             # parallel blocks (falcon-7b/phi) feed attention and MLP from the
@@ -313,10 +339,13 @@ class TransformerLM:
         return jnp.concatenate([rot, x[..., rd:]], axis=-1)
 
     def _attn(self, block: Params, h: jax.Array, positions: jax.Array,
-              attn_mask: Optional[jax.Array] = None) -> jax.Array:
+              attn_mask: Optional[jax.Array] = None,
+              window: Optional[jax.Array] = None) -> jax.Array:
         """Attention over the (pre-normed, or raw for post-LN) input h.
         ``attn_mask`` [B, S] (1 = real token) masks padding bidirectionally
-        via the segment-ids mechanism (encoders)."""
+        via the segment-ids mechanism (encoders). ``window`` (traced scalar,
+        0 = global) restricts each query to the last ``window`` keys
+        (mistral sliding window / gpt-neo local layers)."""
         c = self.config
         B, S, _ = h.shape
         q = self._block_layers["q_proj"](block["q_proj"], h).reshape(B, S, c.num_heads, c.head_dim)
@@ -326,19 +355,25 @@ class TransformerLM:
             q = self._rotate(q, positions)
             k = self._rotate(k, positions)
         seg = attn_mask.astype(jnp.int32) if attn_mask is not None else None
+        kw = {}
+        if c.attn_scale is not None:
+            kw["scale"] = c.attn_scale
+        if window is not None:
+            kw["window"] = window
         if c.seq_parallel == "ring":
             if seg is not None:
                 raise ValueError("ring attention does not support padding "
                                  "masks (attention_mask)")
             from ..sequence.ring_attention import ring_attention
-            out = ring_attention(q, k, v, causal=True)
+            out = ring_attention(q, k, v, causal=True, scale=c.attn_scale)
         elif self._alibi_slopes is not None:
             out = ulysses_attention(flash_attention, q, k, v, causal=c.causal,
                                     segment_ids=seg,
-                                    alibi_slopes=jnp.asarray(self._alibi_slopes))
+                                    alibi_slopes=jnp.asarray(self._alibi_slopes),
+                                    **kw)
         else:
             out = ulysses_attention(flash_attention, q, k, v, causal=c.causal,
-                                    segment_ids=seg)
+                                    segment_ids=seg, **kw)
         out = out.reshape(B, S, c.num_heads * c.head_dim)
         return self._block_layers["o_proj"](block["o_proj"], out)
 
@@ -358,7 +393,11 @@ class TransformerLM:
         return out, aux
 
     def _block_fn(self, attn_mask, carry, block_and_keep):
-        block, keep = block_and_keep
+        if len(block_and_keep) == 3:
+            block, keep, window = block_and_keep
+        else:  # pipeline stage path: global attention only
+            block, keep = block_and_keep
+            window = None
         x, positions, aux_acc = carry
         c = self.config
         # keep: per-layer stochastic-depth gate (progressive layer drop,
@@ -379,13 +418,13 @@ class TransformerLM:
             # falcon/phi residual form: both branches read the block INPUT —
             # through one shared norm (phi/falcon-7b) or per-branch norms
             # (falcon-40b new decoder)
-            attn_out = self._attn(block, h1, positions, attn_mask)
+            attn_out = self._attn(block, h1, positions, attn_mask, window)
             hm = (self._block_layers["ln_2"](block["ln_2"], x)
                   if c.parallel_norms else h1)
             mlp_out, aux = self._mlp(block, hm)
             x = _c(x + keep * (attn_out + mlp_out), ACT_SPEC)
         else:
-            x = x + keep * self._attn(block, h1, positions, attn_mask)
+            x = x + keep * self._attn(block, h1, positions, attn_mask, window)
             h2 = self._block_layers["ln_2"](block["ln_2"], x)
             mlp_out, aux = self._mlp(block, h2)
             x = _c(x + keep * mlp_out, ACT_SPEC)
@@ -435,8 +474,11 @@ class TransformerLM:
             keep = jnp.ones((c.num_layers,), c.dtype)
         else:
             keep = layer_mask.astype(c.dtype)
+        xs = (params["blocks"], keep)
+        if self._windows is not None:
+            xs = xs + (jnp.asarray(self._windows, jnp.int32),)
         (x, _, aux), _ = jax.lax.scan(block_fn, (x, positions, jnp.zeros((), jnp.float32)),
-                                      (params["blocks"], keep))
+                                      xs)
         if self._ln_f is not None:
             x = self._ln_f(params["ln_f"], x)
         if return_hidden:

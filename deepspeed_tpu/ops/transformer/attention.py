@@ -36,7 +36,8 @@ def alibi_slopes(num_heads: int):
 
 def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    scale: Optional[float], segment_ids: Optional[jax.Array],
-                   alibi: Optional[jax.Array] = None) -> jax.Array:
+                   alibi: Optional[jax.Array] = None,
+                   window: Optional[jax.Array] = None) -> jax.Array:
     """Reference-semantics attention in pure XLA, GQA-NATIVE: K/V keep
     their kv_heads — query heads are grouped for the contractions, so
     grouped-query models never materialize a repeated KV.
@@ -66,6 +67,12 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         logits = logits + alibi.reshape(kvH, G)[None, :, :, None, None] * rel
     if causal:
         mask = q_pos >= k_pos
+        if window is not None:
+            # 0 = global; w > 0: query attends keys in (q_pos - w, q_pos].
+            # Traced scalar — one compiled block serves gpt-neo's
+            # alternating global/local pattern through the layer scan.
+            w = jnp.asarray(window, jnp.int32)
+            mask = mask & ((w <= 0) | (q_pos - k_pos < w))
         logits = jnp.where(mask[None, None, None], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -105,20 +112,21 @@ def flash_attention(q: jax.Array,
                     causal: bool = True,
                     scale: Optional[float] = None,
                     segment_ids: Optional[jax.Array] = None,
-                    alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
+                    alibi_slopes: Optional[jax.Array] = None,
+                    window: Optional[jax.Array] = None) -> jax.Array:
     """Multi-head attention, [B, S, H, D] layout, GQA-aware.
 
     Dispatches to the Pallas TPU flash kernel when shapes allow, else XLA.
     The XLA path consumes GQA natively; the Pallas stock kernel needs
     matched head counts, so only there K/V are broadcast up.
-    ``alibi_slopes`` [num_heads] adds the ALiBi positional bias (bloom) —
-    XLA path only.
+    ``alibi_slopes`` [num_heads] adds the ALiBi positional bias (bloom);
+    ``window`` (0 = global) is the causal sliding window — XLA path only.
     """
     head_dim = q.shape[-1]
     # head_dim 64 (gpt2) is supported by the stock kernel — Mosaic pads the
     # lane dim; requiring %128 hid the Pallas path from the benched model
     if (_pallas_flash_available() and segment_ids is None
-            and alibi_slopes is None and head_dim % 64 == 0
+            and alibi_slopes is None and window is None and head_dim % 64 == 0
             and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0):
         num_q_heads, num_kv_heads = q.shape[2], k.shape[2]
         if num_kv_heads != num_q_heads:
@@ -134,7 +142,8 @@ def flash_attention(q: jax.Array,
             causal=causal, sm_scale=sm_scale)
         return out.transpose(0, 2, 1, 3)
     _log_path_once("xla")
-    return _xla_attention(q, k, v, causal, scale, segment_ids, alibi_slopes)
+    return _xla_attention(q, k, v, causal, scale, segment_ids, alibi_slopes,
+                          window)
 
 
 @functools.lru_cache(None)

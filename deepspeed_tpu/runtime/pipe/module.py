@@ -58,6 +58,9 @@ class PipelineModule:
         self.layers_per_stage = config.num_layers // num_stages
         self.num_microbatches = num_microbatches or num_stages
         self._lm = TransformerLM(config)
+        if self._lm._windows is not None:  # all-zero windows normalize away
+            raise ValueError("per-layer attention windows are not threaded "
+                             "through the pipeline stage scan yet")
 
     # -- params: reshape blocks [L, ...] -> [P, L/P, ...] --------------------
     def init(self, rng: jax.Array, dtype=jnp.float32) -> Dict[str, Any]:

@@ -170,6 +170,9 @@ def _llama_family_config(hf: Dict[str, Any]) -> Dict[str, Any]:
         cfg["moe"] = MoEConfig(
             num_experts=hf.get("num_local_experts", 8),
             top_k=hf.get("num_experts_per_tok", 2))
+    # mistral/mixtral causal sliding window (null in many configs = global)
+    if hf.get("sliding_window"):
+        cfg["attn_windows"] = int(hf["sliding_window"])
     return cfg
 
 
@@ -759,6 +762,55 @@ def _bert_params_for(prefix: str, head: str):
     return params_fn
 
 
+def _gpt_neo_config(hf: Dict[str, Any]) -> Dict[str, Any]:
+    # attention_types [[["global","local"], N], ...] expands to a per-layer
+    # pattern; local layers attend a window_size causal window
+    layers = []
+    for types, n in hf.get("attention_types") or [[["global"], hf["num_layers"]]]:
+        layers.extend(list(types) * n)
+    if len(layers) != hf["num_layers"]:
+        raise ValueError(f"attention_types expands to {len(layers)} layers, "
+                         f"config has {hf['num_layers']}")
+    window = int(hf.get("window_size", 256))
+    return dict(
+            vocab_size=hf["vocab_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            num_layers=hf["num_layers"],
+            num_heads=hf["num_heads"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf.get("intermediate_size") or 4 * hf["hidden_size"],
+            activation=_map_activation(hf.get("activation_function", "gelu_new")),
+            norm="layernorm", position="learned",
+            attn_windows=tuple(window if t == "local" else 0 for t in layers),
+            attn_scale=1.0,  # gpt-neo applies NO 1/sqrt(d) scaling
+            attn_bias=False, attn_out_bias=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            tie_embeddings=hf.get("tie_word_embeddings", True))
+
+
+def _gpt_neo_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """HF GPT-Neo: transformer.* naming, nn.Linear ([out, in]) everywhere,
+    bias-free q/k/v with a biased out_proj."""
+    sd = _strip_prefix(sd, "transformer.")
+    L = cfg.num_layers
+    blocks = {
+        "ln_1": _ln_stack(sd, "h.{i}.ln_1", L),
+        "ln_2": _ln_stack(sd, "h.{i}.ln_2", L),
+        "q_proj": _lin_stack(sd, "h.{i}.attn.attention.q_proj", L, bias=False),
+        "k_proj": _lin_stack(sd, "h.{i}.attn.attention.k_proj", L, bias=False),
+        "v_proj": _lin_stack(sd, "h.{i}.attn.attention.v_proj", L, bias=False),
+        "o_proj": _lin_stack(sd, "h.{i}.attn.attention.out_proj", L),
+        "fc_in": _lin_stack(sd, "h.{i}.mlp.c_fc", L),
+        "fc_out": _lin_stack(sd, "h.{i}.mlp.c_proj", L),
+    }
+    return {
+        "wte": {"embedding": sd["wte.weight"]},
+        "wpe": {"embedding": sd["wpe.weight"]},
+        "ln_f": {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        "blocks": blocks,
+    }
+
+
 def _distilbert_config(hf: Dict[str, Any]) -> Dict[str, Any]:
     if hf.get("sinusoidal_pos_embds", False):
         raise ValueError("sinusoidal-position DistilBERT variants are "
@@ -1008,6 +1060,7 @@ def _register_builtins() -> None:
     register_architecture("roberta", _roberta_config,
                           _bert_params_for("roberta.", "lm_head"))
     register_architecture("distilbert", _distilbert_config, _distilbert_params)
+    register_architecture("gpt_neo", _gpt_neo_config, _gpt_neo_params)
 
 
 _register_builtins()

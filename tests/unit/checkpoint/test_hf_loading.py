@@ -206,6 +206,36 @@ def roberta_ckpt(tmp_path_factory):
 
 
 @pytest.fixture(scope="module")
+def gpt_neo_ckpt(tmp_path_factory):
+    """alternating global/local attention (window 4 < seq so it matters),
+    UNSCALED attention, bias-free q/k/v with biased out_proj."""
+    path = tmp_path_factory.mktemp("hf_gpt_neo")
+    cfg = transformers.GPTNeoConfig(
+        vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=256, max_position_embeddings=64,
+        attention_types=[[["global", "local"], 1]], window_size=4)
+    torch.manual_seed(15)
+    m = transformers.GPTNeoForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def mistral_sw_ckpt(tmp_path_factory):
+    """mistral with a sliding window SMALLER than the test sequence, so the
+    window mask actually changes logits."""
+    path = tmp_path_factory.mktemp("hf_mistral_sw")
+    cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=6)
+    torch.manual_seed(16)
+    m = transformers.MistralForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
 def distilbert_ckpt(tmp_path_factory):
     """no token types, q_lin/k_lin naming, vocab_transform MLM head."""
     path = tmp_path_factory.mktemp("hf_distilbert")
@@ -235,7 +265,8 @@ def _our_logits(path, ids, **overrides):
                                   "bloom_ckpt", "gpt_neox_ckpt",
                                   "gpt_neox_seq_ckpt", "gpt_neox_nobias_ckpt",
                                   "gptj_ckpt", "bert_ckpt", "roberta_ckpt",
-                                  "distilbert_ckpt"])
+                                  "distilbert_ckpt", "gpt_neo_ckpt",
+                                  "mistral_sw_ckpt"])
 def test_hf_logits_parity(request, eight_devices, ckpt):
     """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
     path, m = request.getfixturevalue(ckpt)
@@ -387,6 +418,36 @@ def test_bert_mlm_trains_under_zero(eight_devices, bert_ckpt):
     batch = {"input_ids": masked, "labels": labels}
     losses = [float(engine.train_batch(batch)) for _ in range(3)]
     assert losses[-1] < losses[0], losses
+
+
+def test_v2_engine_gates_sub_context_windows(eight_devices, mistral_sw_ckpt,
+                                             gpt_neo_ckpt):
+    """The paged path has no sliding-window mask: a window smaller than the
+    serving context must fail loudly, and v1 must still serve it correctly
+    (greedy matches HF generate through the windowed layers)."""
+    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
+    path, m = mistral_sw_ckpt
+    with pytest.raises(ValueError, match="sliding-window"):
+        build_hf_engine(str(path))
+    engine = deepspeed_tpu.init_inference(
+        model_path=str(path), config={"dtype": jnp.float32})
+    prompt = np.random.default_rng(12).integers(0, 128, size=(1, 14))
+    with torch.no_grad():
+        ref = m.generate(torch.tensor(prompt), max_new_tokens=6,
+                         do_sample=False).numpy()[0, 14:]
+    out = np.asarray(engine.generate(jnp.asarray(prompt),
+                                     max_new_tokens=6))[0, 14:]
+    np.testing.assert_array_equal(out, ref)
+    # gpt-neo (unscaled + local layers) through v1 greedy as well
+    path_n, m_n = gpt_neo_ckpt
+    engine_n = deepspeed_tpu.init_inference(
+        model_path=str(path_n), config={"dtype": jnp.float32})
+    with torch.no_grad():
+        ref_n = m_n.generate(torch.tensor(prompt), max_new_tokens=6,
+                             do_sample=False).numpy()[0, 14:]
+    out_n = np.asarray(engine_n.generate(jnp.asarray(prompt),
+                                         max_new_tokens=6))[0, 14:]
+    np.testing.assert_array_equal(out_n, ref_n)
 
 
 def test_v1_inference_alibi(eight_devices, bloom_ckpt):

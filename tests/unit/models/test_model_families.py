@@ -10,9 +10,9 @@ import jax
 import jax.numpy as jnp
 
 from deepspeed_tpu.models import (bert_model, bloom_model, falcon_model,
-                                  gpt2_model, gpt_neox_model, gptj_model,
-                                  llama_model, mixtral_model, opt_model,
-                                  phi_model, roberta_model)
+                                  gpt2_model, gpt_neo_model, gpt_neox_model,
+                                  gptj_model, llama_model, mixtral_model,
+                                  opt_model, phi_model, roberta_model)
 
 TINY = dict(max_seq_len=32, vocab_size=128, remat=False, dtype=jnp.float32)
 
@@ -35,6 +35,8 @@ FAMILIES = {
     # bidirectional post-LN encoder + segment embeddings + MLM head
     "bert": lambda: bert_model("bert-tiny", **TINY),
     "roberta": lambda: roberta_model("bert-tiny", **TINY),
+    # alternating global/local windowed attention, unscaled logits
+    "gpt-neo": lambda: gpt_neo_model("gpt-neo-tiny", **TINY),
 }
 
 

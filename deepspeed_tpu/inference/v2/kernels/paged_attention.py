@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ....ops.transformer.attention import sliding_window_allowed
+
 NEG_INF = -2.3819763e38  # pallas kernel's mask value
 
 
@@ -72,7 +74,8 @@ def _gqa_logits(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
 
 
 def _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables,
-                      scale: float, alibi_slopes=None) -> jax.Array:
+                      scale: float, alibi_slopes=None,
+                      window=None) -> jax.Array:
     k = _gather_pages(k_pages, block_tables)
     v = _gather_pages(v_pages, block_tables)
     B, kvH, C, D = k.shape
@@ -84,6 +87,11 @@ def _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables,
                - (context_lens[:, None] - 1)).astype(jnp.float32)  # [B, C]
         logits = logits + alibi_slopes[None, :, None] * rel[:, None, :]
     mask = jnp.arange(C)[None, :] < context_lens[:, None]
+    if window is not None:
+        # sliding window: the decode query (pos context_lens-1) sees only
+        # the last `window` keys; 0 = global
+        mask = mask & sliding_window_allowed(
+            context_lens[:, None] - 1, jnp.arange(C)[None, :], window)
     logits = jnp.where(mask[:, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     pg = probs.reshape(B, kvH, H // kvH, C)
@@ -98,19 +106,21 @@ def paged_decode_attention(q: jax.Array,
                            block_tables: jax.Array,
                            scale: Optional[float] = None,
                            use_pallas: Optional[bool] = None,
-                           alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
+                           alibi_slopes: Optional[jax.Array] = None,
+                           window: Optional[jax.Array] = None) -> jax.Array:
     """q [B, H, D]; returns [B, H, D].
 
     ``context_lens[b]`` counts tokens *including* the one just written at
     position ``context_lens[b]-1``. ``alibi_slopes`` [H] adds the ALiBi
-    bias (bloom) — XLA path only.
+    bias (bloom); ``window`` (traced scalar, 0 = global) is the causal
+    sliding window — XLA path only.
     """
     D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     if use_pallas is None:
         use_pallas = _pallas_paged_available()
-    if alibi_slopes is not None:
-        use_pallas = False  # stock kernel has no bias input
+    if alibi_slopes is not None or window is not None:
+        use_pallas = False  # stock kernel has no bias/window inputs
     if use_pallas:
         from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention as pa_kernel
         pages_per_block = min(8, block_tables.shape[1])
@@ -134,7 +144,7 @@ def paged_decode_attention(q: jax.Array,
                     f"q={q.shape} pages={k_pages.shape} "
                     f"({type(e).__name__}: {e}); using XLA gather fallback")
     return _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables,
-                             scale, alibi_slopes)
+                             scale, alibi_slopes, window)
 
 
 _KERNEL_FALLBACK_WARNED = False
@@ -146,7 +156,8 @@ def ragged_chunk_attention(q: jax.Array,
                            history_lens: jax.Array,
                            block_tables: jax.Array,
                            scale: Optional[float] = None,
-                           alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
+                           alibi_slopes: Optional[jax.Array] = None,
+                           window: Optional[jax.Array] = None) -> jax.Array:
     """Batched SplitFuse attention: S sequences × T chunk tokens each.
 
     The one-program form of the reference's ``build_atoms`` +
@@ -178,6 +189,9 @@ def ragged_chunk_attention(q: jax.Array,
         logits = logits + (alibi_slopes.reshape(kvH, group)[None, :, :, None, None]
                            * rel[:, None, None])
     allowed = jnp.arange(C)[None, None, :] <= pos_q[:, :, None]   # [S, T, C]
+    if window is not None:
+        allowed = allowed & sliding_window_allowed(
+            pos_q[:, :, None], jnp.arange(C)[None, None, :], window)
     logits = jnp.where(allowed[:, None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("skgtc,skcd->skgtd", probs, v)
@@ -189,7 +203,8 @@ def chunk_prefill_attention(q: jax.Array,
                             v_ctx: jax.Array,
                             history_len: jax.Array,
                             scale: Optional[float] = None,
-                            alibi_slopes: Optional[jax.Array] = None) -> jax.Array:
+                            alibi_slopes: Optional[jax.Array] = None,
+                            window: Optional[jax.Array] = None) -> jax.Array:
     """SplitFuse prefill-chunk attention for ONE sequence.
 
     q [T, H, D] — chunk queries at absolute positions history_len + i.
@@ -210,6 +225,9 @@ def chunk_prefill_attention(q: jax.Array,
         logits = logits + (alibi_slopes.reshape(kvH, group)[:, :, None, None]
                            * rel[None, None])
     allowed = jnp.arange(C)[None, :] <= pos_q[:, None]
+    if window is not None:
+        allowed = allowed & sliding_window_allowed(
+            pos_q[:, None], jnp.arange(C)[None, :], window)
     logits = jnp.where(allowed[None, None], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     out = jnp.einsum("kgtc,kcd->kgtd", probs, v_ctx)

@@ -53,18 +53,14 @@ class RaggedInferenceModel:
                 "the ragged serving engine generates autoregressively; "
                 "bidirectional encoders (bert/roberta) have no decode "
                 "semantics — use the model's apply() for MLM scoring")
+        # per-layer sliding windows (mistral / gpt-neo): a [L] vector read
+        # inside the layer loop; forces the XLA paged path (the stock Pallas
+        # kernel takes no window mask)
         if model._windows is not None:
-            # a window >= the serving context is a no-op and safe to ignore;
-            # a smaller one would change logits silently (paged attention
-            # has no sliding-window mask yet)
-            ctx = max_blocks_per_seq * block_size
-            live = [w for w in model._windows if 0 < w < ctx]
-            if live:
-                raise ValueError(
-                    f"sliding-window attention (window {min(live)} < serving "
-                    f"context {ctx}) is not supported by the ragged paged "
-                    f"path yet; shrink max_context below the window or use "
-                    f"inference v1")
+            self._windows_arr = jnp.asarray(model._windows, jnp.int32)
+            self.use_pallas = False
+        else:
+            self._windows_arr = None
         # gpt-neo's unscaled attention: thread the config's scale override
         # into every paged program (None → the kernels' 1/sqrt(D) default)
         self._scale = c.attn_scale
@@ -151,7 +147,9 @@ class RaggedInferenceModel:
             v_l = self._write_kv(v_pages[l], v, write_idx)
             k_pages = k_pages.at[l].set(k_l)
             v_pages = v_pages.at[l].set(v_l)
-            attn_out = attn_fn(q, k_l, v_l)
+            win = (self._windows_arr[l] if self._windows_arr is not None
+                   else None)
+            attn_out = attn_fn(q, k_l, v_l, win)
             o = m._block_layers["o_proj"](
                 block["o_proj"], attn_out.reshape(x.shape[0], -1))
             if c.parallel_block:
@@ -213,18 +211,18 @@ class RaggedInferenceModel:
         write_idx = jnp.clip(
             jnp.concatenate([d_write, p_write.reshape(-1)]), 0, max_flat - 1)
 
-        def attn(q, k_l, v_l):
+        def attn(q, k_l, v_l, window):
             outs = []
             if Bd:
                 outs.append(paged_decode_attention(
                     q[:Bd], k_l, v_l, d_context_lens, d_block_tables,
                     scale=self._scale, use_pallas=self.use_pallas,
-                    alibi_slopes=self._alibi))
+                    alibi_slopes=self._alibi, window=window))
             if Sp:
                 op = ragged_chunk_attention(
                     q[Bd:].reshape(Sp, T, *q.shape[1:]), k_l, v_l,
                     p_history, p_block_tables, scale=self._scale,
-                    alibi_slopes=self._alibi)
+                    alibi_slopes=self._alibi, window=window)
                 outs.append(op.reshape(Sp * T, *op.shape[2:]))
             return outs[0] if len(outs) == 1 else jnp.concatenate(outs)
 
@@ -256,14 +254,15 @@ class RaggedInferenceModel:
 
         ctx_idx = (block_table[:, None] * ps + jnp.arange(ps)[None, :]).reshape(-1)
 
-        def attn(q, k_l, v_l):
+        def attn(q, k_l, v_l, window):
             kf = k_l.reshape(k_l.shape[0], -1, k_l.shape[-1])
             k_ctx = kf[:, ctx_idx, :]
             vf = v_l.reshape(v_l.shape[0], -1, v_l.shape[-1])
             v_ctx = vf[:, ctx_idx, :]
             return chunk_prefill_attention(q, k_ctx, v_ctx, history_len,
                                            scale=self._scale,
-                                           alibi_slopes=self._alibi)
+                                           alibi_slopes=self._alibi,
+                                           window=window)
 
         x, k_pages, v_pages = self._layer_loop(
             params, k_pages, v_pages, x, attn, write_idx, positions)
@@ -300,11 +299,12 @@ class RaggedInferenceModel:
                                            axis=1)[:, 0]
             write_idx = jnp.clip(pages_of * ps + pos_c % ps, 0, max_flat - 1)
 
-            def attn(q, k_l, v_l):
+            def attn(q, k_l, v_l, window):
                 return paged_decode_attention(q, k_l, v_l, pos_c + 1,
                                               block_tables, scale=self._scale,
                                               use_pallas=self.use_pallas,
-                                              alibi_slopes=self._alibi)
+                                              alibi_slopes=self._alibi,
+                                              window=window)
 
             x, k_pages, v_pages = self._layer_loop(
                 params, k_pages, v_pages, x, attn, write_idx, positions)
@@ -335,11 +335,12 @@ class RaggedInferenceModel:
                                        axis=1)[:, 0]
         write_idx = jnp.clip(pages_of * ps + pos_c % ps, 0, max_flat - 1)
 
-        def attn(q, k_l, v_l):
+        def attn(q, k_l, v_l, window):
             return paged_decode_attention(q, k_l, v_l, context_lens, block_tables,
                                           scale=self._scale,
                                           use_pallas=self.use_pallas,
-                                          alibi_slopes=self._alibi)
+                                          alibi_slopes=self._alibi,
+                                          window=window)
 
         x, k_pages, v_pages = self._layer_loop(
             params, k_pages, v_pages, x, attn, write_idx, positions)

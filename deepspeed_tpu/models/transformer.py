@@ -204,9 +204,13 @@ class TransformerLM:
             if len(self._windows) != c.num_layers:
                 raise ValueError(f"attn_windows has {len(self._windows)} "
                                  f"entries for {c.num_layers} layers")
+            # windows that can never bind (>= max_seq_len, e.g. mistral's
+            # 4096 under a 4096 context) normalize to global, and all-global
+            # patterns to None, so PP and the Pallas gate stay open for
+            # effectively-windowless models
+            self._windows = tuple(0 if wi >= c.max_seq_len else wi
+                                  for wi in self._windows)
             if not any(self._windows):
-                # all-global (e.g. gpt-neo attention_types [['global'], N]):
-                # treat as windowless so PP and the Pallas gate stay open
                 self._windows = None
         else:
             self._windows = None

@@ -34,6 +34,16 @@ def alibi_slopes(num_heads: int):
     return slopes.astype(np.float32)
 
 
+def sliding_window_allowed(q_pos: jax.Array, k_pos: jax.Array,
+                           window) -> jax.Array:
+    """True where key ``k_pos`` is within the causal sliding window of query
+    ``q_pos`` (broadcasting); ``window`` is a (possibly traced) scalar,
+    <= 0 = global. ONE definition shared by the training kernel and all
+    three paged serving programs so the four paths cannot diverge."""
+    w = jnp.asarray(window, jnp.int32)
+    return (w <= 0) | ((q_pos - k_pos) < w)
+
+
 def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    scale: Optional[float], segment_ids: Optional[jax.Array],
                    alibi: Optional[jax.Array] = None,
@@ -68,11 +78,9 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     if causal:
         mask = q_pos >= k_pos
         if window is not None:
-            # 0 = global; w > 0: query attends keys in (q_pos - w, q_pos].
-            # Traced scalar — one compiled block serves gpt-neo's
-            # alternating global/local pattern through the layer scan.
-            w = jnp.asarray(window, jnp.int32)
-            mask = mask & ((w <= 0) | (q_pos - k_pos < w))
+            # traced scalar — one compiled block serves gpt-neo's
+            # alternating global/local pattern through the layer scan
+            mask = mask & sliding_window_allowed(q_pos, k_pos, window)
         logits = jnp.where(mask[None, None, None], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]

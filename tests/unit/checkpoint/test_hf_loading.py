@@ -306,7 +306,8 @@ def test_shard_param_tree_matches_device_slices(eight_devices, llama_ckpt):
 
 @pytest.mark.parametrize("ckpt", ["llama_ckpt", "opt_ckpt", "phi_ckpt",
                                   "falcon_gqa_ckpt", "bloom_ckpt",
-                                  "gpt_neox_ckpt", "gptj_ckpt"])
+                                  "gpt_neox_ckpt", "gptj_ckpt",
+                                  "mistral_sw_ckpt", "gpt_neo_ckpt"])
 def test_build_hf_engine_v2_greedy_matches_hf(request, eight_devices, ckpt):
     """The ragged serving engine loaded from the checkpoint must greedy-decode
     the same tokens as HF ``generate`` — across the decoder family matrix."""
@@ -420,34 +421,20 @@ def test_bert_mlm_trains_under_zero(eight_devices, bert_ckpt):
     assert losses[-1] < losses[0], losses
 
 
-def test_v2_engine_gates_sub_context_windows(eight_devices, mistral_sw_ckpt,
-                                             gpt_neo_ckpt):
-    """The paged path has no sliding-window mask: a window smaller than the
-    serving context must fail loudly, and v1 must still serve it correctly
-    (greedy matches HF generate through the windowed layers)."""
-    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
-    path, m = mistral_sw_ckpt
-    with pytest.raises(ValueError, match="sliding-window"):
-        build_hf_engine(str(path))
-    engine = deepspeed_tpu.init_inference(
-        model_path=str(path), config={"dtype": jnp.float32})
+def test_windowed_models_serve_v1(eight_devices, mistral_sw_ckpt,
+                                  gpt_neo_ckpt):
+    """v1 greedy matches HF generate through windowed layers (mistral
+    sub-sequence sliding window; gpt-neo unscaled + alternating local)."""
     prompt = np.random.default_rng(12).integers(0, 128, size=(1, 14))
-    with torch.no_grad():
-        ref = m.generate(torch.tensor(prompt), max_new_tokens=6,
-                         do_sample=False).numpy()[0, 14:]
-    out = np.asarray(engine.generate(jnp.asarray(prompt),
-                                     max_new_tokens=6))[0, 14:]
-    np.testing.assert_array_equal(out, ref)
-    # gpt-neo (unscaled + local layers) through v1 greedy as well
-    path_n, m_n = gpt_neo_ckpt
-    engine_n = deepspeed_tpu.init_inference(
-        model_path=str(path_n), config={"dtype": jnp.float32})
-    with torch.no_grad():
-        ref_n = m_n.generate(torch.tensor(prompt), max_new_tokens=6,
+    for path, m in (mistral_sw_ckpt, gpt_neo_ckpt):
+        engine = deepspeed_tpu.init_inference(
+            model_path=str(path), config={"dtype": jnp.float32})
+        with torch.no_grad():
+            ref = m.generate(torch.tensor(prompt), max_new_tokens=6,
                              do_sample=False).numpy()[0, 14:]
-    out_n = np.asarray(engine_n.generate(jnp.asarray(prompt),
+        out = np.asarray(engine.generate(jnp.asarray(prompt),
                                          max_new_tokens=6))[0, 14:]
-    np.testing.assert_array_equal(out_n, ref_n)
+        np.testing.assert_array_equal(out, ref)
 
 
 def test_v1_inference_alibi(eight_devices, bloom_ckpt):

@@ -10,12 +10,17 @@ Layout (tag-based dirs like the reference):
     <dir>/<tag>/meta.json        # treedef paths, dtypes, client state
     <dir>/latest                 # text file holding the newest tag
 
-Leaves are saved *unsharded* (gathered) in this round-1 store; sharded leaves
-are fetched with ``jax.device_get`` which performs the gather. On load,
-leaves are re-placed with the engine's sharding tree, so a checkpoint written
-under one topology loads under any other — the "universal checkpoint"
-property the reference needs a whole offline tool for (``checkpoint/
-ds_to_universal.py``) falls out of addressing params by logical name.
+Single-process runs save leaves *unsharded* (``jax.device_get`` gathers).
+Multi-host runs save per-process shard files (``state.rank{p}.npz``) — each
+process writes only the pieces whose ``replica_id == 0`` live on its
+devices (the reference's per-dp-rank zero shards, ``engine.py:3467``),
+because remote shards are not addressable and a full gather would be both
+impossible and wasteful. On load the rank files reassemble by global index
+and leaves are re-placed with the engine's sharding tree, so a checkpoint
+written under one topology/process count loads under any other — the
+"universal checkpoint" property the reference needs a whole offline tool
+for (``checkpoint/ds_to_universal.py``) falls out of addressing params by
+logical name.
 """
 
 from __future__ import annotations
@@ -36,25 +41,138 @@ def _flatten_with_paths(tree) -> Dict[str, Any]:
     return flat
 
 
+def _owned_pieces(i: int, v) -> Dict[str, np.ndarray]:
+    """This process's canonical pieces of leaf i: addressable shards with
+    ``replica_id == 0`` (exactly one copy of every byte exists across all
+    rank files). Piece key encodes the global index:
+    ``leaf_{i}__{start}_{stop}__{start}_{stop}...``."""
+    out = {}
+    for s in v.addressable_shards:
+        if s.replica_id != 0:
+            continue
+        idx = s.index if s.index else ()
+        spans = "__".join(
+            f"{sl.start or 0}_{sl.stop if sl.stop is not None else v.shape[d]}"
+            for d, sl in enumerate(idx))
+        out[f"leaf_{i}__{spans}" if spans else f"leaf_{i}__full"] = (
+            np.asarray(s.data))
+    return out
+
+
 def save_checkpoint(save_dir: str, tag: str, state, client_state: Dict[str, Any],
                     save_latest: bool = True) -> None:
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
     flat = _flatten_with_paths(state)
-    host = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
-    # npz keys cannot contain some chars; index them
-    keys = sorted(host.keys())
-    np.savez(os.path.join(path, "state.npz"), **{f"leaf_{i}": host[k] for i, k in enumerate(keys)})
-    meta = {
-        "keys": keys,
-        "dtypes": {k: str(host[k].dtype) for k in keys},
-        "client_state": client_state,
-    }
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2, default=str)
-    if save_latest:
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(tag)
+    keys = sorted(flat.keys())
+    pcount = jax.process_count()
+    if pcount == 1:
+        host = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
+        # npz keys cannot contain some chars; index them
+        np.savez(os.path.join(path, "state.npz"),
+                 **{f"leaf_{i}": host[k] for i, k in enumerate(keys)})
+        # an elastic restart may re-save a tag previously written at
+        # another process count — stale rank files must not shadow this
+        import glob as _glob
+        for f in _glob.glob(os.path.join(path, "state.rank*.npz")):
+            os.remove(f)
+        dtypes = {k: str(host[k].dtype) for k in keys}
+        shapes = {k: list(host[k].shape) for k in keys}
+    else:
+        # multi-host: remote shards are not addressable — every process
+        # writes its replica-0 pieces; the union across rank files tiles
+        # each leaf exactly once
+        pieces: Dict[str, np.ndarray] = {}
+        for i, k in enumerate(keys):
+            v = flat[k]
+            if hasattr(v, "addressable_shards"):
+                pieces.update(_owned_pieces(i, v))
+            elif jax.process_index() == 0:  # host scalars/ndarrays
+                pieces[f"leaf_{i}__full"] = np.asarray(v)
+        np.savez(os.path.join(path, f"state.rank{jax.process_index()}.npz"),
+                 **pieces)
+        dtypes = {k: str(np.dtype(flat[k].dtype)) for k in keys}
+        shapes = {k: list(np.shape(flat[k])) for k in keys}
+        # commit fence: every rank's shard file must be on disk before rank
+        # 0 writes meta.json and repoints `latest` — otherwise a crash in
+        # the window leaves `latest` naming an unreadable checkpoint
+        from ..comm import comm as _comm
+        _comm.barrier()
+        if jax.process_index() == 0:
+            single = os.path.join(path, "state.npz")
+            if os.path.exists(single):  # stale single-process format
+                os.remove(single)
+    if pcount == 1 or jax.process_index() == 0:
+        meta = {
+            "keys": keys,
+            "dtypes": dtypes,
+            "shapes": shapes,
+            "num_shard_files": pcount if pcount > 1 else 0,
+            "client_state": client_state,
+        }
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump(meta, f, indent=2, default=str)
+        if save_latest:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(tag)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """numpy dtype from its saved string name; ml_dtypes names (bfloat16,
+    int4, ...) are not always registered with np.dtype."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _reassemble_rank_shards(path: str, meta: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Rebuild full leaves from per-process shard files: pieces are keyed
+    ``leaf_{i}__{start}_{stop}...`` with global index spans; replica-0
+    filtering at save time guarantees each byte appears exactly once.
+
+    Known cost: every loading process materializes the FULL state on host
+    before re-sharding (reads all N rank files). For resume at the largest
+    scales a span filter against the target shardings' local indices would
+    bound this at 1/n_hosts — acceptable today because resume is rare and
+    host RAM on TPU VMs is large relative to per-host HBM."""
+    keys = meta["keys"]
+    out: Dict[str, np.ndarray] = {}
+    filled: Dict[int, int] = {}
+    n = int(meta.get("num_shard_files") or 0)
+    files = [os.path.join(path, f"state.rank{p}.npz") for p in range(n)]
+    missing = [f for f in files if not os.path.exists(f)]
+    if missing:
+        raise FileNotFoundError(
+            f"checkpoint is missing shard files {missing} — all "
+            f"{n} per-process files are required to reassemble")
+    for f in files:
+        data = np.load(f)
+        for piece_key in data.files:
+            head, _, spans = piece_key.partition("__")
+            i = int(head[len("leaf_"):])
+            k = keys[i]
+            piece = data[piece_key]
+            if spans == "full" or not spans:
+                out[k] = piece
+                filled[i] = piece.size
+                continue
+            if k not in out:
+                out[k] = np.empty(meta["shapes"][k],
+                                  dtype=_np_dtype(meta["dtypes"][k]))
+                filled[i] = 0
+            bounds = [tuple(map(int, s.split("_")))
+                      for s in spans.split("__")]
+            out[k][tuple(slice(a, b) for a, b in bounds)] = piece
+            filled[i] += piece.size
+    for i, k in enumerate(keys):
+        if k not in out or filled.get(i, 0) != int(np.prod(meta["shapes"][k] or [1])):
+            raise ValueError(
+                f"checkpoint leaf '{k}' reassembled "
+                f"{filled.get(i, 0)} of {np.prod(meta['shapes'][k] or [1])} "
+                f"elements — shard files are inconsistent")
+    return out
 
 
 def load_checkpoint(load_dir: str, tag: Optional[str], state_template, shardings,
@@ -67,13 +185,19 @@ def load_checkpoint(load_dir: str, tag: Optional[str], state_template, shardings
         with open(latest_path) as f:
             tag = f.read().strip()
     path = os.path.join(load_dir, tag)
-    if not os.path.exists(os.path.join(path, "state.npz")):
+    # meta.json is the commit record (written LAST, after all data files):
+    # its absence means "no checkpoint"; once present, missing data files
+    # are corruption and fail loudly instead of silently re-initializing
+    meta_path = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_path):
         return None, {}, None
-
-    with open(os.path.join(path, "meta.json")) as f:
+    with open(meta_path) as f:
         meta = json.load(f)
-    data = np.load(os.path.join(path, "state.npz"))
-    by_key = {k: data[f"leaf_{i}"] for i, k in enumerate(meta["keys"])}
+    if int(meta.get("num_shard_files") or 0) > 0:
+        by_key = _reassemble_rank_shards(path, meta)
+    else:
+        data = np.load(os.path.join(path, "state.npz"))
+        by_key = {k: data[f"leaf_{i}"] for i, k in enumerate(meta["keys"])}
 
     template_flat = _flatten_with_paths(state_template)
     sharding_flat = _flatten_with_paths(shardings)

@@ -28,8 +28,14 @@ def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
     path = os.path.join(ckpt_dir, tag)
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
-    data = np.load(os.path.join(path, "state.npz"))
-    by_key = {k: data[f"leaf_{i}"] for i, k in enumerate(meta["keys"])}
+    if int(meta.get("num_shard_files") or 0) > 0:
+        # multi-host checkpoint: per-process shard files instead of a
+        # gathered state.npz — reassemble by global index
+        from ..checkpoint.store import _reassemble_rank_shards
+        by_key = _reassemble_rank_shards(path, meta)
+    else:
+        data = np.load(os.path.join(path, "state.npz"))
+        by_key = {k: data[f"leaf_{i}"] for i, k in enumerate(meta["keys"])}
 
     out: Dict[str, np.ndarray] = {}
     for key, value in by_key.items():

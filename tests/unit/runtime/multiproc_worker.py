@@ -25,7 +25,7 @@ from deepspeed_tpu import comm  # noqa: E402
 from deepspeed_tpu.models import gpt2_model  # noqa: E402
 
 
-def main(out_dir: str) -> int:
+def main(out_dir: str, mode: str = "train") -> int:
     comm.init_distributed()
     assert jax.process_count() == 2, jax.process_count()
     assert jax.device_count() == 8, jax.device_count()
@@ -44,8 +44,14 @@ def main(out_dir: str) -> int:
 
     with open(os.path.join(out_dir, f"loss_{jax.process_index()}.txt"), "w") as f:
         f.write(repr(losses))
+
+    if mode == "save":
+        # per-process shard files (replica-0 pieces) — the multi-host
+        # checkpoint story the resume phase reloads at a DIFFERENT process
+        # count/topology
+        engine.save_checkpoint(os.path.join(out_dir, "ckpt"))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1]))
+    sys.exit(main(sys.argv[1], sys.argv[2] if len(sys.argv) > 2 else "train"))

@@ -128,51 +128,92 @@ def _np_dtype(name: str) -> np.dtype:
 
 
 def _reassemble_rank_shards(path: str, meta: Dict[str, Any]) -> Dict[str, np.ndarray]:
-    """Rebuild full leaves from per-process shard files: pieces are keyed
-    ``leaf_{i}__{start}_{stop}...`` with global index spans; replica-0
-    filtering at save time guarantees each byte appears exactly once.
+    """Rebuild FULL leaves from per-process shard files — used by single-
+    process consumers (topology-collapse resume, zero_to_fp32 export).
+    Multi-process resume uses :class:`_PieceReader` directly, assembling
+    only each host's addressable spans (~1/n_hosts of the bytes); this is
+    the read_full-over-every-leaf special case of the same reader, so one
+    parser/validator covers both paths."""
+    reader = _PieceReader(path, meta)
+    return {k: reader.read_full(i, tuple(meta["shapes"][k]),
+                                _np_dtype(meta["dtypes"][k]))
+            for i, k in enumerate(meta["keys"])}
 
-    Known cost: every loading process materializes the FULL state on host
-    before re-sharding (reads all N rank files). For resume at the largest
-    scales a span filter against the target shardings' local indices would
-    bound this at 1/n_hosts — acceptable today because resume is rare and
-    host RAM on TPU VMs is large relative to per-host HBM."""
-    keys = meta["keys"]
-    out: Dict[str, np.ndarray] = {}
-    filled: Dict[int, int] = {}
-    n = int(meta.get("num_shard_files") or 0)
-    files = [os.path.join(path, f"state.rank{p}.npz") for p in range(n)]
-    missing = [f for f in files if not os.path.exists(f)]
-    if missing:
-        raise FileNotFoundError(
-            f"checkpoint is missing shard files {missing} — all "
-            f"{n} per-process files are required to reassemble")
-    for f in files:
-        data = np.load(f)
-        for piece_key in data.files:
-            head, _, spans = piece_key.partition("__")
-            i = int(head[len("leaf_"):])
-            k = keys[i]
-            piece = data[piece_key]
-            if spans == "full" or not spans:
-                out[k] = piece
-                filled[i] = piece.size
+
+class _PieceReader:
+    """Span-addressed reader over the per-process shard files: assembles an
+    arbitrary global slice of a leaf from only the pieces that intersect
+    it, decompressing npz members lazily — so a resuming process touches
+    ~1/n_hosts of the checkpoint bytes instead of the whole state."""
+
+    def __init__(self, path: str, meta: Dict[str, Any]):
+        n = int(meta["num_shard_files"])
+        self._files = [os.path.join(path, f"state.rank{p}.npz")
+                       for p in range(n)]
+        missing = [f for f in self._files if not os.path.exists(f)]
+        if missing:
+            raise FileNotFoundError(
+                f"checkpoint is missing shard files {missing} — all "
+                f"{n} per-process files are required")
+        self._meta = meta
+        # index transiently: keeping n NpzFile handles open would exhaust
+        # fds at exactly the host counts this path exists for
+        self._index: Dict[int, list] = {}
+        for fi, f in enumerate(self._files):
+            with np.load(f) as z:
+                names = list(z.files)
+            for piece_key in names:
+                head, _, spans = piece_key.partition("__")
+                i = int(head[len("leaf_"):])
+                if spans == "full" or not spans:
+                    bounds = tuple((0, d) for d in meta["shapes"][meta["keys"][i]])
+                else:
+                    bounds = tuple(tuple(map(int, s.split("_")))
+                                   for s in spans.split("__"))
+                self._index.setdefault(i, []).append((bounds, fi, piece_key))
+
+    def read(self, i: int, shape, dtype, idx) -> np.ndarray:
+        """Assemble the global slice ``idx`` (tuple of slices) of leaf i."""
+        pieces = self._index.get(i, ())
+        if not pieces:
+            raise ValueError(f"leaf {i} has no pieces in any shard file — "
+                             "checkpoint is inconsistent with its meta.json")
+        req = tuple((sl.start or 0,
+                     sl.stop if sl.stop is not None else dim)
+                    for sl, dim in zip(idx, shape)) if idx else ()
+        if not req:  # scalar leaf
+            bounds, fi, k = pieces[0]
+            with np.load(self._files[fi]) as z:
+                return np.asarray(z[k], dtype)
+        out = np.empty([b - a for a, b in req], dtype)
+        covered = 0
+        # group by file so each needed shard file opens once per read
+        by_file: Dict[int, list] = {}
+        for bounds, fi, k in pieces:
+            inter = [(max(a, ba), min(b, bb))
+                     for (a, b), (ba, bb) in zip(req, bounds)]
+            if any(a >= b for a, b in inter):
                 continue
-            if k not in out:
-                out[k] = np.empty(meta["shapes"][k],
-                                  dtype=_np_dtype(meta["dtypes"][k]))
-                filled[i] = 0
-            bounds = [tuple(map(int, s.split("_")))
-                      for s in spans.split("__")]
-            out[k][tuple(slice(a, b) for a, b in bounds)] = piece
-            filled[i] += piece.size
-    for i, k in enumerate(keys):
-        if k not in out or filled.get(i, 0) != int(np.prod(meta["shapes"][k] or [1])):
+            by_file.setdefault(fi, []).append((bounds, k, inter))
+        for fi, items in by_file.items():
+            with np.load(self._files[fi]) as z:
+                for bounds, k, inter in items:
+                    piece = z[k]
+                    src = tuple(slice(a - ba, b - ba)
+                                for (a, b), (ba, bb) in zip(inter, bounds))
+                    dst = tuple(slice(a - ra, b - ra)
+                                for (a, b), (ra, _) in zip(inter, req))
+                    out[dst] = piece[src]
+                    covered += int(np.prod([b - a for a, b in inter]))
+        if covered != out.size:
             raise ValueError(
-                f"checkpoint leaf '{k}' reassembled "
-                f"{filled.get(i, 0)} of {np.prod(meta['shapes'][k] or [1])} "
-                f"elements — shard files are inconsistent")
-    return out
+                f"leaf {i}: assembled {covered} of {out.size} elements for "
+                f"slice {req} — shard files are inconsistent")
+        return out
+
+    def read_full(self, i: int, shape, dtype) -> np.ndarray:
+        return self.read(i, shape, dtype,
+                         tuple(slice(0, d) for d in shape))
 
 
 def load_checkpoint(load_dir: str, tag: Optional[str], state_template, shardings,
@@ -193,7 +234,14 @@ def load_checkpoint(load_dir: str, tag: Optional[str], state_template, shardings
         return None, {}, None
     with open(meta_path) as f:
         meta = json.load(f)
-    if int(meta.get("num_shard_files") or 0) > 0:
+    sharded_ckpt = int(meta.get("num_shard_files") or 0) > 0
+    reader = by_key = None
+    if sharded_ckpt and jax.process_count() > 1:
+        # distributed resume: DON'T materialize the full state per host —
+        # each process assembles only the spans its target shardings make
+        # addressable (1/n_hosts of the bytes)
+        reader = _PieceReader(path, meta)
+    elif sharded_ckpt:
         by_key = _reassemble_rank_shards(path, meta)
     else:
         data = np.load(os.path.join(path, "state.npz"))
@@ -202,16 +250,33 @@ def load_checkpoint(load_dir: str, tag: Optional[str], state_template, shardings
     template_flat = _flatten_with_paths(state_template)
     sharding_flat = _flatten_with_paths(shardings)
     leaves, treedef = jax.tree_util.tree_flatten(state_template)
+    key_index = {k: i for i, k in enumerate(meta["keys"])}
     # rebuild in template order; skip optimizer states on request
     new_flat = {}
     for key, tmpl in template_flat.items():
-        if key in by_key and (load_optimizer_states or not key.startswith("opt/")):
-            value = by_key[key]
-            if tuple(value.shape) != tuple(tmpl.shape):
+        wanted = (load_optimizer_states or not key.startswith("opt/"))
+        in_ckpt = key in key_index and (by_key is None or key in by_key)
+        if in_ckpt and wanted:
+            shape = tuple(meta["shapes"][key]) if reader is not None \
+                else tuple(by_key[key].shape)
+            if shape != tuple(np.shape(tmpl)):
                 raise ValueError(
-                    f"checkpoint leaf '{key}' shape {value.shape} != expected {tmpl.shape}")
+                    f"checkpoint leaf '{key}' shape {shape} != expected "
+                    f"{np.shape(tmpl)}")
             sharding = sharding_flat.get(key)
-            arr = jax.device_put(value.astype(tmpl.dtype), sharding)
+            if reader is not None and sharding is not None:
+                i = key_index[key]
+                dtype = np.dtype(tmpl.dtype)
+                arr = jax.make_array_from_callback(
+                    shape, sharding,
+                    lambda idx, i=i, s=shape, d=dtype:
+                        reader.read(i, s, d, idx))
+            else:
+                value = (reader.read_full(key_index[key], shape,
+                                          np.dtype(tmpl.dtype))
+                         if reader is not None else by_key[key])
+                arr = jax.device_put(np.asarray(value).astype(tmpl.dtype),
+                                     sharding)
         else:
             arr = tmpl
         new_flat[key] = arr

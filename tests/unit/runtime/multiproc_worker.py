@@ -36,8 +36,23 @@ def main(out_dir: str, mode: str = "train") -> int:
         "train_micro_batch_size_per_gpu": 1,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": 2},
-    }, seed=3)
+    }, seed=99 if mode == "resume" else 3)
     batch = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+
+    if mode == "resume":
+        # distributed resume: each process assembles only its addressable
+        # spans (_PieceReader) — the loaded weights must beat the seed-99
+        # fresh init it would otherwise train from
+        tag, _ = engine.load_checkpoint(os.path.join(out_dir, "ckpt"))
+        assert tag is not None
+        assert engine.global_steps == 2, engine.global_steps
+        losses = [float(engine.train_batch(batch))]
+        assert np.isfinite(losses[0])
+        with open(os.path.join(out_dir,
+                               f"resume_loss_{jax.process_index()}.txt"), "w") as f:
+            f.write(repr(losses))
+        return 0
+
     losses = [float(engine.train_batch(batch)) for _ in range(2)]
     assert all(np.isfinite(losses)), losses
     assert losses[1] < losses[0], losses

@@ -42,9 +42,10 @@ def _launch_two_procs(tmp_path, mode="train"):
     r = subprocess.run(cmd, env=env, capture_output=True, text=True,
                        timeout=420, cwd=REPO)
     assert r.returncode == 0, f"stdout:\n{r.stdout[-2000:]}\nstderr:\n{r.stderr[-4000:]}"
+    prefix = "resume_loss" if mode == "resume" else "loss"
     losses = []
     for i in range(2):
-        path = tmp_path / f"loss_{i}.txt"
+        path = tmp_path / f"{prefix}_{i}.txt"
         assert path.exists(), f"process {i} wrote no result"
         losses.append(eval(path.read_text()))
     return losses
@@ -114,3 +115,13 @@ def test_multihost_checkpoint_resumes_single_process(tmp_path):
     sd = get_fp32_state_dict_from_zero_checkpoint(str(tmp_path / "ckpt"))
     assert sd and all(v.dtype == np.float32 for v in sd.values())
     assert any(k.startswith("blocks/") or "wte" in k for k in sd)
+
+
+def test_multihost_checkpoint_resumes_two_process(tmp_path):
+    """Distributed resume at the SAME process count: each process assembles
+    only its addressable spans (_PieceReader + make_array_from_callback)
+    and training continues below the pre-save loss on both ranks."""
+    saved = _launch_two_procs(tmp_path, mode="save")
+    resumed = _launch_two_procs(tmp_path, mode="resume")
+    np.testing.assert_allclose(resumed[0], resumed[1], rtol=0, atol=0)
+    assert resumed[0][0] < saved[0][0], (resumed, saved)

@@ -1,0 +1,16 @@
+"""Shared invariant helpers for model tests."""
+
+import jax
+import jax.sharding
+
+
+def assert_specs_cover_params(params, specs):
+    """Every param leaf must have a matching PartitionSpec leaf (AutoTP and
+    ZeRO placement both walk these trees in lockstep)."""
+    p_paths = {jax.tree_util.keystr(p) for p, _ in
+               jax.tree_util.tree_flatten_with_path(params)[0]}
+    s_paths = {jax.tree_util.keystr(p) for p, _ in
+               jax.tree_util.tree_flatten_with_path(
+                   specs, is_leaf=lambda x: isinstance(
+                       x, jax.sharding.PartitionSpec))[0]}
+    assert p_paths == s_paths

@@ -96,13 +96,6 @@ def test_encoder_configs_rejected_by_pipeline(eight_devices):
 def test_family_specs_cover_params(eight_devices, family):
     """Every param leaf must have a matching PartitionSpec leaf (AutoTP and
     ZeRO placement both walk these trees in lockstep)."""
+    from tests.unit.models.spec_utils import assert_specs_cover_params
     model = FAMILIES[family]()
-    params = model.init(jax.random.PRNGKey(0))
-    specs = model.specs()
-    p_paths = {jax.tree_util.keystr(p) for p, _ in
-               jax.tree_util.tree_flatten_with_path(params)[0]}
-    s_paths = {jax.tree_util.keystr(p) for p, _ in
-               jax.tree_util.tree_flatten_with_path(
-                   specs, is_leaf=lambda x: isinstance(
-                       x, jax.sharding.PartitionSpec))[0]}
-    assert p_paths == s_paths
+    assert_specs_cover_params(model.init(jax.random.PRNGKey(0)), model.specs())

@@ -167,6 +167,44 @@ def test_untied_mlm_checkpoint_rejected(eight_devices, tmp_path):
     assert "mlm" not in params
 
 
+@pytest.mark.parametrize("task", ["sequence_classification",
+                                  "token_classification",
+                                  "question_answering"])
+def test_task_specs_cover_params(eight_devices, task):
+    """Every head param leaf has a matching PartitionSpec (ZeRO/AutoTP walk
+    the trees in lockstep — same invariant as the family matrix)."""
+    from deepspeed_tpu.models import bert_model
+    from deepspeed_tpu.models.heads import EncoderTaskModel
+    lm = bert_model("bert-tiny", max_seq_len=32, vocab_size=128,
+                    remat=False, dtype=jnp.float32, mlm_head=False)
+    from tests.unit.models.spec_utils import assert_specs_cover_params
+    model = EncoderTaskModel(lm, task, num_labels=3)
+    assert_specs_cover_params(model.init(jax.random.PRNGKey(0)), model.specs())
+
+
+def test_task_model_tp2_matches_single(eight_devices, tmp_path, ids):
+    """Classification logits are identical under TP=2 placement (the
+    encoder body's row/column sharding composes with the replicated head)."""
+    from deepspeed_tpu.runtime.topology import MeshTopology, TopologyConfig
+    cfg = transformers.BertConfig(num_labels=3, **_DIMS)
+    torch.manual_seed(30)
+    _save(tmp_path, transformers.BertForSequenceClassification(cfg))
+    model, params = load_hf_task_model(str(tmp_path), "sequence_classification",
+                                       dtype=jnp.float32)
+    ref = np.asarray(model.apply(jax.tree.map(jnp.asarray, params),
+                                 jnp.asarray(ids)))
+    topo = MeshTopology(TopologyConfig(model=2, data=-1))
+    from jax.sharding import NamedSharding
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(topo.mesh, s), model.specs(),
+        is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec))
+    placed = jax.tree.map(lambda x, sh: jax.device_put(np.asarray(x), sh),
+                          params, shardings)
+    with topo.mesh:
+        tp_out = np.asarray(model.apply(placed, jnp.asarray(ids)))
+    np.testing.assert_allclose(tp_out, ref, rtol=1e-5, atol=1e-5)
+
+
 def test_qa_loss_and_grads(eight_devices, tmp_path, ids):
     cfg = transformers.BertConfig(**_DIMS)
     torch.manual_seed(26)

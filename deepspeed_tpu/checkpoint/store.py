@@ -115,6 +115,11 @@ def save_checkpoint(save_dir: str, tag: str, state, client_state: Dict[str, Any]
         if save_latest:
             with open(os.path.join(save_dir, "latest"), "w") as f:
                 f.write(tag)
+    if pcount > 1:
+        # second fence: non-zero ranks must not return (and possibly
+        # load_checkpoint) until rank 0 has committed meta.json/latest
+        from ..comm import comm as _comm
+        _comm.barrier()
 
 
 def _np_dtype(name: str) -> np.dtype:

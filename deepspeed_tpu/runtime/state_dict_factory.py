@@ -313,8 +313,11 @@ def _gptj_config(hf: Dict[str, Any]) -> Dict[str, Any]:
             activation=_map_activation(hf.get("activation_function", "gelu_new")),
             norm="layernorm", position="rope",
             # config.json may omit keys equal to HF defaults; GPTJConfig's
-            # rotary_dim default is 64, NOT full-head
-            rope_dim=hf.get("rotary_dim", 64) or 64,
+            # rotary_dim default is 64, NOT full-head — but an EXPLICIT
+            # null means full-head rotary in HF modeling code
+            rope_dim=(hf["n_embd"] // hf["n_head"]
+                      if ("rotary_dim" in hf and hf["rotary_dim"] is None)
+                      else hf.get("rotary_dim", 64)),
             rope_style="interleaved",
             parallel_block=True, attn_bias=False, lm_head_bias=True,
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
@@ -923,7 +926,10 @@ class MegatronSDLoader:
                 version = raw.get("checkpoint_version")
             shards.append({k: _torch_to_numpy(v)
                            for k, v in self._flatten(raw).items()})
-        return shards, (version if version is not None else 2.0)
+        # Pre-versioning Megatron checkpoints carry no checkpoint_version and
+        # use the version-0 row layout [3, np, hn] (reference
+        # megatron/checkpointing.py get_checkpoint_version defaults to 0)
+        return shards, (version if version is not None else 0)
 
     @staticmethod
     def merge_query_key_value(params, version: float) -> np.ndarray:

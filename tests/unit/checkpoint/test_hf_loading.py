@@ -477,3 +477,15 @@ def test_hf_weights_into_training_engine(eight_devices, gpt2_ckpt):
     batch = {"input_ids": np.random.default_rng(2).integers(0, 128, size=(8, 16))}
     losses = [float(engine.train_batch(batch)) for _ in range(3)]
     assert losses[-1] < losses[0], losses
+
+
+def test_gptj_explicit_null_rotary_dim_is_full_head():
+    """HF GPT-J applies FULL-head rotary when config.rotary_dim is an
+    explicit null; only an ABSENT key falls back to the GPTJConfig default
+    of 64 (partial rotary)."""
+    from deepspeed_tpu.runtime.state_dict_factory import hf_to_transformer_config
+    base = dict(model_type="gptj", vocab_size=128, n_positions=64,
+                n_embd=512, n_layer=2, n_head=4)  # head_dim 128 != default 64
+    assert hf_to_transformer_config(dict(base, rotary_dim=None)).rope_dim == 128
+    assert hf_to_transformer_config(dict(base, rotary_dim=8)).rope_dim == 8
+    assert hf_to_transformer_config(base).rope_dim == 64  # GPTJConfig default

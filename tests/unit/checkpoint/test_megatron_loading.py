@@ -75,11 +75,11 @@ def _shard(sd, tp, rank, version):
     return out
 
 
-def _save_shards(tmp_path, sd, tp, version, nested=False):
+def _save_shards(tmp_path, sd, tp, version, nested=False, write_version=True):
     paths = []
     for r in range(tp):
         shard = {k: torch.tensor(v) for k, v in _shard(sd, tp, r, version).items()}
-        payload = {"checkpoint_version": version}
+        payload = {"checkpoint_version": version} if write_version else {}
         if nested:
             payload["model"] = shard
             payload["iteration"] = 1000  # non-tensor bookkeeping must be skipped
@@ -148,6 +148,20 @@ def test_nested_model_dict_and_explicit_list(tmp_path, full_sd):
     paths = _save_shards(db, full_sd, 2, 2.0, nested=True)
     _, a = load_megatron_model(str(da), CFG)
     _, b = load_megatron_model([str(p) for p in paths], CFG)
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_unversioned_checkpoint_defaults_to_v0_layout(tmp_path, full_sd):
+    """Files with no checkpoint_version key are pre-versioning Megatron and
+    use the version-0 QKV row layout (reference get_checkpoint_version
+    defaults to 0) — defaulting to 2.0 would silently mis-merge."""
+    da = tmp_path / "unversioned"; da.mkdir()
+    db = tmp_path / "explicit0"; db.mkdir()
+    _save_shards(da, full_sd, 2, 0, write_version=False)
+    _save_shards(db, full_sd, 2, 0)
+    _, a = load_megatron_model(str(da), CFG)
+    _, b = load_megatron_model(str(db), CFG)
     for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
         np.testing.assert_array_equal(x, y)
 

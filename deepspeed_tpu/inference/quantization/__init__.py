@@ -1,3 +1,4 @@
 from .quantization import (QuantizationConfig, dequantize_param_tree,  # noqa: F401
-                           quantize_param_tree, quantize_placed,
+                           quantize_kernel, quantize_param_tree,
+                           quantize_placed, quantize_specs,
                            quantized_matmul, quantized_tree_bytes)

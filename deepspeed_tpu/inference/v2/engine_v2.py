@@ -44,7 +44,8 @@ class InferenceEngineV2:
                  config: Optional[RaggedInferenceEngineConfig] = None,
                  params: Optional[Any] = None,
                  topology: Optional[MeshTopology] = None,
-                 seed: int = 0):
+                 seed: int = 0,
+                 donate_params: bool = False):
         self.config = config or RaggedInferenceEngineConfig()
         c = model.config
         self.topology = topology or MeshTopology(
@@ -84,16 +85,24 @@ class InferenceEngineV2:
         self._qcfg = (QuantizationConfig.from_mode(self.config.quantization_mode)
                       if self._impls["linear"].name != "dense" else None)
         with self.mesh:
-            if params is not None:
+            if params is not None and self._qcfg is not None:
+                # STREAMING quantized placement: one leaf at a time, host ->
+                # device -> int8. Whole-tree placement would put the full
+                # dense copy in HBM before quantizing (dense + int8 peak:
+                # llama2-7b bf16 is 13.5 GB, + 6.7 GB int8 > a v5e's 16 GB);
+                # this path peaks at int8 total + ONE dense leaf.
+                self.params = self._place_quantized_streaming(
+                    specs, params, donate=donate_params)
+            elif params is not None:
                 self.params = jax.jit(
                     lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
                     out_shardings=shardings)(params)
             else:
                 self.params = jax.jit(lambda rng: model.init(rng, c.dtype),
                                       out_shardings=shardings)(jax.random.PRNGKey(seed))
-            if self._qcfg is not None:
-                self.params = quantize_placed(self.mesh, specs, self.params,
-                                               self._qcfg)
+                if self._qcfg is not None:
+                    self.params = quantize_placed(self.mesh, specs,
+                                                  self.params, self._qcfg)
             # pages layout [L, kvH, P, ps, D]: shard the HEAD dim over the
             # model axis when it divides — attention is then fully local per
             # head (k/v projections are already head-column-sharded, so the
@@ -122,20 +131,80 @@ class InferenceEngineV2:
             f"attn={self._impls['decode'].name}/{self._impls['prefill'].name}, "
             f"linear={self._impls['linear'].name}", ranks=[0])
 
+    def _place_quantized_streaming(self, specs: Any, params: Any,
+                                   donate: bool = False) -> Any:
+        """Walk the param tree leaf-wise: targeted kernels are pushed dense,
+        quantized on device (jit, sharded out), and the device dense copy
+        dropped before the next leaf — bounding peak HBM at the int8 total
+        plus one dense leaf (reference loads + quantizes per layer container
+        for the same reason, inference/quantization). With ``donate=True``
+        the caller's host tree is CONSUMED (leaves popped as placed) so host
+        RAM is also bounded; the default leaves the input intact."""
+        import numpy as np
+        from jax.sharding import NamedSharding
+        from ..quantization import quantize_kernel, quantize_specs
+        c = self.model.config
+        cfg = self._qcfg
+        targets = set(cfg.targets)
+        np_dtype = np.dtype(c.dtype)
+        # one compiled quantize program per distinct (shape, sharding) —
+        # llama2-7b has ~10 distinct kernel shapes across ~225 leaves
+        jit_cache: Dict[Any, Any] = {}
+
+        def host_cast(v):
+            host = np.asarray(v)
+            return host.astype(np_dtype) if host.dtype != np_dtype else host
+
+        def walk(spec_tree, tree, inside_target):
+            if not isinstance(tree, dict):
+                return tree
+            out = {}
+            for k in list(tree):
+                v = tree.pop(k) if donate else tree[k]
+                if k == "kernel" and inside_target:
+                    key = (v.shape, str(spec_tree["kernel"]))
+                    if key not in jit_cache:
+                        q_shape = jax.eval_shape(
+                            lambda a: quantize_kernel(a, cfg),
+                            jax.ShapeDtypeStruct(v.shape, c.dtype))["q"]
+                        qs = quantize_specs({"kernel": spec_tree["kernel"]},
+                                            {"q": q_shape, "scale": None},
+                                            self.mesh)
+                        shard = {name: NamedSharding(self.mesh, s)
+                                 for name, s in qs.items()}
+                        jit_cache[key] = jax.jit(
+                            lambda a: quantize_kernel(a, cfg),
+                            out_shardings=shard)
+                    qp = jit_cache[key](host_cast(v))  # push 2-byte, not 4
+                    out["q"], out["scale"] = qp["q"], qp["scale"]
+                elif isinstance(v, dict):
+                    out[k] = walk(spec_tree[k], v,
+                                  inside_target or k in targets)
+                else:
+                    out[k] = jax.device_put(
+                        host_cast(v), NamedSharding(self.mesh, spec_tree[k]))
+            return out
+
+        return walk(specs, params, False)
+
     def update_params(self, params: Any) -> None:
         """Rebind weights (hybrid-engine train->generate flip): cast into the
         engine's shardings without touching compiled programs."""
         c = self.model.config
         specs = self.model.specs()
-        shardings = jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
-                                 is_leaf=lambda s: isinstance(s, P))
         with self.mesh:
-            self.params = jax.jit(
-                lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
-                out_shardings=shardings)(params)
             if self._qcfg is not None:
-                self.params = quantize_placed(self.mesh, specs, self.params,
-                                               self._qcfg)
+                # same streaming placement as __init__: whole-tree dense +
+                # int8 resident at once would OOM exactly the large-model
+                # flip this path serves (see _place_quantized_streaming)
+                self.params = self._place_quantized_streaming(specs, params)
+            else:
+                shardings = jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s), specs,
+                    is_leaf=lambda s: isinstance(s, P))
+                self.params = jax.jit(
+                    lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
+                    out_shardings=shardings)(params)
 
     # ------------------------------------------------------------------
     # compiled-program cache (jax.jit retraces per (S, T, mp) bucket)
@@ -386,4 +455,7 @@ def build_hf_engine(model_path: str,
     """
     from ...runtime.state_dict_factory import load_hf_model
     model, params = load_hf_model(model_path, dtype=dtype)
+    # the freshly loaded host tree is owned here: donate it so the
+    # quantized streaming load releases host RAM leaf by leaf
+    kwargs.setdefault("donate_params", True)
     return InferenceEngineV2(model, config=config, params=params, **kwargs)

@@ -31,6 +31,7 @@ boundaries exactly like the reference
 from __future__ import annotations
 
 import os
+import tempfile
 from typing import Any, Dict, Iterable, Optional, Tuple
 
 import jax
@@ -100,6 +101,31 @@ class DeepSpeedEngine:
         self._offload_device = (str(getattr(oc.device, "value", oc.device))
                                 if oc is not None else "none")
         self._offload = None  # created after state init (needs master leaves)
+        # Twin-Flow partial offload (reference stage3.py:814 partial_offload;
+        # blogs/deepspeed-offloadpp): ratio of master/optimizer elements on
+        # the host, the rest stepped on device by the jitted optimizer
+        self._offload_ratio = float(oc.ratio) if oc is not None else 1.0
+        if self._offload_device != "none" and self._offload_ratio == 0.0:
+            raise ValueError(
+                "offload_optimizer ratio=0.0 keeps the whole optimizer on "
+                "device — remove the offload_optimizer block instead")
+        # -- ZeRO-Infinity parameter offload (reference
+        #    partitioned_param_swapper.py:36): bf16 param shards page to
+        #    host/NVMe for out-of-core phases (offload_param_cache /
+        #    reload_param_cache), freeing HBM between train/generate flips --
+        pc = config.zero_config.offload_param
+        self._param_offload_device = (str(getattr(pc.device, "value", pc.device))
+                                      if pc is not None else "none")
+        if self._param_offload_device != "none":
+            if config.zero_config.stage != 3:
+                raise ValueError(
+                    "offload_param requires ZeRO stage 3 (params must be "
+                    "partitioned to page per-shard); got stage "
+                    f"{config.zero_config.stage}")
+            self._param_offload_cfg = pc
+        self._param_swapper = None   # NVMe swapper, created on first use
+        self._param_host_store = {}  # device=cpu: host-RAM shard store
+        self._pcache = None          # metadata while params are paged out
 
         # -- 1-bit optimizers (reference runtime/fp16/onebit): explicit
         #    shard_map DP step so gradients stay local for compression -------
@@ -151,12 +177,39 @@ class DeepSpeedEngine:
         # -- ZeRO plan -------------------------------------------------------
         param_specs = model.specs()
         shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), self.param_dtype))
+        self._param_struct = shapes  # abstract param tree, reused throughout
         shape_tree = jax.tree.map(lambda x: x.shape, shapes)
         self.zero_plan = ZeroPartitionPlan(self.topology, config.zero_config,
                                            param_specs, shape_tree)
         self._param_shardings = self.zero_plan.param_shardings()
         self._grad_shardings = self.zero_plan.grad_shardings()
         log_dist(self.zero_plan.summary(), ranks=[0])
+
+        # Twin-Flow leaf split: host gets ~ratio of the master elements
+        # (largest-first greedy), device keeps the rest with a jitted
+        # optimizer step. Computed here (not in _init_offload_runner) because
+        # _state_shardings needs the device subset's optimizer shardings.
+        self._offload_host_idx: list = []
+        self._offload_device_idx: list = []
+        self._offload_leaf_names: list = []
+        if self._offload_device != "none":
+            leaves_paths = jax.tree_util.tree_flatten_with_path(shapes)[0]
+            names = ["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                              for p in path) for path, _ in leaves_paths]
+            sizes = [int(np.prod(leaf.shape)) or 1 for _, leaf in leaves_paths]
+            self._offload_leaf_names = names
+            target = self._offload_ratio * sum(sizes)
+            acc = 0.0
+            host = set()
+            for i in sorted(range(len(sizes)), key=lambda j: -sizes[j]):
+                if abs(acc + sizes[i] - target) <= abs(acc - target):
+                    host.add(i)
+                    acc += sizes[i]
+            if not host:  # ratio>0 guarantees at least one host leaf
+                host.add(min(range(len(sizes)), key=lambda j: sizes[j]))
+            self._offload_host_idx = [i for i in range(len(sizes)) if i in host]
+            self._offload_device_idx = [i for i in range(len(sizes))
+                                        if i not in host]
 
         # -- state init (sharded at init like reference zero.Init,
         #    partition_parameters.py:734) ------------------------------------
@@ -276,10 +329,29 @@ class DeepSpeedEngine:
             return self._onebit_state_shardings()
         if self._offload_device != "none":
             opt_shardings = {}
+            if self._offload_device_idx:
+                # Twin-Flow: the device-resident subset keeps a jitted
+                # optimizer; its state is a name-keyed dict (names match the
+                # params tree paths so opt/master/<name> lines up for
+                # zero_to_fp32)
+                spec_leaves = jax.tree.leaves(
+                    opt_spec, is_leaf=lambda s: isinstance(s, P))
+                param_leaves = jax.tree.leaves(self._param_struct)
+                dev = {self._offload_leaf_names[i]: param_leaves[i]
+                       for i in self._offload_device_idx}
+                dev_named = {self._offload_leaf_names[i]:
+                             NamedSharding(mesh, spec_leaves[i])
+                             for i in self._offload_device_idx}
+                opt_template = jax.eval_shape(
+                    lambda: self.optimizer.init(
+                        {k: jnp.zeros(v.shape, v.dtype)
+                         for k, v in dev.items()}))
+                for key in opt_template:
+                    opt_shardings[key] = rep if key == "step" else dev_named
         else:
             opt_template = jax.eval_shape(
-                lambda: self.optimizer.init(jax.tree.map(jnp.zeros_like, jax.eval_shape(
-                    lambda: self.model.init(jax.random.PRNGKey(0), self.param_dtype)))))
+                lambda: self.optimizer.init(
+                    jax.tree.map(jnp.zeros_like, self._param_struct)))
             opt_shardings = {}
             for key in opt_template:
                 opt_shardings[key] = rep if key == "step" else opt_named
@@ -325,7 +397,14 @@ class DeepSpeedEngine:
                     opt[key] = jax.tree.map(
                         lambda e: jnp.zeros((dp,) + e.shape, e.dtype), opt[key])
                 return opt
-            return {} if offload else self.optimizer.init(params)
+            if offload:
+                if not self._offload_device_idx:
+                    return {}
+                leaves = jax.tree.leaves(params)
+                return self.optimizer.init(
+                    {self._offload_leaf_names[i]: leaves[i]
+                     for i in self._offload_device_idx})
+            return self.optimizer.init(params)
 
         def make_grad_acc(params):
             if self._onebit_opt is not None:  # local per-device accumulators
@@ -432,16 +511,24 @@ class DeepSpeedEngine:
 
         leaves_paths, self._offload_treedef = \
             jax.tree_util.tree_flatten_with_path(state["params"])
-        names, shapes, sizes = [], [], []
+        host_idx = self._offload_host_idx
+        all_names, all_shapes = [], []
         for path, leaf in leaves_paths:
-            names.append("/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                                  for p in path))
-            shapes.append(leaf.shape)
-            sizes.append(int(leaf.size))
+            all_names.append("/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                                      for p in path))
+            all_shapes.append(leaf.shape)
+        # full-tree metadata (unflatten rebuilds EVERY leaf); host-subset
+        # metadata for the flat master/moments the host runner owns
+        self._offload_full_shapes = all_shapes
+        all_layouts = self._leaf_flat_layouts(
+            self.zero_plan.optimizer_spec_tree())
+        self._offload_all_layouts = all_layouts
+        names = [all_names[i] for i in host_idx]
+        shapes = [all_shapes[i] for i in host_idx]
+        sizes = [int(np.prod(s)) or 1 for s in shapes]
         self._offload_names = names
         self._offload_shapes = shapes
-        self._offload_layouts = self._leaf_flat_layouts(
-            self.zero_plan.optimizer_spec_tree())
+        self._offload_layouts = [all_layouts[i] for i in host_idx]
         self._offload_layout = {"sizes": sizes, "total": sum(sizes)}
         self._offload_flat_shardings = tuple(
             NamedSharding(self.mesh, P(axes) if axes else P())
@@ -450,8 +537,9 @@ class DeepSpeedEngine:
         layouts = self._offload_layouts
 
         def flatten_master(params):
-            return tuple(self._to_flat(l, dim) for l, (dim, _)
-                         in zip(jax.tree.leaves(params), layouts))
+            leaves = jax.tree.leaves(params)
+            return tuple(self._to_flat(leaves[i], dim)
+                         for i, (dim, _) in zip(host_idx, layouts))
 
         with self.mesh:
             flat_leaves = jax.jit(
@@ -481,10 +569,16 @@ class DeepSpeedEngine:
             device=self._offload_device,
             nvme_path=oc.nvme_path,
             pipeline=oc.pipeline_read or oc.pipeline_write)
+        twin = ""
+        if self._offload_device_idx:
+            dev_elems = sum(int(np.prod(all_shapes[i])) or 1
+                            for i in self._offload_device_idx)
+            twin = (f", Twin-Flow ratio {self._offload_ratio}: "
+                    f"{dev_elems / 1e6:.1f}M elements stay device-stepped")
         log_dist(f"ZeRO-Offload: optimizer on {self._offload_device} "
                  f"(local {local_master.size / 1e6:.1f}M of "
                  f"{self._offload_layout['total'] / 1e6:.1f}M master params, "
-                 f"{len(chunks)} chunks)", ranks=[0])
+                 f"{len(chunks)} chunks{twin})", ranks=[0])
 
     # ------------------------------------------------------------------
     # jitted step functions
@@ -892,6 +986,7 @@ class DeepSpeedEngine:
 
     def forward(self, batch: Dict[str, Any]):
         """Compute loss (and gradients — fused; see module docstring)."""
+        self._require_params("forward")
         # retraces (new shapes) must see THIS engine's mesh, not whichever
         # engine was constructed last
         topo_mod.set_topology(self.topology)
@@ -935,6 +1030,7 @@ class DeepSpeedEngine:
 
     def step(self):
         """Apply the optimizer at accumulation boundaries (engine.py:2120)."""
+        self._require_params("step")
         if not self.is_gradient_accumulation_boundary():
             return
         self._build_jits()
@@ -989,52 +1085,98 @@ class DeepSpeedEngine:
         optimizer on the local master segment (NVMe chunks stream through
         the pipelined swapper), then scatter the updated master back into
         the sharded param tree in one jitted dispatch."""
+        host_idx = self._offload_host_idx
+        dev_idx = self._offload_device_idx
+        dev_names = [self._offload_leaf_names[i] for i in dev_idx]
         if getattr(self, "_jit_offload_fetch", None) is None:
             clip = self.gradient_clipping
             fp16 = self.config.fp16.enabled
             rep = NamedSharding(self.mesh, P())
             layouts = self._offload_layouts
+            grad_sh_leaves = jax.tree.leaves(self._grad_shardings)
+            dev_grad_sh = {n: grad_sh_leaves[i]
+                           for n, i in zip(dev_names, dev_idx)}
 
             def fetch(grad_acc, scale):
-                flats = [self._to_flat(g, dim) for g, (dim, _)
-                         in zip(jax.tree.leaves(grad_acc), layouts)]
+                leaves = jax.tree.leaves(grad_acc)
+                flats = [self._to_flat(leaves[i], dim)
+                         for i, (dim, _) in zip(host_idx, layouts)]
+                dev = {n: leaves[i].astype(jnp.float32)
+                       for n, i in zip(dev_names, dev_idx)}
+                every = flats + list(dev.values())
                 overflow = (~jnp.all(jnp.asarray(
-                    [jnp.all(jnp.isfinite(f)) for f in flats])) if fp16
+                    [jnp.all(jnp.isfinite(f)) for f in every])) if fp16
                     else jnp.asarray(False))
                 inv = jnp.where(overflow, 0.0, 1.0 / scale)
                 flats = [f * inv for f in flats]
-                gnorm = jnp.sqrt(sum(jnp.sum(f * f) for f in flats))
+                dev = {k: v * inv for k, v in dev.items()}
+                # grad norm (and the clip factor) span BOTH partitions —
+                # host and device see one consistent global norm
+                gnorm = jnp.sqrt(sum(jnp.sum(f * f) for f in flats)
+                                 + sum(jnp.sum(v * v) for v in dev.values()))
                 if clip > 0:
                     factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                     flats = [f * factor for f in flats]
-                return tuple(flats), gnorm, overflow
+                    dev = {k: v * factor for k, v in dev.items()}
+                return tuple(flats), dev, gnorm, overflow
 
             self._jit_offload_fetch = jax.jit(
                 fetch,
-                out_shardings=(self._offload_flat_shardings, rep, rep))
+                out_shardings=(self._offload_flat_shardings, dev_grad_sh,
+                               rep, rep))
 
             shapes = self._offload_shapes
             treedef, dtype = self._offload_treedef, self.param_dtype
+            full_shapes = self._offload_full_shapes
 
-            def unflatten(flats):
-                outs = []
-                for f, (dim, _), shape in zip(flats, layouts, shapes):
+            def unflatten(flats, dev_params):
+                outs = [None] * len(full_shapes)
+                for f, (dim, _), shape, i in zip(flats, layouts, shapes,
+                                                 host_idx):
                     if dim is None:
                         a = f.reshape(shape)
                     else:
                         moved = (shape[dim],) + shape[:dim] + shape[dim + 1:]
                         a = jnp.moveaxis(f.reshape(moved), 0, dim)
-                    outs.append(a.astype(dtype))
+                    outs[i] = a.astype(dtype)
+                for n, i in zip(dev_names, dev_idx):
+                    outs[i] = dev_params[n]
                 return jax.tree.unflatten(treedef, outs)
 
             self._jit_offload_unflatten = jax.jit(
                 unflatten, out_shardings=self._param_shardings)
 
+            if dev_idx:
+                param_sh_leaves = jax.tree.leaves(self._param_shardings)
+                dev_param_sh = {n: param_sh_leaves[i]
+                                for n, i in zip(dev_names, dev_idx)}
+                opt_sh = self._state_shardings()["opt"]
+
+                def dev_step(dev_grads, opt, lr_val):
+                    new_master, new_opt = self.optimizer.update(
+                        dev_grads, opt, lr_val)
+                    new_params = jax.tree.map(
+                        lambda m: m.astype(dtype), new_master)
+                    return new_params, new_opt
+
+                self._jit_offload_devstep = jax.jit(
+                    dev_step, out_shardings=(dev_param_sh, opt_sh))
+
         with self.mesh:
-            flat_grads, gnorm_d, ovf_d = self._jit_offload_fetch(
+            flat_grads, dev_grads, gnorm_d, ovf_d = self._jit_offload_fetch(
                 self.state["grad_acc"], self.state["loss_scale"]["cur_scale"])
         overflow, gnorm = bool(ovf_d), float(gnorm_d)
         if not overflow:
+            dev_params = {}
+            if dev_idx:
+                # Twin-Flow device partition: dispatch the jitted optimizer
+                # step FIRST (async) so it overlaps the host D2H + CPU step
+                # below; only the unflatten at the end joins the two flows
+                with self.mesh:
+                    dev_params, self.state["opt"] = \
+                        self._jit_offload_devstep(
+                            dev_grads, self.state["opt"],
+                            jnp.asarray(lr, jnp.float32))
             # one batched D2H pull over every local shard, not per-shard
             pieces = [data for arr in flat_grads
                       for _, _, data in self._leaf_local_groups(arr)]
@@ -1059,7 +1201,8 @@ class DeepSpeedEngine:
                     self._offload_flat_shardings[i], arrs)
                 for i, arrs in enumerate(per_leaf))
             with self.mesh:
-                self.state["params"] = self._jit_offload_unflatten(flat_masters)
+                self.state["params"] = self._jit_offload_unflatten(
+                    flat_masters, dev_params)
 
         # zero the accumulator + update loss scale on device
         if getattr(self, "_jit_offload_epilogue", None) is None:
@@ -1087,6 +1230,7 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter_or_batch) -> jax.Array:
         """One full optimizer step: gas micro-steps + apply (the
         PipelineEngine-style entry, pipe/engine.py:321)."""
+        self._require_params("training")
         fp_cfg = self.config.flops_profiler_config
         profiling = fp_cfg.enabled and self.global_steps == fp_cfg.profile_step
         if profiling:
@@ -1139,6 +1283,7 @@ class DeepSpeedEngine:
             return 0.0
 
     def eval_batch(self, batch: Dict[str, Any]) -> jax.Array:
+        self._require_params("eval_batch")
         topo_mod.set_topology(self.topology)
         if getattr(self, "_jit_eval", None) is None:
             self._jit_eval = jax.jit(self.model.loss)
@@ -1171,6 +1316,7 @@ class DeepSpeedEngine:
     def module_state_dict(self):
         """Gathered (replicated) params as a host pytree — reference
         ``_zero3_consolidated_16bit_state_dict`` (engine.py:3477)."""
+        self._require_params("module_state_dict")
         with self.mesh:
             gathered = jax.jit(
                 lambda p: p,
@@ -1179,11 +1325,113 @@ class DeepSpeedEngine:
         return jax.device_get(gathered)
 
     # ------------------------------------------------------------------
+    # ZeRO-Infinity parameter offload (reference
+    # partitioned_param_swapper.py:36 + parameter_offload.py:201): page the
+    # bf16 param shards out of HBM between phases (train <-> generate in the
+    # hybrid engine, checkpoint export, serving restarts) and back. Under
+    # jit every param must be device-resident DURING a step, so paging
+    # happens at phase boundaries — the TPU-native shape of fetch/release.
+    # ------------------------------------------------------------------
+    def _require_params(self, op: str) -> None:
+        if self._pcache is not None:
+            raise RuntimeError(
+                f"params are paged out (offload_param_cache); call "
+                f"reload_param_cache() before {op}")
+
+    def _get_param_swapper(self):
+        if self._param_swapper is None:
+            from .swap_tensor.partitioned_param_swapper import \
+                AsyncPartitionedParameterSwapper
+            cfg = self._param_offload_cfg
+            swap_dir = cfg.nvme_path or os.path.join(
+                tempfile.gettempdir(), f"dstpu_param_swap_{os.getpid()}")
+            self._param_swapper = AsyncPartitionedParameterSwapper(
+                os.path.join(swap_dir, f"rank{jax.process_index()}"))
+        return self._param_swapper
+
+    def device_state_bytes(self) -> int:
+        """Actual device-resident bytes of the training state on THIS host
+        (sums every addressable shard, so replication is counted)."""
+        total = 0
+        for leaf in jax.tree.leaves(self.state):
+            if isinstance(leaf, jax.Array) and hasattr(leaf, "addressable_shards"):
+                total += sum(s.data.nbytes for s in leaf.addressable_shards)
+        return total
+
+    def offload_param_cache(self) -> None:
+        """Page every param shard to host/NVMe and FREE its HBM (reference
+        ``swap_out_and_release``). ``reload_param_cache`` restores them."""
+        if self._param_offload_device == "none":
+            raise ValueError(
+                "offload_param_cache requires zero_optimization.offload_param "
+                "with device cpu|nvme (got none)")
+        if self._pcache is not None:
+            return  # already paged out
+        leaves, treedef = jax.tree_util.tree_flatten(self.state["params"])
+        nvme = self._param_offload_device == "nvme"
+        swapper = self._get_param_swapper() if nvme else None
+        meta = []
+        for idx, leaf in enumerate(leaves):
+            pieces = []
+            groups = {}
+            for s in leaf.addressable_shards:
+                key = tuple((sl.start or 0) for sl in s.index) \
+                    if s.index else ()
+                groups.setdefault(key, []).append(s)
+            for key in sorted(groups):
+                shards = groups[key]
+                name = f"p{idx}__" + "_".join(map(str, key))
+                host = np.asarray(jax.device_get(shards[0].data))
+                if nvme:
+                    swapper.swap_out(name, host)  # async; fenced below
+                else:
+                    self._param_host_store[name] = host
+                pieces.append((name, [s.device for s in shards]))
+            meta.append({"shape": leaf.shape, "dtype": leaf.dtype,
+                         "sharding": leaf.sharding, "pieces": pieces})
+        if nvme:
+            swapper.synchronize_writes()
+        for leaf in leaves:
+            leaf.delete()  # the actual HBM release
+        self._pcache = {"treedef": treedef, "meta": meta}
+        self.state["params"] = None
+        self._jit_micro_step = None  # old programs captured donated buffers
+
+    def reload_param_cache(self) -> None:
+        """Rebuild the device-sharded param tree from the paged shards."""
+        if self._pcache is None:
+            return
+        nvme = self._param_offload_device == "nvme"
+        swapper = self._param_swapper
+        if nvme:  # prefetch everything; reads overlap the rebuild below
+            swapper.swap_in([n for m in self._pcache["meta"]
+                             for n, _ in m["pieces"]], async_op=True)
+        leaves = []
+        for m in self._pcache["meta"]:
+            arrs = []
+            for name, devices in m["pieces"]:
+                host = swapper.get(name) if nvme \
+                    else self._param_host_store[name]
+                arrs.extend(jax.device_put(host, d) for d in devices)
+            leaves.append(jax.make_array_from_single_device_arrays(
+                m["shape"], m["sharding"], arrs))
+        self.state["params"] = jax.tree_util.tree_unflatten(
+            self._pcache["treedef"], leaves)
+        for m in self._pcache["meta"]:
+            for name, _ in m["pieces"]:
+                if nvme:
+                    swapper.release(name)
+                else:
+                    self._param_host_store.pop(name, None)
+        self._pcache = None
+
+    # ------------------------------------------------------------------
     # checkpointing (reference engine.py:3050 save / :2688 load)
     # ------------------------------------------------------------------
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict[str, Any]] = None,
                         save_latest: bool = True) -> None:
+        self._require_params("save_checkpoint")
         from ..checkpoint.store import save_checkpoint as _save
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
@@ -1240,6 +1488,7 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True) -> Tuple[Optional[str], Dict[str, Any]]:
+        self._require_params("load_checkpoint")
         from ..checkpoint.store import load_checkpoint as _load
         shardings = self._state_shardings()
         with self.mesh:

@@ -6,13 +6,15 @@ NVMe when not in use and page back (with prefetch) ahead of their layer's
 execution. In the TPU engine the jit-compiled train step needs all params
 resident, so this component serves the *out-of-core* paths that run outside
 jit: huge-model checkpoint import/export, CPU-staged initialization
-(zero.Init with offload_param device=nvme), and inference weight streaming.
+(zero.Init with offload_param device=nvme), inference weight streaming, and
+the engine's ``offload_param_cache``/``reload_param_cache`` phase flips
+(train↔generate HBM handoff, reference hybrid_engine.py:32).
 """
 
 from __future__ import annotations
 
 import os
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
 
@@ -29,6 +31,9 @@ class AsyncPartitionedParameterSwapper:
         self._meta: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {}
         self._resident: Dict[str, np.ndarray] = {}
         self._inflight: List[str] = []
+        # names whose NVMe file has an uncompleted async write: reading the
+        # file before the write lands would return a torn shard
+        self._pending_writes: Set[str] = set()
 
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, f"param_{name}.swp")
@@ -38,18 +43,30 @@ class AsyncPartitionedParameterSwapper:
         return len(self._resident)
 
     def swap_out(self, name: str, value: np.ndarray, release: bool = True) -> None:
-        """Page a parameter shard to NVMe (reference ``swap_out_and_release``)."""
+        """Begin paging a parameter shard to NVMe (reference
+        ``swap_out_and_release``). ASYNC: returns as soon as the write is
+        queued — the AIO handle pins ``value`` until the write completes, and
+        any read of ``name`` (or ``synchronize_writes``) fences first."""
         value = np.ascontiguousarray(value)
         self._meta[name] = (value.shape, value.dtype)
         self.aio.async_pwrite(value.reshape(-1), self._path(name))
+        self._pending_writes.add(name)
         if release:
             self._resident.pop(name, None)
         else:
             self._resident[name] = value
-        self.aio.wait()
+
+    def synchronize_writes(self) -> None:
+        """Fence every queued write (reference ``synchronize_writes``)."""
+        if self._pending_writes:
+            self.aio.wait()
+            self._pending_writes.clear()
+            self._inflight.clear()  # wait() drains reads too (one handle)
 
     def swap_in(self, names: List[str], async_op: bool = True) -> None:
         """Begin paging shards in (reference ``swap_in`` with prefetch)."""
+        if self._pending_writes.intersection(names):
+            self.synchronize_writes()
         for name in names:
             if name in self._resident:
                 continue
@@ -65,13 +82,15 @@ class AsyncPartitionedParameterSwapper:
         if self._inflight:
             self.aio.wait()
             self._inflight.clear()
+            self._pending_writes.clear()  # one handle: wait() drains all
 
     def get(self, name: str) -> np.ndarray:
         """Resident view of a shard; fetches synchronously if paged out."""
         if name not in self._resident:
             self.swap_in([name], async_op=False)
-        elif name in self._inflight:
+        elif name in self._inflight or name in self._pending_writes:
             self.synchronize_reads()
+            self.synchronize_writes()
         return self._resident[name]
 
     def release(self, name: str) -> None:
@@ -81,4 +100,5 @@ class AsyncPartitionedParameterSwapper:
         return max(0, 64 - len(self._resident))
 
     def close(self) -> None:
+        self.synchronize_writes()
         self.aio.close()

@@ -192,3 +192,141 @@ class TestOffloadEngine:
         for name in sd:
             np.testing.assert_allclose(sd[name], flat_params[name],
                                        rtol=1e-6, atol=1e-7, err_msg=name)
+
+
+class TestAsyncSwapOut:
+
+    def test_swap_out_is_async_and_read_fenced(self, tmp_path):
+        """swap_out queues without blocking; a read of the same shard fences
+        the pending write first (no torn reads)."""
+        sw = AsyncPartitionedParameterSwapper(str(tmp_path))
+        a = np.random.default_rng(0).normal(size=(256, 64)).astype(np.float32)
+        sw.swap_out("w", a)
+        # immediately read back: must fence the in-flight write
+        np.testing.assert_array_equal(sw.get("w"), a)
+        sw.release("w")
+        b = a * 2
+        sw.swap_out("w", b, release=False)
+        assert sw.resident_params == 1
+        sw.synchronize_writes()
+        np.testing.assert_array_equal(sw.get("w"), b)
+        sw.close()
+
+
+class TestTwinFlow:
+    """OffloadPP partial offload (reference stage3.py:814, blogs/
+    deepspeed-offloadpp): ratio of the master elements on host, rest
+    device-stepped."""
+
+    def _engine(self, ratio, seed=7):
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw",
+                          "params": {"lr": 1e-3, "weight_decay": 0.01}},
+            "zero_optimization": {"stage": 2, "offload_optimizer": {
+                "device": "cpu", "ratio": ratio}},
+        }, seed=seed)
+        return eng
+
+    def test_ratio_splits_elements_half_and_half(self):
+        import jax
+        eng = self._engine(0.5)
+        assert eng._offload_host_idx and eng._offload_device_idx
+        host = sum(eng._offload_layout["sizes"])
+        # host gets ~ratio of the elements (leaf-granular greedy)
+        frac = host / sum(int(np.prod(l.shape)) or 1
+                          for l in jax.tree.leaves(eng.state["params"]))
+        assert 0.3 < frac < 0.7, frac
+        # the device partition carries a jitted optimizer state keyed by name
+        assert set(eng.state["opt"]["master"]) == {
+            eng._offload_leaf_names[i] for i in eng._offload_device_idx}
+
+    def test_ratio_trajectory_matches_full_offload(self):
+        b = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+        full = _make_engine("cpu")          # ratio 1.0
+        twin = self._engine(0.5)
+        for _ in range(3):
+            l_full = float(full.train_batch(b))
+            l_twin = float(twin.train_batch(b))
+        assert abs(l_full - l_twin) < 5e-3, (l_full, l_twin)
+        import jax
+        for a, c in zip(jax.tree.leaves(jax.device_get(full.state["params"])),
+                        jax.tree.leaves(jax.device_get(twin.state["params"]))):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(c, np.float32),
+                                       rtol=2e-2, atol=2e-3)
+
+    def test_ratio_zero_rejected(self):
+        with pytest.raises(ValueError, match="ratio=0.0"):
+            self._engine(0.0)
+
+
+class TestParamOffload:
+    """ZeRO-Infinity offload_param wiring (reference
+    partitioned_param_swapper.py:36): phase-boundary paging of bf16 param
+    shards, freeing HBM between train/generate flips."""
+
+    def _engine(self, tmp_path=None, device="nvme", offload_opt=True, seed=7):
+        zero = {"stage": 3,
+                "offload_param": {"device": device,
+                                  **({"nvme_path": str(tmp_path)}
+                                     if tmp_path else {})}}
+        if offload_opt:
+            zero["offload_optimizer"] = {"device": "cpu"}
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": zero,
+        }, seed=seed)
+        return eng
+
+    def test_requires_stage3(self):
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+        with pytest.raises(ValueError, match="offload_param requires ZeRO stage 3"):
+            deepspeed_tpu.initialize(model=m, config={
+                "train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2,
+                                      "offload_param": {"device": "cpu"}}})
+
+    @pytest.mark.parametrize("device", ["cpu", "nvme"])
+    def test_page_out_frees_hbm_and_roundtrips(self, tmp_path, device):
+        b = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+        eng = self._engine(tmp_path if device == "nvme" else None, device=device)
+        ctl = self._engine(tmp_path / "ctl" if device == "nvme" else None,
+                           device=device)
+        float(eng.train_batch(b)); float(ctl.train_batch(b))
+        bytes_resident = eng.device_state_bytes()
+        import jax
+        param_bytes = sum(
+            sum(s.data.nbytes for s in l.addressable_shards)
+            for l in jax.tree.leaves(eng.state["params"]))
+        eng.offload_param_cache()
+        assert eng.device_state_bytes() <= bytes_resident - param_bytes
+        with pytest.raises(RuntimeError, match="paged out"):
+            eng.train_batch(b)
+        eng.reload_param_cache()
+        # the flip is lossless: both engines continue identically
+        l1, l2 = float(eng.train_batch(b)), float(ctl.train_batch(b))
+        assert abs(l1 - l2) < 1e-5, (l1, l2)
+
+    def test_footprint_fits_synthetic_device_cap(self):
+        """ZeRO-Infinity's memory claim: with optimizer on host and params
+        pageable, device bytes fit a cap the non-offload config exceeds."""
+        eng = self._engine(None, device="cpu")
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128, remat=False)
+        dense, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 0}})
+        # synthetic device cap: a quarter of what the replicated fp32
+        # master+m+v configuration needs — the offload engine fits, the
+        # dense one cannot
+        cap = dense.device_state_bytes() // 4
+        resident = eng.device_state_bytes()
+        assert resident < cap < dense.device_state_bytes(), (
+            resident, cap, dense.device_state_bytes())
+        eng.offload_param_cache()
+        assert eng.device_state_bytes() < resident  # params' HBM released

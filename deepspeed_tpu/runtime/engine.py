@@ -454,40 +454,75 @@ class DeepSpeedEngine:
                             f"offload_optimizer.rank{jax.process_index()}.npz")
 
     def _leaf_flat_layouts(self, spec_tree):
-        """Per-leaf flat layout: (sharded_dim | None, dp_axes) from the
-        optimizer partitioning spec. The flat form moves the sharded dim to
-        the front before reshape(-1) — a LOCAL transpose, so the SPMD
-        partitioner never has to rematerialize (the concat-everything
-        layout forced a full replicate-and-reslice of every leaf)."""
+        """Per-leaf flat layout from the optimizer partitioning spec:
+        ``(dp_dim, dp_axes, mp_dim, mp_axes)``. The flat form is 2-D —
+        ``[dp_dim, mp_dim*rest]`` with the dp-sharded dim first and any
+        model/tensor-sharded dim as the MAJOR component of the second —
+        both LOCAL transposes, so the SPMD partitioner never has to
+        rematerialize, and a tp/sp-sharded leaf keeps its model sharding
+        on dim 1 while the host master partitions over dim 0 (offload x
+        model parallel, reference stage_1_and_2.py:96 init with mpu)."""
+        from .topology import EXPERT_AXIS, MICS_AXIS, SEQ_AXIS
+        dp_set = (DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS)
         layouts = []
         for spec in jax.tree.leaves(spec_tree,
                                     is_leaf=lambda s: isinstance(s, P)):
-            dim, axes = self._dp_axes_in(spec)
-            axes = tuple(a for a in axes if self.topology.axis_size(a) > 1)
-            layouts.append((dim if axes else None, axes))
+            dp_dim, dp_axes = self._dp_axes_in(spec)
+            dp_axes = tuple(a for a in dp_axes
+                            if self.topology.axis_size(a) > 1)
+            mp_dim, mp_axes = None, ()
+            for dim, entry in enumerate(spec):
+                if entry is None or dim == dp_dim:
+                    continue
+                ax = entry if isinstance(entry, (tuple, list)) else (entry,)
+                mp = tuple(a for a in ax if a not in dp_set
+                           and self.topology.axis_size(a) > 1)
+                if mp:
+                    if mp_dim is not None:
+                        raise ValueError(
+                            f"optimizer leaf spec {spec} shards two "
+                            "non-data dims — no 2-D flat host layout")
+                    mp_dim, mp_axes = dim, mp
+            layouts.append((dp_dim if dp_axes else None, dp_axes,
+                            mp_dim, mp_axes))
         return layouts
 
     @staticmethod
-    def _to_flat(x, dim):
+    def _flat_order(ndim, dp_dim, mp_dim):
+        order = [d for d in (dp_dim, mp_dim) if d is not None]
+        return order + [d for d in range(ndim) if d not in order]
+
+    @staticmethod
+    def _to_flat(x, layout):
+        """[...] -> 2-D [dp, rest] per the leaf layout (fp32)."""
+        dp_dim, _, mp_dim, _ = layout
         x = x.astype(jnp.float32)
-        if dim is not None:
-            x = jnp.moveaxis(x, dim, 0)
-        return x.reshape(-1)
+        if x.ndim == 0:
+            return x.reshape(1, 1)
+        x = x.transpose(DeepSpeedEngine._flat_order(x.ndim, dp_dim, mp_dim))
+        lead = x.shape[0] if dp_dim is not None else 1
+        return x.reshape(lead, -1)
+
+    @staticmethod
+    def _flat2_sharding_spec(layout) -> P:
+        dp_dim, dp_axes, mp_dim, mp_axes = layout
+        return P(dp_axes if dp_axes else None, mp_axes if mp_axes else None)
 
     @staticmethod
     def _leaf_local_groups(arr):
-        """Host-local shards of a 1-D array grouped by global offset:
-        sorted [(start, [devices], device_data)] with replicated copies
-        deduplicated (every device in the group gets the same data back on
-        push). ``device_data`` stays on device — batch the D2H pull with
-        one ``jax.device_get`` over all groups, not per-shard copies."""
+        """Host-local shards of a 2-D flat array grouped by global offset:
+        sorted [((row_start, col_start), [devices], device_data)] with
+        replicated copies deduplicated (every device in the group gets the
+        same data back on push). ``device_data`` stays on device — batch
+        the D2H pull with one ``jax.device_get`` over all groups, not
+        per-shard copies."""
         groups = {}
         for s in arr.addressable_shards:
-            start = (s.index[0].start or 0) if s.index else 0
-            groups.setdefault(start, []).append(s)
-        return [(start, [s.device for s in groups[start]],
-                 groups[start][0].data)
-                for start in sorted(groups)]
+            key = tuple((sl.start or 0) for sl in s.index) if s.index else ()
+            key = (key + (0, 0))[:2]
+            groups.setdefault(key, []).append(s)
+        return [(key, [s.device for s in groups[key]], groups[key][0].data)
+                for key in sorted(groups)]
 
     def _init_offload_runner(self, state) -> None:
         """Host master copy + CPU/NVMe optimizer, PARTITIONED over devices.
@@ -502,12 +537,11 @@ class DeepSpeedEngine:
         from .zero.offload_optimizer import OffloadedOptimizerRunner
         oc = self.config.zero_config.offload_optimizer
         t = self.topology
-        if (t.model_parallel_size * t.sequence_parallel_size
-                * t.pipe_parallel_size * t.expert_parallel_size) != 1:
+        if (t.pipe_parallel_size * t.expert_parallel_size) != 1:
             raise ValueError(
-                "offload_optimizer requires a pure data-parallel mesh "
-                f"(plus mics); got {t} — the flat host partitioning cannot "
-                "express additional tensor/sequence/pipe sharding")
+                "offload_optimizer composes with tensor/sequence parallel "
+                "meshes but not pipe/expert (a leaf sharded over two "
+                f"non-data dims has no 2-D flat host layout); got {t}")
 
         leaves_paths, self._offload_treedef = \
             jax.tree_util.tree_flatten_with_path(state["params"])
@@ -531,27 +565,28 @@ class DeepSpeedEngine:
         self._offload_layouts = [all_layouts[i] for i in host_idx]
         self._offload_layout = {"sizes": sizes, "total": sum(sizes)}
         self._offload_flat_shardings = tuple(
-            NamedSharding(self.mesh, P(axes) if axes else P())
-            for _, axes in self._offload_layouts)
+            NamedSharding(self.mesh, self._flat2_sharding_spec(lay))
+            for lay in self._offload_layouts)
 
         layouts = self._offload_layouts
 
         def flatten_master(params):
             leaves = jax.tree.leaves(params)
-            return tuple(self._to_flat(leaves[i], dim)
-                         for i, (dim, _) in zip(host_idx, layouts))
+            return tuple(self._to_flat(leaves[i], lay)
+                         for i, lay in zip(host_idx, layouts))
 
         with self.mesh:
             flat_leaves = jax.jit(
                 flatten_master,
                 out_shardings=self._offload_flat_shardings)(state["params"])
-        # spans: (leaf_idx, global_start, length, [devices]) in local
+        self._offload_flat_shapes = [a.shape for a in flat_leaves]
+        # spans: (leaf_idx, (row0, col0), piece_shape, [devices]) in local
         # processing order — THE layout contract for fetch/step/push/ckpt
         self._offload_spans = []
         pieces = []
         for i, arr in enumerate(flat_leaves):
-            for start, devices, data in self._leaf_local_groups(arr):
-                self._offload_spans.append((i, start, data.size, devices))
+            for key, devices, data in self._leaf_local_groups(arr):
+                self._offload_spans.append((i, key, data.shape, devices))
                 pieces.append(data)
         pieces = [np.asarray(p, np.float32).reshape(-1)
                   for p in jax.device_get(pieces)]
@@ -1099,8 +1134,8 @@ class DeepSpeedEngine:
 
             def fetch(grad_acc, scale):
                 leaves = jax.tree.leaves(grad_acc)
-                flats = [self._to_flat(leaves[i], dim)
-                         for i, (dim, _) in zip(host_idx, layouts)]
+                flats = [self._to_flat(leaves[i], lay)
+                         for i, lay in zip(host_idx, layouts)]
                 dev = {n: leaves[i].astype(jnp.float32)
                        for n, i in zip(dev_names, dev_idx)}
                 every = flats + list(dev.values())
@@ -1131,13 +1166,16 @@ class DeepSpeedEngine:
 
             def unflatten(flats, dev_params):
                 outs = [None] * len(full_shapes)
-                for f, (dim, _), shape, i in zip(flats, layouts, shapes,
-                                                 host_idx):
-                    if dim is None:
-                        a = f.reshape(shape)
+                for f, lay, shape, i in zip(flats, layouts, shapes,
+                                            host_idx):
+                    if len(shape) == 0:
+                        a = f.reshape(())
                     else:
-                        moved = (shape[dim],) + shape[:dim] + shape[dim + 1:]
-                        a = jnp.moveaxis(f.reshape(moved), 0, dim)
+                        dp_dim, _, mp_dim, _ = lay
+                        order = self._flat_order(len(shape), dp_dim, mp_dim)
+                        a = f.reshape(tuple(shape[d] for d in order))
+                        a = a.transpose([order.index(d)
+                                         for d in range(len(shape))])
                     outs[i] = a.astype(dtype)
                 for n, i in zip(dev_names, dev_idx):
                     outs[i] = dev_params[n]
@@ -1190,14 +1228,15 @@ class DeepSpeedEngine:
             # flat global array from this host's device segments
             per_leaf = [[] for _ in flat_grads]
             off = 0
-            for leaf_idx, _, length, devices in self._offload_spans:
-                seg = master[off:off + length]
+            for leaf_idx, _, pshape, devices in self._offload_spans:
+                length = int(np.prod(pshape))
+                seg = master[off:off + length].reshape(pshape)
                 off += length
                 per_leaf[leaf_idx].extend(
                     jax.device_put(seg, d) for d in devices)
             flat_masters = tuple(
                 jax.make_array_from_single_device_arrays(
-                    (int(np.prod(self._offload_shapes[i])) or 0,),
+                    self._offload_flat_shapes[i],
                     self._offload_flat_shardings[i], arrs)
                 for i, arrs in enumerate(per_leaf))
             with self.mesh:
@@ -1460,17 +1499,24 @@ class DeepSpeedEngine:
                      sizes=np.array(lay["sizes"], np.int64),
                      total=lay["total"],
                      chunk_elems=self._OFFLOAD_CHUNK_ELEMS,
-                     # per-leaf flat form: which dim was moved to front
-                     # (-1 = natural/replicated order)
+                     # per-leaf 2-D flat form: dp dim first, model dim (if
+                     # any) major of the second (-1 = absent)
                      shard_dims=np.array(
-                         [-1 if d is None else d
-                          for d, _ in self._offload_layouts], np.int64),
+                         [-1 if lay[0] is None else lay[0]
+                          for lay in self._offload_layouts], np.int64),
+                     mp_dims=np.array(
+                         [-1 if lay[2] is None else lay[2]
+                          for lay in self._offload_layouts], np.int64),
                      span_leaf=np.array(
                          [i for i, _, _, _ in self._offload_spans], np.int64),
                      span_starts=np.array(
-                         [s for _, s, _, _ in self._offload_spans], np.int64),
+                         [k for _, k, _, _ in self._offload_spans], np.int64),
                      span_lens=np.array(
-                         [l for _, _, l, _ in self._offload_spans], np.int64))
+                         [int(np.prod(sh))
+                          for _, _, sh, _ in self._offload_spans], np.int64),
+                     span_shapes=np.array(
+                         [sh for _, _, sh, _ in self._offload_spans],
+                         np.int64))
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
 
     def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.npz") -> None:
@@ -1521,10 +1567,25 @@ class DeepSpeedEngine:
                     f"offload checkpoint chunk size {saved_chunk} != "
                     f"current {self._OFFLOAD_CHUNK_ELEMS}; the m/v state "
                     "layout is chunked — load with the same chunk size")
-            saved = list(zip((int(x) for x in z["span_leaf"]),
-                             (int(x) for x in z["span_starts"]),
-                             (int(x) for x in z["span_lens"])))
-            cur = [(i, s, l) for i, s, l, _ in self._offload_spans]
+            starts = np.asarray(z["span_starts"])
+            if starts.ndim == 1:
+                # legacy 1-D flat layout (pure-dp): element offset ->
+                # (row, 0) on the 2-D flat whose row width is the leaf's
+                # trailing extent
+                conv = []
+                for leaf, st, ln in zip(z["span_leaf"], starts,
+                                        z["span_lens"]):
+                    cols = self._offload_flat_shapes[int(leaf)][1]
+                    conv.append((int(leaf), (int(st) // max(cols, 1), 0),
+                                 (int(ln) // max(cols, 1), cols)))
+                saved = conv
+            else:
+                saved = [(int(l), tuple(int(x) for x in st),
+                          tuple(int(x) for x in sh))
+                         for l, st, sh in zip(z["span_leaf"], starts,
+                                              z["span_shapes"])]
+            cur = [(i, tuple(k), tuple(sh))
+                   for i, k, sh, _ in self._offload_spans]
             if saved != cur:
                 raise ValueError(
                     "offload checkpoint was saved on a different "

@@ -69,24 +69,77 @@ def get_fp32_state_dict_from_zero_checkpoint(ckpt_dir: str,
         names = [str(n) for n in z["names"]]
         sizes = [int(s) for s in z["sizes"]]
         shard_dims = [int(d) for d in z["shard_dims"]]
+        # 2-D flat layout (offload x tensor parallel): a model-sharded dim
+        # rides as the major component of the flat's second dim
+        mp_dims = ([int(d) for d in z["mp_dims"]] if "mp_dims" in z
+                   else [-1] * len(names))
         if flat.size < int(z["total"]):
             raise ValueError(
                 "offload_optimizer.npz holds only a partial (multi-host) "
                 "master segment; consolidate per-host segments first")
-        off = 0
-        for name, size, dim in zip(names, sizes, shard_dims):
-            seg = flat[off:off + size]
-            off += size
-            if name not in out:
+        # master_flat is the concatenation of per-device SPAN pieces (in
+        # (row, col) order) — NOT necessarily row-major per leaf: a leaf
+        # sharded over dp (rows) AND model (cols) interleaves column
+        # blocks. Rebuild each leaf's 2-D flat from the span records, then
+        # invert the [dp, mp*rest] transpose.
+        flats2 = _leaf_flats_from_spans(z, names, sizes, shard_dims, mp_dims,
+                                        {n: out[n].shape for n in out
+                                         if n in set(names)}, flat)
+        for name, dim, mp in zip(names, shard_dims, mp_dims):
+            if name not in out or name not in flats2:
                 continue
             shape = out[name].shape
-            if dim < 0:
+            order = [d for d in (dim, mp) if d >= 0]
+            order += [d for d in range(len(shape)) if d not in order]
+            seg = flats2[name]
+            if not order:  # scalar
                 out[name] = seg.reshape(shape)
-            else:
-                # per-leaf flat form is shard-major: the dp-sharded dim was
-                # moved to the front before flattening — invert it
-                moved = (shape[dim],) + shape[:dim] + shape[dim + 1:]
-                out[name] = np.moveaxis(seg.reshape(moved), 0, dim)
+                continue
+            a = seg.reshape(tuple(shape[d] for d in order))
+            out[name] = a.transpose([order.index(d)
+                                     for d in range(len(shape))])
+    return out
+
+
+def _leaf_flats_from_spans(z, names, sizes, shard_dims, mp_dims, shapes,
+                           flat: np.ndarray) -> Dict[str, np.ndarray]:
+    """Per-leaf 2-D flat [dp_extent, rest] rebuilt from the span records.
+
+    Spans are (leaf, (row0, col0), piece_shape) in concatenation order;
+    placing each piece at its (row, col) offset handles column-sharded
+    (offload x tensor-parallel) layouts that a plain row-major reshape
+    would scramble. Falls back to sequential row-major slicing for
+    checkpoints without span_shapes (pure-dp writers)."""
+    out: Dict[str, np.ndarray] = {}
+    flat2_shapes = {}
+    for name, size, dim in zip(names, sizes, shard_dims):
+        if name not in shapes:
+            continue
+        shape = shapes[name]
+        lead = shape[dim] if dim >= 0 and shape else 1
+        flat2_shapes[name] = (lead, max(size // max(lead, 1), 1))
+    if "span_shapes" not in z:
+        off = 0
+        for name, size in zip(names, sizes):
+            seg = flat[off:off + size]
+            off += size
+            if name in flat2_shapes:
+                out[name] = seg.reshape(flat2_shapes[name])
+        return out
+    for name in flat2_shapes:
+        out[name] = np.zeros(flat2_shapes[name], np.float32)
+    leaf_names = {i: n for i, n in enumerate(names)}
+    off = 0
+    for leaf, (r0, c0), pshape in zip(z["span_leaf"], z["span_starts"],
+                                      z["span_shapes"]):
+        ln = int(np.prod(pshape))
+        seg = flat[off:off + ln]
+        off += ln
+        name = leaf_names.get(int(leaf))
+        if name in out:
+            out[name][int(r0):int(r0) + int(pshape[0]),
+                      int(c0):int(c0) + int(pshape[1])] = seg.reshape(
+                          tuple(int(x) for x in pshape))
     return out
 
 

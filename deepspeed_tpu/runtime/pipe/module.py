@@ -121,7 +121,13 @@ class PipelineModule:
         x_mb = x.reshape(M, mb, S, c.hidden_size)
 
         Pst = self.num_stages
-        ticks = M + Pst - 1
+        # the scan executes the INSTRUCTION SCHEDULE (schedule.py): tick
+        # count, stage-0 feed and last-stage emit all derive from it — the
+        # schedule is the single source of truth, the scan its interpreter
+        from .schedule import forward_tick_plan
+        ticks, feed_plan, emit_plan = forward_tick_plan(M, Pst)
+        feed_plan = jnp.asarray(feed_plan)   # [ticks] mb to load, -1=bubble
+        emit_plan = jnp.asarray(emit_plan)   # [ticks] mb emitted, -1=bubble
         buf = jnp.zeros((Pst, mb, S, c.hidden_size), c.dtype)
         out_mb = jnp.zeros((M, mb, S, c.hidden_size), c.dtype)
         aux_total = jnp.zeros((), jnp.float32)
@@ -133,16 +139,18 @@ class PipelineModule:
             # shift activations one stage forward: roll over the pipe-sharded
             # stage dim == collective_permute on ICI
             shifted = jnp.roll(buf, shift=1, axis=0)
-            # stage 0 ingests microbatch t (zeros during drain)
+            # LoadMicroBatch: stage 0 ingests the scheduled microbatch
+            # (zeros during drain bubbles)
+            feed_idx = feed_plan[t]
             feed = jax.lax.dynamic_index_in_dim(
-                x_mb, jnp.minimum(t, M - 1), axis=0, keepdims=False)
-            feed = jnp.where(t < M, feed, jnp.zeros_like(feed))
+                x_mb, jnp.maximum(feed_idx, 0), axis=0, keepdims=False)
+            feed = jnp.where(feed_idx >= 0, feed, jnp.zeros_like(feed))
             inp = shifted.at[0].set(feed)
             # every stage computes in parallel (stage dim sharded over pipe)
             out, aux = jax.vmap(self._stage_fn, in_axes=(0, 0, None))(
                 params["blocks"], inp, positions)
-            # last stage emits microbatch t-(P-1) during drain
-            emit_idx = t - (Pst - 1)
+            # last stage emits the scheduled microbatch during drain
+            emit_idx = emit_plan[t]
             out_mb = jax.lax.cond(
                 emit_idx >= 0,
                 lambda o: jax.lax.dynamic_update_index_in_dim(o, out[Pst - 1], jnp.maximum(emit_idx, 0), axis=0),

@@ -100,13 +100,36 @@ class InferenceSchedule(PipeSchedule):
             mb = step_id - self.stage_id
             if 0 <= mb < self.micro_batches:
                 if self.is_first_stage:
-                    cmds.append(LoadMicroBatch(buffer_id=mb % 2))
+                    cmds.append(LoadMicroBatch(buffer_id=mb % 2, micro_batch=mb))
                 else:
-                    cmds.append(RecvActivation(buffer_id=mb % 2))
-                cmds.append(ForwardPass(buffer_id=mb % 2))
+                    cmds.append(RecvActivation(buffer_id=mb % 2, micro_batch=mb))
+                cmds.append(ForwardPass(buffer_id=mb % 2, micro_batch=mb))
                 if not self.is_last_stage:
-                    cmds.append(SendActivation(buffer_id=mb % 2))
+                    cmds.append(SendActivation(buffer_id=mb % 2, micro_batch=mb))
             yield cmds
+
+
+def forward_tick_plan(micro_batches: int, stages: int):
+    """Executable plan for the SPMD scan executor, DERIVED from the
+    instruction schedule (single source of truth — ``PipelineModule.apply``
+    runs exactly this): per scan tick, which microbatch stage 0 loads and
+    which microbatch the last stage emits (-1 = bubble).
+
+    Returns ``(ticks, feed_mb, emit_mb)`` where the lists have one entry
+    per tick. The backward half of ``TrainSchedule`` is the exact mirror
+    (same tick count, stages reversed) and is realized by ``jax.grad``
+    reversing the scan, so only the forward plan is materialized."""
+    first = InferenceSchedule(micro_batches, stages, stage_id=0)
+    last = InferenceSchedule(micro_batches, stages, stage_id=stages - 1)
+    feed_mb, emit_mb = [], []
+    for step in first.steps():
+        loads = [c for c in step if isinstance(c, LoadMicroBatch)]
+        feed_mb.append(loads[0].micro_batch if loads else -1)
+    for step in last.steps():
+        fwds = [c for c in step if isinstance(c, ForwardPass)]
+        emit_mb.append(fwds[0].micro_batch if fwds else -1)
+    assert len(feed_mb) == len(emit_mb)
+    return len(feed_mb), feed_mb, emit_mb
 
 
 class TrainSchedule(PipeSchedule):
@@ -124,22 +147,22 @@ class TrainSchedule(PipeSchedule):
             mb = t - s
             if 0 <= mb < M:
                 if self.is_first_stage:
-                    cmds.append(LoadMicroBatch(buffer_id=mb % 2))
+                    cmds.append(LoadMicroBatch(buffer_id=mb % 2, micro_batch=mb))
                 else:
-                    cmds.append(RecvActivation(buffer_id=mb % 2))
-                cmds.append(ForwardPass(buffer_id=mb % 2))
+                    cmds.append(RecvActivation(buffer_id=mb % 2, micro_batch=mb))
+                cmds.append(ForwardPass(buffer_id=mb % 2, micro_batch=mb))
                 if not self.is_last_stage:
-                    cmds.append(SendActivation(buffer_id=mb % 2))
+                    cmds.append(SendActivation(buffer_id=mb % 2, micro_batch=mb))
             yield cmds
         for t in range(fwd_ticks):
             cmds = []
             mb = t - (S - 1 - s)  # backward flows last→first
             if 0 <= mb < M:
                 if not self.is_last_stage:
-                    cmds.append(RecvGrad(buffer_id=mb % 2))
-                cmds.append(BackwardPass(buffer_id=mb % 2))
+                    cmds.append(RecvGrad(buffer_id=mb % 2, micro_batch=mb))
+                cmds.append(BackwardPass(buffer_id=mb % 2, micro_batch=mb))
                 if not self.is_first_stage:
-                    cmds.append(SendGrad(buffer_id=mb % 2))
+                    cmds.append(SendGrad(buffer_id=mb % 2, micro_batch=mb))
             yield cmds
         yield [ReduceGrads(), OptimizerStep()]
 

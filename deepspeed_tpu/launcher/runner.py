@@ -50,11 +50,15 @@ def parse_args(args=None):
                         help="coordinator address (defaults to first host)")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "local", "popen"],
+                        choices=["ssh", "local", "popen", "slurm"],
                         help="remote exec method ('popen' spawns one local "
                              "process per hostfile entry — the reference "
                              "launch.py per-rank spawner, for single-host "
-                             "multi-process runs)")
+                             "multi-process runs; 'slurm' emits one srun "
+                             "step, one task per node)")
+    parser.add_argument("--slurm_args", type=str, default="",
+                        help="extra arguments spliced into the srun command "
+                             "(e.g. '--partition=tpu --time=2:00:00')")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--elastic_training", action="store_true",
                         help="supervise workers through the elastic agent: "
@@ -235,11 +239,74 @@ def _run_ssh(args, active: Dict[str, List[int]]) -> int:
     return _wait_all(procs)
 
 
+def build_srun_command(args, active: Dict[str, List[int]],
+                       exports: Dict[str, str]) -> List[str]:
+    """srun command for a batch-scheduled TPU fleet (reference
+    ``SlurmRunner.get_cmd``, multinode_runner.py:117). One task per node —
+    a TPU host runs a single JAX process; per-task identity comes from
+    SLURM_PROCID/SLURM_NTASKS, which ``jax.distributed.initialize()``
+    auto-detects, so no JAX_PROCESS_ID is baked into the command."""
+    hosts = sorted(active.keys())
+    n = len(hosts)
+    master = args.master_addr or hosts[0]
+    cmd = ["srun", "--nodes", str(n), "--ntasks", str(n),
+           "--ntasks-per-node", "1"]
+    synthetic = all(h.startswith("slurm-node-") for h in hosts)
+    if hosts and hosts != ["localhost"] and not synthetic:
+        # real hostnames pin the step to the hostfile's nodes; the
+        # synthetic names main() makes inside an allocation do not exist,
+        # so srun places tasks itself there
+        cmd += ["--nodelist", ",".join(hosts)]
+    if args.slurm_args:
+        cmd += shlex.split(args.slurm_args)
+    export_kvs = {}
+    if args.master_addr or not synthetic:
+        export_kvs["JAX_COORDINATOR_ADDRESS"] = f"{master}:{args.master_port}"
+    # else: jax.distributed.initialize() derives the coordinator from the
+    # SLURM environment (first node of SLURM_JOB_NODELIST)
+    export_kvs["DSTPU_WORLD_INFO"] = encode_world_info(active)
+    # --export=ALL forwards the whole submitting environment — the
+    # collected exports (and .deepspeed_env) are injected into srun's OWN
+    # env by _run_slurm, NOT listed here: srun splits the --export list on
+    # commas, so values like TPU_PROCESS_BOUNDS=2,2,1 would be truncated.
+    # Only the two computed (comma-free) variables ride the list.
+    for v in export_kvs.values():
+        assert "," not in str(v), f"--export value may not contain commas: {v}"
+    cmd += ["--export=" + ",".join(
+        ["ALL"] + [f"{k}={v}" for k, v in sorted(export_kvs.items())])]
+    cmd += [sys.executable, args.user_script] + args.user_args
+    return cmd
+
+
+def _run_slurm(args, active: Dict[str, List[int]]) -> int:
+    exports = _collect_env_exports()
+    cmd = build_srun_command(args, active, exports)
+    logger.info(f"launching srun: {' '.join(map(shlex.quote, cmd))}")
+    env = dict(os.environ)
+    env.update(exports)  # forwarded via --export=ALL, commas intact
+    proc = subprocess.Popen(cmd, env=env)
+
+    def forward(sig, frame):
+        proc.send_signal(sig)
+
+    signal.signal(signal.SIGINT, forward)
+    signal.signal(signal.SIGTERM, forward)
+    return proc.wait()
+
+
 def main(args=None) -> int:
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
     if args.elastic_training:
         return _run_elastic(args, resource_pool)
+    if args.launcher == "slurm" and not resource_pool:
+        # inside an existing allocation: srun infers the node set itself
+        n = int(os.environ.get("SLURM_NNODES", "0"))
+        if not n:
+            raise ValueError(
+                "--launcher slurm needs a hostfile or an active SLURM "
+                "allocation (SLURM_NNODES)")
+        resource_pool = {f"slurm-node-{i}": 1 for i in range(n)}
     if not resource_pool or args.launcher == "local":
         return _run_local(args)
     active = _parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
@@ -247,6 +314,8 @@ def main(args=None) -> int:
         # popen spawns per SLOT — a single-host 'localhost slots=8' entry
         # is its primary use case, so no single-host short-circuit
         return _run_popen(args, active)
+    if args.launcher == "slurm":
+        return _run_slurm(args, active)
     if len(active) == 1 and not args.force_multi:
         return _run_local(args)
     return _run_ssh(args, active)

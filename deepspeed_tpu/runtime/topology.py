@@ -96,10 +96,42 @@ class MeshTopology:
         self._mesh = Mesh(self._device_grid(devices, shape), MESH_AXES)
 
     @staticmethod
+    def _hybrid_dcn_shape(shape: Tuple[int, ...],
+                          n_slices: int) -> Optional[Tuple[int, ...]]:
+        """Which mesh axis absorbs the data-center network (multi-slice)
+        dimension. Replica-style axes whose collectives are bandwidth-light
+        per step — ``data``, then ``mics``, then ``pipe`` (stage boundary
+        crossings are point-to-point) — may span DCN; ``model``/``seq``/
+        ``expert`` collectives must stay on ICI (reference concern:
+        topology-aware process-group placement, pipe/topology.py:244).
+        Returns the dcn mesh shape, or None if no eligible axis divides."""
+        if n_slices <= 1:
+            return None
+        dcn = [1] * len(shape)
+        for axis in (DATA_AXIS, MICS_AXIS, PIPE_AXIS):
+            i = MESH_AXES.index(axis)
+            if shape[i] % n_slices == 0:
+                dcn[i] = n_slices
+                return tuple(dcn)
+        return None
+
+    @staticmethod
     def _device_grid(devices: Sequence[jax.Device], shape: Tuple[int, ...]) -> np.ndarray:
         if len(devices) > 1 and devices[0].platform == "tpu":
+            from jax.experimental import mesh_utils
+            n_slices = len({getattr(d, "slice_index", 0) for d in devices})
+            if n_slices > 1:
+                # multi-slice (v5p pods over DCN): data-like axes ride DCN,
+                # model/seq/expert stay inside each slice's ICI torus
+                dcn = MeshTopology._hybrid_dcn_shape(shape, n_slices)
+                if dcn is not None:
+                    try:
+                        ici = tuple(s // d for s, d in zip(shape, dcn))
+                        return mesh_utils.create_hybrid_device_mesh(
+                            ici, dcn, devices=devices)
+                    except Exception:
+                        pass  # fall through to the single-torus layout
             try:
-                from jax.experimental import mesh_utils
                 return mesh_utils.create_device_mesh(shape, devices=devices)
             except Exception:
                 pass

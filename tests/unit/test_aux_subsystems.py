@@ -59,6 +59,61 @@ def test_include_exclude_filters():
     assert active == {"a": [0], "b": [0, 1]}
 
 
+def test_slurm_runner_command_line():
+    """--launcher slurm emits one srun step, one task per node, with env
+    propagation (reference SlurmRunner.get_cmd, multinode_runner.py:117)."""
+    from deepspeed_tpu.launcher.runner import build_srun_command, parse_args
+    args = parse_args(["--launcher", "slurm", "--master_port", "6007",
+                       "--slurm_args=--partition=tpu",
+                       "train.py", "--lr", "0.1"])
+    active = {"tpu-host-1": [0], "tpu-host-0": [0]}
+    cmd = build_srun_command(args, active,
+                             {"TPU_PROCESS_BOUNDS": "2,2,1"})
+    assert cmd[:7] == ["srun", "--nodes", "2", "--ntasks", "2",
+                       "--ntasks-per-node", "1"]
+    assert "--nodelist" in cmd
+    assert cmd[cmd.index("--nodelist") + 1] == "tpu-host-0,tpu-host-1"
+    assert "--partition=tpu" in cmd
+    export = next(c for c in cmd if c.startswith("--export="))
+    # collected env vars ride srun's OWN environment (via --export=ALL),
+    # never the comma-split list — TPU_PROCESS_BOUNDS=2,2,1 would be
+    # truncated by slurm's comma parsing
+    assert export.startswith("--export=ALL,")
+    assert "TPU_PROCESS_BOUNDS" not in export
+    assert "JAX_COORDINATOR_ADDRESS=tpu-host-0:6007" in export
+    assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+
+def test_slurm_runner_inside_allocation_defers_to_slurm():
+    """Without a hostfile, synthetic node names must NOT be pinned via
+    --nodelist, and the coordinator comes from the SLURM env (jax
+    auto-detection), not a baked fake hostname."""
+    from deepspeed_tpu.launcher.runner import build_srun_command, parse_args
+    args = parse_args(["--launcher", "slurm", "train.py"])
+    active = {f"slurm-node-{i}": [0] for i in range(4)}
+    cmd = build_srun_command(args, active, {})
+    assert "--nodelist" not in cmd
+    export = next(c for c in cmd if c.startswith("--export="))
+    assert "JAX_COORDINATOR_ADDRESS" not in export
+
+
+def test_hybrid_mesh_dcn_axis_placement():
+    """Multi-slice meshes put data-like axes on DCN, never model/seq/expert
+    (reference: topology-aware groups, pipe/topology.py:244)."""
+    from deepspeed_tpu.runtime.topology import MESH_AXES, MeshTopology
+    # shape order: (pipe, data, mics, expert, seq, model)
+    dcn = MeshTopology._hybrid_dcn_shape((1, 8, 1, 1, 2, 2), n_slices=4)
+    assert dcn == (1, 4, 1, 1, 1, 1)  # data absorbs the slice dim
+    # data indivisible -> mics takes it
+    dcn = MeshTopology._hybrid_dcn_shape((1, 3, 4, 1, 1, 1), n_slices=2)
+    assert dcn == (1, 1, 2, 1, 1, 1)
+    # data/mics/pipe all indivisible -> no hybrid layout (caller falls back);
+    # model/seq/expert must never absorb DCN even when divisible
+    assert MeshTopology._hybrid_dcn_shape((1, 3, 1, 2, 2, 2), 2) is None
+    assert MeshTopology._hybrid_dcn_shape((1, 8, 1, 1, 1, 1), 1) is None
+    assert MESH_AXES.index("data") == 1
+
+
 # -- lr schedules (reference tests/unit/runtime/test_lr_schedulers.py) -------
 
 def test_warmup_lr_ramp():

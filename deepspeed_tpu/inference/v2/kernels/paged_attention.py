@@ -122,16 +122,13 @@ def paged_decode_attention(q: jax.Array,
     if alibi_slopes is not None or window is not None:
         use_pallas = False  # stock kernel has no bias/window inputs
     if use_pallas:
-        from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention as pa_kernel
-        pages_per_block = min(8, block_tables.shape[1])
-        while block_tables.shape[1] % pages_per_block:
-            pages_per_block -= 1
+        # builder-written kernel (pallas_paged_decode.py): GQA-native,
+        # head_dim-64 capable, burst-scan compatible — the three gaps that
+        # made the stock jax.experimental kernel unusable here (r2)
+        from .pallas_paged_decode import paged_gqa_decode
         try:
-            return pa_kernel(
-                (q * scale).astype(q.dtype),  # kernel applies no softmax scale itself
-                k_pages, v_pages,
-                lengths=context_lens, page_indices=block_tables,
-                pages_per_compute_block=pages_per_block)
+            return paged_gqa_decode(q, k_pages, v_pages, context_lens,
+                                    block_tables, scale=scale)
         except (ValueError, TypeError, NotImplementedError) as e:
             # shape/backend constraints the kernel cannot express; anything
             # else (real bugs) propagates

@@ -88,17 +88,55 @@ def quantize_kernel(kernel: jax.Array, cfg: QuantizationConfig) -> Dict[str, jax
     return {"q": q.astype(_qdtype(cfg.bits)), "scale": scale}
 
 
+# flip to the G-loop form when the batched partial product [tokens, G, out]
+# would exceed this many fp32 elements (the einsum form materializes it:
+# a 2048-token wave through llama2-7b's quantized lm_head would be
+# 2048*32*32000*4B = 8.4 GB — an HBM OOM the loop form caps at [tokens, out])
+_PARTIAL_ELEMS_LIMIT = 64 * 1024 * 1024
+
+
 def quantized_matmul(x: jax.Array, qp: Dict[str, jax.Array]) -> jax.Array:
     """x [..., in] @ quantized kernel -> [..., out], scales factored out of
     each group's contraction so the int weights feed the MXU directly."""
     q, scale = qp["q"], qp["scale"]
     G, gs, d_out = q.shape[-3:]
     xg = x.reshape(*x.shape[:-1], G, gs)
-    # [..., G, out] partial products, scaled per group then summed
-    y = jnp.einsum("...gi,gio->...go", xg, q.astype(x.dtype),
-                   preferred_element_type=jnp.float32)
-    y = y * scale.reshape(G, d_out).astype(jnp.float32)
-    return jnp.sum(y, axis=-2).astype(x.dtype)
+    wdt = x.dtype
+    if jax.default_backend() == "cpu" and x.dtype == jnp.bfloat16:
+        # XLA:CPU has no DotThunk for batched bf16 x bf16 -> f32 (G > 1
+        # lowers to a batched dot); upcasting is trace-time static, so the
+        # TPU program — where bf16 x bf16 -> f32 IS the native MXU mode —
+        # is untouched
+        xg, wdt = xg.astype(jnp.float32), jnp.float32
+    tokens = int(np.prod(x.shape[:-1])) or 1
+    if tokens * G * d_out <= _PARTIAL_ELEMS_LIMIT:
+        # [..., G, out] partial products, scaled per group then summed
+        y = jnp.einsum("...gi,gio->...go", xg, q.astype(wdt),
+                       preferred_element_type=jnp.float32)
+        y = y * scale.reshape(G, d_out).astype(jnp.float32)
+        return jnp.sum(y, axis=-2).astype(x.dtype)
+
+    # large-activation form: accumulate over CHUNKS of groups so the live
+    # intermediate stays at [..., Gc, out] <= the limit (instead of G times
+    # that), while each chunk still runs as one batched dot on the MXU
+    gc = max(1, _PARTIAL_ELEMS_LIMIT // max(tokens * d_out, 1))
+    while G % gc:
+        gc -= 1
+    sc = scale.reshape(G, d_out).astype(jnp.float32)
+    xc = jnp.moveaxis(xg.reshape(*x.shape[:-1], G // gc, gc, gs),
+                      -3, 0)                       # [nc, ..., gc, gs]
+    qc = q.reshape(G // gc, gc, gs, d_out)
+    scc = sc.reshape(G // gc, gc, d_out)
+
+    def step(acc, args):
+        xk, qk, sk = args
+        y = jnp.einsum("...gi,gio->...go", xk, qk.astype(wdt),
+                       preferred_element_type=jnp.float32)
+        return acc + jnp.sum(y * sk, axis=-2), None
+
+    acc = jnp.zeros(x.shape[:-1] + (d_out,), jnp.float32)
+    acc, _ = jax.lax.scan(step, acc, (xc, qc, scc))
+    return acc.astype(x.dtype)
 
 
 def dequantize_kernel(qp: Dict[str, jax.Array], dtype=jnp.float32) -> jax.Array:

@@ -192,11 +192,13 @@ class InferenceEngineV2:
         engine's shardings without touching compiled programs."""
         c = self.model.config
         specs = self.model.specs()
+        leaves = jax.tree.leaves(params)
+        on_device = bool(leaves) and isinstance(leaves[0], jax.Array)
         with self.mesh:
-            if self._qcfg is not None:
-                # same streaming placement as __init__: whole-tree dense +
-                # int8 resident at once would OOM exactly the large-model
-                # flip this path serves (see _place_quantized_streaming)
+            if self._qcfg is not None and not on_device:
+                # host tree (checkpoint reload): stream leaf-by-leaf so the
+                # dense copy never fully materializes in HBM (see
+                # _place_quantized_streaming)
                 self.params = self._place_quantized_streaming(specs, params)
             else:
                 shardings = jax.tree.map(
@@ -205,6 +207,12 @@ class InferenceEngineV2:
                 self.params = jax.jit(
                     lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
                     out_shardings=shardings)(params)
+                if self._qcfg is not None:
+                    # hybrid-engine flip: the dense tree is already device-
+                    # resident (it IS the training copy), so the on-device
+                    # quantize stays sharded and never round-trips the host
+                    self.params = quantize_placed(self.mesh, specs,
+                                                  self.params, self._qcfg)
 
     # ------------------------------------------------------------------
     # compiled-program cache (jax.jit retraces per (S, T, mp) bucket)

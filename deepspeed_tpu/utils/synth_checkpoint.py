@@ -1,0 +1,118 @@
+"""Synthesize a full-depth HF checkpoint directory on local disk.
+
+The attached environment has no network egress, so real checkpoint weights
+cannot be downloaded. For full-architecture benching (VERDICT r2 #1) this
+writes a REAL-format HF directory — config.json + sharded safetensors with
+an index — whose architecture matches the named model exactly (full layer
+count, real dims); only the values are random. Serving throughput, TTFT,
+HBM footprint and compile behavior are identical to the real weights.
+
+Reference capability mirrored: ``build_hf_engine`` consuming a downloaded
+HF snapshot (``/root/reference/deepspeed/inference/v2/engine_factory.py:65``).
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+from typing import Dict, Tuple
+
+# real published architectures (HF config.json fields)
+ARCHS: Dict[str, Dict] = {
+    "llama2-7b": dict(
+        model_type="llama", architectures=["LlamaForCausalLM"],
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=4096, rms_norm_eps=1e-5, rope_theta=10000.0,
+        hidden_act="silu", tie_word_embeddings=False, torch_dtype="bfloat16"),
+    "tinyllama-1.1b": dict(
+        model_type="llama", architectures=["LlamaForCausalLM"],
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=22, num_attention_heads=32, num_key_value_heads=4,
+        max_position_embeddings=2048, rms_norm_eps=1e-5, rope_theta=10000.0,
+        hidden_act="silu", tie_word_embeddings=False, torch_dtype="bfloat16"),
+    # not a real model: small GQA llama for unit-testing this writer
+    "llama-test-tiny": dict(
+        model_type="llama", architectures=["LlamaForCausalLM"],
+        vocab_size=256, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        hidden_act="silu", tie_word_embeddings=False, torch_dtype="bfloat16"),
+}
+
+
+def _llama_tensor_shapes(cfg: Dict) -> Dict[str, Tuple[int, ...]]:
+    h, ffn = cfg["hidden_size"], cfg["intermediate_size"]
+    kvh = cfg["num_key_value_heads"] * (h // cfg["num_attention_heads"])
+    shapes: Dict[str, Tuple[int, ...]] = {
+        "model.embed_tokens.weight": (cfg["vocab_size"], h),
+        "model.norm.weight": (h,),
+        "lm_head.weight": (cfg["vocab_size"], h),
+    }
+    for i in range(cfg["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        shapes[p + "input_layernorm.weight"] = (h,)
+        shapes[p + "post_attention_layernorm.weight"] = (h,)
+        shapes[p + "self_attn.q_proj.weight"] = (h, h)
+        shapes[p + "self_attn.k_proj.weight"] = (kvh, h)
+        shapes[p + "self_attn.v_proj.weight"] = (kvh, h)
+        shapes[p + "self_attn.o_proj.weight"] = (h, h)
+        shapes[p + "mlp.gate_proj.weight"] = (ffn, h)
+        shapes[p + "mlp.up_proj.weight"] = (ffn, h)
+        shapes[p + "mlp.down_proj.weight"] = (h, ffn)
+    return shapes
+
+
+def synthesize_hf_checkpoint(arch: str, out_dir: str,
+                             shard_bytes: int = 2 << 30,
+                             seed: int = 0) -> str:
+    """Write ``out_dir`` as an HF llama-family checkpoint (bf16 safetensors
+    shards + index + config.json). Idempotent: returns immediately if the
+    directory already holds a matching config. Peak host RAM ~= one shard."""
+    cfg = ARCHS[arch]
+    marker = os.path.join(out_dir, "config.json")
+    if os.path.exists(marker):
+        with open(marker) as f:
+            if json.load(f).get("_dstpu_synth") == arch:
+                return out_dir
+    import torch
+    from safetensors.torch import save_file
+
+    os.makedirs(out_dir, exist_ok=True)
+    shapes = _llama_tensor_shapes(cfg)
+    gen = torch.Generator().manual_seed(seed)
+
+    index, shard, shard_sz, shard_id = {}, {}, 0, 1
+    names = list(shapes)
+    # count shards up front so filenames carry the final total
+    total_bytes = sum(2 * int(torch.tensor(s).prod()) for s in shapes.values())
+    n_shards = max(1, -(-total_bytes // shard_bytes))
+
+    def flush(shard, shard_id):
+        fname = f"model-{shard_id:05d}-of-{n_shards:05d}.safetensors"
+        save_file(shard, os.path.join(out_dir, fname))
+        for k in shard:
+            index[k] = fname
+        return fname
+
+    for name in names:
+        t = torch.empty(shapes[name], dtype=torch.float32)
+        t.normal_(0.0, 0.02, generator=gen)
+        if name.endswith("layernorm.weight") or name == "model.norm.weight":
+            t.fill_(1.0)  # norms init to one so activations stay finite
+        shard[name] = t.to(torch.bfloat16)
+        shard_sz += shard[name].numel() * 2
+        if shard_sz >= shard_bytes:
+            flush(shard, shard_id)
+            shard, shard_sz, shard_id = {}, 0, shard_id + 1
+            gc.collect()
+    if shard:
+        flush(shard, shard_id)
+
+    with open(os.path.join(out_dir, "model.safetensors.index.json"), "w") as f:
+        json.dump({"metadata": {"total_size": total_bytes},
+                   "weight_map": index}, f)
+    with open(marker, "w") as f:
+        json.dump({**cfg, "_dstpu_synth": arch}, f, indent=2)
+    return out_dir

@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Full-depth llama2-7b int8 serving bench (bench.py runs this in a
+subprocess with a hard timeout: the ~6 min weight stream + multi-minute
+XLA compiles of a 32-layer program must not be able to hang the whole
+bench if the remote compile helper stalls).
+
+Prints ONE JSON line (the bench_serving dict) on success.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    n_requests = int(os.environ.get("DSTPU_7B_REQS", "4"))
+    from bench import PEAK_TFLOPS, bench_serving
+    from deepspeed_tpu.utils.synth_checkpoint import synthesize_hf_checkpoint
+    import jax
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+    path = synthesize_hf_checkpoint(
+        "llama2-7b", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".synth_ckpts", "llama2-7b"))
+    line = bench_serving(
+        None, n_requests=n_requests, prompt_len=512, max_new=64,
+        token_budget=2048, peak_tflops=peak, model_path=path,
+        quantization="int8", label="llama2-7b FULL 32L int8 WOQ, ")
+    print(json.dumps(line), flush=True)
+
+
+if __name__ == "__main__":
+    main()

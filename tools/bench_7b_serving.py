@@ -1,10 +1,17 @@
 #!/usr/bin/env python
-"""Full-depth llama2-7b int8 serving bench (bench.py runs this in a
-subprocess with a hard timeout: the ~6 min weight stream + multi-minute
-XLA compiles of a 32-layer program must not be able to hang the whole
-bench if the remote compile helper stalls).
+"""Full-depth serving bench (bench.py runs this in a subprocess with a
+hard timeout: the multi-minute weight stream + 32-layer compiles through
+the remote-device tunnel must not be able to hang the whole bench if the
+compile helper stalls).
 
-Prints ONE JSON line (the bench_serving dict) on success.
+Tries llama2-7b (32 layers, real dims, int8 WOQ ≈ 6.6 GB HBM) first; if
+that fails on this chip (HBM headroom through the tunnel environment is
+marginal — see memory notes), falls back to tinyllama-1.1b, ALSO a real
+published architecture at full depth (22 layers, GQA 32h/4kv), so the
+bench always produces a no-scaling serving line.
+
+Prints one JSON line per attempt; the LAST line is the result bench.py
+keeps.
 """
 
 import json
@@ -14,20 +21,36 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
-    n_requests = int(os.environ.get("DSTPU_7B_REQS", "4"))
+def run(arch: str, n_requests: int, token_budget: int):
     from bench import PEAK_TFLOPS, bench_serving
     from deepspeed_tpu.utils.synth_checkpoint import synthesize_hf_checkpoint
     import jax
     peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = synthesize_hf_checkpoint(
-        "llama2-7b", os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".synth_ckpts", "llama2-7b"))
-    line = bench_serving(
+        arch, os.path.join(root, ".synth_ckpts", arch))
+    label = {"llama2-7b": "llama2-7b FULL 32L int8 WOQ, ",
+             "tinyllama-1.1b": "tinyllama-1.1b FULL 22L int8 WOQ, "}[arch]
+    return bench_serving(
         None, n_requests=n_requests, prompt_len=512, max_new=64,
-        token_budget=2048, peak_tflops=peak, model_path=path,
-        quantization="int8", label="llama2-7b FULL 32L int8 WOQ, ")
-    print(json.dumps(line), flush=True)
+        token_budget=token_budget, peak_tflops=peak, model_path=path,
+        quantization="int8", label=label)
+
+
+def main():
+    attempts = [("llama2-7b", int(os.environ.get("DSTPU_7B_REQS", "4")), 1024),
+                ("tinyllama-1.1b", 16, 2048)]
+    if os.environ.get("DSTPU_7B_SKIP") == "1":
+        attempts = attempts[1:]
+    for arch, reqs, budget in attempts:
+        try:
+            line = run(arch, reqs, budget)
+            print(json.dumps(line), flush=True)
+            return
+        except Exception as e:  # noqa: BLE001 — fall back to the next arch
+            print(json.dumps({"attempt": arch, "error": str(e)[:200]}),
+                  flush=True)
+    raise SystemExit(1)
 
 
 if __name__ == "__main__":

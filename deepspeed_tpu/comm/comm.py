@@ -80,6 +80,20 @@ def init_distributed(dist_backend: Optional[str] = None,
             kwargs["process_id"] = rank
         elif os.environ.get("JAX_PROCESS_ID"):
             kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+        else:
+            # mpirun-launched jobs (reference ``mpi_discovery``, comm.py:673):
+            # one command line cannot bake a per-process id, so identity
+            # comes from the MPI runtime — OpenMPI's OMPI_COMM_WORLD_RANK or
+            # the PMI vars MPICH/Intel MPI set. Size fallback likewise.
+            for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK"):
+                if os.environ.get(var):
+                    kwargs["process_id"] = int(os.environ[var])
+                    break
+            if "num_processes" not in kwargs:
+                for var in ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE"):
+                    if os.environ.get(var):
+                        kwargs["num_processes"] = int(os.environ[var])
+                        break
         jax.distributed.initialize(**kwargs)
         if verbose:
             logger.info(f"jax.distributed initialized: process {jax.process_index()}/{jax.process_count()}")

@@ -50,15 +50,22 @@ def parse_args(args=None):
                         help="coordinator address (defaults to first host)")
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "local", "popen", "slurm"],
+                        choices=["ssh", "local", "popen", "slurm",
+                                 "openmpi", "mpich", "impi"],
                         help="remote exec method ('popen' spawns one local "
                              "process per hostfile entry — the reference "
                              "launch.py per-rank spawner, for single-host "
                              "multi-process runs; 'slurm' emits one srun "
-                             "step, one task per node)")
+                             "step, one task per node; 'openmpi'/'mpich'/"
+                             "'impi' emit one mpirun with one task per node "
+                             "— rank identity comes from the MPI runtime's "
+                             "OMPI_COMM_WORLD_RANK / PMI_RANK)")
     parser.add_argument("--slurm_args", type=str, default="",
                         help="extra arguments spliced into the srun command "
                              "(e.g. '--partition=tpu --time=2:00:00')")
+    parser.add_argument("--launcher_args", type=str, default="",
+                        help="extra arguments spliced into the mpirun "
+                             "command (openmpi/mpich/impi launchers)")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--elastic_training", action="store_true",
                         help="supervise workers through the elastic agent: "
@@ -140,11 +147,12 @@ def _collect_env_exports() -> Dict[str, str]:
     return exports
 
 
-def _run_local(args) -> int:
-    """Single-host exec with signal forwarding (reference launch.py:249,313)."""
-    cmd = [sys.executable, args.user_script] + args.user_args
-    logger.info(f"launching local: {' '.join(map(shlex.quote, cmd))}")
-    proc = subprocess.Popen(cmd)
+def _spawn_and_forward(cmd: List[str], what: str,
+                       env: Optional[Dict[str, str]] = None) -> int:
+    """Popen + SIGINT/SIGTERM forwarding + wait — the shared tail of the
+    single-child runners (local / srun / mpirun)."""
+    logger.info(f"launching {what}: {' '.join(map(shlex.quote, cmd))}")
+    proc = subprocess.Popen(cmd, env=env)
 
     def forward(sig, frame):
         proc.send_signal(sig)
@@ -152,6 +160,12 @@ def _run_local(args) -> int:
     signal.signal(signal.SIGINT, forward)
     signal.signal(signal.SIGTERM, forward)
     return proc.wait()
+
+
+def _run_local(args) -> int:
+    """Single-host exec with signal forwarding (reference launch.py:249,313)."""
+    cmd = [sys.executable, args.user_script] + args.user_args
+    return _spawn_and_forward(cmd, "local")
 
 
 def _install_fan_out(procs: List[subprocess.Popen]) -> None:
@@ -278,20 +292,61 @@ def build_srun_command(args, active: Dict[str, List[int]],
     return cmd
 
 
+def build_mpirun_command(args, active: Dict[str, List[int]],
+                         exports: Dict[str, str]) -> List[str]:
+    """mpirun command for MPI-scheduled fleets (reference
+    ``OpenMPIRunner``/``MPICHRunner``/``IMPIRunner``,
+    multinode_runner.py:18-117). One task per node — a TPU host runs a
+    single JAX process. Per-task identity is NOT baked into the command:
+    the MPI runtime sets OMPI_COMM_WORLD_RANK (OpenMPI) or PMI_RANK
+    (MPICH/Intel MPI), which ``init_distributed`` reads (comm.py
+    mpi_discovery parity)."""
+    hosts = sorted(active.keys())
+    n = len(hosts)
+    master = args.master_addr or hosts[0]
+    env_kvs = dict(exports)
+    # a leaked JAX_PROCESS_ID (manual single-process test, .deepspeed_env)
+    # would give every rank identity 0 — init_distributed prefers it over
+    # the MPI runtime's rank vars
+    env_kvs.pop("JAX_PROCESS_ID", None)
+    env_kvs["JAX_COORDINATOR_ADDRESS"] = f"{master}:{args.master_port}"
+    env_kvs["JAX_NUM_PROCESSES"] = str(n)
+    env_kvs["DSTPU_WORLD_INFO"] = encode_world_info(active)
+    if args.launcher == "openmpi":
+        # --host h:1 caps one slot per node; -x FOO=bar sets + forwards
+        cmd = ["mpirun", "-np", str(n),
+               "--host", ",".join(f"{h}:1" for h in hosts),
+               "--map-by", "ppr:1:node"]
+        if args.launcher_args:
+            cmd += shlex.split(args.launcher_args)
+        for k, v in sorted(env_kvs.items()):
+            cmd += ["-x", f"{k}={v}"]
+    else:  # mpich / impi share the hydra CLI: -ppn + -genv K V
+        cmd = ["mpirun", "-n", str(n), "-ppn", "1",
+               "-hosts", ",".join(hosts)]
+        if args.launcher_args:
+            cmd += shlex.split(args.launcher_args)
+        for k, v in sorted(env_kvs.items()):
+            cmd += ["-genv", k, str(v)]
+    cmd += [sys.executable, args.user_script] + args.user_args
+    return cmd
+
+
+def _run_mpi(args, active: Dict[str, List[int]]) -> int:
+    cmd = build_mpirun_command(args, active, _collect_env_exports())
+    # mpirun inherits and propagates its own environment too (hydra fully,
+    # OpenMPI to launch-node ranks) — strip the leaked identity there as
+    # well, not just from the -genv/-x list
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PROCESS_ID"}
+    return _spawn_and_forward(cmd, "mpirun", env=env)
+
+
 def _run_slurm(args, active: Dict[str, List[int]]) -> int:
     exports = _collect_env_exports()
     cmd = build_srun_command(args, active, exports)
-    logger.info(f"launching srun: {' '.join(map(shlex.quote, cmd))}")
     env = dict(os.environ)
     env.update(exports)  # forwarded via --export=ALL, commas intact
-    proc = subprocess.Popen(cmd, env=env)
-
-    def forward(sig, frame):
-        proc.send_signal(sig)
-
-    signal.signal(signal.SIGINT, forward)
-    signal.signal(signal.SIGTERM, forward)
-    return proc.wait()
+    return _spawn_and_forward(cmd, "srun", env=env)
 
 
 def main(args=None) -> int:
@@ -307,6 +362,11 @@ def main(args=None) -> int:
                 "--launcher slurm needs a hostfile or an active SLURM "
                 "allocation (SLURM_NNODES)")
         resource_pool = {f"slurm-node-{i}": 1 for i in range(n)}
+    if args.launcher in ("openmpi", "mpich", "impi") and not resource_pool:
+        # silently degrading the requested multi-host job to one local
+        # process would be the worst failure mode
+        raise ValueError(f"--launcher {args.launcher} needs a hostfile "
+                         f"(none at {args.hostfile})")
     if not resource_pool or args.launcher == "local":
         return _run_local(args)
     active = _parse_inclusion_exclusion(resource_pool, args.include, args.exclude)
@@ -316,6 +376,8 @@ def main(args=None) -> int:
         return _run_popen(args, active)
     if args.launcher == "slurm":
         return _run_slurm(args, active)
+    if args.launcher in ("openmpi", "mpich", "impi"):
+        return _run_mpi(args, active)
     if len(active) == 1 and not args.force_multi:
         return _run_local(args)
     return _run_ssh(args, active)

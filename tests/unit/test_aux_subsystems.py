@@ -97,6 +97,68 @@ def test_slurm_runner_inside_allocation_defers_to_slurm():
     assert "JAX_COORDINATOR_ADDRESS" not in export
 
 
+def test_openmpi_runner_command_line():
+    """--launcher openmpi emits one mpirun, one task per node, env via -x
+    (reference OpenMPIRunner.get_cmd, multinode_runner.py:18)."""
+    from deepspeed_tpu.launcher.runner import build_mpirun_command, parse_args
+    args = parse_args(["--launcher", "openmpi", "--master_port", "6007",
+                       "--launcher_args=--mca btl ^openib",
+                       "train.py", "--lr", "0.1"])
+    active = {"tpu-host-1": [0], "tpu-host-0": [0]}
+    cmd = build_mpirun_command(args, active, {"TPU_NAME": "pod"})
+    assert cmd[:3] == ["mpirun", "-np", "2"]
+    assert cmd[cmd.index("--host") + 1] == "tpu-host-0:1,tpu-host-1:1"
+    assert cmd[cmd.index("--map-by") + 1] == "ppr:1:node"
+    assert "^openib" in cmd
+    assert "-x" in cmd
+    xvals = [cmd[i + 1] for i, c in enumerate(cmd) if c == "-x"]
+    assert "JAX_COORDINATOR_ADDRESS=tpu-host-0:6007" in xvals
+    assert "JAX_NUM_PROCESSES=2" in xvals
+    assert "TPU_NAME=pod" in xvals
+    # rank identity comes from OMPI_COMM_WORLD_RANK, never baked in
+    assert not any(v.startswith("JAX_PROCESS_ID") for v in xvals)
+    assert cmd[-3:] == ["train.py", "--lr", "0.1"]
+
+
+def test_mpich_impi_runner_command_line():
+    """mpich/impi use the hydra CLI: -ppn 1 + -genv K V pairs (reference
+    MPICHRunner/IMPIRunner, multinode_runner.py:70,117)."""
+    from deepspeed_tpu.launcher.runner import build_mpirun_command, parse_args
+    for flavor in ("mpich", "impi"):
+        args = parse_args(["--launcher", flavor, "train.py"])
+        active = {"h0": [0], "h1": [0], "h2": [0]}
+        cmd = build_mpirun_command(args, active, {})
+        assert cmd[:5] == ["mpirun", "-n", "3", "-ppn", "1"]
+        assert cmd[cmd.index("-hosts") + 1] == "h0,h1,h2"
+        genvs = {cmd[i + 1]: cmd[i + 2]
+                 for i, c in enumerate(cmd) if c == "-genv"}
+        assert genvs["JAX_COORDINATOR_ADDRESS"] == "h0:29500"
+        assert genvs["JAX_NUM_PROCESSES"] == "3"
+        assert "JAX_PROCESS_ID" not in genvs
+        assert cmd[-1] == "train.py"
+
+
+def test_mpi_rank_discovery(monkeypatch):
+    """init_distributed reads OMPI/PMI rank+size when no JAX_PROCESS_ID is
+    set (reference mpi_discovery, comm.py:673)."""
+    from deepspeed_tpu.comm import comm as C
+    captured = {}
+    monkeypatch.setattr(C, "_INITIALIZED", False)
+    monkeypatch.setattr(C.jax.distributed, "initialize",
+                        lambda **kw: captured.update(kw))
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "h0:29500")
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.setenv("OMPI_COMM_WORLD_RANK", "2")
+    monkeypatch.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    try:
+        C.init_distributed(verbose=False)
+    finally:
+        C._INITIALIZED = False
+    assert captured == {"coordinator_address": "h0:29500",
+                        "process_id": 2, "num_processes": 4}
+
+
 def test_hybrid_mesh_dcn_axis_placement():
     """Multi-slice meshes put data-like axes on DCN, never model/seq/expert
     (reference: topology-aware groups, pipe/topology.py:244)."""

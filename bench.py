@@ -365,7 +365,7 @@ def main():
             # reports 53 TF at seq512), single device
             bert_model("bert-large", dtype=jnp.bfloat16, remat=True,
                        max_seq_len=512),
-            zero_cfg(1, 64, grad_bf16=False), 64, 128, steps,
+            zero_cfg(1, 64), 64, 128, steps,
             REF_MFU_BERT, peak, remat_forced=True))
         runs.append(lambda: bench_train(
             # FULL architecture, no dims scaling: GPT-2-large, all 36

@@ -468,7 +468,8 @@ class TransformerLM:
         x = _c(x.astype(c.dtype), ACT_SPEC)
 
         block_fn = functools.partial(self._block_fn, attention_mask)
-        if c.remat:
+        alternating = c.remat and c.remat_policy == "alternating"
+        if c.remat and not alternating:
             policy = None
             if c.remat_policy and c.remat_policy not in ("full", "nothing_saveable"):
                 policy = getattr(jax.checkpoint_policies, c.remat_policy)
@@ -481,8 +482,32 @@ class TransformerLM:
         xs = (params["blocks"], keep)
         if self._windows is not None:
             xs = xs + (jnp.asarray(self._windows, jnp.int32),)
-        (x, _, aux), _ = jax.lax.scan(block_fn, (x, positions, jnp.zeros((), jnp.float32)),
-                                      xs)
+        init = (x, positions, jnp.zeros((), jnp.float32))
+        if alternating:
+            # HALF-remat: scan over layer pairs, checkpointing only the
+            # first of each pair — the backward recomputes every other
+            # layer (half the recompute FLOPs of full remat) while the
+            # scan stores residuals for only half the layers (half the
+            # activation memory of no remat). The sweet spot when full
+            # activations don't fit but full recompute over-pays.
+            ck_fn = jax.checkpoint(block_fn)
+
+            def pair_fn(carry, xs_pair):
+                carry, _ = ck_fn(carry, jax.tree.map(lambda a: a[0], xs_pair))
+                carry, _ = block_fn(carry, jax.tree.map(lambda a: a[1], xs_pair))
+                return carry, None
+
+            n_pairs = c.num_layers // 2
+            xs_even = jax.tree.map(
+                lambda a: a[:n_pairs * 2].reshape((n_pairs, 2) + a.shape[1:]),
+                xs)
+            (x, _, aux), _ = jax.lax.scan(pair_fn, init, xs_even)
+            if c.num_layers % 2:  # odd depth: last layer, checkpointed
+                (x, _, aux), _ = ck_fn(
+                    (x, positions, aux),
+                    jax.tree.map(lambda a: a[-1], xs))
+        else:
+            (x, _, aux), _ = jax.lax.scan(block_fn, init, xs)
         if self._ln_f is not None:
             x = self._ln_f(params["ln_f"], x)
         if return_hidden:

@@ -87,7 +87,12 @@ class PipelineModule:
                 None, carry, (block, jnp.asarray(1.0, self.config.dtype)))
         if self.config.remat:
             policy = None
-            if self.config.remat_policy and self.config.remat_policy not in ("full", "nothing_saveable"):
+            if self.config.remat_policy == "alternating":
+                # the pair-scan half-remat lives in the dense model's layer
+                # scan (transformer.py apply); a pipeline stage's slice may
+                # be a single layer, so it degrades to full remat here
+                pass
+            elif self.config.remat_policy and self.config.remat_policy not in ("full", "nothing_saveable"):
                 policy = getattr(jax.checkpoint_policies, self.config.remat_policy)
             block_fn = jax.checkpoint(block_fn, policy=policy)
         (x, _, aux), _ = jax.lax.scan(

@@ -85,6 +85,41 @@ def test_train_batch_api(eight_devices):
     assert engine.global_steps == 1
 
 
+def test_fused_step_matches_split(eight_devices, monkeypatch):
+    """The one-dispatch fused step (gas==1) must match the split
+    forward/backward/step path, and must not engage when ineligible."""
+    def run(fused, stage=1):
+        monkeypatch.setenv("DSTPU_FUSED_STEP", "1" if fused else "0")
+        cfg = dict(BASE_CONFIG, zero_optimization={"stage": stage})
+        e, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg,
+                                              seed=7)
+        batch = make_batch(seed=4)
+        losses = [float(e.train_batch(batch)) for _ in range(3)]
+        assert (e._jit_train_step is not None) == fused
+        assert e.global_steps == 3 and e.micro_steps == 3
+        return losses
+
+    np.testing.assert_allclose(run(True), run(False), rtol=2e-5)
+    # gas>1 must stay on the split path even when fusing is enabled
+    monkeypatch.setenv("DSTPU_FUSED_STEP", "1")
+    cfg = dict(BASE_CONFIG, gradient_accumulation_steps=2)
+    e, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg)
+    e.train_batch(make_batch())
+    assert e._jit_train_step is None
+
+
+def test_fused_step_alternating_remat(eight_devices):
+    """The 'alternating' half-remat policy trains and learns (odd depth
+    exercises the trailing checkpointed layer)."""
+    m = gpt2_model("gpt2-tiny", max_seq_len=32, vocab_size=256, remat=True,
+                   remat_policy="alternating", num_layers=3)
+    cfg = dict(BASE_CONFIG, zero_optimization={"stage": 1})
+    e, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+    batch = make_batch()
+    losses = [float(e.train_batch(batch)) for _ in range(4)]
+    assert losses[-1] < losses[0], losses
+
+
 def test_bf16_training(eight_devices):
     config = dict(BASE_CONFIG, bf16={"enabled": True}, zero_optimization={"stage": 2})
     engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(dtype=jnp.bfloat16), config=config)

@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Where does the bert-large seq128 step time go?
+
+Times, separately and interleaved: (a) the full train_batch step,
+(b) the jitted micro step (loss+grads) alone, (c) the jitted apply step
+(optimizer) alone, and (d) forward-only loss. Variants via argv:
+grad_accum_dtype bf16 and fp32 (the bench uses fp32).
+
+Run:  python tools/bert_profile.py [bf16_grads]
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import bert_model
+from deepspeed_tpu.runtime import topology as topo_mod
+
+STEPS = 20
+
+
+def sync(x):
+    return float(jax.device_get(jnp.ravel(jax.tree.leaves(x)[0])[0]))
+
+
+def main():
+    bf16_grads = "bf16_grads" in sys.argv[1:]
+    topo_mod.reset()
+    model = bert_model("bert-large", dtype=jnp.bfloat16, remat=True,
+                       max_seq_len=512)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 64,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+    }
+    if bf16_grads:
+        cfg["data_types"] = {"grad_accum_dtype": "bf16"}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, model.config.vocab_size, size=(64, 128))
+    labels = np.full_like(ids, -100)
+    mask = rng.random(ids.shape) < 0.15
+    labels[mask] = ids[mask]
+    batch = {"input_ids": ids, "labels": labels}
+
+    sync(engine.train_batch(batch))
+    sync(engine.train_batch(batch))
+
+    pieces = {}
+
+    def timeit(name, fn):
+        best = float("inf")
+        for _ in range(3):
+            out = fn()  # compile outside the window on the first call
+            sync(out)
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                out = fn()
+            sync(out)
+            best = min(best, (time.perf_counter() - t0) / STEPS)
+        pieces[name] = round(best * 1e3, 2)
+
+    # forward-only loss (no grads) — pure fwd cost
+    params_only = jax.jit(lambda p, b: model.loss(p, b))
+    dbatch = engine._device_batch(batch)
+    timeit("fwd_loss_only", lambda: params_only(engine.state["params"], dbatch))
+    # micro step (fwd + bwd + grad accumulate)
+    timeit("micro_fwd_bwd", lambda: engine.forward(batch))
+    # full step (micro + optimizer apply)
+    def full():
+        loss = engine.train_batch(batch)
+        return loss
+    timeit("full_train_batch", full)
+    pieces["apply_est"] = round(
+        pieces["full_train_batch"] - pieces["micro_fwd_bwd"], 2)
+    print(json.dumps({"grads": "bf16" if bf16_grads else "fp32",
+                      **pieces}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1508,7 +1508,9 @@ class DeepSpeedEngine:
             leaf.delete()  # the actual HBM release
         self._pcache = {"treedef": treedef, "meta": meta}
         self.state["params"] = None
-        self._jit_micro_step = None  # old programs captured donated buffers
+        # old programs captured donated buffers — both step entry points
+        self._jit_micro_step = None
+        self._jit_train_step = None
 
     def reload_param_cache(self) -> None:
         """Rebuild the device-sharded param tree from the paged shards."""

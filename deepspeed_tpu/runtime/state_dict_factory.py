@@ -166,6 +166,12 @@ def _llama_family_config(hf: Dict[str, Any]) -> Dict[str, Any]:
             rope_theta=hf.get("rope_theta", 10000.0),
             norm_eps=hf.get("rms_norm_eps", 1e-6),
             tie_embeddings=hf.get("tie_word_embeddings", False))
+    # modern llama configs carry attention_bias; internlm (v1) spells the
+    # same architecture choice "bias" (reference container: containers/
+    # internlm.py — llama block with biased q/k/v/o)
+    if hf.get("attention_bias", hf.get("bias", False)):
+        cfg["attn_bias"] = True
+        cfg["attn_out_bias"] = True
     if hf.get("model_type") == "mixtral":
         cfg["moe"] = MoEConfig(
             num_experts=hf.get("num_local_experts", 8),
@@ -389,6 +395,10 @@ def _llama_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str
         "v_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, T)},
         "o_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, T)},
     }
+    if "model.layers.0.self_attn.q_proj.bias" in sd:  # attention_bias models
+        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+            blocks[name]["bias"] = _stack(
+                sd, "model.layers.{i}.self_attn." + name + ".bias", L)
     if cfg.moe is not None:
         E = cfg.moe.num_experts
         blocks["moe"] = {
@@ -1054,7 +1064,7 @@ def load_megatron_model(ckpt, config: TransformerConfig,
 def _register_builtins() -> None:
     from ..models.registry import register_architecture
     register_architecture("gpt2", _gpt2_config, _gpt2_params)
-    for mt in ("llama", "mistral", "mixtral"):
+    for mt in ("llama", "mistral", "mixtral", "internlm"):
         register_architecture(mt, _llama_family_config, _llama_params)
     register_architecture("opt", _opt_config, _opt_params)
     register_architecture("phi", _phi_config, _phi_params)

@@ -248,6 +248,36 @@ def distilbert_ckpt(tmp_path_factory):
     return path, m
 
 
+@pytest.fixture(scope="module")
+def internlm_ckpt(tmp_path_factory):
+    """InternLM v1 = the llama block with biased q/k/v/o (reference
+    containers/internlm.py). transformers has no native class, but
+    LlamaForCausalLM with attention_bias=True IS that architecture — save
+    it, then relabel the config to internlm's own spelling (model_type +
+    'bias') so the loader's internlm mapping is what gets exercised."""
+    import json as _json
+    path = tmp_path_factory.mktemp("hf_internlm")
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, attention_bias=True)
+    torch.manual_seed(21)
+    m = transformers.LlamaForCausalLM(cfg).eval()
+    with torch.no_grad():  # saved biases must be nonzero to prove loading
+        for layer in m.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj, layer.self_attn.o_proj):
+                proj.bias.uniform_(-0.05, 0.05)
+    m.save_pretrained(path)
+    cfg_path = path / "config.json"
+    raw = _json.loads(cfg_path.read_text())
+    raw["model_type"] = "internlm"
+    raw.pop("attention_bias", None)
+    raw["bias"] = True
+    cfg_path.write_text(_json.dumps(raw))
+    return path, m
+
+
 def _ref_logits(m, ids):
     with torch.no_grad():
         return m(torch.tensor(ids)).logits.float().numpy()
@@ -266,7 +296,7 @@ def _our_logits(path, ids, **overrides):
                                   "gpt_neox_seq_ckpt", "gpt_neox_nobias_ckpt",
                                   "gptj_ckpt", "bert_ckpt", "roberta_ckpt",
                                   "distilbert_ckpt", "gpt_neo_ckpt",
-                                  "mistral_sw_ckpt"])
+                                  "mistral_sw_ckpt", "internlm_ckpt"])
 def test_hf_logits_parity(request, eight_devices, ckpt):
     """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
     path, m = request.getfixturevalue(ckpt)

@@ -10,6 +10,7 @@ Run:  python tools/bert_profile.py [bf16_grads]
 """
 
 import json
+import os
 import sys
 import time
 
@@ -74,13 +75,16 @@ def main():
     timeit("fwd_loss_only", lambda: params_only(engine.state["params"], dbatch))
     # micro step (fwd + bwd + grad accumulate)
     timeit("micro_fwd_bwd", lambda: engine.forward(batch))
-    # full step (micro + optimizer apply)
-    def full():
-        loss = engine.train_batch(batch)
-        return loss
-    timeit("full_train_batch", full)
+    # full step. apply_est = full - micro is only meaningful on the SPLIT
+    # path; the fused one-dispatch step would make it read near zero, so
+    # force the split program for the component breakdown and report the
+    # fused total as its own line.
+    os.environ["DSTPU_FUSED_STEP"] = "0"
+    timeit("full_train_batch_split", lambda: engine.train_batch(batch))
     pieces["apply_est"] = round(
-        pieces["full_train_batch"] - pieces["micro_fwd_bwd"], 2)
+        pieces["full_train_batch_split"] - pieces["micro_fwd_bwd"], 2)
+    os.environ["DSTPU_FUSED_STEP"] = "1"
+    timeit("full_train_batch_fused", lambda: engine.train_batch(batch))
     print(json.dumps({"grads": "bf16" if bf16_grads else "fp32",
                       **pieces}), flush=True)
 

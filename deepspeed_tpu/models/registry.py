@@ -10,9 +10,9 @@ their registration (``inference/v2/model_implementations/*`` registered via
 - ``config_fn(hf_config_dict) -> kwargs for TransformerConfig``
 - ``params_fn(cfg, state_dict) -> TransformerLM param pytree``
 
-``runtime/state_dict_factory.py`` registers the built-in fifteen
-(gpt2/llama/mistral/mixtral/internlm/opt/phi/falcon/bloom/gpt_neo/gpt_neox/
-gptj and the bert/roberta/distilbert encoders) at import; user code can register
+``runtime/state_dict_factory.py`` registers the built-in sixteen
+(gpt2/llama/mistral/mixtral/internlm/qwen2/opt/phi/falcon/bloom/gpt_neo/
+gpt_neox/gptj and the bert/roberta/distilbert encoders) at import; user code can register
 additional families without touching the loader.
 """
 

@@ -168,17 +168,32 @@ def _llama_family_config(hf: Dict[str, Any]) -> Dict[str, Any]:
             tie_embeddings=hf.get("tie_word_embeddings", False))
     # modern llama configs carry attention_bias; internlm (v1) spells the
     # same architecture choice "bias" (reference container: containers/
-    # internlm.py — llama block with biased q/k/v/o)
-    if hf.get("attention_bias", hf.get("bias", False)):
+    # internlm.py — llama block with biased q/k/v/o); qwen2 always biases
+    # q/k/v but never o_proj
+    if hf.get("model_type") == "qwen2":
+        cfg["attn_bias"] = True
+        cfg["attn_out_bias"] = False
+    elif hf.get("attention_bias", hf.get("bias", False)):
         cfg["attn_bias"] = True
         cfg["attn_out_bias"] = True
     if hf.get("model_type") == "mixtral":
         cfg["moe"] = MoEConfig(
             num_experts=hf.get("num_local_experts", 8),
             top_k=hf.get("num_experts_per_tok", 2))
-    # mistral/mixtral causal sliding window (null in many configs = global)
-    if hf.get("sliding_window"):
-        cfg["attn_windows"] = int(hf["sliding_window"])
+    # mistral/mixtral causal sliding window (null in many configs =
+    # global). qwen2 configs CARRY a sliding_window value that is inert
+    # unless use_sliding_window is set — honoring it unconditionally
+    # would silently truncate attention — and even then it applies only
+    # to layers >= max_window_layers (HF layer_types: lower layers attend
+    # globally); attn_windows takes the per-layer tuple form for that.
+    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+        w = int(hf["sliding_window"])
+        mwl = hf.get("max_window_layers")
+        if mwl is not None and hf.get("model_type") == "qwen2":
+            cfg["attn_windows"] = tuple(
+                0 if i < mwl else w for i in range(hf["num_hidden_layers"]))
+        else:
+            cfg["attn_windows"] = w
     return cfg
 
 
@@ -395,8 +410,10 @@ def _llama_params(cfg: TransformerConfig, sd: Dict[str, np.ndarray]) -> Dict[str
         "v_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.v_proj.weight", L, T)},
         "o_proj": {"kernel": _stack(sd, "model.layers.{i}.self_attn.o_proj.weight", L, T)},
     }
-    if "model.layers.0.self_attn.q_proj.bias" in sd:  # attention_bias models
-        for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+    # attention-bias models: internlm carries biases on all four
+    # projections, qwen2 on q/k/v only — stack whichever are present
+    for name in ("q_proj", "k_proj", "v_proj", "o_proj"):
+        if f"model.layers.0.self_attn.{name}.bias" in sd:
             blocks[name]["bias"] = _stack(
                 sd, "model.layers.{i}.self_attn." + name + ".bias", L)
     if cfg.moe is not None:
@@ -1064,7 +1081,7 @@ def load_megatron_model(ckpt, config: TransformerConfig,
 def _register_builtins() -> None:
     from ..models.registry import register_architecture
     register_architecture("gpt2", _gpt2_config, _gpt2_params)
-    for mt in ("llama", "mistral", "mixtral", "internlm"):
+    for mt in ("llama", "mistral", "mixtral", "internlm", "qwen2"):
         register_architecture(mt, _llama_family_config, _llama_params)
     register_architecture("opt", _opt_config, _opt_params)
     register_architecture("phi", _phi_config, _phi_params)

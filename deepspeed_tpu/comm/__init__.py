@@ -1,4 +1,6 @@
-from .comm import (ReduceOp, all_gather, all_reduce, all_to_all, axis_index,  # noqa: F401
-                   axis_size, barrier, broadcast, configure, get_local_rank,
-                   get_rank, get_world_size, inference_all_reduce, init_distributed,
-                   is_initialized, log_summary, ppermute, reduce_scatter)
+from .comm import (ReduceOp, all_gather, all_reduce, all_to_all,  # noqa: F401
+                   all_to_all_single, axis_index, axis_size, barrier,
+                   broadcast, configure, gather, get_local_rank, get_rank,
+                   get_world_size, inference_all_reduce, init_distributed,
+                   is_initialized, log_summary, monitored_barrier, ppermute,
+                   recv, reduce, reduce_scatter, scatter, send)

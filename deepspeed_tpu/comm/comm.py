@@ -204,6 +204,109 @@ def ppermute(tensor, perm, axis: AxisNames = "pipe"):
     return jax.lax.ppermute(tensor, axis, perm)
 
 
+def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM,
+           axis: AxisNames = "data"):
+    """Reduce toward ``dst`` (reference comm.py reduce). SPMD has no cheap
+    rooted reduce — every device computes the psum; non-dst members get
+    zeros so the contract (result valid only on dst) still holds and XLA
+    can dead-code the unused copies."""
+    _record("reduce", tensor, axis)
+    # jax.lax directly, not all_reduce(): the frontend wrapper would
+    # _record a second (phantom) op in the CommsLogger
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = jax.lax.psum(tensor, axis)
+        if op == ReduceOp.AVG:
+            out = out / axis_size(axis)
+    elif op == ReduceOp.MAX:
+        out = jax.lax.pmax(tensor, axis)
+    elif op == ReduceOp.MIN:
+        out = jax.lax.pmin(tensor, axis)
+    else:
+        raise ValueError(f"Unsupported reduce op {op}")
+    return jnp.where(jax.lax.axis_index(axis) == dst, out,
+                     jnp.zeros_like(out))
+
+
+def gather(tensor, dst: int = 0, axis: AxisNames = "data", tensor_axis: int = 0):
+    """Gather shards to ``dst`` (reference comm.py gather): all_gather with
+    the same only-valid-on-dst contract (zeros elsewhere)."""
+    _record("gather", tensor, axis)
+    out = jax.lax.all_gather(tensor, axis, axis=tensor_axis, tiled=True)
+    return jnp.where(jax.lax.axis_index(axis) == dst, out,
+                     jnp.zeros_like(out))
+
+
+def scatter(tensor, src: int = 0, axis: AxisNames = "data", tensor_axis: int = 0):
+    """Scatter ``src``'s shards across the axis (reference comm.py scatter):
+    broadcast from src, then each member takes its static slice."""
+    _record("scatter", tensor, axis)
+    n = axis_size(axis)
+    if tensor.shape[tensor_axis] % n:
+        # torch.distributed raises on uneven scatter too — truncating
+        # would silently drop the tail elements
+        raise ValueError(
+            f"scatter: dim {tensor_axis} ({tensor.shape[tensor_axis]}) "
+            f"is not divisible by the {axis!r} axis size {n}")
+    # jax.lax directly (broadcast() would double-_record in the logger)
+    full = jax.lax.all_gather(tensor, axis)[src]
+    k = full.shape[tensor_axis] // n
+    idx = jax.lax.axis_index(axis) * k
+    return jax.lax.dynamic_slice_in_dim(full, idx, k, axis=tensor_axis)
+
+
+def all_to_all_single(tensor, axis: AxisNames = "seq", split_axis: int = 0,
+                      concat_axis: int = 0):
+    """Alias of :func:`all_to_all` (reference all_to_all_single,
+    comm.py:388 — the tensor-form API)."""
+    return all_to_all(tensor, axis=axis, split_axis=split_axis,
+                      concat_axis=concat_axis)
+
+
+def send(tensor, dst: int, axis: AxisNames = "pipe"):
+    """Rooted two-sided p2p has no XLA/SPMD primitive — every device runs
+    the same program, so transfers are expressed as permutations. Rejected
+    loudly rather than silently mis-mapped (reference pipe p2p.send)."""
+    raise NotImplementedError(
+        "two-sided send does not exist under SPMD; express the transfer "
+        "as a permutation with comm.ppermute(tensor, perm, axis) — e.g. "
+        "pipeline next-stage transfer: perm=[(i, i+1), ...]")
+
+
+def recv(tensor, src: int, axis: AxisNames = "pipe"):
+    """See :func:`send` — same story in the receive direction (reference
+    pipe p2p.recv signature: (tensor, src))."""
+    raise NotImplementedError(
+        "two-sided recv does not exist under SPMD; the matching ppermute "
+        "on every member IS the receive — comm.ppermute(tensor, perm, "
+        "axis) delivers each member the value permuted to its index")
+
+
+def monitored_barrier(timeout_s: float = 300.0,
+                      name: str = "dstpu_monitored_barrier") -> None:
+    """Barrier that names the stragglers instead of hanging silently
+    (reference comm.py monitored_barrier): waits in a helper thread and
+    logs every ``timeout_s`` with the barrier name until it completes."""
+    if jax.process_count() <= 1:
+        return
+    import threading
+    done = threading.Event()
+
+    def watchdog():
+        waited = 0.0
+        while not done.wait(timeout_s):
+            waited += timeout_s
+            logger.warning(
+                f"monitored_barrier '{name}': process {get_rank()} still "
+                f"waiting after {waited:.0f}s — a peer has not arrived")
+
+    t = threading.Thread(target=watchdog, daemon=True)
+    t.start()
+    try:
+        barrier(name)
+    finally:
+        done.set()
+
+
 def axis_index(axis: AxisNames):
     return jax.lax.axis_index(axis)
 

@@ -186,7 +186,12 @@ def _llama_family_config(hf: Dict[str, Any]) -> Dict[str, Any]:
     # would silently truncate attention — and even then it applies only
     # to layers >= max_window_layers (HF layer_types: lower layers attend
     # globally); attn_windows takes the per-layer tuple form for that.
-    if hf.get("sliding_window") and hf.get("use_sliding_window", True):
+    # default matches each family: HF Qwen2Config defaults
+    # use_sliding_window=False (its sliding_window field is populated but
+    # inert by default); mistral-family configs have no such key and the
+    # window is active when present
+    sw_default = hf.get("model_type") != "qwen2"
+    if hf.get("sliding_window") and hf.get("use_sliding_window", sw_default):
         w = int(hf["sliding_window"])
         mwl = hf.get("max_window_layers")
         if mwl is not None and hf.get("model_type") == "qwen2":

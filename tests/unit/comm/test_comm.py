@@ -1,0 +1,81 @@
+"""Comm frontend collectives on the 8-device CPU mesh (reference
+tests/unit/comm/test_dist.py: rooted + collective op semantics).
+
+Each op runs inside shard_map over a 1-axis mesh, matching how engine and
+parallelism code invoke the frontend.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.comm import comm
+from deepspeed_tpu.comm.comm import ReduceOp
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), ("data",))
+
+
+def _run(fn, x, out_specs=P("data")):
+    mesh = _mesh()
+    return shard_map(fn, mesh=mesh, in_specs=P("data"),
+                     out_specs=out_specs, check_vma=False)(x)
+
+
+def test_reduce_rooted_contract(eight_devices):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = _run(lambda v: comm.reduce(v, dst=3, axis="data"), x)
+    # valid only on dst=3; zeros elsewhere
+    np.testing.assert_array_equal(np.asarray(out).ravel(),
+                                  [0, 0, 0, 28, 0, 0, 0, 0])
+
+
+def test_gather_rooted_contract(eight_devices):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    out = _run(lambda v: comm.gather(v, dst=2, axis="data"),
+               x, out_specs=P("data"))
+    got = np.asarray(out).reshape(8, 8)  # each member's [8] result stacked
+    np.testing.assert_array_equal(got[2], np.arange(8))
+    assert (got[[0, 1, 3, 4, 5, 6, 7]] == 0).all()
+
+
+def test_scatter_distributes_src_shards(eight_devices):
+    # every member holds a DIFFERENT local tensor; only src's must win
+    x = np.stack([np.arange(16, dtype=np.float32) + 100 * i
+                  for i in range(8)])  # [8, 16]
+    out = _run(lambda v: comm.scatter(v[0], src=5, axis="data"),
+               x, out_specs=P("data"))
+    got = np.asarray(out).reshape(8, 2)
+    np.testing.assert_array_equal(got.ravel(), np.arange(16) + 500)
+
+
+def test_all_to_all_single_alias(eight_devices):
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    a = _run(lambda v: comm.all_to_all(v, axis="data",
+                                       split_axis=1, concat_axis=1), x)
+    b = _run(lambda v: comm.all_to_all_single(v, axis="data",
+                                              split_axis=1, concat_axis=1), x)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_send_recv_rejected_loudly(eight_devices):
+    with pytest.raises(NotImplementedError, match="ppermute"):
+        comm.send(jnp.zeros(1), dst=1)
+    with pytest.raises(NotImplementedError, match="ppermute"):
+        comm.recv(jnp.zeros(1), src=0)  # torch-style (tensor, src) call
+
+
+def test_monitored_barrier_single_process_noop(eight_devices):
+    comm.monitored_barrier(timeout_s=0.1)  # must return immediately
+
+
+def test_reduce_avg_and_allreduce_ops(eight_devices):
+    x = np.arange(8, dtype=np.float32).reshape(8, 1)
+    avg = _run(lambda v: comm.all_reduce(v, op=ReduceOp.AVG, axis="data"), x)
+    np.testing.assert_allclose(np.asarray(avg).ravel(), [3.5] * 8)
+    mx = _run(lambda v: comm.all_reduce(v, op=ReduceOp.MAX, axis="data"), x)
+    np.testing.assert_array_equal(np.asarray(mx).ravel(), [7] * 8)

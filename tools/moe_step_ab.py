@@ -16,7 +16,6 @@ Run:  python tools/moe_step_ab.py                (driver, A/B/A/B)
 import gc
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -92,31 +91,11 @@ def main():
     if "--single" in sys.argv:
         run_single(sys.argv[sys.argv.index("--single") + 1])
         return
+    from ab_common import run_interleaved
     names = sys.argv[1:] or list(VARIANTS)
-    best = {}
-    for name in names * 2:  # interleaved: A B C A B C
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--single", name],
-            capture_output=True, text=True, timeout=1200)
-        parsed = False
-        for ln in r.stdout.strip().splitlines():
-            try:
-                d = json.loads(ln)
-            except json.JSONDecodeError:
-                continue
-            parsed = True
-            if "error" in d:
-                print(ln, flush=True)
-            elif name not in best or \
-                    d["best_window_s"] < best[name]["best_window_s"]:
-                best[name] = d
-        if not parsed:
-            print(json.dumps({"variant": name,
-                              "error": f"subprocess rc={r.returncode}, "
-                                       f"no JSON: {r.stderr[-300:]}"}),
-                  flush=True)
-    for d in best.values():
-        print(json.dumps(d), flush=True)
+    me = os.path.abspath(__file__)
+    run_interleaved(names,
+                    lambda n: [sys.executable, me, "--single", n])
 
 
 if __name__ == "__main__":

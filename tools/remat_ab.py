@@ -106,35 +106,12 @@ def main():
         return
 
     import os
-    import subprocess
-    best = {}
-    for policy in ("nothing_saveable", candidate) * 2:  # A B A B
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), which, candidate,
-             "--single", policy],
-            capture_output=True, text=True, timeout=900)
-        parsed_any = False
-        for ln in r.stdout.strip().splitlines():
-            try:
-                d = json.loads(ln)
-            except json.JSONDecodeError:
-                continue
-            parsed_any = True
-            if "error" in d:
-                print(ln, flush=True)
-            elif d["variant"] == policy:
-                if policy not in best or \
-                        d["best_window_s"] < best[policy]["best_window_s"]:
-                    best[policy] = d
-        if not parsed_any:
-            # a child killed before its except clause (OOM kill, libtpu
-            # abort) must not silently vanish from the comparison
-            print(json.dumps({"variant": policy, "model": which,
-                              "error": f"subprocess rc={r.returncode}, "
-                                       f"no JSON: {r.stderr[-300:]}"}),
-                  flush=True)
-    for d in best.values():
-        print(json.dumps(d), flush=True)
+    from ab_common import run_interleaved
+    me = os.path.abspath(__file__)
+    run_interleaved(
+        ("nothing_saveable", candidate),
+        lambda p: [sys.executable, me, which, candidate, "--single", p],
+        timeout=900)
 
 
 if __name__ == "__main__":

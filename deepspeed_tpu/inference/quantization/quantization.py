@@ -97,9 +97,27 @@ _PARTIAL_ELEMS_LIMIT = 64 * 1024 * 1024
 
 def quantized_matmul(x: jax.Array, qp: Dict[str, jax.Array]) -> jax.Array:
     """x [..., in] @ quantized kernel -> [..., out], scales factored out of
-    each group's contraction so the int weights feed the MXU directly."""
+    each group's contraction so the int weights feed the MXU directly.
+
+    ``DSTPU_PALLAS_WOQ=1`` routes 2-D int8 kernels through the
+    builder-written Pallas kernel (ops/quantizer/pallas_woq_matmul.py) —
+    opt-in: it beats this XLA form by ~7% on the attached chip but not
+    bf16-dense (numbers in the kernel's docstring)."""
     q, scale = qp["q"], qp["scale"]
     G, gs, d_out = q.shape[-3:]
+    import os
+    if (os.environ.get("DSTPU_PALLAS_WOQ") == "1" and q.ndim == 3
+            and q.dtype == jnp.int8 and x.dtype == jnp.bfloat16
+            and jax.default_backend() == "tpu"
+            and d_out % 128 == 0
+            # decode-shaped only: the kernel's VMEM accumulator is
+            # (M, bn) f32 — a prefill wave's M in the thousands would
+            # blow VMEM (and was never the bandwidth-bound case)
+            and int(np.prod(x.shape[:-1])) <= 32):
+        from ...ops.quantizer.pallas_woq_matmul import woq_matmul
+        lead = x.shape[:-1]
+        out = woq_matmul(x.reshape(-1, x.shape[-1]), q, scale)
+        return out.reshape(*lead, d_out)
     xg = x.reshape(*x.shape[:-1], G, gs)
     wdt = x.dtype
     if jax.default_backend() == "cpu" and x.dtype == jnp.bfloat16:

@@ -10,7 +10,6 @@ dropping the variant from the comparison.
 
 import json
 import subprocess
-import sys
 
 
 def run_interleaved(names, mk_cmd, rounds: int = 2, timeout: int = 1200):
@@ -54,7 +53,3 @@ def run_interleaved(names, mk_cmd, rounds: int = 2, timeout: int = 1200):
     for d in best.values():
         print(json.dumps(d), flush=True)
     return best
-
-
-def child_cmd(script_path, *args):
-    return [sys.executable, script_path, *args]

@@ -1,0 +1,59 @@
+"""MoE parameter utilities.
+
+Counterpart of the reference ``deepspeed/moe/utils.py`` (``is_moe_param``
+:23, ``split_params_into_shared_and_expert_params`` :29,
+``split_params_into_different_moe_groups_for_optimizer`` :65). The
+reference needs these to give expert parameters their own torch optimizer
+param groups (their gradient allreduce runs over a different process
+group). Under SPMD the collective routing is already carried by each
+leaf's PartitionSpec — what remains useful is the SPLIT itself: per-group
+optimizer hyperparameters (expert LR scaling, excluding experts from
+weight decay) over a param pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.topology import EXPERT_AXIS
+from ..runtime.zero.partition import _flatten_spec_axes
+
+
+def _spec_leaf(s) -> bool:
+    # replicated leaves carry spec None (the add_axes_to_spec convention,
+    # runtime/zero/partition.py:54) — they must stay LEAVES, not vanish
+    # as empty subtrees
+    return s is None or isinstance(s, P)
+
+
+def is_moe_spec(spec) -> bool:
+    """True when a leaf's PartitionSpec shards it over the expert axis —
+    the SPMD analogue of the reference's ``param.allreduce = False`` mark
+    (``is_moe_param``, moe/utils.py:23)."""
+    if not isinstance(spec, P):
+        return False
+    return EXPERT_AXIS in _flatten_spec_axes(spec)
+
+
+def expert_param_mask(specs: Dict[str, Any]) -> Dict[str, Any]:
+    """Boolean pytree (True = expert-sharded leaf): the
+    ``split_params_into_different_moe_groups_for_optimizer`` equivalent —
+    pass to ``optax.masked(tx, mask)`` to scope a transform to expert (or
+    with ``jax.tree.map(operator.not_, mask)``, shared) parameters."""
+    return jax.tree.map(is_moe_spec, specs, is_leaf=_spec_leaf)
+
+
+def split_params_into_shared_and_expert_params(
+        params: Dict[str, Any], specs: Dict[str, Any],
+) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """Two same-structure trees: (shared, expert) — each leaf appears in
+    exactly one of them, the other holds ``None`` (reference
+    moe/utils.py:29). For optax integration use :func:`expert_param_mask`
+    (``optax.masked`` wants the boolean mask, not these trees)."""
+    mask = expert_param_mask(specs)
+    shared = jax.tree.map(lambda p, m: None if m else p, params, mask)
+    expert = jax.tree.map(lambda p, m: p if m else None, params, mask)
+    return shared, expert

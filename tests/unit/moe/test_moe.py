@@ -116,3 +116,49 @@ def test_gather_dispatch_flops_beat_dense():
 
     old = jax.jit(dense).lower(p, x).compile().cost_analysis()
     assert new["flops"] * 3 < old["flops"], (new["flops"], old["flops"])
+
+
+def test_split_shared_and_expert_params(eight_devices):
+    """Expert-sharded leaves split out by spec (reference moe/utils.py:29
+    split_params_into_shared_and_expert_params)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.moe.layer import MoE
+    from deepspeed_tpu.moe.utils import (expert_param_mask, is_moe_spec,
+                                         split_params_into_shared_and_expert_params)
+
+    moe = MoE(hidden_size=16, intermediate_size=32, num_experts=4, top_k=2)
+    params = moe.init(jax.random.PRNGKey(0), jnp.float32)
+    specs = moe.specs()
+    assert not is_moe_spec(specs["gate"])
+    assert is_moe_spec(specs["wo"])
+    shared, expert = split_params_into_shared_and_expert_params(params, specs)
+    assert shared["gate"] is not None and expert["gate"] is None
+    assert shared["wo"] is None and expert["wo"] is not None
+    mask = expert_param_mask(specs)
+    assert mask["wo"] is True and mask["gate"] is False
+    # the masks drive optax.masked: a transform scoped to expert leaves
+    import optax
+    tx = optax.masked(optax.scale(0.0), mask)
+    grads = jax.tree.map(jnp.ones_like, params)
+    state = tx.init(params)
+    out, _ = tx.update(grads, state, params)
+    assert float(jnp.sum(jnp.abs(out["wo"]))) == 0.0      # scaled to zero
+    assert float(jnp.sum(jnp.abs(out["gate"]))) > 0.0     # untouched
+
+
+def test_moe_split_handles_replicated_none_specs(eight_devices):
+    """Replicated leaves carry spec None (add_axes_to_spec convention) —
+    they must split as shared, not crash the tree map."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.moe.utils import (expert_param_mask,
+                                         split_params_into_shared_and_expert_params)
+    params = {"a": np.ones(2), "b": np.ones(2)}
+    specs = {"a": None, "b": P("expert", None)}
+    assert expert_param_mask(specs) == {"a": False, "b": True}
+    shared, expert = split_params_into_shared_and_expert_params(params, specs)
+    assert shared["a"] is not None and expert["a"] is None
+    assert shared["b"] is None and expert["b"] is not None

@@ -19,7 +19,7 @@ import jax
 from jax.sharding import PartitionSpec as P
 
 from ..runtime.topology import EXPERT_AXIS
-from ..runtime.zero.partition import _flatten_spec_axes
+from ..runtime.zero.partition import flatten_spec_axes
 
 
 def _spec_leaf(s) -> bool:
@@ -35,7 +35,7 @@ def is_moe_spec(spec) -> bool:
     (``is_moe_param``, moe/utils.py:23)."""
     if not isinstance(spec, P):
         return False
-    return EXPERT_AXIS in _flatten_spec_axes(spec)
+    return EXPERT_AXIS in flatten_spec_axes(spec)
 
 
 def expert_param_mask(specs: Dict[str, Any]) -> Dict[str, Any]:
@@ -51,8 +51,11 @@ def split_params_into_shared_and_expert_params(
 ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
     """Two same-structure trees: (shared, expert) — each leaf appears in
     exactly one of them, the other holds ``None`` (reference
-    moe/utils.py:29). For optax integration use :func:`expert_param_mask`
-    (``optax.masked`` wants the boolean mask, not these trees)."""
+    moe/utils.py:29). Same DICT shape, not the same pytree structure:
+    None entries flatten to zero leaves in JAX, so don't tree.map the
+    two trees against each other or against ``params``. For optax
+    integration use :func:`expert_param_mask` (``optax.masked`` wants
+    the boolean mask, not these trees)."""
     mask = expert_param_mask(specs)
     shared = jax.tree.map(lambda p, m: None if m else p, params, mask)
     expert = jax.tree.map(lambda p, m: p if m else None, params, mask)

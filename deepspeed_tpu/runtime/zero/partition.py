@@ -39,7 +39,9 @@ from ..topology import (DENSE_GRAD_AXES, EXPERT_AXIS, EXPERT_GRAD_AXES, MICS_AXI
 from .config import DeepSpeedZeroConfig
 
 
-def _flatten_spec_axes(spec: P) -> set:
+def flatten_spec_axes(spec: P) -> set:
+    """Set of mesh-axis names a PartitionSpec shards over (public: also
+    consumed by moe/utils.py for expert-leaf detection)."""
     used = set()
     for entry in spec:
         if entry is None:
@@ -62,7 +64,7 @@ def add_axes_to_spec(spec: Optional[P], shape: Tuple[int, ...], axes: Tuple[str,
     """
     spec = spec if spec is not None else P(*([None] * len(shape)))
     entries = list(spec) + [None] * (len(shape) - len(spec))
-    used = _flatten_spec_axes(spec)
+    used = flatten_spec_axes(spec)
     # A size-1 mesh axis shards nothing; keep specs minimal so that e.g. the
     # 'mics' axis only appears when MiCS is actually in play (mics > 1).
     axes = tuple(a for a in axes if a not in used and axis_sizes[a] > 1)
@@ -122,7 +124,7 @@ class ZeroPartitionPlan:
         Under MiCS, partitioning is confined to the sub-group axis."""
         if self.mics:
             return (MICS_AXIS,)
-        if EXPERT_AXIS in _flatten_spec_axes(spec):
+        if EXPERT_AXIS in flatten_spec_axes(spec):
             return EXPERT_GRAD_AXES
         return DENSE_GRAD_AXES
 

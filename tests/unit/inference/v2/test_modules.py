@@ -61,8 +61,8 @@ def test_custom_registration():
 def test_architecture_registry_builtin():
     assert supported_architectures() == \
         ["bert", "bloom", "distilbert", "falcon", "gpt2", "gpt_neo",
-         "gpt_neox", "gptj", "llama", "mistral", "mixtral", "opt", "phi",
-         "roberta"]
+         "gpt_neox", "gptj", "internlm", "llama", "mistral", "mixtral",
+         "opt", "phi", "qwen2", "roberta"]
     spec = get_architecture("falcon")
     cfg = spec.config_fn({"model_type": "falcon", "vocab_size": 128,
                           "hidden_size": 64, "num_hidden_layers": 2,

@@ -58,6 +58,13 @@ def test_ulysses_lowers_to_all_to_all(eight_devices):
         model=model, config=dict(BASE, topology={"seq": 2}), seed=7)
     batch = {"input_ids": np.random.default_rng(3).integers(0, 256, size=(8, 32))}
     engine.train_batch(batch)  # builds + compiles the jits
-    hlo = engine._jit_micro_step.lower(
-        engine.state, engine._device_batch(batch)).compile().as_text()
+    # gas==1 builds the fused one-dispatch program; otherwise the split
+    # micro step — inspect whichever ran
+    if engine._jit_train_step is not None:
+        hlo = engine._jit_train_step.lower(
+            engine.state, engine._device_batch(batch),
+            jnp.asarray(1e-4, jnp.float32)).compile().as_text()
+    else:
+        hlo = engine._jit_micro_step.lower(
+            engine.state, engine._device_batch(batch)).compile().as_text()
     assert "all-to-all" in hlo

@@ -6,10 +6,13 @@ and the v2 ``quantization_mode`` plumbing (``inference/v2/config_v2.py:33``) —
 weights live in HBM at 8 or 4 bits and are expanded on the fly inside the
 matmul, halving/quartering the weight bandwidth that bounds decode.
 
-TPU-first form: SYMMETRIC groupwise quantization over the contraction dim,
-stored as ``jnp.int8``/``jnp.int4`` (int4 is a native TPU dtype — XLA
-converts it to bf16 in registers, no unpack kernel needed). The matmul
-factors the scale OUT of the contraction per group:
+TPU-first form: SYMMETRIC groupwise quantization over the contraction dim.
+int8 stores plain ``jnp.int8``; int4 stores PACKED ``uint8`` — two bias-8
+nibbles per byte along the within-group axis — because sub-byte arrays
+cannot cross every device-transfer path (the attached tunnel's shard-arg
+handling of ``jnp.int4`` jit inputs recurses), while uint8 goes
+everywhere; the unpack (shift/mask, XLA-fused into the consumer) happens
+in-program. The matmul factors the scale OUT of the contraction per group:
 
     y = sum_g (x_g @ q_g) * scale[g]         # q int, x/scale bf16
 
@@ -17,9 +20,13 @@ so the MXU consumes the int weights directly and no dequantized copy of the
 kernel ever materializes in HBM — the property the reference's fused
 dequant+GEMM CUDA kernels exist to provide.
 
-A quantized kernel leaf is the subtree ``{"q": int[G, in/G, out],
-"scale": f32[G, 1, out]}`` in place of ``{"kernel": [in, out]}``;
-``nn.Linear`` dispatches on the presence of ``"q"``.
+A quantized kernel leaf is the subtree ``{"q": int8[G, gs, out]`` (int8)
+``| uint8[G, gs/2, out]`` (packed int4)``, "scale": f32[G, 1, out]}`` in
+place of ``{"kernel": [in, out]}``; ``nn.Linear`` dispatches on the
+presence of ``"q"``, and consumers dispatch packed-vs-plain on
+``q.dtype == uint8``. (Distinct from the COLLECTIVE wire format in
+``ops/quantizer/quantizer.py`` — last-axis two's-complement nibbles — a
+per-message transient, not a storage layout.)
 """
 
 from __future__ import annotations
@@ -66,14 +73,34 @@ class QuantizationConfig:
         return QuantizationConfig(bits=table[mode])
 
 
-def _qdtype(bits: int):
-    return {8: jnp.int8, 4: jnp.int4}[bits]
+def _pack_int4(q: jax.Array) -> jax.Array:
+    """int values in [-8, 7], [..., G, gs, out] -> biased nibbles packed
+    two-per-byte along gs: uint8 [..., G, gs/2, out]. Packed uint8 is the
+    int4 STORAGE format because sub-byte arrays cannot cross every
+    device-transfer path (the attached tunnel's shard-arg handling of
+    jnp.int4 jit INPUTS recurses — arrays can be created on device but
+    never fed back in), while uint8 goes everywhere."""
+    b = (q + 8).astype(jnp.uint8)
+    return b[..., 0::2, :] | (b[..., 1::2, :] << 4)
+
+
+def _unpack_int4(p: jax.Array) -> jax.Array:
+    """uint8 [..., G, gs/2, out] -> int8 [..., G, gs, out] (in-program:
+    XLA fuses the shifts into the consumer, no unpacked copy in HBM
+    between calls)."""
+    lo = (p & 0xF).astype(jnp.int8) - 8
+    hi = (p >> 4).astype(jnp.int8) - 8
+    *lead, G, gsp, d_out = p.shape
+    return jnp.stack([lo, hi], axis=-2).reshape(*lead, G, 2 * gsp, d_out)
 
 
 def quantize_kernel(kernel: jax.Array, cfg: QuantizationConfig) -> Dict[str, jax.Array]:
     """[..., in, out] -> {"q": int[..., G, gs, out], "scale": f32[..., G, 1, out]}.
 
-    Leading dims (the scanned layer axis) pass through untouched.
+    Leading dims (the scanned layer axis) pass through untouched. int8
+    stores plain ``jnp.int8``; int4 stores PACKED uint8 (two biased
+    nibbles per byte along gs — see :func:`_pack_int4`), detected
+    downstream by ``q.dtype == uint8``.
     """
     *lead, d_in, d_out = kernel.shape
     gs = min(cfg.group_size, d_in)
@@ -85,7 +112,10 @@ def quantize_kernel(kernel: jax.Array, cfg: QuantizationConfig) -> Dict[str, jax
     absmax = jnp.max(jnp.abs(w), axis=-2, keepdims=True)  # [..., G, 1, out]
     scale = jnp.maximum(absmax, 1e-12) / qmax
     q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax)
-    return {"q": q.astype(_qdtype(cfg.bits)), "scale": scale}
+    if cfg.bits == 4 and gs % 2 == 0:
+        return {"q": _pack_int4(q.astype(jnp.int8)), "scale": scale}
+    # odd-gs int4 degrades to int8 storage (correct, just uncompressed)
+    return {"q": q.astype(jnp.int8), "scale": scale}
 
 
 # flip to the G-loop form when the batched partial product [tokens, G, out]
@@ -104,10 +134,15 @@ def quantized_matmul(x: jax.Array, qp: Dict[str, jax.Array]) -> jax.Array:
     opt-in: it beats this XLA form by ~7% on the attached chip but not
     bf16-dense (numbers in the kernel's docstring)."""
     q, scale = qp["q"], qp["scale"]
+    stored_int8 = q.dtype == jnp.int8  # before unpack: the Pallas kernel
+    # streams STORED bytes — feeding it unpacked int4 would materialize
+    # the int8 copy in HBM as a pallas_call operand (opaque to fusion)
+    if q.dtype == jnp.uint8:  # packed int4 storage
+        q = _unpack_int4(q)
     G, gs, d_out = q.shape[-3:]
     import os
     if (os.environ.get("DSTPU_PALLAS_WOQ") == "1" and q.ndim == 3
-            and q.dtype == jnp.int8 and x.dtype == jnp.bfloat16
+            and stored_int8 and x.dtype == jnp.bfloat16
             and jax.default_backend() == "tpu"
             and d_out % 128 == 0
             # decode-shaped only: the kernel's VMEM accumulator is
@@ -159,6 +194,8 @@ def quantized_matmul(x: jax.Array, qp: Dict[str, jax.Array]) -> jax.Array:
 
 def dequantize_kernel(qp: Dict[str, jax.Array], dtype=jnp.float32) -> jax.Array:
     q, scale = qp["q"], qp["scale"]
+    if q.dtype == jnp.uint8:  # packed int4 storage
+        q = _unpack_int4(q)
     *lead, G, gs, d_out = q.shape
     w = q.astype(jnp.float32) * scale
     return w.reshape(*lead, G * gs, d_out).astype(dtype)
@@ -255,6 +292,8 @@ def quantize_placed(mesh, specs: Dict[str, Any], params: Dict[str, Any],
 
 
 def quantized_tree_bytes(params: Dict[str, Any]) -> int:
+    # packed-int4 leaves are uint8, so plain itemsize accounting is exact;
+    # the jnp.int4 branch remains for user-supplied native sub-byte arrays
     return sum(x.size * jnp.dtype(x.dtype).itemsize if x.dtype != jnp.int4
                else (x.size + 1) // 2
                for x in jax.tree.leaves(params))

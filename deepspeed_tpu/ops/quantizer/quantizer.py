@@ -7,7 +7,11 @@ quantized-weight all-gather (qwZ), quantized-gradient all-to-all reduce
 (qgZ), and inference weight-only quantization.
 
 Layout: input is reshaped to [groups, group_size]; each group gets a scale
-(and zero-point when asymmetric). int4 values are packed two-per-int8. The
+(and zero-point when asymmetric). int4 values are packed two-per-int8 —
+this is the COLLECTIVE WIRE format (last-axis two's-complement nibbles, a
+per-message transient); the weight STORAGE format lives in
+inference/quantization/quantization.py (gs-axis bias-8 nibbles) — the two
+serve different layouts and are intentionally separate. The
 ops are pure XLA — packing/unpacking is shift/mask arithmetic the TPU VPU
 handles well, and XLA fuses quantize into the producing op and dequantize
 into the consuming matmul. (A Pallas variant is only warranted fused into

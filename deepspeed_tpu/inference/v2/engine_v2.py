@@ -36,6 +36,51 @@ from .ragged.kv_cache import BlockedKVCache
 from .ragged.ragged_manager import DSStateManager
 from .ragged.ragged_wrapper import _next_bucket
 
+def _put_chunk_bytes() -> int:
+    """Per-transfer byte cap for weight/KV uploads: single host->device
+    transfers beyond ~2 GiB fail with RESOURCE_EXHAUSTED on the attached
+    remote-device path (llama2-7b's stacked down_proj is 2.9 GiB dense
+    bf16 — the leaf that killed every 7B serving attempt); slabs of
+    <=1 GiB go through. Overridable for direct-attached TPUs."""
+    import os
+    return int(os.environ.get("DSTPU_PUT_CHUNK_BYTES", 1 << 30))
+
+
+def _chunked_put(host: np.ndarray, sharding) -> jax.Array:
+    """device_put in bounded slabs along axis 0, assembled on device.
+    Small arrays (or unsplittable ones) go through in one put."""
+    cap = _put_chunk_bytes()
+    if host.nbytes <= cap or host.ndim == 0 or host.shape[0] <= 1:
+        return jax.device_put(host, sharding)
+    rows = max(1, int(cap // max(host.nbytes // host.shape[0], 1)))
+    # an axis-0-sharded leaf needs every slab divisible by the partition
+    # count; round rows down to a multiple (or give up slabbing)
+    spec0 = sharding.spec[0] if sharding.spec else None
+    if spec0 is not None:
+        axes = spec0 if isinstance(spec0, (tuple, list)) else (spec0,)
+        parts = 1
+        for a in axes:
+            parts *= sharding.mesh.shape[a]
+        rows = (rows // parts) * parts
+        if rows < parts:
+            return jax.device_put(host, sharding)  # can't slab cleanly
+    slabs = [jax.device_put(host[i:i + rows], sharding)
+             for i in range(0, host.shape[0], rows)]
+    # donate the slabs: peak device transient stays ~2x the leaf, not 3x
+    return jax.jit(lambda xs: jnp.concatenate(xs, axis=0),
+                   out_shardings=sharding, donate_argnums=0)(slabs)
+
+
+def _place_dense(mesh, specs, params, np_dtype) -> Any:
+    """Leaf-wise host->device placement with the transfer cap (used by
+    __init__ and update_params for unquantized HOST trees whose leaves
+    can exceed the cap)."""
+    return jax.tree.map(
+        lambda s_, x: _chunked_put(
+            np.asarray(x).astype(np_dtype, copy=False),
+            NamedSharding(mesh, s_)),
+        specs, params, is_leaf=lambda s_: isinstance(s_, P))
+
 
 class InferenceEngineV2:
 
@@ -94,9 +139,21 @@ class InferenceEngineV2:
                 self.params = self._place_quantized_streaming(
                     specs, params, donate=donate_params)
             elif params is not None:
-                self.params = jax.jit(
-                    lambda p: jax.tree.map(lambda x: jnp.asarray(x, c.dtype), p),
-                    out_shardings=shardings)(params)
+                host_leaves = jax.tree.leaves(params)
+                # .nbytes avoids fetching device-resident leaves; only
+                # HOST trees with oversized leaves (7B-dims stacked
+                # projections) take the slab path
+                on_device = bool(host_leaves) and \
+                    isinstance(host_leaves[0], jax.Array)
+                if not on_device and any(x.nbytes > _put_chunk_bytes()
+                                         for x in host_leaves):
+                    self.params = _place_dense(self.mesh, specs, params,
+                                               np.dtype(c.dtype))
+                else:
+                    self.params = jax.jit(
+                        lambda p: jax.tree.map(
+                            lambda x: jnp.asarray(x, c.dtype), p),
+                        out_shardings=shardings)(params)
             else:
                 self.params = jax.jit(lambda rng: model.init(rng, c.dtype),
                                       out_shardings=shardings)(jax.random.PRNGKey(seed))
@@ -119,6 +176,9 @@ class InferenceEngineV2:
             else:  # MQA + indivisible block count: replicate (still correct)
                 spec = P()
             kv_spec = NamedSharding(self.mesh, spec)
+            # the pools are already DEVICE arrays (jnp.zeros at cache
+            # construction) — device_put here is a device-side reshard,
+            # never a host transfer, so no slab cap applies
             self.kv_cache.update(
                 jax.device_put(self.kv_cache.k_pages, kv_spec),
                 jax.device_put(self.kv_cache.v_pages, kv_spec))
@@ -175,13 +235,19 @@ class InferenceEngineV2:
                         jit_cache[key] = jax.jit(
                             lambda a: quantize_kernel(a, cfg),
                             out_shardings=shard)
-                    qp = jit_cache[key](host_cast(v))  # push 2-byte, not 4
+                    # push 2-byte (not 4), in bounded slabs; the dense
+                    # device copy is dropped when qp replaces it
+                    dense = _chunked_put(
+                        host_cast(v),
+                        NamedSharding(self.mesh, spec_tree["kernel"]))
+                    qp = jit_cache[key](dense)
+                    del dense
                     out["q"], out["scale"] = qp["q"], qp["scale"]
                 elif isinstance(v, dict):
                     out[k] = walk(spec_tree[k], v,
                                   inside_target or k in targets)
                 else:
-                    out[k] = jax.device_put(
+                    out[k] = _chunked_put(
                         host_cast(v), NamedSharding(self.mesh, spec_tree[k]))
             return out
 
@@ -200,6 +266,11 @@ class InferenceEngineV2:
                 # dense copy never fully materializes in HBM (see
                 # _place_quantized_streaming)
                 self.params = self._place_quantized_streaming(specs, params)
+            elif not on_device and any(x.nbytes > _put_chunk_bytes()
+                                       for x in leaves):
+                # host tree with oversized leaves: same slab path as init
+                self.params = _place_dense(self.mesh, specs, params,
+                                           np.dtype(c.dtype))
             else:
                 shardings = jax.tree.map(
                     lambda s: NamedSharding(self.mesh, s), specs,

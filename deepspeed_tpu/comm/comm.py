@@ -281,11 +281,20 @@ def recv(tensor, src: int, axis: AxisNames = "pipe"):
         "axis) delivers each member the value permuted to its index")
 
 
-def monitored_barrier(timeout_s: float = 300.0,
+def monitored_barrier(group=None, timeout=None, wait_all_ranks: bool = False,
+                      timeout_s: float = 300.0,
                       name: str = "dstpu_monitored_barrier") -> None:
     """Barrier that names the stragglers instead of hanging silently
     (reference comm.py monitored_barrier): waits in a helper thread and
-    logs every ``timeout_s`` with the barrier name until it completes."""
+    logs every ``timeout_s`` with the barrier name until it completes.
+
+    ``group``/``timeout``/``wait_all_ranks`` mirror the reference signature
+    for drop-in callers: group is accepted and ignored (the XLA barrier is
+    global), ``timeout`` (seconds or datetime.timedelta) aliases
+    ``timeout_s``, and wait_all_ranks is moot — the watchdog never raises,
+    it reports while continuing to wait."""
+    if timeout is not None:
+        timeout_s = float(getattr(timeout, "total_seconds", lambda: timeout)())
     if jax.process_count() <= 1:
         return
     import threading

@@ -1,4 +1,5 @@
 from .quantization import (QuantizationConfig, dequantize_param_tree,  # noqa: F401
-                           quantize_kernel, quantize_param_tree,
-                           quantize_placed, quantize_specs,
-                           quantized_matmul, quantized_tree_bytes)
+                           host_quantize_kernel, quantize_kernel,
+                           quantize_param_tree, quantize_placed,
+                           quantize_specs, quantized_matmul,
+                           quantized_tree_bytes)

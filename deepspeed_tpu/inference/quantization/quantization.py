@@ -32,7 +32,7 @@ per-message transient, not a storage layout.)
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -118,6 +118,36 @@ def quantize_kernel(kernel: jax.Array, cfg: QuantizationConfig) -> Dict[str, jax
     return {"q": q.astype(jnp.int8), "scale": scale}
 
 
+def host_quantize_kernel(kernel: "np.ndarray", cfg: QuantizationConfig,
+                         model_np_dtype) -> Tuple["np.ndarray", "np.ndarray"]:
+    """Numpy mirror of :func:`quantize_kernel`, bit-identical: cast to the
+    model dtype first (matching the device path, which uploads the host
+    bf16 cast and quantizes from it), fp32 group math, round-half-even
+    (``np.rint`` == ``jnp.round``). Returns (q, scale) as host arrays so
+    the engine can upload the 4-8x smaller int payload directly instead of
+    pushing dense bf16 and quantizing on device — the difference between a
+    ~286 s and a sub-100 s llama2-7b engine build through a ~50 MB/s
+    link."""
+    w = np.asarray(kernel)
+    if w.dtype != model_np_dtype:
+        w = w.astype(model_np_dtype)
+    *lead, d_in, d_out = w.shape
+    gs = min(cfg.group_size, d_in)
+    while d_in % gs:
+        gs //= 2
+    G = d_in // gs
+    w = w.astype(np.float32).reshape(*lead, G, gs, d_out)
+    qmax = float(2 ** (cfg.bits - 1) - 1)
+    absmax = np.max(np.abs(w), axis=-2, keepdims=True)
+    scale = np.maximum(absmax, 1e-12) / qmax
+    q = np.clip(np.rint(w / scale), -qmax - 1, qmax)
+    if cfg.bits == 4 and gs % 2 == 0:
+        b = (q.astype(np.int8) + 8).astype(np.uint8)
+        packed = b[..., 0::2, :] | (b[..., 1::2, :] << 4)
+        return packed, scale.astype(np.float32)
+    return q.astype(np.int8), scale.astype(np.float32)
+
+
 # flip to the G-loop form when the batched partial product [tokens, G, out]
 # would exceed this many fp32 elements (the einsum form materializes it:
 # a 2048-token wave through llama2-7b's quantized lm_head would be
@@ -132,7 +162,12 @@ def quantized_matmul(x: jax.Array, qp: Dict[str, jax.Array]) -> jax.Array:
     ``DSTPU_PALLAS_WOQ=1`` routes 2-D int8 kernels through the
     builder-written Pallas kernel (ops/quantizer/pallas_woq_matmul.py) —
     opt-in: it beats this XLA form by ~7% on the attached chip but not
-    bf16-dense (numbers in the kernel's docstring)."""
+    bf16-dense (numbers in the kernel's docstring).
+
+    NOTE (A/B protocol): the flag is read at TRACE time — a jitted caller
+    that already compiled keeps the path it traced with, so flipping the
+    env var mid-process has no effect on cached programs. A/B runs must
+    use fresh processes (tools/ab_common.py does) or jax.clear_caches()."""
     q, scale = qp["q"], qp["scale"]
     stored_int8 = q.dtype == jnp.int8  # before unpack: the Pallas kernel
     # streams STORED bytes — feeding it unpacked int4 would materialize

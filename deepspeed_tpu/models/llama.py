@@ -10,6 +10,16 @@ from .transformer import TransformerConfig, TransformerLM
 _PRESETS = {
     "llama2-tiny": dict(num_layers=2, num_heads=4, num_kv_heads=2, hidden_size=128,
                         intermediate_size=352, max_seq_len=256, vocab_size=1024),
+    # TinyLlama-1.1B: the largest published llama-family model whose full
+    # AdamW train state fits one 16 GB chip (bf16 params/grads + fp32
+    # master + bf16 moments = ~13.2 GiB) — the full-depth training bench
+    "tinyllama-1.1b": dict(num_layers=22, num_heads=32, num_kv_heads=4,
+                           hidden_size=2048, intermediate_size=5632,
+                           max_seq_len=2048),
+    # OpenLLaMA-3B: largest full-depth llama whose params+grads fit one
+    # chip (13.3 GiB bf16); training it needs the host-offloaded optimizer
+    "open-llama-3b": dict(num_layers=26, num_heads=32, hidden_size=3200,
+                          intermediate_size=8640, max_seq_len=2048),
     "llama2-7b": dict(num_layers=32, num_heads=32, hidden_size=4096,
                       intermediate_size=11008, max_seq_len=4096),
     "llama2-13b": dict(num_layers=40, num_heads=40, hidden_size=5120,

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from ..runtime import topology as topo_mod
 from ..runtime.topology import BATCH_AXES, DATA_AXIS, EXPERT_AXIS
 from .sharded_moe import capacity as _capacity, top_k_gating_indices
 
@@ -97,9 +98,24 @@ class MoE:
         src = jnp.zeros((e * cap + 1,), jnp.int32).at[slot].set(
             jnp.repeat(jnp.arange(n_tok, dtype=jnp.int32), self.top_k) + 1,
             mode="drop")[:e * cap]
-        expert_in = jnp.where((src > 0)[:, None],
-                              tokens[jnp.maximum(src - 1, 0)],
-                              jnp.zeros((), x.dtype)).reshape(e, cap, h)
+        # under PIPELINE composition the dispatch/combine gathers sit inside
+        # the stage vmap, where the partitioner cannot move their operands
+        # from the stage-propagated sharding to the expert layout without an
+        # "involuntary full rematerialization" fallback (a silent perf
+        # cliff); pin the gather boundaries explicitly there. In the pure-EP
+        # regime the propagated shardings are already right — and the pinned
+        # replication would CHANGE the exchange pattern — so this is
+        # trace-time conditional on a real pipe axis.
+        pipelined = (topo_mod.is_initialized()
+                     and topo_mod.get_topology().pipe_parallel_size > 1)
+        if pipelined:
+            tokens = _c(tokens, P(BATCH_AXES, None))
+        gathered = jnp.where((src > 0)[:, None],
+                             tokens[jnp.maximum(src - 1, 0)],
+                             jnp.zeros((), x.dtype))
+        if pipelined:
+            gathered = _c(gathered, P(None, None))
+        expert_in = gathered.reshape(e, cap, h)
         # all-to-all over ICI: expert dim sharded across the expert axis
         expert_in = _c(expert_in, P(EXPERT_AXIS, BATCH_AXES, None))
 

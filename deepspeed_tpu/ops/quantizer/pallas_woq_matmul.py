@@ -84,19 +84,26 @@ def _woq_kernel(x_ref, q_ref, s_ref, out_ref, acc_ref):
         out_ref[...] = acc_ref[...].astype(out_ref.dtype)
 
 
-def _pick_bn(n: int, gs: int, vmem_budget: int = 1100 * 1024) -> int:
+def _pick_bn(n: int, gs: int, mp: int = _MIN_M,
+             vmem_budget: int = 1100 * 1024) -> int:
     """Largest lane-multiple tile of N that divides it and keeps the int8
-    weight block + f32 accumulator comfortably inside VMEM."""
+    weight block + f32 accumulator (sized with the ACTUAL padded M, not
+    the minimum) comfortably inside VMEM."""
+    if n % _LANE:
+        raise ValueError(f"N={n} is not a multiple of {_LANE}")
     best = 0
     for mult in range(1, n // _LANE + 1):
         bn = mult * _LANE
         if n % bn:
             continue
-        if gs * bn + 4 * _MIN_M * bn > vmem_budget:
+        if gs * bn + 4 * mp * bn > vmem_budget:
             break
         best = bn
     if not best:
-        raise ValueError(f"N={n} is not a multiple of {_LANE}")
+        raise ValueError(
+            f"no N tile fits the VMEM budget: even bn={_LANE} needs "
+            f"{gs * _LANE + 4 * mp * _LANE} bytes (gs={gs}, Mp={mp}) > "
+            f"{vmem_budget}; reduce the quantization group size or M")
     return best
 
 
@@ -114,11 +121,11 @@ def woq_matmul(x: jax.Array, q: jax.Array, scale: jax.Array,
     M, K = x.shape
     G, gs, N = q.shape
     assert K == G * gs, (K, G, gs)
-    bn = bn or _pick_bn(N, gs)
+    Mp = max(_MIN_M, -(-M // 8) * 8)
+    bn = bn or _pick_bn(N, gs, Mp)
     bk = bk or _pick_bk(K, gs)
     gk = bk // gs
     assert bk % gs == 0 and G % gk == 0, (bk, gs, G)
-    Mp = max(_MIN_M, -(-M // 8) * 8)
     if Mp != M:
         x = jnp.pad(x, ((0, Mp - M), (0, 0)))
 

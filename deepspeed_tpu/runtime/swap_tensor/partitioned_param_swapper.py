@@ -24,7 +24,7 @@ from ...ops.aio import AsyncIOHandle
 class AsyncPartitionedParameterSwapper:
 
     def __init__(self, swap_dir: str, block_size: int = 1 << 20,
-                 num_threads: int = 2):
+                 num_threads: int = 2, pool_bytes: int = 1 << 30):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
         self.aio = AsyncIOHandle(block_size=block_size, num_threads=num_threads)
@@ -34,6 +34,15 @@ class AsyncPartitionedParameterSwapper:
         # names whose NVMe file has an uncompleted async write: reading the
         # file before the write lands would return a torn shard
         self._pending_writes: Set[str] = set()
+        # bounded swap-in buffer pool (reference SwapBufferManager,
+        # swap_tensor/utils.py:180): released swap-in buffers are retained —
+        # up to ``pool_bytes`` — and reused by the next swap_in of the same
+        # byte size, so a steady-state page-in/page-out cycle allocates no
+        # new host memory. Keyed by exact byte size; stored as flat uint8.
+        self.pool_bytes = int(pool_bytes)
+        self._free: Dict[int, List[np.ndarray]] = {}
+        self._free_bytes = 0
+        self._pool_owned: Set[str] = set()
 
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, f"param_{name}.swp")
@@ -51,6 +60,10 @@ class AsyncPartitionedParameterSwapper:
         self._meta[name] = (value.shape, value.dtype)
         self.aio.async_pwrite(value.reshape(-1), self._path(name))
         self._pending_writes.add(name)
+        # the caller's array replaces (or evicts) any pooled buffer under
+        # this name; ownership ends here — the old buffer may still back a
+        # caller-held view, so it must NOT re-enter the free list
+        self._pool_owned.discard(name)
         if release:
             self._resident.pop(name, None)
         else:
@@ -63,16 +76,29 @@ class AsyncPartitionedParameterSwapper:
             self._pending_writes.clear()
             self._inflight.clear()  # wait() drains reads too (one handle)
 
+    def _take_buffer(self, count: int, dtype) -> np.ndarray:
+        """Flat typed buffer, reusing a pooled one of the exact byte size."""
+        nbytes = count * np.dtype(dtype).itemsize
+        lst = self._free.get(nbytes)
+        if lst:
+            raw = lst.pop()
+            self._free_bytes -= nbytes
+            return raw.view(dtype)
+        return np.empty(count, dtype=dtype)
+
     def swap_in(self, names: List[str], async_op: bool = True) -> None:
-        """Begin paging shards in (reference ``swap_in`` with prefetch)."""
+        """Begin paging shards in (reference ``swap_in`` with prefetch).
+        Buffers come from the bounded pool — a shard released after use
+        donates its buffer to the next swap_in of the same size."""
         if self._pending_writes.intersection(names):
             self.synchronize_writes()
         for name in names:
             if name in self._resident:
                 continue
             shape, dtype = self._meta[name]
-            buf = np.empty(int(np.prod(shape)), dtype=dtype)
+            buf = self._take_buffer(int(np.prod(shape)), dtype)
             self._resident[name] = buf.reshape(shape)
+            self._pool_owned.add(name)
             self.aio.async_pread(buf, self._path(name))
             self._inflight.append(name)
         if not async_op:
@@ -94,10 +120,27 @@ class AsyncPartitionedParameterSwapper:
         return self._resident[name]
 
     def release(self, name: str) -> None:
-        self._resident.pop(name, None)
+        """Drop a resident shard; pool-owned buffers (allocated by swap_in)
+        return to the free list for reuse, up to ``pool_bytes`` retained."""
+        arr = self._resident.pop(name, None)
+        if arr is None or name not in self._pool_owned:
+            return
+        self._pool_owned.discard(name)
+        if name in self._inflight:
+            # the AIO worker is still writing into this buffer — recycling
+            # it now would hand the next swap_in a buffer being mutated
+            self.synchronize_reads()
+        raw = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
+        if self._free_bytes + raw.nbytes <= self.pool_bytes:
+            self._free.setdefault(raw.nbytes, []).append(raw)
+            self._free_bytes += raw.nbytes
 
-    def available_swap_in_buffers(self) -> int:  # reference API parity
-        return max(0, 64 - len(self._resident))
+    def available_swap_in_buffers(self) -> int:
+        """Number of pooled buffers ready for reuse without allocating
+        (reference ``SwapBufferManager.free_buffer_count`` semantics,
+        swap_tensor/utils.py:180) — a real count of the free list, not an
+        invented capacity."""
+        return sum(len(v) for v in self._free.values())
 
     def close(self) -> None:
         self.synchronize_writes()

@@ -44,8 +44,18 @@ def safe_get_full_fp32_param(engine, path: str) -> np.ndarray:
     return np.asarray(jax.device_get(leaf), dtype=np.float32)
 
 
+def _require_grad_buffer(engine):
+    if not jax.tree.leaves(engine.state["grad_acc"]):
+        raise RuntimeError(
+            "this engine runs the fused gas==1 step, which keeps no "
+            "persistent gradient buffer (gradients are XLA program "
+            "temporaries); to observe gradients, run the split path — "
+            "engine.forward()/backward() or DSTPU_FUSED_STEP=0")
+
+
 def safe_get_full_grad(engine, path: str) -> np.ndarray:
     """Gathered accumulated gradient (reference :168)."""
+    _require_grad_buffer(engine)
     leaf = _get_by_path(engine.state["grad_acc"], path)
     return np.asarray(jax.device_get(leaf), dtype=np.float32)
 
@@ -79,6 +89,7 @@ def safe_get_local_fp32_param(engine, path: str) -> np.ndarray:
 
 
 def safe_get_local_grad(engine, path: str) -> np.ndarray:
+    _require_grad_buffer(engine)
     leaf = _get_by_path(engine.state["grad_acc"], path)
     shards = [s for s in leaf.addressable_shards]
     return np.asarray(shards[0].data) if shards else np.asarray(leaf)

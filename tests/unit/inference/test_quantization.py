@@ -133,3 +133,20 @@ def test_quantized_tp2_row_parallel_sharding(eight_devices):
     out = np.asarray(q.forward(ids))
     expect = np.asarray(ref.forward(ids))
     assert np.max(np.abs(out - expect)) / np.max(np.abs(expect)) < 0.02
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+@pytest.mark.parametrize("src_dtype", [np.float32, np.float16])
+def test_host_quantize_matches_device(eight_devices, bits, src_dtype):
+    """host_quantize_kernel (the pipelined-upload path) must be
+    BIT-IDENTICAL to the device quantize_kernel it replaces — same bf16
+    pre-cast, same fp32 group math, same round-half-even."""
+    from deepspeed_tpu.inference.quantization.quantization import (
+        host_quantize_kernel)
+    rng = np.random.default_rng(bits)
+    w = (rng.normal(size=(256, 128)) * 0.1).astype(src_dtype)
+    cfg = QuantizationConfig(bits=bits)
+    dev = quantize_kernel(jnp.asarray(w, jnp.bfloat16), cfg)
+    q_host, scale_host = host_quantize_kernel(w, cfg, np.dtype(jnp.bfloat16))
+    np.testing.assert_array_equal(np.asarray(dev["q"]), q_host)
+    np.testing.assert_array_equal(np.asarray(dev["scale"]), scale_host)

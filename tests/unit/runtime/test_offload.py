@@ -74,6 +74,55 @@ class TestParamSwapper:
         assert sw.resident_params == 1
         sw.close()
 
+    def test_buffer_pool_reuse_and_count(self, tmp_path):
+        """available_swap_in_buffers counts REAL pooled buffers (reference
+        SwapBufferManager, swap_tensor/utils.py:180): a released swap-in
+        buffer is reused byte-for-byte by the next same-size swap_in."""
+        sw = AsyncPartitionedParameterSwapper(str(tmp_path))
+        a = np.arange(64, dtype=np.float32).reshape(8, 8)
+        b = -np.arange(64, dtype=np.float32).reshape(8, 8)
+        sw.swap_out("a", a)
+        sw.swap_out("b", b)
+        assert sw.available_swap_in_buffers() == 0
+        sw.swap_in(["a"], async_op=False)
+        first = sw.get("a")
+        first_iface = first.__array_interface__["data"][0]
+        sw.release("a")
+        assert sw.available_swap_in_buffers() == 1  # pooled, not dropped
+        sw.swap_in(["b"], async_op=False)
+        second = sw.get("b")
+        # same backing memory: the pool recycled the released buffer
+        assert second.__array_interface__["data"][0] == first_iface
+        assert sw.available_swap_in_buffers() == 0
+        np.testing.assert_array_equal(second, b)
+        sw.close()
+
+    def test_buffer_pool_bounded(self, tmp_path):
+        """Retained free-list memory never exceeds pool_bytes."""
+        sw = AsyncPartitionedParameterSwapper(str(tmp_path), pool_bytes=256)
+        big = np.zeros(512, dtype=np.float32)  # 2 KiB > pool cap
+        sw.swap_out("big", big)
+        sw.swap_in(["big"], async_op=False)
+        sw.release("big")
+        assert sw.available_swap_in_buffers() == 0  # over cap: not retained
+        small = np.zeros(32, dtype=np.float32)  # 128 B fits
+        sw.swap_out("small", small)
+        sw.swap_in(["small"], async_op=False)
+        sw.release("small")
+        assert sw.available_swap_in_buffers() == 1
+        sw.close()
+
+    def test_caller_arrays_never_pooled(self, tmp_path):
+        """swap_out(release=False) keeps the CALLER's array resident; a
+        later release must not donate caller memory to the pool."""
+        sw = AsyncPartitionedParameterSwapper(str(tmp_path))
+        a = np.ones(16, dtype=np.float32)
+        sw.swap_out("a", a, release=False)
+        sw.synchronize_writes()
+        sw.release("a")
+        assert sw.available_swap_in_buffers() == 0
+        sw.close()
+
 
 def _make_engine(offload_device=None, nvme_path=None, seed=7):
     zero = {"stage": 1}

@@ -110,9 +110,24 @@ class MoE:
                      and topo_mod.get_topology().pipe_parallel_size > 1)
         if pipelined:
             tokens = _c(tokens, P(BATCH_AXES, None))
-        gathered = jnp.where((src > 0)[:, None],
-                             tokens[jnp.maximum(src - 1, 0)],
-                             jnp.zeros((), x.dtype))
+        # Unfilled capacity slots gather token 0's row UNMASKED: the
+        # combine below never reads them (their combine weight is 0 and no
+        # token's slot index points at them), so their contribution to
+        # every output — and therefore their backward cotangent — is
+        # exactly zero *as long as the pad rows' activations stay finite*.
+        # Masking them with a where() would add a full [e*cap, h] select
+        # plus its backward per layer for bytes that are already dead.
+        # fp16 keeps the mask: a pad row routed through an expert it was
+        # never assigned to can overflow fp16's range, and 0 * inf = NaN
+        # would poison the expert-weight gradients (bf16/fp32 share
+        # fp32's exponent range, so a pad row overflows only where a real
+        # row would too). DSTPU_MOE_MASK_PAD=1 forces the masked form
+        # (trace-time; for A/B).
+        import os
+        gathered = tokens[jnp.maximum(src - 1, 0)]
+        if x.dtype == jnp.float16 or os.environ.get("DSTPU_MOE_MASK_PAD") == "1":
+            gathered = jnp.where((src > 0)[:, None], gathered,
+                                 jnp.zeros((), x.dtype))
         if pipelined:
             gathered = _c(gathered, P(None, None))
         expert_in = gathered.reshape(e, cap, h)

@@ -242,9 +242,12 @@ class DeepSpeedEngine:
         # the fused program's gradients are XLA temporaries (see
         # _train_step_fn). The split forward/backward path allocates the
         # buffer lazily on first use (_ensure_grad_acc).
+        # (offload engines qualify too: their gas==1 micro step REPLACES
+        # the empty tree with the fresh gradients instead of accumulating —
+        # params + grad buffer + fresh grads would be 3x model bytes, the
+        # difference between a 3B step compiling on one chip and OOM)
         self._gradacc_lazy = (
             config.gradient_accumulation_steps == 1
-            and self._offload_device == "none"
             and not self._zeropp
             and self._onebit_opt is None
             and os.environ.get("DSTPU_FUSED_STEP", "1") != "0")
@@ -472,6 +475,7 @@ class DeepSpeedEngine:
                 rng = jax.random.PRNGKey(seed)
                 state = jax.jit(make_state, out_shardings=shardings)(rng)
         if offload:
+            log_dist("state initialized; building offload runner", ranks=[0])
             self._init_offload_runner(state)
         return state
 
@@ -531,9 +535,14 @@ class DeepSpeedEngine:
 
     @staticmethod
     def _to_flat(x, layout):
-        """[...] -> 2-D [dp, rest] per the leaf layout (fp32)."""
+        """[...] -> 2-D [dp, rest] per the leaf layout, in the LEAF's own
+        dtype: the fp32 widening happens on the HOST after the fetch (both
+        consumers already np.asarray(..., float32)). Widening on device
+        would double the HBM transient and the D2H bytes — at 3B params
+        the fp32 flat copy (13.7 GB) next to the bf16 params cannot even
+        fit the chip, which is what stalled the first full-depth 3B
+        attempt."""
         dp_dim, _, mp_dim, _ = layout
-        x = x.astype(jnp.float32)
         if x.ndim == 0:
             return x.reshape(1, 1)
         x = x.transpose(DeepSpeedEngine._flat_order(x.ndim, dp_dim, mp_dim))
@@ -607,26 +616,54 @@ class DeepSpeedEngine:
 
         layouts = self._offload_layouts
 
-        def flatten_master(params):
-            leaves = jax.tree.leaves(params)
-            return tuple(self._to_flat(leaves[i], lay)
-                         for i, lay in zip(host_idx, layouts))
-
-        with self.mesh:
-            flat_leaves = jax.jit(
-                flatten_master,
-                out_shardings=self._offload_flat_shardings)(state["params"])
-        self._offload_flat_shapes = [a.shape for a in flat_leaves]
-        # spans: (leaf_idx, (row0, col0), piece_shape, [devices]) in local
-        # processing order — THE layout contract for fetch/step/push/ckpt
+        # phase markers: at multi-GiB model sizes each of these phases can
+        # take minutes through a slow host<->device link — a silent stall
+        # here is indistinguishable from a hang without them. Flattening is
+        # one small program PER LEAF (shared cache with the step path): the
+        # monolithic whole-tree flatten stalls the remote compile helper
+        # at 3B+ params.
+        import time as _time
+        _t0 = _time.perf_counter()
+        # Flatten -> fetch -> RELEASE one leaf at a time: holding every
+        # flat copy at once would put params + grad buffer + flats
+        # (3x model bytes) on the chip together — 20.4 GB at 3B params,
+        # which cannot fit 15.75 GiB HBM. Peak here is 2x model bytes plus
+        # ONE flat leaf. spans: (leaf_idx, (row0, col0), piece_shape,
+        # [devices]) in local processing order — THE layout contract for
+        # fetch/step/push/ckpt.
+        param_leaves = jax.tree.leaves(state["params"])
+        self._offload_flat_shapes = []
+        self._offload_direct = []  # per host leaf: raw-C-order move ok?
         self._offload_spans = []
         pieces = []
-        for i, arr in enumerate(flat_leaves):
-            for key, devices, data in self._leaf_local_groups(arr):
-                self._offload_spans.append((i, key, data.shape, devices))
-                pieces.append(data)
-        pieces = [np.asarray(p, np.float32).reshape(-1)
-                  for p in jax.device_get(pieces)]
+        total_b = 0
+        with self.mesh:
+            for k, (i, lay, sh) in enumerate(zip(
+                    host_idx, layouts, self._offload_flat_shardings)):
+                leaf = param_leaves[i]
+                direct = self._offload_leaf_direct(leaf.shape, lay)
+                self._offload_direct.append(direct)
+                if direct:
+                    fshape = self._flat_shape(leaf.shape, lay)
+                    self._offload_flat_shapes.append(fshape)
+                    self._offload_spans.append(
+                        (k, (0, 0), fshape, list(leaf.devices())))
+                    total_b += leaf.nbytes
+                    pieces.append(np.asarray(jax.device_get(leaf),
+                                             np.float32).reshape(-1))
+                    continue
+                flat = self._flat_leaf_jit(leaf.shape, leaf.dtype, lay, sh)(leaf)
+                self._offload_flat_shapes.append(flat.shape)
+                datas = []
+                for key, devices, data in self._leaf_local_groups(flat):
+                    self._offload_spans.append((k, key, data.shape, devices))
+                    datas.append(data)
+                total_b += sum(d.nbytes for d in datas)
+                pieces.extend(np.asarray(p, np.float32).reshape(-1)
+                              for p in jax.device_get(datas))
+                del flat, datas
+        log_dist(f"offload init: flatten+fetch {total_b / 1e9:.1f} GB in "
+                 f"{_time.perf_counter() - _t0:.1f}s", ranks=[0])
         local_master = (np.concatenate(pieces) if pieces
                         else np.zeros(0, np.float32))
         # chunk the local segment so NVMe paging streams fixed-size blocks
@@ -666,8 +703,13 @@ class DeepSpeedEngine:
 
         grads_fn = jax.grad(scaled_loss, has_aux=True)
         grads, loss = grads_fn(state["params"])
-        new_acc = jax.tree.map(lambda a, g: a + g.astype(self.grad_dtype),
-                               state["grad_acc"], grads)
+        if jax.tree.leaves(state["grad_acc"]):
+            new_acc = jax.tree.map(lambda a, g: a + g.astype(self.grad_dtype),
+                                   state["grad_acc"], grads)
+        else:
+            # bufferless gas==1 (offload engines): the fresh gradients ARE
+            # the accumulator — no add against a persistent zeros tree
+            new_acc = jax.tree.map(lambda g: g.astype(self.grad_dtype), grads)
         state = dict(state)
         state["grad_acc"] = new_acc
         return state, loss
@@ -1039,11 +1081,17 @@ class DeepSpeedEngine:
             # batch in_shardings None: inherit _device_batch placement (data
             # leaves sharded over BATCH_AXES, aux leaves like layer_mask
             # replicated)
+            micro_out = shardings
+            if self._gradacc_lazy and self._offload_device != "none":
+                # bufferless offload micro: input grad_acc is the empty
+                # tree, output carries the fresh gradients
+                micro_out = dict(shardings)
+                micro_out["grad_acc"] = self._grad_shardings
             self._jit_micro_step = jax.jit(
                 self._micro_step_fn,
                 donate_argnums=(0,),
                 in_shardings=(shardings, None),
-                out_shardings=(shardings, rep),
+                out_shardings=(micro_out, rep),
             )
         if self._jit_apply_step is None:
             self._jit_apply_step = jax.jit(
@@ -1166,8 +1214,23 @@ class DeepSpeedEngine:
         """Allocate the persistent gradient buffer on first use of the
         split forward/backward path when the engine was built without one
         (gas==1 fused-eligible). Invalidate jits/shardings built against
-        the empty tree."""
-        if not self._gradacc_lazy or jax.tree.leaves(self.state["grad_acc"]):
+        the empty tree.
+
+        Offload engines NEVER allocate it at gas==1: their micro step
+        replaces the empty tree with the fresh gradients (see
+        _micro_step_fn) and the offload apply consumes + drops them —
+        a persistent buffer would put 3x model bytes on the chip."""
+        if not self._gradacc_lazy:
+            return
+        if self._offload_device != "none":
+            if jax.tree.leaves(self.state["grad_acc"]):
+                raise RuntimeError(
+                    "offload engines at gradient_accumulation_steps == 1 "
+                    "hold gradients only between forward and step; call "
+                    "step() before the next forward (set "
+                    "gradient_accumulation_steps > 1 for accumulation)")
+            return
+        if jax.tree.leaves(self.state["grad_acc"]):
             return
         self._gradacc_lazy = False
         with self.mesh:
@@ -1271,136 +1334,236 @@ class DeepSpeedEngine:
                 ("Train/lr", self.lr_scheduler.get_lr(), self.global_steps),
             ])
 
+    def _offload_jit(self, kind, key, build):
+        """Per-leaf program cache for the offload path. The offload data
+        movement is deliberately MANY SMALL programs, not one monolithic
+        flatten/unflatten over every leaf: the 226-leaf whole-tree form
+        stalls this environment's remote compile helper indefinitely at
+        3B+ params, and per-leaf dispatch overhead is noise next to the
+        multi-GiB host<->device transfers these models imply."""
+        if not hasattr(self, "_offload_jits"):
+            self._offload_jits = {}
+        full = (kind,) + key
+        if full not in self._offload_jits:
+            self._offload_jits[full] = build()
+        return self._offload_jits[full]
+
+    def _flat_leaf_jit(self, shape, dtype, lay, sharding):
+        return self._offload_jit(
+            "flat", (shape, str(dtype), lay, str(sharding)),
+            lambda: jax.jit(lambda x, _l=lay: self._to_flat(x, _l),
+                            out_shardings=sharding))
+
+    @staticmethod
+    def _flat_shape(shape, lay):
+        """Shape _to_flat would produce, without tracing."""
+        if len(shape) == 0:
+            return (1, 1)
+        dp_dim, _, mp_dim, _ = lay
+        order = DeepSpeedEngine._flat_order(len(shape), dp_dim, mp_dim)
+        t = tuple(shape[d] for d in order)
+        lead = t[0] if dp_dim is not None else 1
+        total = 1
+        for d in t:
+            total *= d
+        return (lead, total // max(lead, 1))
+
+    def _offload_leaf_direct(self, shape, lay) -> bool:
+        """True when the leaf's flat layout is its C-order view on a
+        1-device mesh: fetch/push then move the RAW leaf (device_get /
+        device_put) with ZERO device-side transient — no transpose
+        program, no flat copy. At 3B params on one 16 GB chip the flat
+        copy (even one leaf's) next to params + grad buffer is the
+        difference between fitting and RESOURCE_EXHAUSTED. Multi-device
+        meshes keep the sharded flat machinery."""
+        if self.mesh.size != 1:
+            return False
+        if len(shape) == 0:
+            return True
+        dp_dim, _, mp_dim, _ = lay
+        order = self._flat_order(len(shape), dp_dim, mp_dim)
+        return list(order) == list(range(len(shape)))
+
+    def _stat_leaf_jit(self, shape, dtype, fp16):
+        def build():
+            def stat(x):
+                sq = jnp.sum(jnp.square(x.astype(jnp.float32)))
+                fin = jnp.all(jnp.isfinite(x)) if fp16 else jnp.asarray(True)
+                return sq, fin
+            return jax.jit(stat)
+        return self._offload_jit("stat", (shape, str(dtype), fp16), build)
+
+    def _unflat_leaf_jit(self, lay, shape, sharding):
+        dtype = self.param_dtype
+
+        def build():
+            def unflat(f):
+                if len(shape) == 0:
+                    a = f.reshape(())
+                else:
+                    dp_dim, _, mp_dim, _ = lay
+                    order = self._flat_order(len(shape), dp_dim, mp_dim)
+                    a = f.reshape(tuple(shape[d] for d in order))
+                    a = a.transpose([order.index(d)
+                                     for d in range(len(shape))])
+                return a.astype(dtype)
+            return jax.jit(unflat, out_shardings=sharding)
+        return self._offload_jit("unflat", (lay, shape, str(sharding)), build)
+
     def _apply_step_offload(self, lr: float):
         """Optimizer boundary on the host (ZeRO-Offload): fetch the LOCAL
-        shard of the flat gradient (unscale/clip/norm run jitted on device;
-        each host reads only its addressable 1/n_hosts), native CPU
-        optimizer on the local master segment (NVMe chunks stream through
-        the pipelined swapper), then scatter the updated master back into
-        the sharded param tree in one jitted dispatch."""
+        shard of the flat gradient (each host reads only its addressable
+        1/n_hosts, in the GRAD dtype — fp32 widening, unscale and clip all
+        happen on the host), native CPU optimizer on the local master
+        segment (NVMe chunks stream through the pipelined swapper), then
+        scatter the updated master back into the sharded param tree, one
+        small program per leaf (see _offload_jit)."""
         host_idx = self._offload_host_idx
         dev_idx = self._offload_device_idx
         dev_names = [self._offload_leaf_names[i] for i in dev_idx]
-        if getattr(self, "_jit_offload_fetch", None) is None:
-            clip = self.gradient_clipping
-            fp16 = self.config.fp16.enabled
-            rep = NamedSharding(self.mesh, P())
-            layouts = self._offload_layouts
-            grad_sh_leaves = jax.tree.leaves(self._grad_shardings)
-            dev_grad_sh = {n: grad_sh_leaves[i]
-                           for n, i in zip(dev_names, dev_idx)}
+        layouts = self._offload_layouts
+        fp16 = self.config.fp16.enabled
 
-            def fetch(grad_acc, scale):
-                leaves = jax.tree.leaves(grad_acc)
-                flats = [self._to_flat(leaves[i], lay)
-                         for i, lay in zip(host_idx, layouts)]
-                dev = {n: leaves[i].astype(jnp.float32)
-                       for n, i in zip(dev_names, dev_idx)}
-                every = flats + list(dev.values())
-                overflow = (~jnp.all(jnp.asarray(
-                    [jnp.all(jnp.isfinite(f)) for f in every])) if fp16
-                    else jnp.asarray(False))
-                inv = jnp.where(overflow, 0.0, 1.0 / scale)
-                flats = [f * inv for f in flats]
-                dev = {k: v * inv for k, v in dev.items()}
-                # grad norm (and the clip factor) span BOTH partitions —
-                # host and device see one consistent global norm
-                gnorm = jnp.sqrt(sum(jnp.sum(f * f) for f in flats)
-                                 + sum(jnp.sum(v * v) for v in dev.values()))
-                if clip > 0:
-                    factor = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                    flats = [f * factor for f in flats]
-                    dev = {k: v * factor for k, v in dev.items()}
-                return tuple(flats), dev, gnorm, overflow
-
-            self._jit_offload_fetch = jax.jit(
-                fetch,
-                out_shardings=(self._offload_flat_shardings, dev_grad_sh,
-                               rep, rep))
-
-            shapes = self._offload_shapes
-            treedef, dtype = self._offload_treedef, self.param_dtype
-            full_shapes = self._offload_full_shapes
-
-            def unflatten(flats, dev_params):
-                outs = [None] * len(full_shapes)
-                for f, lay, shape, i in zip(flats, layouts, shapes,
-                                            host_idx):
-                    if len(shape) == 0:
-                        a = f.reshape(())
-                    else:
-                        dp_dim, _, mp_dim, _ = lay
-                        order = self._flat_order(len(shape), dp_dim, mp_dim)
-                        a = f.reshape(tuple(shape[d] for d in order))
-                        a = a.transpose([order.index(d)
-                                         for d in range(len(shape))])
-                    outs[i] = a.astype(dtype)
-                for n, i in zip(dev_names, dev_idx):
-                    outs[i] = dev_params[n]
-                return jax.tree.unflatten(treedef, outs)
-
-            self._jit_offload_unflatten = jax.jit(
-                unflatten, out_shardings=self._param_shardings)
-
-            if dev_idx:
-                param_sh_leaves = jax.tree.leaves(self._param_shardings)
-                dev_param_sh = {n: param_sh_leaves[i]
-                                for n, i in zip(dev_names, dev_idx)}
-                opt_sh = self._state_shardings()["opt"]
-
-                def dev_step(dev_grads, opt, lr_val):
-                    new_master, new_opt = self.optimizer.update(
-                        dev_grads, opt, lr_val)
-                    new_params = jax.tree.map(
-                        lambda m: m.astype(dtype), new_master)
-                    return new_params, new_opt
-
-                self._jit_offload_devstep = jax.jit(
-                    dev_step, out_shardings=(dev_param_sh, opt_sh))
-
+        leaves = jax.tree.leaves(self.state["grad_acc"])
         with self.mesh:
-            flat_grads, dev_grads, gnorm_d, ovf_d = self._jit_offload_fetch(
-                self.state["grad_acc"], self.state["loss_scale"]["cur_scale"])
-        overflow, gnorm = bool(ovf_d), float(gnorm_d)
+            dev_grads = {n: leaves[i] for n, i in zip(dev_names, dev_idx)}
+            # sq-norm and finiteness on the RAW leaves (both are
+            # layout-invariant) — the flat copies don't exist yet, and
+            # materializing them all at once would not fit (see below)
+            stats = [self._stat_leaf_jit(leaves[i].shape, leaves[i].dtype,
+                                         fp16)(leaves[i])
+                     for i in host_idx]
+            stats += [self._stat_leaf_jit(v.shape, v.dtype, fp16)(v)
+                      for v in dev_grads.values()]
+        # ONE host round trip for every scalar (sq-norms, finite flags, the
+        # loss scale): gnorm/overflow/clip resolve on the host
+        fetched = jax.device_get(
+            [self.state["loss_scale"]["cur_scale"]] + list(stats))
+        scale = float(fetched[0])
+        sq = float(sum(s for s, _ in fetched[1:]))
+        finite = all(bool(f) for _, f in fetched[1:])
+        overflow = bool(fp16 and not finite)
+        inv = 0.0 if overflow else 1.0 / scale
+        gnorm = (sq ** 0.5) * inv
+        mult = inv
+        if self.gradient_clipping > 0:
+            mult = inv * min(1.0, self.gradient_clipping / (gnorm + 1e-6))
         if not overflow:
             dev_params = {}
             if dev_idx:
                 # Twin-Flow device partition: dispatch the jitted optimizer
                 # step FIRST (async) so it overlaps the host D2H + CPU step
-                # below; only the unflatten at the end joins the two flows
+                # below; unscale/clip fold into the update's per-leaf cast
+                # (grad_scale), so the raw grads never widen on device
+                if getattr(self, "_jit_offload_devstep", None) is None:
+                    param_sh_leaves = jax.tree.leaves(self._param_shardings)
+                    dev_param_sh = {n: param_sh_leaves[i]
+                                    for n, i in zip(dev_names, dev_idx)}
+                    opt_sh = self._state_shardings()["opt"]
+                    dtype = self.param_dtype
+
+                    def dev_step(dg, opt, lr_val, gs):
+                        new_master, new_opt = self.optimizer.update(
+                            dg, opt, lr_val, grad_scale=gs)
+                        new_params = jax.tree.map(
+                            lambda m: m.astype(dtype), new_master)
+                        return new_params, new_opt
+
+                    self._jit_offload_devstep = jax.jit(
+                        dev_step, out_shardings=(dev_param_sh, opt_sh))
                 with self.mesh:
                     dev_params, self.state["opt"] = \
                         self._jit_offload_devstep(
                             dev_grads, self.state["opt"],
-                            jnp.asarray(lr, jnp.float32))
-            # one batched D2H pull over every local shard, not per-shard
-            pieces = [data for arr in flat_grads
-                      for _, _, data in self._leaf_local_groups(arr)]
-            pieces = [np.asarray(p, np.float32).reshape(-1)
-                      for p in jax.device_get(pieces)]
+                            jnp.asarray(lr, jnp.float32),
+                            jnp.asarray(mult, jnp.float32))
+            # flatten -> pull -> RELEASE one leaf at a time (same memory
+            # argument as the init fetch: all flat grad copies at once is a
+            # third model-size on a chip already holding two; direct leaves
+            # move raw with no device transient at all); widen to fp32 and
+            # apply unscale x clip HOST-side
+            pieces = []
+            with self.mesh:
+                for k, (i, lay, sh) in enumerate(zip(
+                        host_idx, layouts, self._offload_flat_shardings)):
+                    if self._offload_direct[k]:
+                        pieces.append(np.asarray(
+                            jax.device_get(leaves[i]),
+                            np.float32).reshape(-1))
+                        continue
+                    flat = self._flat_leaf_jit(
+                        leaves[i].shape, leaves[i].dtype, lay, sh)(leaves[i])
+                    datas = [d for _, _, d in self._leaf_local_groups(flat)]
+                    pieces.extend(np.asarray(p, np.float32).reshape(-1)
+                                  for p in jax.device_get(datas))
+                    del flat, datas
+            if mult != 1.0:
+                for j, pc in enumerate(pieces):
+                    if pc.flags.writeable:
+                        np.multiply(pc, np.float32(mult), out=pc)
+                    else:  # zero-copy device_get views are read-only
+                        pieces[j] = pc * np.float32(mult)
             local_grad = (np.concatenate(pieces) if pieces
                           else np.zeros(0, np.float32))
             master_chunks = self._offload.step(self._chunked(local_grad), lr=lr)
+            # paging-stall visibility: seconds the host step spent BLOCKED
+            # on NVMe fences (0 for device=cpu), and its total wall time —
+            # the bench reports stall_frac from these
+            self.last_offload_stall_s = self._offload.last_stall_s
+            self.last_offload_compute_s = self._offload.last_compute_s
             master = np.concatenate([m.reshape(-1) for m in master_chunks])
-            # split the updated master back per span and rebuild each leaf's
-            # flat global array from this host's device segments
-            per_leaf = [[] for _ in flat_grads]
+            # the OLD params are dead from here on (their gradients are
+            # consumed, their replacement is rebuilt from the host master
+            # and dev_params): drop the tree so the push's incoming flats
+            # + rebuilt leaves fit beside the grad buffer at 3B scale
+            self.state["params"] = None
+            # split the updated master back per span: direct leaves upload
+            # straight as the new param leaf (reshape + cast on host, no
+            # device-side unflatten program); sharded leaves rebuild their
+            # flat global array and unflatten one small program per leaf,
+            # released before the next so only ONE flat transient is live
+            per_leaf: Dict[int, list] = {}
             off = 0
+            # push in the PARAM dtype, not fp32: the unflatten casts to
+            # param dtype anyway, so uploading wide only doubles H2D
+            # bytes (at 3B params: 13.7 GB vs 6.8)
+            push_dt = np.dtype(self.param_dtype)
+            param_sh_leaves = jax.tree.leaves(self._param_shardings)
+            outs = [None] * len(self._offload_full_shapes)
             for leaf_idx, _, pshape, devices in self._offload_spans:
                 length = int(np.prod(pshape))
-                seg = master[off:off + length].reshape(pshape)
+                seg = master[off:off + length]
                 off += length
-                per_leaf[leaf_idx].extend(
-                    jax.device_put(seg, d) for d in devices)
-            flat_masters = tuple(
-                jax.make_array_from_single_device_arrays(
-                    self._offload_flat_shapes[i],
-                    self._offload_flat_shardings[i], arrs)
-                for i, arrs in enumerate(per_leaf))
+                i = host_idx[leaf_idx]
+                if self._offload_direct[leaf_idx]:
+                    leaf_shape = self._offload_shapes[leaf_idx]
+                    outs[i] = jax.device_put(
+                        seg.reshape(leaf_shape).astype(push_dt),
+                        param_sh_leaves[i])
+                    continue
+                per_leaf.setdefault(leaf_idx, []).extend(
+                    jax.device_put(seg.reshape(pshape).astype(push_dt), d)
+                    for d in devices)
             with self.mesh:
-                self.state["params"] = self._jit_offload_unflatten(
-                    flat_masters, dev_params)
+                for leaf_idx, arrs in per_leaf.items():
+                    flat = jax.make_array_from_single_device_arrays(
+                        self._offload_flat_shapes[leaf_idx],
+                        self._offload_flat_shardings[leaf_idx], arrs)
+                    i = host_idx[leaf_idx]
+                    outs[i] = self._unflat_leaf_jit(
+                        layouts[leaf_idx], self._offload_shapes[leaf_idx],
+                        param_sh_leaves[i])(flat)
+                    del flat
+            for n, i in zip(dev_names, dev_idx):
+                outs[i] = dev_params[n]
+            self.state["params"] = jax.tree.unflatten(
+                self._offload_treedef, outs)
 
+        if self._gradacc_lazy:
+            # bufferless mode: the per-step gradients were consumed above —
+            # restore the empty-tree invariant the micro jit was traced
+            # with (the epilogue's zeros-of-{} is then a no-op)
+            self.state["grad_acc"] = {}
         # zero the accumulator + update loss scale on device
         if getattr(self, "_jit_offload_epilogue", None) is None:
             shardings = self._cached_shardings

@@ -29,6 +29,15 @@ class OptimizerStateSwapper:
         self._read = AsyncIOHandle(block_size=block_size)
         self._write = AsyncIOHandle(block_size=block_size)
         self._sizes: Dict[str, Tuple[int, ...]] = {}
+        # cumulative wall time BLOCKED on I/O fences — the paging stall the
+        # pipelining exists to hide (reference pipelined_optimizer_swapper
+        # hides it behind compute); consumers report stall_frac from this
+        self.stall_s = 0.0
+
+    def take_stall(self) -> float:
+        """Return and reset the accumulated I/O-blocked seconds."""
+        s, self.stall_s = self.stall_s, 0.0
+        return s
 
     def _path(self, key: str) -> str:
         return os.path.join(self.swap_dir, f"{key}.swp")
@@ -49,14 +58,20 @@ class OptimizerStateSwapper:
         self._read.async_pread(buffer.reshape(-1), self._path(key))
 
     def finish_read(self) -> None:
+        import time
+        t0 = time.perf_counter()
         self._read.wait()
+        self.stall_s += time.perf_counter() - t0
 
     def start_write(self, key: str, value: np.ndarray) -> None:
         self._write.async_pwrite(
             np.ascontiguousarray(value, np.float32).reshape(-1), self._path(key))
 
     def finish_writes(self) -> None:
+        import time
+        t0 = time.perf_counter()
         self._write.wait()
+        self.stall_s += time.perf_counter() - t0
 
     def swap_groups(self, keys: Sequence[str],
                     buffers: Sequence[np.ndarray]) -> Iterator[Tuple[str, np.ndarray]]:
@@ -81,17 +96,25 @@ class OptimizerStateSwapper:
         for i, key in enumerate(keys):
             self.finish_read()
             if self.pipeline and i + 1 < len(keys):
+                # buffer (i+1) % nbuf may hold the not-yet-fenced write of
+                # key i+1-nbuf — async_pwrite holds a raw no-copy view into
+                # the rotating buffer, so reading into it before the write
+                # lands would tear that key's file. The AIO handle fences
+                # all-or-nothing, so drain the write queue once the rotation
+                # wraps (writes issued more than one iteration ago have had
+                # a full compute phase to complete; this wait is usually
+                # momentary).
+                if i + 1 >= nbuf:
+                    self.finish_writes()
                 self.start_read(keys[i + 1], view(i + 1))
             buf = view(i)
             yield key, buf
-            # write back (async); fence before this buffer is reused for a read
+            # write back (async); fenced before this buffer's reuse above
             self.start_write(key, buf)
             if not self.pipeline:
                 self.finish_writes()
-            elif i + 2 < len(keys) and (i + 2) % nbuf == i % nbuf:
-                self.finish_writes()
-            if not self.pipeline and i + 1 < len(keys):
-                self.start_read(keys[i + 1], view(i + 1))
+                if i + 1 < len(keys):
+                    self.start_read(keys[i + 1], view(i + 1))
         self.finish_writes()
 
     def close(self) -> None:

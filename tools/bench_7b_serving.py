@@ -35,14 +35,18 @@ def run(arch: str, n_requests: int, token_budget: int):
     quant = {"llama2-7b": "int4", "tinyllama-1.1b": "int8"}[arch]
     label = {"llama2-7b": "llama2-7b FULL 32L int4 WOQ, ",
              "tinyllama-1.1b": "tinyllama-1.1b FULL 22L int8 WOQ, "}[arch]
+    # request ARRIVAL spacing (FastGen benches an arrival process, not a
+    # burst): ~ one 512-token prefill wave, so each arrival's prefill runs
+    # in its own wave and every request's own-clock TTFT meets the SLA
+    stagger = float(os.environ.get("DSTPU_STAGGER_S", "0.6"))
     return bench_serving(
         None, n_requests=n_requests, prompt_len=512, max_new=64,
         token_budget=token_budget, peak_tflops=peak, model_path=path,
-        quantization=quant, label=label)
+        quantization=quant, label=label, stagger_s=stagger)
 
 
 def main():
-    attempts = [("llama2-7b", int(os.environ.get("DSTPU_7B_REQS", "4")), 1024),
+    attempts = [("llama2-7b", int(os.environ.get("DSTPU_7B_REQS", "6")), 1024),
                 ("tinyllama-1.1b", 16, 2048)]
     if os.environ.get("DSTPU_7B_SKIP") == "1":
         attempts = attempts[1:]

@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""7B serving frontier under the staggered-arrival protocol + 16-req bisect.
+
+Round-3 verdict next #4: serve with per-request prompt-SLA frac 1.0 at 4
+AND 6 concurrent requests, and name the variable behind the 16-request
+RESOURCE_EXHAUSTED (round 3 stopped at "tunnel-runtime ceiling").
+
+Sweeps n_requests in (4, 6, 8) through bench_serving with arrival
+stagger DSTPU_STAGGER_S (default 0.6 s ~ one 512-token prefill wave),
+then attempts 16 requests at three knob settings to bisect the ceiling:
+full KV pool, halved KV pool (max_context trimmed), halved token budget.
+
+Each sweep point is its own subprocess (fresh HBM; a 16-req death cannot
+take the sweep down). Run: python tools/serving_frontier.py
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def child(n_requests: int, budget: int, max_new: int = 64) -> None:
+    from bench import PEAK_TFLOPS, bench_serving
+    from deepspeed_tpu.utils.synth_checkpoint import synthesize_hf_checkpoint
+    import jax
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = synthesize_hf_checkpoint(
+        "llama2-7b", os.path.join(root, ".synth_ckpts", "llama2-7b"))
+    stagger = float(os.environ.get("DSTPU_STAGGER_S", "0.6"))
+    line = bench_serving(
+        None, n_requests=n_requests, prompt_len=512, max_new=max_new,
+        token_budget=budget, peak_tflops=peak, model_path=path,
+        quantization="int4", label=f"frontier n={n_requests} b={budget}, ",
+        stagger_s=stagger)
+    print(json.dumps(line), flush=True)
+
+
+def main():
+    if "--child" in sys.argv:
+        i = sys.argv.index("--child")
+        child(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
+        return
+
+    points = [
+        (4, 1024, {}),
+        (6, 1024, {}),
+        (8, 1024, {}),
+        # 16-req bisect: vary one knob at a time
+        (16, 1024, {}),                                  # full config
+        (16, 1024, {"DSTPU_PUT_CHUNK_BYTES": str(1 << 29)}),  # smaller slabs
+        (16, 512, {}),                                   # halved budget
+    ]
+    for n, budget, env_extra in points:
+        env = dict(os.environ, **env_extra)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--child", str(n), str(budget)],
+                capture_output=True, text=True, timeout=2400, env=env)
+        except subprocess.TimeoutExpired as e:
+            print(json.dumps({"point": [n, budget, env_extra],
+                              "error": f"timeout; tail: {str(e.stdout)[-200:]}"}),
+                  flush=True)
+            continue
+        got = None
+        for ln in (r.stdout or "").strip().splitlines():
+            try:
+                d = json.loads(ln)
+                if "metric" in d:
+                    got = d
+            except json.JSONDecodeError:
+                continue
+        if got is None:
+            print(json.dumps({"point": [n, budget, env_extra],
+                              "error": (r.stderr or r.stdout or "")[-400:]}),
+                  flush=True)
+        else:
+            got["point"] = [n, budget, env_extra]
+            print(json.dumps(got), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -172,7 +172,7 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
 
 def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
                   peak_tflops, model_path=None, quantization=None, label="",
-                  stagger_s=0.0):
+                  stagger_s=0.0, decode_burst=None):
     import numpy as np
 
     from deepspeed_tpu.inference.v2.config_v2 import (
@@ -202,6 +202,11 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
         # through the remote-device tunnel, 256-token chunks pay two round
         # trips per 512-token prompt for no fairness benefit at this scale
         max_prefill_chunk=prompt_len,
+        # under an ARRIVAL process the decode-burst quantum bounds how long
+        # a new arrival's prefill can wait behind an unpreemptible fused
+        # burst: 32 tokens (~1 s at 7B decode rates) wrecked TTFT, 8 keeps
+        # the block ~0.25 s. Burst-arrival runs keep the deeper default.
+        **({"decode_burst": decode_burst} if decode_burst else {}),
         quantization_mode=quantization)
     load_s = None
     if model_path is not None:
@@ -213,7 +218,10 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
         model = engine.model
     else:
         engine = build_engine(model, config=cfg)
-    sched = ContinuousBatchingScheduler(engine, token_budget=token_budget)
+    sched = ContinuousBatchingScheduler(
+        engine, token_budget=token_budget,
+        # arrival-mode: canonical wave shapes (see scheduler ctor)
+        max_prefills_per_wave=1 if stagger_s else None)
     rng = np.random.default_rng(0)
     vocab = model.config.vocab_size
 
@@ -223,11 +231,22 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
     # every decode-burst (B, blocks, K) program compile outside the timed
     # window (a shorter warmup max_new leaves the K=decode_burst program
     # compiling inside the measurement)
-    warm = [sched.submit(rng.integers(0, vocab, size=(prompt_len,)),
-                         max_new_tokens=max_new) for _ in range(n_requests)]
-    while sched.has_work:
-        if sched.step() == 0:
-            break
+    # warmup REPLAYS the arrival pattern: staggered runs produce different
+    # wave shapes (one prefill chunk mixed with k decode tokens, shallow
+    # bursts) than a burst submission — those buckets must compile here,
+    # not inside the timed window
+    warm = []
+    wt0 = time.perf_counter()
+    while len(warm) < n_requests or sched.has_work:
+        now = time.perf_counter() - wt0
+        while len(warm) < n_requests and now >= len(warm) * stagger_s:
+            warm.append(sched.submit(rng.integers(0, vocab, size=(prompt_len,)),
+                                     max_new_tokens=max_new))
+        if sched.has_work:
+            if sched.step() == 0 and len(warm) == n_requests:
+                break
+        else:
+            time.sleep(0.002)
     assert all(w.done for w in warm)
 
     # Arrival process: ``stagger_s`` spaces submissions (the FastGen
@@ -333,7 +352,40 @@ def _last_metric_line(stdout: str):
     return None
 
 
+def _offload_denominator():
+    """Child mode for the NVMe line's denominator: the SAME model with the
+    optimizer resident in host RAM, in a fresh process (HBM isolation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import llama_model
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
+    steps = 30 if on_tpu else 3
+    cfg = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 3,
+                              "offload_optimizer": {"device": "cpu"}},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "data_types": {"grad_accum_dtype": "bf16"},
+    }
+    model = llama_model("llama2-7b", dtype=jnp.bfloat16, remat=True,
+                        num_layers=2, hidden_size=768, intermediate_size=2048,
+                        num_heads=12, num_kv_heads=4, vocab_size=4096,
+                        max_seq_len=512)
+    _emit(bench_train("llama-arch ZeRO-3 cpu-offload (denominator)", model,
+                      cfg, 4, 512, max(6, steps // 5), REF_MFU_ZERO3, peak))
+
+
 def main():
+    if "--offload-denominator" in sys.argv:
+        return _offload_denominator()
     if "--one" not in sys.argv and _probe_backend() not in ("cpu",):
         return _dispatch_tpu()  # client-free parent
     return _run_configs()
@@ -473,15 +525,19 @@ def _run_configs():
             # the optimizer resident in host RAM (device=cpu) — the ratio
             # isolates what NVMe paging costs, with the tunnel constant in
             # both numerator and denominator. The MFU-vs-V100 figure stays
-            # vs_baseline 0.0 (no honest denominator for that).
-            cfg_cpu = zero_cfg(3, 4)
-            cfg_cpu["zero_optimization"]["offload_optimizer"] = {
-                "device": "cpu"}
-            cpu_line = bench_train(
-                "llama-arch ZeRO-3 cpu-offload (denominator)",
-                offload_model(), cfg_cpu, 4, 512,
-                max(6, steps // 5), REF_MFU_ZERO3, peak)
-            if cpu_line.get("value"):
+            # vs_baseline 0.0 (no honest denominator for that). Runs in its
+            # OWN subprocess per the bench isolation protocol (the NVMe
+            # engine's HBM residue would dirty an in-process denominator).
+            import subprocess
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--offload-denominator"],
+                    capture_output=True, text=True, timeout=2400)
+                cpu_line = _last_metric_line(r.stdout)
+            except subprocess.TimeoutExpired:
+                cpu_line = None
+            if cpu_line and cpu_line.get("value"):
                 line["vs_cpu_offload"] = round(
                     line["value"] / cpu_line["value"], 3)
                 line["cpu_offload_tokens_per_sec"] = cpu_line["value"]

@@ -99,8 +99,12 @@ class InferenceEngineV2:
                  params: Optional[Any] = None,
                  topology: Optional[MeshTopology] = None,
                  seed: int = 0,
-                 donate_params: bool = False):
+                 donate_params: bool = False,
+                 quant_cache_dir: Optional[str] = None,
+                 quant_cache_fingerprint: Optional[Any] = None):
         self.config = config or RaggedInferenceEngineConfig()
+        self._quant_cache_dir = quant_cache_dir
+        self._quant_cache_fingerprint = quant_cache_fingerprint
         c = model.config
         self.topology = topology or MeshTopology(
             TopologyConfig(model=self.config.tensor_parallel_degree, data=-1))
@@ -251,36 +255,78 @@ class InferenceEngineV2:
         from collections import deque
         items: deque = deque()
 
-        def collect(spec_tree, tree, inside_target, out):
+        def collect(spec_tree, tree, inside_target, out, path):
+            if inside_target and "q" in tree and "scale" in tree:
+                # PRE-QUANTIZED subtree (quant-cache reload): the int
+                # payload uploads directly, no dense read or quantize.
+                # Handled as a PAIR before the loop so donate-mode pops
+                # cannot double-consume either member regardless of key
+                # order.
+                qv = tree.pop("q") if donate else tree["q"]
+                sv = tree.pop("scale") if donate else tree["scale"]
+                items.append((out, "preq", (qv, sv), spec_tree["kernel"],
+                              path))
             for k in list(tree):
+                if not donate and inside_target and k in ("q", "scale"):
+                    continue  # consumed by the pair above
                 v = tree.pop(k) if donate else tree[k]
                 if k == "kernel" and inside_target:
-                    items.append((out, "quant", v, spec_tree["kernel"]))
+                    items.append((out, "quant", v, spec_tree["kernel"],
+                                  path + "/kernel"))
                 elif isinstance(v, dict):
                     out[k] = {}
                     collect(spec_tree[k], v, inside_target or k in targets,
-                            out[k])
+                            out[k], path + "/" + k)
                 else:
-                    items.append((out, k, v, spec_tree[k]))
+                    items.append((out, k, v, spec_tree[k], path + "/" + k))
 
         result: Dict[str, Any] = {}
-        collect(specs, params, False, result)
+        collect(specs, params, False, result, "")
+
+        # the cache is only coherent when THIS build quantizes on the host
+        # (the device-quantize path never produces host q/scale to persist;
+        # writing a dense-only manifest would poison later cache hits)
+        cache_dir = self._quant_cache_dir if host_quant else None
+        cache_manifest: list = []
+
+        def _cache_file(path, suffix):
+            return os.path.join(cache_dir,
+                                path.strip("/").replace("/", "__") + suffix)
 
         # pass 2: prepare (worker thread) || upload (main thread)
         def prepare(item):
-            out, key, v, spec = item
+            out, key, v, spec, path = item
             if key == "quant" and host_quant:
                 q, scale = host_quantize_kernel(np.asarray(v), cfg, np_dtype)
+                if cache_dir:
+                    np.save(_cache_file(path, ".q.npy"), q)
+                    np.save(_cache_file(path, ".scale.npy"), scale)
+                    cache_manifest.append((path, "quant"))
                 return (out, "host_q", (q, scale), spec, v.shape)
-            return (out, key, host_cast(v), spec, None)
+            if key == "preq":
+                return (out, "host_q", v, spec, None)
+            host = host_cast(v)
+            if cache_dir and key != "quant":
+                # npy has no bf16: persist the raw 2-byte payload as uint16
+                # (the loader views it back through the manifest dtype)
+                sv = host.view(np.uint16) if host.dtype.str == "<V2" or \
+                    host.dtype == np.dtype(jnp.bfloat16) else host
+                np.save(_cache_file(path, ".dense.npy"), sv)
+                cache_manifest.append((path, "dense"))
+            return (out, key, host, spec, None)
 
         def place(prepared):
             out, key, v, spec, shape = prepared
             if key == "host_q":
                 q, scale = v
+                if shape is None:  # pre-quantized: derive the dense shape
+                    *lead, G, gse, dout = q.shape
+                    gs = gse * 2 if q.dtype == np.uint8 else gse
+                    shape = (*lead, G * gs, dout)
                 shard = q_shardings(shape, spec)
-                out["q"] = _chunked_put(q, shard["q"])
-                out["scale"] = jax.device_put(scale, shard["scale"])
+                out["q"] = _chunked_put(np.asarray(q), shard["q"])
+                out["scale"] = jax.device_put(np.asarray(scale),
+                                              shard["scale"])
             elif key == "quant":  # device-quantize path
                 ck = (v.shape, str(spec))
                 if ck not in jit_cache:
@@ -296,9 +342,14 @@ class InferenceEngineV2:
             else:
                 out[key] = _chunked_put(v, NamedSharding(self.mesh, spec))
 
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
         from concurrent.futures import ThreadPoolExecutor
-        depth = 3  # bounded: at most `depth` prepared leaves in host RAM
-        with ThreadPoolExecutor(max_workers=2) as ex:
+        depth = 5  # bounded: at most `depth` prepared leaves in host RAM
+        # 4 workers: the host quantize is numpy (releases the GIL on the
+        # big ufuncs), so leaves quantize in parallel while the main
+        # thread streams device puts
+        with ThreadPoolExecutor(max_workers=4) as ex:
             pending: deque = deque()
             while items:
                 pending.append(ex.submit(prepare, items.popleft()))
@@ -306,6 +357,14 @@ class InferenceEngineV2:
                     place(pending.popleft().result())
             while pending:
                 place(pending.popleft().result())
+        if cache_dir and cache_manifest:
+            import json as _json
+            with open(os.path.join(cache_dir, "manifest.json"), "w") as f:
+                _json.dump({"bits": cfg.bits, "group_size": cfg.group_size,
+                            "dtype": str(np_dtype),
+                            "fingerprint": getattr(
+                                self, "_quant_cache_fingerprint", None),
+                            "leaves": cache_manifest}, f)
         return result
 
     def update_params(self, params: Any) -> None:
@@ -577,6 +636,63 @@ def build_engine(model: TransformerLM,
     return InferenceEngineV2(model, config=config, params=params, **kwargs)
 
 
+def _ckpt_fingerprint(model_path: str):
+    """(name, size, mtime) of the checkpoint's weight/config files — a
+    changed or re-saved checkpoint invalidates the quant cache."""
+    names = sorted(n for n in os.listdir(model_path)
+                   if n.endswith((".safetensors", ".bin", ".json"))
+                   and not n.startswith("."))
+    return [(n, os.path.getsize(os.path.join(model_path, n)),
+             int(os.path.getmtime(os.path.join(model_path, n))))
+            for n in names]
+
+
+def _quant_cache_load(model_path: str, cache_dir: str, dtype, qcfg):
+    """(model, pre-quantized host tree) from a quant cache: int payloads +
+    bf16 dense leaves mmap straight off disk — no 2-byte/param dense
+    checkpoint read, no quantize. Returns None if the manifest is absent
+    or mismatched (dtype, bits, group size, or checkpoint fingerprint) —
+    a stale cache must never silently serve old weights."""
+    import json as _json
+    man_path = os.path.join(cache_dir, "manifest.json")
+    if not os.path.exists(man_path):
+        return None
+    with open(man_path) as f:
+        man = _json.load(f)
+    if man.get("dtype") != str(np.dtype(dtype)):
+        return None
+    if qcfg is not None and (man.get("bits") != qcfg.bits
+                             or man.get("group_size") != qcfg.group_size):
+        return None
+    fp = man.get("fingerprint")
+    if fp is None or [tuple(e) for e in fp] != _ckpt_fingerprint(model_path):
+        return None
+    from ...runtime.state_dict_factory import (SDLoaderFactory,
+                                               hf_to_transformer_config)
+    loader = SDLoaderFactory.get_sd_loader(model_path)  # config.json only
+    cfg = hf_to_transformer_config(loader.config, dtype=dtype)
+    tree: Dict[str, Any] = {}
+    for path, kind in man["leaves"]:
+        node = tree
+        parts = path.strip("/").split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        stem = os.path.join(cache_dir, path.strip("/").replace("/", "__"))
+        if kind == "quant":
+            # the pre-quantized {"q", "scale"} subtree replaces {"kernel"}
+            target = node if parts[-1] == "kernel" \
+                else node.setdefault(parts[-1], {})
+            target["q"] = np.load(stem + ".q.npy", mmap_mode="r")
+            target["scale"] = np.load(stem + ".scale.npy", mmap_mode="r")
+        else:
+            arr = np.load(stem + ".dense.npy", mmap_mode="r")
+            if arr.dtype == np.uint16:  # bf16 persisted as raw 2-byte words
+                arr = arr.view(np.dtype(dtype))
+            node[parts[-1]] = arr
+    from ...models.transformer import TransformerLM
+    return TransformerLM(cfg), tree
+
+
 def build_hf_engine(model_path: str,
                     config: Optional[RaggedInferenceEngineConfig] = None,
                     dtype: Any = jnp.bfloat16,
@@ -586,8 +702,29 @@ def build_hf_engine(model_path: str,
 
     ``dtype`` is the weight/compute dtype; the KV cache dtype is governed
     separately by ``config.kv_cache_dtype``.
-    """
+
+    Quantized configs keep a PRE-QUANTIZED cache next to the checkpoint
+    (``.dstpu_quant_cache_<mode>/``): the first build writes it while
+    quantizing on the host, subsequent builds mmap the 4-8x smaller int
+    payload and skip the dense read + quantize entirely (the reference
+    ships pre-sharded/quantized checkpoints for the same reason).
+    ``DSTPU_QUANT_CACHE=0`` disables."""
+    from ..quantization import QuantizationConfig
     from ...runtime.state_dict_factory import load_hf_model
+    qmode = getattr(config, "quantization_mode", None) if config else None
+    cache_dir = None
+    if qmode and os.environ.get("DSTPU_QUANT_CACHE", "1") != "0":
+        qcfg = QuantizationConfig.from_mode(qmode)
+        cache_dir = os.path.join(model_path, f".dstpu_quant_cache_{qmode}")
+        cached = _quant_cache_load(model_path, cache_dir, dtype, qcfg)
+        if cached is not None:
+            model, params = cached
+            log_dist(f"quant cache hit: {cache_dir}", ranks=[0])
+            return InferenceEngineV2(model, config=config, params=params,
+                                     **kwargs)
+        kwargs.setdefault("quant_cache_dir", cache_dir)
+        kwargs.setdefault("quant_cache_fingerprint",
+                          _ckpt_fingerprint(model_path))
     model, params = load_hf_model(model_path, dtype=dtype)
     # the freshly loaded host tree is owned here: donate it so the
     # quantized streaming load releases host RAM leaf by leaf

@@ -38,9 +38,19 @@ class Request:
 
 class ContinuousBatchingScheduler:
 
-    def __init__(self, engine, token_budget: Optional[int] = None, seed: int = 0):
+    def __init__(self, engine, token_budget: Optional[int] = None, seed: int = 0,
+                 max_prefills_per_wave: Optional[int] = None):
         self.engine = engine
         self.token_budget = token_budget or engine.config.state_manager.max_ragged_batch_size
+        # Arrival-mode serving sets max_prefills_per_wave=1: each wave is
+        # then one of THREE canonical shapes (pure prefill, prefill+decodes,
+        # decode burst), all compiled during warmup — unlimited packing
+        # creates novel (decode-count x prefill-slot x chunk-length) bucket
+        # combinations whose first occurrence costs a 4-5 s mid-serving
+        # compile (measured; the TTFT spikes behind it blew the prompt
+        # SLA). Burst-arrival batch jobs keep unlimited packing for
+        # aggregate prefill throughput.
+        self.max_prefills_per_wave = max_prefills_per_wave or (1 << 30)
         self._uid_gen = itertools.count(1)
         self._queue: List[Request] = []       # waiting for / mid prefill
         self._running: List[Request] = []     # generating
@@ -152,9 +162,20 @@ class ContinuousBatchingScheduler:
         return len(reqs) * k
 
     def step(self) -> int:
-        """Run one SplitFuse-composed forward; returns tokens processed."""
+        """Run one SplitFuse-composed forward; returns tokens processed.
+        ``DSTPU_SCHED_LOG=1`` prints one line per wave (kind, per-request
+        token counts, wall ms) — the serving analog of the comms logger."""
+        import os
+        log = os.environ.get("DSTPU_SCHED_LOG") == "1"
+        if log:
+            import time as _t
+            _t0 = _t.perf_counter()
         burst = self._try_decode_burst()
         if burst:
+            if log:
+                print(f"[sched] burst tokens={burst} "
+                      f"running={len(self._running)} "
+                      f"ms={(_t.perf_counter() - _t0) * 1e3:.0f}", flush=True)
             return burst
         uids: List[int] = []
         tokens: List[np.ndarray] = []
@@ -181,7 +202,7 @@ class ContinuousBatchingScheduler:
         # 2. remaining budget → prefill chunks, FIFO
         prefill_reqs: List[Request] = []
         for req in self._queue:
-            if budget <= 0:
+            if budget <= 0 or len(prefill_reqs) >= self.max_prefills_per_wave:
                 break
             take = min(budget, req.prefill_remaining)
             chunk = req.prompt[req.prompt_consumed:req.prompt_consumed + take]
@@ -197,6 +218,11 @@ class ContinuousBatchingScheduler:
             return 0
 
         logits = self.engine.put(uids, tokens)
+        if log:
+            print(f"[sched] wave decode={len(decode_reqs)} "
+                  f"prefill={[len(tokens[uids.index(r.uid)]) for r in prefill_reqs]} "
+                  f"queue={len(self._queue)} "
+                  f"ms={(_t.perf_counter() - _t0) * 1e3:.0f}", flush=True)
         by_uid: Dict[int, np.ndarray] = dict(zip(uids, logits))
 
         for req in decode_reqs:

@@ -99,15 +99,28 @@ def _flash_kernel_importable() -> bool:
         return False
 
 
-def _pallas_flash_available() -> bool:
-    """Opt-IN via DSTPU_PALLAS_FLASH=1: measured on the attached v5e
-    (round 2), the stock Pallas flash kernel ran 5-14x SLOWER than XLA's
-    fused attention at both head_dim 64 and 128 (0.1-1.9 TF eff vs
-    2.2-9.2 TF), so the default hot path is XLA. The kernel stays one env
-    var away for hardware where it wins. Only the import probe is cached —
-    the env read stays live so toggling mid-process works."""
+# At and above this query length the flash kernel is the DEFAULT: the
+# XLA path's materialized scores ([B, H, S, S] fp32, 2.1 GiB per unit
+# batch at 4k) fail to compile next to a full-depth train state —
+# measured round 4, full-depth TinyLlama-1.1B on one v5e: XLA wins by
+# 24% at 2k, is a compile OOM at 4k/8k, while flash trains both
+# (tools/longseq_ab.py, docs/PERF_NOTES_R4.md).
+FLASH_DEFAULT_MIN_SEQ = 4096
+
+
+def _pallas_flash_available(seq_len: int = 0) -> bool:
+    """DSTPU_PALLAS_FLASH=1 forces the kernel ON, =0 forces it OFF; unset,
+    it auto-enables at seq >= FLASH_DEFAULT_MIN_SEQ where the XLA path
+    cannot compile at scale. Below that, XLA stays the hot path: measured
+    on the attached v5e (round 2), the stock Pallas flash kernel ran
+    5-14x slower than XLA's fused attention at short seq. Only the import
+    probe is cached — the env read stays live so toggling mid-process
+    works (per-trace: jitted callers keep the path they traced with)."""
     import os
-    if os.environ.get("DSTPU_PALLAS_FLASH", "0") != "1":
+    flag = os.environ.get("DSTPU_PALLAS_FLASH", "")
+    if flag == "0":
+        return False
+    if flag != "1" and seq_len < FLASH_DEFAULT_MIN_SEQ:
         return False
     if jax.default_backend() == "cpu":
         return False
@@ -133,7 +146,7 @@ def flash_attention(q: jax.Array,
     head_dim = q.shape[-1]
     # head_dim 64 (gpt2) is supported by the stock kernel — Mosaic pads the
     # lane dim; requiring %128 hid the Pallas path from the benched model
-    if (_pallas_flash_available() and segment_ids is None
+    if (_pallas_flash_available(q.shape[1]) and segment_ids is None
             and alibi_slopes is None and window is None and head_dim % 64 == 0
             and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0):
         num_q_heads, num_kv_heads = q.shape[2], k.shape[2]

@@ -64,8 +64,13 @@ class OptimizerStateSwapper:
         self.stall_s += time.perf_counter() - t0
 
     def start_write(self, key: str, value: np.ndarray) -> None:
+        # SNAPSHOT copy: the async write must not keep a view into the
+        # caller's (rotating) buffer, or the next read into that buffer
+        # races the in-flight write and tears the file. The memcpy is
+        # cheap next to the file write it decouples.
         self._write.async_pwrite(
-            np.ascontiguousarray(value, np.float32).reshape(-1), self._path(key))
+            np.array(value, np.float32, copy=True).reshape(-1),
+            self._path(key))
 
     def finish_writes(self) -> None:
         import time
@@ -96,21 +101,15 @@ class OptimizerStateSwapper:
         for i, key in enumerate(keys):
             self.finish_read()
             if self.pipeline and i + 1 < len(keys):
-                # buffer (i+1) % nbuf may hold the not-yet-fenced write of
-                # key i+1-nbuf — async_pwrite holds a raw no-copy view into
-                # the rotating buffer, so reading into it before the write
-                # lands would tear that key's file. The AIO handle fences
-                # all-or-nothing, so drain the write queue once the rotation
-                # wraps (writes issued more than one iteration ago have had
-                # a full compute phase to complete; this wait is usually
-                # momentary).
-                if i + 1 >= nbuf:
+                # buffer reuse is race-free (start_write snapshots), so the
+                # only fence here BOUNDS the in-flight write copies to ~one
+                # buffer rotation's worth of memory
+                if (i + 1) % nbuf == 0:
                     self.finish_writes()
                 self.start_read(keys[i + 1], view(i + 1))
             buf = view(i)
             yield key, buf
-            # write back (async); fenced before this buffer's reuse above
-            self.start_write(key, buf)
+            self.start_write(key, buf)  # async; snapshot-copied
             if not self.pipeline:
                 self.finish_writes()
                 if i + 1 < len(keys):

@@ -150,3 +150,36 @@ def test_host_quantize_matches_device(eight_devices, bits, src_dtype):
     q_host, scale_host = host_quantize_kernel(w, cfg, np.dtype(jnp.bfloat16))
     np.testing.assert_array_equal(np.asarray(dev["q"]), q_host)
     np.testing.assert_array_equal(np.asarray(dev["scale"]), scale_host)
+
+
+def test_quant_cache_roundtrip(eight_devices, tmp_path):
+    """build_hf_engine writes a pre-quantized cache on the first build and
+    reloads from it on the second — logits must match exactly (the cache
+    holds the very q/scale arrays the first engine served with)."""
+    import os
+    from deepspeed_tpu.inference.v2.config_v2 import (
+        DeepSpeedTPStateManagerConfig, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.utils.synth_checkpoint import synthesize_hf_checkpoint
+
+    path = synthesize_hf_checkpoint("llama-test-tiny", str(tmp_path / "ckpt"))
+    cfg = lambda: RaggedInferenceEngineConfig(
+        num_kv_blocks=32, kv_block_size=4, max_prefill_chunk=16,
+        quantization_mode="int4",
+        state_manager=DeepSpeedTPStateManagerConfig(
+            max_ragged_batch_size=32, max_ragged_sequence_count=4,
+            max_context=64))
+    prompt = np.random.default_rng(1).integers(0, 256, size=(1, 12))
+
+    eng1 = build_hf_engine(path, config=cfg())
+    cache = os.path.join(path, ".dstpu_quant_cache_int4")
+    assert os.path.exists(os.path.join(cache, "manifest.json"))
+    with eng1.mesh:
+        logits1, _ = jax.jit(eng1.model.apply)(eng1.params, jnp.asarray(prompt))
+
+    topo_mod.reset()
+    eng2 = build_hf_engine(path, config=cfg())  # cache hit
+    with eng2.mesh:
+        logits2, _ = jax.jit(eng2.model.apply)(eng2.params, jnp.asarray(prompt))
+    np.testing.assert_array_equal(np.asarray(logits1), np.asarray(logits2))

@@ -42,7 +42,8 @@ def run(arch: str, n_requests: int, token_budget: int):
     return bench_serving(
         None, n_requests=n_requests, prompt_len=512, max_new=64,
         token_budget=token_budget, peak_tflops=peak, model_path=path,
-        quantization=quant, label=label, stagger_s=stagger)
+        quantization=quant, label=label, stagger_s=stagger,
+        decode_burst=8 if stagger > 0 else None)
 
 
 def main():

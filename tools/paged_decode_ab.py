@@ -5,7 +5,9 @@ wins everywhere the measurement justifies the default permanently).
 
 Interleaved best-of-4 windows per the repo noise protocol; sync by scalar
 fetch. Covers the llama2-7b decode shape (kvH=32, D=128, MHA) and the
-TinyLlama/GQA shape (kvH=4, D=64) at context 2k/4k/8k.
+TinyLlama/GQA shape (kvH=4, D=64) at context 2k-32k (the 16k/32k points
+are the round-4 long-context serving evidence: KV for B=8 at 32k is
+4 GiB in the 7B shape — the regime the paged kernel exists for).
 
 Run: python tools/paged_decode_ab.py
 """
@@ -59,7 +61,7 @@ def bench_pair(fa, fb, *args):
 def main():
     rng = np.random.default_rng(0)
     for kvH, H, D in [(32, 32, 128), (4, 32, 64)]:
-        for ctx in (2048, 4096, 8192):
+        for ctx in (2048, 4096, 8192, 16384, 32768):
             mp = ctx // PS
             P = B * mp + 1
             q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.bfloat16)

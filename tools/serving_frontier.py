@@ -35,7 +35,7 @@ def child(n_requests: int, budget: int, max_new: int = 64) -> None:
         None, n_requests=n_requests, prompt_len=512, max_new=max_new,
         token_budget=budget, peak_tflops=peak, model_path=path,
         quantization="int4", label=f"frontier n={n_requests} b={budget}, ",
-        stagger_s=stagger)
+        stagger_s=stagger, decode_burst=8 if stagger > 0 else None)
     print(json.dumps(line), flush=True)
 
 

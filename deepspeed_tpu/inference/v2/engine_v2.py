@@ -293,15 +293,26 @@ class InferenceEngineV2:
             return os.path.join(cache_dir,
                                 path.strip("/").replace("/", "__") + suffix)
 
+        def _atomic_save(fname, arr):
+            # tmp must end in .npy or np.save appends the extension; the
+            # os.replace makes concurrent builders converge on a complete
+            # file instead of interleaving writes
+            tmp = f"{fname}.{os.getpid()}.tmp.npy"
+            np.save(tmp, arr)
+            os.replace(tmp, fname)
+
         # pass 2: prepare (worker thread) || upload (main thread)
         def prepare(item):
             out, key, v, spec, path = item
             if key == "quant" and host_quant:
                 q, scale = host_quantize_kernel(np.asarray(v), cfg, np_dtype)
                 if cache_dir:
-                    np.save(_cache_file(path, ".q.npy"), q)
-                    np.save(_cache_file(path, ".scale.npy"), scale)
-                    cache_manifest.append((path, "quant"))
+                    try:
+                        _atomic_save(_cache_file(path, ".q.npy"), q)
+                        _atomic_save(_cache_file(path, ".scale.npy"), scale)
+                        cache_manifest.append((path, "quant"))
+                    except OSError:
+                        pass  # read-only mount: serve uncached
                 return (out, "host_q", (q, scale), spec, v.shape)
             if key == "preq":
                 return (out, "host_q", v, spec, None)
@@ -311,8 +322,11 @@ class InferenceEngineV2:
                 # (the loader views it back through the manifest dtype)
                 sv = host.view(np.uint16) if host.dtype.str == "<V2" or \
                     host.dtype == np.dtype(jnp.bfloat16) else host
-                np.save(_cache_file(path, ".dense.npy"), sv)
-                cache_manifest.append((path, "dense"))
+                try:
+                    _atomic_save(_cache_file(path, ".dense.npy"), sv)
+                    cache_manifest.append((path, "dense"))
+                except OSError:
+                    pass  # read-only mount: serve uncached
             return (out, key, host, spec, None)
 
         def place(prepared):
@@ -343,7 +357,10 @@ class InferenceEngineV2:
                 out[key] = _chunked_put(v, NamedSharding(self.mesh, spec))
 
         if cache_dir:
-            os.makedirs(cache_dir, exist_ok=True)
+            try:
+                os.makedirs(cache_dir, exist_ok=True)
+            except OSError:
+                cache_dir = None  # read-only checkpoint mount: no cache
         from concurrent.futures import ThreadPoolExecutor
         depth = 5  # bounded: at most `depth` prepared leaves in host RAM
         # 4 workers: the host quantize is numpy (releases the GIL on the
@@ -359,12 +376,20 @@ class InferenceEngineV2:
                 place(pending.popleft().result())
         if cache_dir and cache_manifest:
             import json as _json
-            with open(os.path.join(cache_dir, "manifest.json"), "w") as f:
-                _json.dump({"bits": cfg.bits, "group_size": cfg.group_size,
-                            "dtype": str(np_dtype),
-                            "fingerprint": getattr(
-                                self, "_quant_cache_fingerprint", None),
-                            "leaves": cache_manifest}, f)
+            manifest = os.path.join(cache_dir, "manifest.json")
+            tmp = f"{manifest}.{os.getpid()}.tmp"
+            try:
+                with open(tmp, "w") as f:
+                    _json.dump({"bits": cfg.bits,
+                                "group_size": cfg.group_size,
+                                "dtype": str(np_dtype),
+                                "fingerprint": getattr(
+                                    self, "_quant_cache_fingerprint", None),
+                                "leaves": cache_manifest}, f)
+                # atomic: a concurrent reader never sees a torn manifest
+                os.replace(tmp, manifest)
+            except OSError:
+                pass  # cache is best-effort; serving continues uncached
         return result
 
     def update_params(self, params: Any) -> None:

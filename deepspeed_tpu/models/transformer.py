@@ -434,20 +434,14 @@ class TransformerLM:
             x = _c(x + keep * mlp_out, ACT_SPEC)
         return (x, positions, aux_acc + keep * aux), None
 
-    def apply(self, params: Params, input_ids: jax.Array,
-              layer_mask: Optional[jax.Array] = None,
-              token_type_ids: Optional[jax.Array] = None,
-              attention_mask: Optional[jax.Array] = None,
-              return_hidden: bool = False) -> Tuple[jax.Array, jax.Array]:
-        """Return (logits [B,S,V] in fp32, moe_aux_loss scalar).
-
-        ``layer_mask`` [num_layers] gates each block (PLD stochastic depth).
-        ``token_type_ids`` [B,S] selects bert segment embeddings;
-        ``attention_mask`` [B,S] (1 = real) masks padding in encoders.
-        ``return_hidden`` short-circuits before the LM/MLM head, returning
-        the final hidden states [B,S,H] (post final-norm) — the hook task
-        heads (models/heads.py) build on.
-        """
+    def embed(self, params: Params, input_ids: jax.Array,
+              token_type_ids: Optional[jax.Array] = None
+              ) -> Tuple[jax.Array, jax.Array]:
+        """Front of the network: token + position (+ segment) embeddings,
+        embedding norm, cast to compute dtype. Returns (x [B,S,H],
+        positions [1,S]). Split out of ``apply`` so the param-streaming
+        trainer (zero/param_stream.py) can run it as its own program with
+        only the embedding leaves resident."""
         c = self.config
         positions = jnp.arange(input_ids.shape[1])[None, :]
         x = self._wte(params["wte"], input_ids)
@@ -465,7 +459,59 @@ class TransformerLM:
             x = x + self._wtt(params["wtt"], tt)
         if self._ln_emb is not None:
             x = self._ln_emb(params["ln_emb"], x)
-        x = _c(x.astype(c.dtype), ACT_SPEC)
+        return _c(x.astype(c.dtype), ACT_SPEC), positions
+
+    def head(self, params: Params, x: jax.Array) -> jax.Array:
+        """Back of the network: final norm (pre-LN), MLM transform, LM/MLM
+        head. Input is the last block's output; returns fp32 logits. The
+        tied-embedding head reads ``params['wte']`` — the param-streaming
+        trainer keeps the embedding leaves resident for this reason."""
+        c = self.config
+        if self._ln_f is not None:
+            x = self._ln_f(params["ln_f"], x)
+        if c.mlm_head:
+            # bert cls.predictions: dense → act → LN → tied decoder + bias
+            x = ACTIVATIONS[c.activation](
+                self._mlm_dense(params["mlm"]["dense"], x))
+            x = self._mlm_ln(params["mlm"]["ln"], x)
+        if c.tie_embeddings:
+            logits = self._wte.attend(params["wte"], x)
+        else:
+            logits = self._lm_head(params["lm_head"], x)
+        if c.mlm_head:
+            logits = logits + params["mlm"]["bias"].astype(logits.dtype)
+        return logits.astype(jnp.float32)
+
+    def block_apply(self, block: Params, x: jax.Array, positions: jax.Array,
+                    keep=1.0, attn_mask: Optional[jax.Array] = None,
+                    window: Optional[jax.Array] = None
+                    ) -> Tuple[jax.Array, jax.Array]:
+        """ONE transformer block over UNSTACKED per-layer params — the
+        param-streaming trainer's unit of compute (reference fetches one
+        module's partitions at a time, partitioned_param_coordinator.py:280).
+        Returns (x', moe_aux)."""
+        carry = (x, positions, jnp.zeros((), jnp.float32))
+        keep = jnp.asarray(keep, self.config.dtype)
+        packed = (block, keep) if window is None else (block, keep, window)
+        (x2, _, aux), _ = self._block_fn(attn_mask, carry, packed)
+        return x2, aux
+
+    def apply(self, params: Params, input_ids: jax.Array,
+              layer_mask: Optional[jax.Array] = None,
+              token_type_ids: Optional[jax.Array] = None,
+              attention_mask: Optional[jax.Array] = None,
+              return_hidden: bool = False) -> Tuple[jax.Array, jax.Array]:
+        """Return (logits [B,S,V] in fp32, moe_aux_loss scalar).
+
+        ``layer_mask`` [num_layers] gates each block (PLD stochastic depth).
+        ``token_type_ids`` [B,S] selects bert segment embeddings;
+        ``attention_mask`` [B,S] (1 = real) masks padding in encoders.
+        ``return_hidden`` short-circuits before the LM/MLM head, returning
+        the final hidden states [B,S,H] (post final-norm) — the hook task
+        heads (models/heads.py) build on.
+        """
+        c = self.config
+        x, positions = self.embed(params, input_ids, token_type_ids)
 
         block_fn = functools.partial(self._block_fn, attention_mask)
         alternating = c.remat and c.remat_policy == "alternating"
@@ -508,22 +554,11 @@ class TransformerLM:
                     jax.tree.map(lambda a: a[-1], xs))
         else:
             (x, _, aux), _ = jax.lax.scan(block_fn, init, xs)
-        if self._ln_f is not None:
-            x = self._ln_f(params["ln_f"], x)
         if return_hidden:
+            if self._ln_f is not None:
+                x = self._ln_f(params["ln_f"], x)
             return x, aux
-        if c.mlm_head:
-            # bert cls.predictions: dense → act → LN → tied decoder + bias
-            x = ACTIVATIONS[c.activation](
-                self._mlm_dense(params["mlm"]["dense"], x))
-            x = self._mlm_ln(params["mlm"]["ln"], x)
-        if c.tie_embeddings:
-            logits = self._wte.attend(params["wte"], x)
-        else:
-            logits = self._lm_head(params["lm_head"], x)
-        if c.mlm_head:
-            logits = logits + params["mlm"]["bias"].astype(logits.dtype)
-        return logits.astype(jnp.float32), aux
+        return self.head(params, x), aux
 
     def loss(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
         """Cross-entropy: next-token for causal LMs (labels derived by shift

@@ -151,6 +151,48 @@ class DeepSpeedEngine:
         self._param_swapper = None   # NVMe swapper, created on first use
         self._param_host_store = {}  # device=cpu: host-RAM shard store
         self._pcache = None          # metadata while params are paged out
+        # -- ZeRO-Infinity IN-TRAINING param streaming (zero/param_stream.py):
+        #    params stay host-resident and page through HBM one layer at a
+        #    time inside the step, so trainable size is no longer capped by
+        #    params+grads <= HBM ------------------------------------------
+        self._param_stream = None
+        self._paged_training = bool(pc is not None and pc.paged_training
+                                    and self._param_offload_device != "none")
+        if self._paged_training:
+            t = self.topology
+            if config.fp16.enabled:
+                raise ValueError("offload_param.paged_training supports "
+                                 "bf16/fp32 (no dynamic loss scaling on the "
+                                 "host-streamed gradient path)")
+            if oc is not None:
+                raise ValueError("offload_param.paged_training already runs "
+                                 "the optimizer on the host — remove "
+                                 "offload_optimizer")
+            if (t.pipe_parallel_size * t.expert_parallel_size) != 1:
+                raise ValueError("offload_param.paged_training composes with "
+                                 "dp/tp/sp meshes, not pipe/expert; got "
+                                 f"{t}")
+            for attr in ("embed", "head", "block_apply"):
+                if not hasattr(model, attr):
+                    raise ValueError(
+                        "offload_param.paged_training needs a model with "
+                        "embed/block_apply/head entry points (TransformerLM "
+                        f"family); {type(model).__name__} lacks .{attr}")
+            _pd = getattr(config, "_param_dict", {})
+            for feature in ("progressive_layer_drop", "quantize_training"):
+                if _pd.get(feature, {}).get("enabled"):
+                    raise ValueError(f"offload_param.paged_training does not "
+                                     f"compose with {feature}")
+            zc = config.zero_config
+            if (zc.zero_quantized_gradients or zc.zero_quantized_weights
+                    or zc.zero_hpz_partition_size > 1):
+                raise ValueError("offload_param.paged_training does not "
+                                 "compose with ZeRO++ knobs")
+            if self.optimizer.name in ("onebit_adam", "onebit_lamb",
+                                       "zero_one_adam"):
+                raise ValueError("offload_param.paged_training uses the host "
+                                 "CPU optimizer; 1-bit optimizers are "
+                                 "device-side")
 
         # -- 1-bit optimizers (reference runtime/fp16/onebit): explicit
         #    shard_map DP step so gradients stay local for compression -------
@@ -251,7 +293,24 @@ class DeepSpeedEngine:
             and not self._zeropp
             and self._onebit_opt is None
             and os.environ.get("DSTPU_FUSED_STEP", "1") != "0")
-        self.state = self._init_state(seed, init_params)
+        if self._paged_training:
+            # params never materialize on device as a full tree — the
+            # runner owns host params + host optimizer state
+            from .zero.param_stream import ParamStreamRunner
+            pc = self.config.zero_config.offload_param
+            self._param_stream = ParamStreamRunner(
+                model, self.mesh,
+                optimizer_cfg=config.optimizer,
+                param_dtype=self.param_dtype,
+                gradient_clipping=config.gradient_clipping,
+                buffer_count=pc.buffer_count,
+                nvme_path=pc.nvme_path,
+                device=self._param_offload_device,
+                seed=seed, init_params=init_params)
+            self.state = {"params": None, "opt": None,
+                          "loss_scale": self._loss_scale_state()}
+        else:
+            self.state = self._init_state(seed, init_params)
 
         # -- bookkeeping -----------------------------------------------------
         self.global_steps = 0
@@ -1444,7 +1503,9 @@ class DeepSpeedEngine:
         finite = all(bool(f) for _, f in fetched[1:])
         overflow = bool(fp16 and not finite)
         inv = 0.0 if overflow else 1.0 / scale
-        gnorm = (sq ** 0.5) * inv
+        # on overflow sq is often inf and inf*0.0 is NaN in Python floats;
+        # the device path reports 0.0 (jnp.where) — match it
+        gnorm = 0.0 if overflow else (sq ** 0.5) * inv
         mult = inv
         if self.gradient_clipping > 0:
             mult = inv * min(1.0, self.gradient_clipping / (gnorm + 1e-6))
@@ -1587,9 +1648,41 @@ class DeepSpeedEngine:
                                            jnp.asarray(overflow))
         return overflow, gnorm
 
+    def _train_batch_paged(self, data_iter_or_batch) -> jax.Array:
+        """ZeRO-Infinity param-streaming step: the runner pages params
+        through HBM per layer; the engine keeps schedule/bookkeeping."""
+        self.tput_timer.start()
+        gas = self.gradient_accumulation_steps
+        if isinstance(data_iter_or_batch, dict):
+            if gas > 1 and not getattr(self, "_gas_replay_warned", False):
+                self._gas_replay_warned = True
+                log_dist(
+                    f"train_batch(dict) with gradient_accumulation_steps="
+                    f"{gas} REPLAYS the same micro-batch for every "
+                    "accumulation step — pass an iterator for real "
+                    "training semantics", ranks=[0])
+            batches = [data_iter_or_batch] * gas
+        else:
+            batches = [next(data_iter_or_batch) for _ in range(gas)]
+        for b in batches:
+            self._validate_batch(b)
+        if self.curriculum_scheduler is not None:
+            batches = [self._apply_curriculum(b) for b in batches]
+        dev = [self._device_batch(b) for b in batches]
+        lr = float(self.lr_scheduler.get_lr())
+        loss = self._param_stream.train_step(dev, lr)
+        self.micro_steps += gas
+        self.global_steps += 1
+        self.lr_scheduler.step()
+        self._last_grad_norm = self._param_stream.last_grad_norm
+        self.tput_timer.stop(global_step=True)
+        return loss
+
     def train_batch(self, data_iter_or_batch) -> jax.Array:
         """One full optimizer step: gas micro-steps + apply (the
         PipelineEngine-style entry, pipe/engine.py:321)."""
+        if self._param_stream is not None:
+            return self._train_batch_paged(data_iter_or_batch)
         self._require_params("training")
         fp_cfg = self.config.flops_profiler_config
         profiling = fp_cfg.enabled and self.global_steps == fp_cfg.profile_step
@@ -1649,6 +1742,9 @@ class DeepSpeedEngine:
             return 0.0
 
     def eval_batch(self, batch: Dict[str, Any]) -> jax.Array:
+        if self._param_stream is not None:
+            self._validate_batch(batch)
+            return self._param_stream.forward_loss(self._device_batch(batch))
         self._require_params("eval_batch")
         topo_mod.set_topology(self.topology)
         if getattr(self, "_jit_eval", None) is None:
@@ -1682,6 +1778,8 @@ class DeepSpeedEngine:
     def module_state_dict(self):
         """Gathered (replicated) params as a host pytree — reference
         ``_zero3_consolidated_16bit_state_dict`` (engine.py:3477)."""
+        if self._param_stream is not None:
+            return self._param_stream.params_host_tree()
         self._require_params("module_state_dict")
         with self.mesh:
             gathered = jax.jit(
@@ -1788,6 +1886,9 @@ class DeepSpeedEngine:
         for m in self._pcache["meta"]:
             for name, _ in m["pieces"]:
                 if nvme:
+                    # no donate: device_put above may still be reading the
+                    # host buffer asynchronously — dropping (not pooling)
+                    # lets refcounting keep it alive until the transfer lands
                     swapper.release(name)
                 else:
                     self._param_host_store.pop(name, None)
@@ -1796,10 +1897,66 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:3050 save / :2688 load)
     # ------------------------------------------------------------------
+    def _paged_ckpt_path(self, dirname: str) -> str:
+        return os.path.join(dirname,
+                            f"param_stream.rank{jax.process_index()}.npz")
+
+    def _save_checkpoint_paged(self, save_dir, tag, client_state,
+                               save_latest) -> None:
+        import json
+        from .. import comm as dist
+        d = os.path.join(save_dir, tag)
+        os.makedirs(d, exist_ok=True)
+        sd = self._param_stream.state_dict()
+        # atomic per-rank file; 'latest' flips only after EVERY rank's file
+        # is complete (barrier), so a crash mid-save never strands 'latest'
+        # on a tag with truncated shards
+        path = self._paged_ckpt_path(d)
+        tmp = f"{path}.{os.getpid()}.tmp.npz"
+        np.savez(tmp, **sd)
+        os.replace(tmp, path)
+        if jax.process_index() == 0:
+            with open(os.path.join(d, "client_state.json"), "w") as f:
+                json.dump(client_state, f)
+        dist.barrier()
+        if save_latest and jax.process_index() == 0:
+            ltmp = os.path.join(save_dir, f".latest.{os.getpid()}.tmp")
+            with open(ltmp, "w") as f:
+                f.write(tag)
+            os.replace(ltmp, os.path.join(save_dir, "latest"))
+        log_dist(f"saved param-stream checkpoint {d}", ranks=[0])
+
+    def _load_checkpoint_paged(self, load_dir, tag, load_optimizer_states):
+        import json
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        d = os.path.join(load_dir, tag)
+        sd = dict(np.load(self._paged_ckpt_path(d)))
+        if not load_optimizer_states:
+            import re
+            # weights derive from the masters regardless; moments reset
+            for k in list(sd):
+                if re.match(r"^[gb]_m\d+/", k):
+                    sd[k] = np.zeros_like(sd[k])
+        self._param_stream.load_state_dict(sd)
+        with open(os.path.join(d, "client_state.json")) as f:
+            client_state = json.load(f)
+        self.global_steps = int(client_state.get("global_steps", 0))
+        self.skipped_steps = int(client_state.get("skipped_steps", 0))
+        self.micro_steps = int(client_state.get("micro_steps", 0))
+        if "lr_scheduler" in client_state:
+            self.lr_scheduler.load_state_dict(client_state["lr_scheduler"])
+        return tag, client_state
+
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict[str, Any]] = None,
                         save_latest: bool = True) -> None:
-        self._require_params("save_checkpoint")
+        if self._param_stream is None:
+            self._require_params("save_checkpoint")
         from ..checkpoint.store import save_checkpoint as _save
         tag = tag or f"global_step{self.global_steps}"
         client_state = dict(client_state or {})
@@ -1809,6 +1966,10 @@ class DeepSpeedEngine:
             "micro_steps": self.micro_steps,
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
+        if self._param_stream is not None:
+            self._save_checkpoint_paged(save_dir, tag, client_state,
+                                        save_latest)
+            return
         if self.quantizer is not None:
             client_state["moq_quantizer"] = self.quantizer.state_dict()
         _save(save_dir, tag, self.state, client_state, save_latest=save_latest)
@@ -1863,6 +2024,9 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True) -> Tuple[Optional[str], Dict[str, Any]]:
+        if self._param_stream is not None:
+            return self._load_checkpoint_paged(load_dir, tag,
+                                               load_optimizer_states)
         self._require_params("load_checkpoint")
         from ..checkpoint.store import load_checkpoint as _load
         shardings = self._state_shardings()

@@ -39,6 +39,36 @@ def _tree_zeros_like(tree, dtype=jnp.float32):
     return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype=dtype), tree)
 
 
+def _sr_to_bf16(x, key):
+    """Stochastically round fp32 → bf16 (E[stored] == value).
+
+    Deterministic truncation freezes a bf16-stored Adam second moment: with
+    beta2=0.999 the per-step EMA increment (1-b2)·(g²-v) is ~2^-10 of v,
+    below bf16's ~2^-8 resolution, so round-to-nearest returns the old value
+    forever and the effective lr silently drifts. Unbiased rounding lets
+    sub-resolution increments land with proportional probability, so the
+    EMA tracks in expectation. bf16 is a truncation of fp32, so SR is: add
+    uniform random low bits, truncate."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    bits = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(jnp.bfloat16)
+
+
+def _narrow_state_tree(tree, sdt, step, slot_seed: int):
+    """Store an optimizer-state pytree at ``sdt``. bf16 stores use
+    stochastic rounding keyed on (step, slot, leaf index) — reproducible
+    across replicas/shards, so ZeRO-partitioned state stays consistent."""
+    if jnp.dtype(sdt) != jnp.dtype(jnp.bfloat16):
+        return jax.tree.map(lambda x: x.astype(sdt), tree)
+    base = jax.random.fold_in(jax.random.key(0x51AB), step)
+    skey = jax.random.fold_in(base, slot_seed)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    leaves = [_sr_to_bf16(x, jax.random.fold_in(skey, i))
+              for i, x in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def _unzip(out, index: int):
     """Select element ``index`` from a pytree whose leaves are tuples."""
     return jax.tree.map(lambda t: t[index], out, is_leaf=lambda x: isinstance(x, tuple))
@@ -177,9 +207,9 @@ class Optimizer:
         mdt = self.master_dtype or f32
         sdt = self.moment_dtype or f32
         new_state["master"] = jax.tree.map(lambda x: x.astype(mdt), new_master)
-        for key in ("exp_avg", "exp_avg_sq", "sum_sq"):
+        for i, key in enumerate(("exp_avg", "exp_avg_sq", "sum_sq")):
             if key in new_state:
-                new_state[key] = jax.tree.map(lambda x: x.astype(sdt), new_state[key])
+                new_state[key] = _narrow_state_tree(new_state[key], sdt, step, i + 1)
         return new_master, new_state
 
 

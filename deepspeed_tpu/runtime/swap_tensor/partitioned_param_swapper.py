@@ -119,13 +119,21 @@ class AsyncPartitionedParameterSwapper:
             self.synchronize_writes()
         return self._resident[name]
 
-    def release(self, name: str) -> None:
-        """Drop a resident shard; pool-owned buffers (allocated by swap_in)
-        return to the free list for reuse, up to ``pool_bytes`` retained."""
+    def release(self, name: str, donate: bool = False) -> None:
+        """Drop a resident shard. Pool-owned buffers (allocated by swap_in)
+        re-enter the free list ONLY when the caller passes ``donate=True``,
+        guaranteeing no outstanding consumer of the buffer remains — e.g. an
+        async ``jax.device_put`` may still be reading the host memory after
+        returning, and a pooled buffer would be overwritten by the next
+        same-size swap_in mid-transfer. Without donation the buffer is
+        simply dropped; Python refcounting keeps it alive for any consumer
+        that still holds a reference."""
         arr = self._resident.pop(name, None)
         if arr is None or name not in self._pool_owned:
             return
         self._pool_owned.discard(name)
+        if not donate:
+            return
         if name in self._inflight:
             # the AIO worker is still writing into this buffer — recycling
             # it now would hand the next swap_in a buffer being mutated

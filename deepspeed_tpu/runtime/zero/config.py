@@ -31,13 +31,24 @@ class OffloadDeviceEnum(str, Enum):
 
 
 class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
-    """Reference ``zero/offload_config.py`` param section."""
+    """Reference ``zero/offload_config.py`` param section.
+
+    ``paged_training`` is the TPU-native switch for ZeRO-Infinity's
+    in-training parameter streaming (reference
+    ``partitioned_param_swapper.py:36`` + ``partitioned_param_coordinator
+    .py:503``): host-resident param leaves page through HBM one layer at a
+    time inside the train step, so trainable size is no longer capped by
+    params+grads <= device memory. Off by default because the SPMD engine's
+    device-resident stage-3 path is faster whenever params DO fit; without
+    it offload_param only governs the phase-flip cache
+    (``offload_param_cache``/``reload_param_cache``)."""
     device: OffloadDeviceEnum = OffloadDeviceEnum.none
     nvme_path: Optional[str] = None
     buffer_count: int = Field(5, ge=0)
     buffer_size: int = Field(100_000_000, ge=0)
     max_in_cpu: int = Field(1_000_000_000, ge=0)
     pin_memory: bool = False
+    paged_training: bool = False
 
 
 class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):

@@ -183,3 +183,33 @@ def test_quant_cache_roundtrip(eight_devices, tmp_path):
     with eng2.mesh:
         logits2, _ = jax.jit(eng2.model.apply)(eng2.params, jnp.asarray(prompt))
     np.testing.assert_array_equal(np.asarray(logits1), np.asarray(logits2))
+
+
+def test_quant_cache_unwritable_checkpoint_degrades(eight_devices, tmp_path):
+    """An unwritable cache location must serve (uncached), not raise: the
+    quant cache is best-effort (ADVICE r4: first quantized build on a
+    read-only mount raised from os.makedirs/np.save). chmod can't model a
+    read-only mount under root, so a regular FILE squats on the cache path
+    — os.makedirs then raises the same OSError class the code must absorb."""
+    import os
+    from deepspeed_tpu.inference.v2.config_v2 import (
+        DeepSpeedTPStateManagerConfig, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.engine_v2 import build_hf_engine
+    from deepspeed_tpu.utils.synth_checkpoint import synthesize_hf_checkpoint
+
+    path = synthesize_hf_checkpoint("llama-test-tiny", str(tmp_path / "ckpt"))
+    cache = os.path.join(path, ".dstpu_quant_cache_int4")
+    with open(cache, "w") as f:
+        f.write("not a directory")
+    cfg = RaggedInferenceEngineConfig(
+        num_kv_blocks=32, kv_block_size=4, max_prefill_chunk=16,
+        quantization_mode="int4",
+        state_manager=DeepSpeedTPStateManagerConfig(
+            max_ragged_batch_size=32, max_ragged_sequence_count=4,
+            max_context=64))
+    eng = build_hf_engine(path, config=cfg)
+    assert os.path.isfile(cache)  # never replaced by a cache dir
+    prompt = np.random.default_rng(1).integers(0, 256, size=(1, 12))
+    with eng.mesh:
+        logits, _ = jax.jit(eng.model.apply)(eng.params, jnp.asarray(prompt))
+    assert np.all(np.isfinite(np.asarray(logits, np.float32)))

@@ -87,7 +87,7 @@ class TestParamSwapper:
         sw.swap_in(["a"], async_op=False)
         first = sw.get("a")
         first_iface = first.__array_interface__["data"][0]
-        sw.release("a")
+        sw.release("a", donate=True)
         assert sw.available_swap_in_buffers() == 1  # pooled, not dropped
         sw.swap_in(["b"], async_op=False)
         second = sw.get("b")
@@ -103,13 +103,29 @@ class TestParamSwapper:
         big = np.zeros(512, dtype=np.float32)  # 2 KiB > pool cap
         sw.swap_out("big", big)
         sw.swap_in(["big"], async_op=False)
-        sw.release("big")
+        sw.release("big", donate=True)
         assert sw.available_swap_in_buffers() == 0  # over cap: not retained
         small = np.zeros(32, dtype=np.float32)  # 128 B fits
         sw.swap_out("small", small)
         sw.swap_in(["small"], async_op=False)
-        sw.release("small")
+        sw.release("small", donate=True)
         assert sw.available_swap_in_buffers() == 1
+        sw.close()
+
+    def test_release_without_donate_never_pools(self, tmp_path):
+        """Plain release() must NOT recycle the buffer: a consumer such as
+        an async jax.device_put may still be reading the host memory, and a
+        pooled buffer would be overwritten by the next same-size swap_in."""
+        sw = AsyncPartitionedParameterSwapper(str(tmp_path))
+        a = np.arange(64, dtype=np.float32)
+        sw.swap_out("a", a)
+        sw.swap_in(["a"], async_op=False)
+        held = sw.get("a")  # simulate an outstanding consumer reference
+        sw.release("a")
+        assert sw.available_swap_in_buffers() == 0
+        sw.swap_in(["a"], async_op=False)
+        # the held view was not overwritten by the new swap_in
+        np.testing.assert_array_equal(held, a)
         sw.close()
 
     def test_caller_arrays_never_pooled(self, tmp_path):
@@ -119,7 +135,7 @@ class TestParamSwapper:
         a = np.ones(16, dtype=np.float32)
         sw.swap_out("a", a, release=False)
         sw.synchronize_writes()
-        sw.release("a")
+        sw.release("a", donate=True)
         assert sw.available_swap_in_buffers() == 0
         sw.close()
 

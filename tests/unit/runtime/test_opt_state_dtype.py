@@ -66,6 +66,35 @@ def test_bf16_moments_close_to_fp32_updates(eight_devices):
     np.testing.assert_allclose(la, lb, rtol=0.05)
 
 
+def test_bf16_second_moment_does_not_freeze(eight_devices):
+    """Long-horizon EMA tracking: with beta2=0.999 the per-step increment
+    (1-b2)*(g^2 - v) is ~2^-10 of v — below bf16's ~2^-8 resolution, so a
+    deterministically-rounded bf16 store freezes v. The stochastic-rounding
+    store must keep v tracking the fp32 EMA in expectation."""
+    from deepspeed_tpu.runtime.optimizers import Optimizer
+
+    g = jnp.full((4096,), 0.5, dtype=jnp.float32)
+    p = jnp.zeros((4096,), dtype=jnp.float32)
+
+    def run(moment_dtype, steps=400):
+        opt = Optimizer(name="adam", lr=0.0, betas=(0.9, 0.999),
+                        moment_dtype=moment_dtype)
+        state = opt.init(p)
+        upd = jax.jit(lambda s: opt.update(g, s, 0.0)[1])
+        for _ in range(steps):
+            state = upd(state)
+        return float(jnp.mean(state["exp_avg_sq"].astype(jnp.float32)))
+
+    v32 = run(None)
+    v16 = run(jnp.bfloat16)
+    # closed form: v_t = g^2 * (1 - b2^t) = 0.25 * (1 - 0.999^400) ~ 0.0824
+    assert v32 > 0.05
+    # SR keeps the bf16 EMA within 10% of the fp32 trajectory; a frozen
+    # store would sit several times lower (stuck once increments fall
+    # below resolution)
+    np.testing.assert_allclose(v16, v32, rtol=0.10)
+
+
 def test_master_weights_in_model_dtype(eight_devices):
     cfg = dict(BASE, fp16_master_weights_and_grads=True)
     engine, _, _, _ = deepspeed_tpu.initialize(model=tiny_model(), config=cfg)

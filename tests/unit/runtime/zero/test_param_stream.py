@@ -1,0 +1,205 @@
+"""ZeRO-Infinity in-training parameter streaming (zero/param_stream.py).
+
+The reference's flagship scale claim — training models whose parameters
+exceed device memory (40B on one V100-32GB,
+reference docs/_posts/2021-03-08-zero3-offload.md:9) — rides on
+``AsyncPartitionedParameterSwapper`` (partitioned_param_swapper.py:36) and
+the coordinator's NVMe prefetch (partitioned_param_coordinator.py:503).
+These tests hold the TPU-native per-layer streaming runner to the same
+bar: device param residency provably below total param bytes, loss parity
+with the resident-param engine, clipping, checkpoint/resume, and sharded
+meshes."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2_model, llama_model
+
+
+def _model(layers=4, fp32=True, **over):
+    return gpt2_model("gpt2-tiny", max_seq_len=32, vocab_size=128,
+                      num_layers=layers, remat=False,
+                      **({"dtype": jnp.float32} if fp32 else {}), **over)
+
+
+def _batch(seed=0, batch=8, seq=16, vocab=128):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": rng.integers(0, vocab, size=(batch, seq))}
+
+
+def _cfg(paged, gas=1, clip=0.0, extra_zero=None, topology=None):
+    zero = {"stage": 3,
+            "offload_param": {"device": "cpu", "paged_training": True}} \
+        if paged else {"stage": 0}
+    if extra_zero:
+        zero.update(extra_zero)
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-3, "weight_decay": 0.01}},
+        "zero_optimization": zero,
+    }
+    if clip:
+        cfg["gradient_clipping"] = clip
+    if topology:
+        cfg["topology"] = topology
+    return cfg
+
+
+def _shared_init(model, seed=11):
+    cpu = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(cpu):
+        return jax.tree.map(np.asarray,
+                            model.init(jax.random.PRNGKey(seed), jnp.float32))
+
+
+class TestParity:
+
+    def test_losses_match_resident_engine(self, eight_devices):
+        """Same init, same data: the paged step must trace the resident
+        engine's loss trajectory (same AdamW math, fp32)."""
+        m = _model()
+        init = _shared_init(m)
+        paged, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True), model_parameters=init)
+        dense, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg(False), model_parameters=init)
+        pl, dl = [], []
+        for i in range(6):
+            b = _batch(seed=i)
+            pl.append(float(paged.train_batch(b)))
+            dl.append(float(dense.train_batch(b)))
+        np.testing.assert_allclose(pl, dl, rtol=2e-3, atol=2e-4)
+
+    def test_gradient_accumulation_parity(self, eight_devices):
+        m = _model()
+        init = _shared_init(m)
+        paged, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True, gas=2), model_parameters=init)
+        dense, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg(False, gas=2), model_parameters=init)
+        it1 = iter([_batch(seed=i) for i in range(4)])
+        it2 = iter([_batch(seed=i) for i in range(4)])
+        l1 = [float(paged.train_batch(it1)) for _ in range(2)]
+        l2 = [float(dense.train_batch(it2)) for _ in range(2)]
+        np.testing.assert_allclose(l1, l2, rtol=2e-3, atol=2e-4)
+
+    def test_eval_batch(self, eight_devices):
+        m = _model()
+        init = _shared_init(m)
+        paged, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True), model_parameters=init)
+        dense, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg(False), model_parameters=init)
+        b = _batch(seed=3)
+        np.testing.assert_allclose(float(paged.eval_batch(b)),
+                                   float(dense.eval_batch(b)),
+                                   rtol=1e-4)
+
+
+class TestOutOfCore:
+
+    def test_device_residency_below_param_bytes(self, eight_devices):
+        """THE ZeRO-Infinity claim: train with device param residency a
+        fraction of total param bytes. 8 layers deep, peak residency must
+        stay under half the param bytes (globals + a few block buffers)."""
+        m = _model(layers=8)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config=_cfg(True))
+        for i in range(2):
+            eng.train_batch(_batch(seed=i))
+        rs = eng._param_stream
+        budget = rs.total_param_bytes // 2  # simulated small-HBM cap
+        assert 0 < rs.peak_param_bytes < budget < rs.total_param_bytes, (
+            rs.peak_param_bytes, budget, rs.total_param_bytes)
+
+    def test_loss_descends_under_budget(self, eight_devices):
+        m = _model(layers=8)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config=_cfg(True))
+        b = _batch(seed=0)  # fixed batch: descent must be monotone-ish
+        losses = [float(eng.train_batch(b)) for _ in range(5)]
+        assert losses[-1] < losses[0], losses
+
+
+class TestMechanics:
+
+    def test_grad_clipping_and_norm(self, eight_devices):
+        m = _model()
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True, clip=1e-4))
+        eng.train_batch(_batch())
+        assert eng.get_global_grad_norm() > 0
+        # a second engine without clip must take a LARGER step
+        m2 = _model()
+        init = _shared_init(m2)
+        e1, _, _, _ = deepspeed_tpu.initialize(
+            model=m2, config=_cfg(True, clip=1e-4), model_parameters=init)
+        e2, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg(True), model_parameters=init)
+        b = _batch(seed=5)
+        e1.train_batch(b); e2.train_batch(b)
+        p1 = e1.module_state_dict()["blocks"]["fc_in"]["kernel"]
+        p2 = e2.module_state_dict()["blocks"]["fc_in"]["kernel"]
+        assert not np.allclose(np.asarray(p1), np.asarray(p2))
+
+    def test_checkpoint_resume(self, eight_devices, tmp_path):
+        m = _model()
+        init = _shared_init(m)
+        e1, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True), model_parameters=init)
+        for i in range(3):
+            e1.train_batch(_batch(seed=i))
+        e1.save_checkpoint(str(tmp_path))
+        cont = [float(e1.train_batch(_batch(seed=i))) for i in range(3, 6)]
+
+        e2, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg(True))
+        tag, client = e2.load_checkpoint(str(tmp_path))
+        assert tag is not None and e2.global_steps == 3
+        resumed = [float(e2.train_batch(_batch(seed=i))) for i in range(3, 6)]
+        np.testing.assert_allclose(resumed, cont, rtol=1e-4, atol=1e-5)
+
+    def test_module_state_dict_matches_master(self, eight_devices):
+        m = _model()
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config=_cfg(True))
+        eng.train_batch(_batch())
+        sd = eng.module_state_dict()
+        leaves = jax.tree.leaves(sd)
+        assert all(np.all(np.isfinite(np.asarray(l, np.float32)))
+                   for l in leaves)
+
+    def test_sharded_mesh_dp_tp(self, eight_devices):
+        """Paged streaming over a dp=2 x tp=2 mesh: per-layer device_put
+        scatters into the NamedShardings; grads come back reduced."""
+        m = llama_model("llama2-tiny", max_seq_len=32, vocab_size=128,
+                        remat=False, dtype=jnp.float32)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True, topology={"data": 4, "model": 2}))
+        b = _batch(seed=0, batch=4)
+        losses = [float(eng.train_batch(b)) for _ in range(3)]
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
+
+class TestRejections:
+
+    def test_fp16_rejected(self, eight_devices):
+        cfg = _cfg(True)
+        cfg["fp16"] = {"enabled": True}
+        with pytest.raises(ValueError, match="bf16/fp32"):
+            deepspeed_tpu.initialize(model=_model(fp32=False), config=cfg)
+
+    def test_offload_optimizer_rejected(self, eight_devices):
+        cfg = _cfg(True, extra_zero={"offload_optimizer": {"device": "cpu"}})
+        with pytest.raises(ValueError, match="remove offload_optimizer"):
+            deepspeed_tpu.initialize(model=_model(), config=cfg)
+
+    def test_moe_rejected(self, eight_devices):
+        from deepspeed_tpu.models import mixtral_model
+        m = mixtral_model("mixtral-tiny", max_seq_len=32, vocab_size=128,
+                          remat=False)
+        with pytest.raises(ValueError, match="MoE"):
+            deepspeed_tpu.initialize(model=m, config=_cfg(True))

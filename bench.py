@@ -172,7 +172,8 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
 
 def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
                   peak_tflops, model_path=None, quantization=None, label="",
-                  stagger_s=0.0, decode_burst=None):
+                  stagger_s=0.0, decode_burst=None, kv_dtype=None):
+    import jax.numpy as jnp
     import numpy as np
 
     from deepspeed_tpu.inference.v2.config_v2 import (
@@ -207,7 +208,13 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
         # burst: 32 tokens (~1 s at 7B decode rates) wrecked TTFT, 8 keeps
         # the block ~0.25 s. Burst-arrival runs keep the deeper default.
         **({"decode_burst": decode_burst} if decode_burst else {}),
+        # fp8 KV: halves (vs bf16) the page pool — the 24-request wall was
+        # a KV-pool compile-time OOM at ~7.3 GiB (PERF_NOTES_R4)
+        **({"kv_cache_dtype": jnp.float8_e4m3fn} if kv_dtype == "fp8" else {}),
         quantization_mode=quantization)
+    if kv_dtype not in (None, "fp8"):
+        raise ValueError(f"kv_dtype must be None or 'fp8', got {kv_dtype!r} "
+                         "(a silently-ignored value would mislabel the line)")
     load_s = None
     if model_path is not None:
         # full-depth real-format checkpoint through the real front door
@@ -321,6 +328,7 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
         "incomplete_requests": incomplete,
         "out_tokens": out_tokens,
         **({"arrival_stagger_s": stagger_s} if stagger_s else {}),
+        **({"kv_cache_dtype": kv_dtype} if kv_dtype else {}),
     }
 
 

@@ -471,6 +471,25 @@ class InferenceEngineV2:
     def flush(self, uid: int) -> None:
         self.state_manager.flush_sequence(uid)
 
+    # -- KV host offload / restore: working form of the reference's
+    #    stubbed BlockedKVCache.offload/restore (kv_cache.py:169,179).
+    #    Preemption stashes a sequence's KV in host RAM; restore resumes
+    #    decoding with one H2D scatter instead of a full re-prefill. -----
+    def offload_sequence(self, uid: int) -> None:
+        with self.mesh:
+            self.state_manager.offload_sequence(uid)
+
+    def can_restore(self, uid: int, headroom: int = 0) -> bool:
+        return (self.state_manager.is_offloaded(uid)
+                and self.state_manager.can_restore(uid, headroom))
+
+    def is_offloaded(self, uid: int) -> bool:
+        return self.state_manager.is_offloaded(uid)
+
+    def restore_sequence(self, uid: int) -> None:
+        with self.mesh:
+            self.state_manager.restore_sequence(uid)
+
     # ------------------------------------------------------------------
     # forward (reference engine_v2.py:107 put)
     # ------------------------------------------------------------------

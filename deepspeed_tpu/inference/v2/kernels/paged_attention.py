@@ -55,9 +55,15 @@ def _pallas_paged_available() -> bool:
             and _paged_kernel_importable())
 
 
-def _gather_pages(pages: jax.Array, block_tables: jax.Array) -> jax.Array:
-    """pages [kvH, P, ps, D], block_tables [B, mp] -> [B, kvH, mp*ps, D]."""
+def _gather_pages(pages: jax.Array, block_tables: jax.Array,
+                  out_dtype=None) -> jax.Array:
+    """pages [kvH, P, ps, D], block_tables [B, mp] -> [B, kvH, mp*ps, D].
+
+    ``out_dtype``: upcast AFTER the gather — with a narrow KV store (fp8
+    cache) only the batch's gathered blocks widen, not the whole pool."""
     g = jnp.take(pages, block_tables, axis=1)          # [kvH, B, mp, ps, D]
+    if out_dtype is not None and g.dtype != out_dtype:
+        g = g.astype(out_dtype)
     kvH, B, mp, ps, D = g.shape
     return g.transpose(1, 0, 2, 3, 4).reshape(B, kvH, mp * ps, D)
 
@@ -76,8 +82,8 @@ def _gqa_logits(q: jax.Array, k: jax.Array, scale: float) -> jax.Array:
 def _xla_paged_decode(q, k_pages, v_pages, context_lens, block_tables,
                       scale: float, alibi_slopes=None,
                       window=None) -> jax.Array:
-    k = _gather_pages(k_pages, block_tables)
-    v = _gather_pages(v_pages, block_tables)
+    k = _gather_pages(k_pages, block_tables, out_dtype=q.dtype)
+    v = _gather_pages(v_pages, block_tables, out_dtype=q.dtype)
     B, kvH, C, D = k.shape
     H = q.shape[1]
     logits = _gqa_logits(q, k, scale)                   # [B, H, C]
@@ -121,6 +127,9 @@ def paged_decode_attention(q: jax.Array,
         use_pallas = _pallas_paged_available()
     if alibi_slopes is not None or window is not None:
         use_pallas = False  # stock kernel has no bias/window inputs
+    if k_pages.dtype != q.dtype:
+        use_pallas = False  # narrow (fp8) KV store: XLA path upcasts the
+        #                     gathered blocks; the kernel has no fp8 read
     if use_pallas:
         # builder-written kernel (pallas_paged_decode.py): GQA-native,
         # head_dim-64 capable, burst-scan compatible — the three gaps that
@@ -170,8 +179,8 @@ def ragged_chunk_attention(q: jax.Array,
     """
     S, T, H, D = q.shape
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
-    k = _gather_pages(k_pages, block_tables)            # [S, kvH, C, D]
-    v = _gather_pages(v_pages, block_tables)
+    k = _gather_pages(k_pages, block_tables, out_dtype=q.dtype)  # [S,kvH,C,D]
+    v = _gather_pages(v_pages, block_tables, out_dtype=q.dtype)
     kvH, C = k.shape[1], k.shape[2]
     group = H // kvH
     # heads-major so both einsums are plain batch matmuls over contiguous

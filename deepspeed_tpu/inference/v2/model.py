@@ -149,6 +149,9 @@ class RaggedInferenceModel:
             v_pages = v_pages.at[l].set(v_l)
             win = (self._windows_arr[l] if self._windows_arr is not None
                    else None)
+            # narrow KV store (fp8 cache): the attention kernels upcast
+            # AFTER their per-sequence block gathers (paged_attention.py
+            # _gather_pages), so the full pool is never widened
             attn_out = attn_fn(q, k_l, v_l, win)
             o = m._block_layers["o_proj"](
                 block["o_proj"], attn_out.reshape(x.shape[0], -1))
@@ -256,9 +259,9 @@ class RaggedInferenceModel:
 
         def attn(q, k_l, v_l, window):
             kf = k_l.reshape(k_l.shape[0], -1, k_l.shape[-1])
-            k_ctx = kf[:, ctx_idx, :]
+            k_ctx = kf[:, ctx_idx, :].astype(q.dtype)  # fp8 store: widen
             vf = v_l.reshape(v_l.shape[0], -1, v_l.shape[-1])
-            v_ctx = vf[:, ctx_idx, :]
+            v_ctx = vf[:, ctx_idx, :].astype(q.dtype)  # the gather only
             return chunk_prefill_attention(q, k_ctx, v_ctx, history_len,
                                            scale=self._scale,
                                            alibi_slopes=self._alibi,

@@ -10,11 +10,22 @@ one array ``[L, kv_heads, num_blocks, block_size, head_dim]`` so the model's
 The cache is a functional value: forward passes take it as a donated jit
 argument and return the updated array (XLA aliases the buffer in place), the
 engine swaps in the new handle — no mutation, no streams.
+
+``offload``/``restore`` page a set of blocks to host RAM and back — the
+API the reference declares but stubs out (``kv_cache.py:169,179`` raise
+NotImplementedError "Offloading is not yet supported"). Here they are
+real: preemption under KV pressure stashes a sequence's blocks instead of
+dropping them, so resuming costs one H2D scatter instead of a full
+re-prefill. Block-id lists are padded to power-of-two buckets (pad target
+= the reserved null block 0) so each distinct gather/scatter program
+compiles once.
 """
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import List, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +49,7 @@ class BlockedKVCache:
         shape = (num_layers, num_kv_heads, num_blocks, block_size, head_dim)
         self.k_pages = jnp.zeros(shape, dtype)
         self.v_pages = jnp.zeros(shape, dtype)
+        self._off_jits = {}  # offload/restore program cache, keyed (kind, n)
 
     @property
     def per_token_bytes(self) -> int:
@@ -55,3 +67,60 @@ class BlockedKVCache:
 
     def mem_bytes(self) -> int:
         return 2 * self.k_pages.size * jnp.dtype(self.dtype).itemsize
+
+    # -- host offload / restore (reference kv_cache.py:169,179 — stubs
+    #    there; working here) -------------------------------------------
+    def _bucket(self, n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _offload_jit(self, n: int):
+        if ("off", n) not in self._off_jits:
+            self._off_jits[("off", n)] = jax.jit(
+                lambda kp, vp, ids: (kp[:, :, ids], vp[:, :, ids]))
+        return self._off_jits[("off", n)]
+
+    def _restore_jit(self, n: int):
+        if ("res", n) not in self._off_jits:
+            # donate the pages: the scatter aliases the pool in place
+            self._off_jits[("res", n)] = jax.jit(
+                lambda kp, vp, ids, hk, hv: (kp.at[:, :, ids].set(hk),
+                                             vp.at[:, :, ids].set(hv)),
+                donate_argnums=(0, 1))
+        return self._off_jits[("res", n)]
+
+    def offload(self, block_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:
+        """Copy ``block_ids``'s pages to host, returning (k, v) of shape
+        [L, H, n_padded, bs, hd]. The caller frees the device blocks; the
+        pad rows (gathered from the null block) are dead weight the
+        matching ``restore`` writes back to the null block."""
+        n = self._bucket(max(len(block_ids), 1))
+        ids = np.zeros(n, np.int32)
+        ids[:len(block_ids)] = block_ids
+        k, v = self._offload_jit(n)(self.k_pages, self.v_pages,
+                                    jnp.asarray(ids))
+        k, v = jax.device_get((k, v))
+        return np.asarray(k), np.asarray(v)
+
+    def restore(self, host_k: np.ndarray, host_v: np.ndarray,
+                block_ids: List[int]) -> None:
+        """Scatter offloaded pages back into freshly-allocated blocks.
+        ``block_ids`` may differ from the offload-time ids (the allocator
+        hands out whatever is free); pad rows land in null block 0."""
+        n = host_k.shape[2]
+        assert len(block_ids) <= n, (len(block_ids), n)
+        ids = np.zeros(n, np.int32)
+        ids[:len(block_ids)] = block_ids
+        kp, vp = self._restore_jit(n)(self.k_pages, self.v_pages,
+                                      jnp.asarray(ids),
+                                      jnp.asarray(host_k),
+                                      jnp.asarray(host_v))
+        self.update(kp, vp)
+
+    def host_bytes(self, n_blocks: int) -> int:
+        """Host bytes one offloaded stash of n_blocks occupies (padded)."""
+        per = (2 * self.num_layers * self.num_kv_heads * self.block_size
+               * self.head_dim * jnp.dtype(self.dtype).itemsize)
+        return self._bucket(max(n_blocks, 1)) * per

@@ -25,6 +25,9 @@ class DSStateManager:
         self.block_size = kv_cache.block_size
         self._allocator = BlockedAllocator(kv_cache.num_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        # uid -> (descriptor, host_k, host_v): sequences whose KV is
+        # stashed in host RAM (preemption under KV pressure)
+        self._offloaded: Dict[int, tuple] = {}
 
     # -- queries (reference ragged_manager.py properties) -------------------
     @property
@@ -62,7 +65,38 @@ class DSStateManager:
 
     def flush_sequence(self, uid: int) -> None:
         """Free a sequence's blocks and forget it (reference
-        ``engine_v2.py:228`` flush)."""
+        ``engine_v2.py:228`` flush). Also drops any host stash."""
         seq = self._seqs.pop(uid, None)
         if seq is not None and seq.blocks:
             self._allocator.free(seq.blocks)
+        self._offloaded.pop(uid, None)
+
+    # -- host offload / restore (working form of the reference's stubbed
+    #    kv_cache.py:169,179 offload/restore) ---------------------------
+    def is_offloaded(self, uid: int) -> bool:
+        return uid in self._offloaded
+
+    def offload_sequence(self, uid: int) -> None:
+        """Page a live sequence's KV blocks to host RAM and free them on
+        device; the descriptor (seen_tokens, block count) rides along so
+        ``restore_sequence`` resumes decoding without re-prefill."""
+        seq = self._seqs.pop(uid)
+        host_k, host_v = self.kv_cache.offload(seq.blocks)
+        self._allocator.free(seq.blocks)
+        self._offloaded[uid] = (seq, host_k, host_v)
+
+    def can_restore(self, uid: int, headroom: int = 0) -> bool:
+        """``headroom`` extra free blocks demanded beyond the restore
+        itself — the scheduler's anti-thrash guard (restoring into a pool
+        with zero slack would re-preempt on the next block boundary)."""
+        seq, _, _ = self._offloaded[uid]
+        return len(seq.blocks) + headroom <= self.free_blocks
+
+    def restore_sequence(self, uid: int) -> None:
+        """Re-place an offloaded sequence's KV into freshly-allocated
+        blocks (ids generally differ from offload time)."""
+        seq, host_k, host_v = self._offloaded.pop(uid)
+        fresh = self._allocator.allocate(len(seq.blocks))
+        self.kv_cache.restore(host_k, host_v, fresh)
+        seq.blocks = fresh
+        self._seqs[uid] = seq

@@ -39,9 +39,16 @@ class Request:
 class ContinuousBatchingScheduler:
 
     def __init__(self, engine, token_budget: Optional[int] = None, seed: int = 0,
-                 max_prefills_per_wave: Optional[int] = None):
+                 max_prefills_per_wave: Optional[int] = None,
+                 kv_host_offload: bool = True):
         self.engine = engine
         self.token_budget = token_budget or engine.config.state_manager.max_ragged_batch_size
+        # preemption stashes KV to host RAM (engine.offload_sequence) and
+        # resumes by restore — no re-prefill. False restores the old
+        # flush-and-recompute behavior.
+        self.kv_host_offload = (kv_host_offload
+                                and hasattr(engine, "offload_sequence"))
+        self._offloaded: List[Request] = []
         # Arrival-mode serving sets max_prefills_per_wave=1: each wave is
         # then one of THREE canonical shapes (pure prefill, prefill+decodes,
         # decode burst), all compiled during warmup — unlimited packing
@@ -71,7 +78,7 @@ class ContinuousBatchingScheduler:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue or self._running)
+        return bool(self._queue or self._running or self._offloaded)
 
     def _sample(self, req: Request, logits: np.ndarray) -> int:
         if req.temperature <= 0.0:
@@ -86,10 +93,26 @@ class ContinuousBatchingScheduler:
         self.engine.flush(req.uid)
 
     def _preempt(self, req: Request) -> None:
-        """KV pressure: drop the sequence's cache and requeue it for
-        re-prefill (prompt + everything generated so far), continuing
-        generation afterwards — the flush-and-recompute preemption the
-        reference leaves to the serving layer."""
+        """KV pressure. Preferred path: page the sequence's KV blocks to
+        host RAM (BlockedKVCache.offload — the capability the reference
+        stubs at kv_cache.py:169) and resume later with one H2D scatter.
+        Fallback (kv_host_offload=False): drop the cache and requeue for
+        re-prefill of prompt + everything generated so far."""
+        if self.kv_host_offload:
+            max_ctx = getattr(self.engine, "max_context", None)
+            ctx = len(req.prompt) + len(req.generated) - req.folded
+            if max_ctx is not None and ctx + 1 >= max_ctx:
+                # context capacity reached: offloading would thrash a full
+                # D2H+H2D of the KV every step with no way to ever decode
+                # another token — end generation (same terminal rule as the
+                # flush path below)
+                self._finish(req)
+                self._running.remove(req)
+                return
+            self.engine.offload_sequence(req.uid)
+            self._running.remove(req)
+            self._offloaded.append(req)
+            return
         self.engine.flush(req.uid)
         self._running.remove(req)
         # fold only the not-yet-folded tail: a second preemption must not
@@ -105,6 +128,21 @@ class ContinuousBatchingScheduler:
             req.done = True
             return
         self._queue.insert(0, req)
+
+    def _restore_offloaded(self) -> int:
+        """Re-place stashed sequences whose KV fits again; returns how
+        many. Headroom 1 block prevents restore->preempt thrash; when
+        nothing else holds blocks, restore unconditionally (no one to
+        wait for)."""
+        n = 0
+        for req in list(self._offloaded):
+            headroom = 1 if (self._running or self._queue) else 0
+            if self.engine.can_restore(req.uid, headroom=headroom):
+                self.engine.restore_sequence(req.uid)
+                self._offloaded.remove(req)
+                self._running.append(req)
+                n += 1
+        return n
 
     # -- one engine step ----------------------------------------------------
     def _try_decode_burst(self) -> int:
@@ -161,7 +199,7 @@ class ContinuousBatchingScheduler:
                     break
         return len(reqs) * k
 
-    def step(self) -> int:
+    def step(self, _retry: bool = True) -> int:
         """Run one SplitFuse-composed forward; returns tokens processed.
         ``DSTPU_SCHED_LOG=1`` prints one line per wave (kind, per-request
         token counts, wall ms) — the serving analog of the comms logger."""
@@ -170,6 +208,9 @@ class ContinuousBatchingScheduler:
         if log:
             import time as _t
             _t0 = _t.perf_counter()
+        # restore offloaded sequences as KV pressure relents — they were
+        # running before anything queued, so they outrank new prefills
+        self._restore_offloaded()
         burst = self._try_decode_burst()
         if burst:
             if log:
@@ -215,6 +256,13 @@ class ContinuousBatchingScheduler:
             budget -= take
 
         if not uids:
+            # a preempt during decode budgeting may have just freed the
+            # blocks an offloaded sequence needs — drivers treat 0 as
+            # deadlock, so retry ONCE after a restore pass rather than
+            # abandoning restorable work (single retry: a genuinely wedged
+            # pool must still return 0)
+            if _retry and self._offloaded and self._restore_offloaded():
+                return self.step(_retry=False)
             return 0
 
         logits = self.engine.put(uids, tokens)

@@ -22,7 +22,8 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def child(n_requests: int, budget: int, max_new: int = 64) -> None:
+def child(n_requests: int, budget: int, max_new: int = 64,
+          kv_dtype=None) -> None:
     from bench import PEAK_TFLOPS, bench_serving
     from deepspeed_tpu.utils.synth_checkpoint import synthesize_hf_checkpoint
     import jax
@@ -31,38 +32,46 @@ def child(n_requests: int, budget: int, max_new: int = 64) -> None:
     path = synthesize_hf_checkpoint(
         "llama2-7b", os.path.join(root, ".synth_ckpts", "llama2-7b"))
     stagger = float(os.environ.get("DSTPU_STAGGER_S", "0.6"))
+    kd = f" kv={kv_dtype}" if kv_dtype else ""
     line = bench_serving(
         None, n_requests=n_requests, prompt_len=512, max_new=max_new,
         token_budget=budget, peak_tflops=peak, model_path=path,
-        quantization="int4", label=f"frontier n={n_requests} b={budget}, ",
-        stagger_s=stagger, decode_burst=8 if stagger > 0 else None)
+        quantization="int4",
+        label=f"frontier n={n_requests} b={budget}{kd}, ",
+        stagger_s=stagger, decode_burst=8 if stagger > 0 else None,
+        kv_dtype=kv_dtype)
     print(json.dumps(line), flush=True)
 
 
 def main():
     if "--child" in sys.argv:
         i = sys.argv.index("--child")
-        child(int(sys.argv[i + 1]), int(sys.argv[i + 2]))
+        kd = sys.argv[i + 3] if len(sys.argv) > i + 3 else ""
+        child(int(sys.argv[i + 1]), int(sys.argv[i + 2]),
+              kv_dtype=kd or None)
         return
 
-    points = [
-        (4, 1024, {}),
-        (6, 1024, {}),
-        (8, 1024, {}),
-        # 16-req bisect: vary one knob at a time
-        (16, 1024, {}),                                  # full config
-        (16, 1024, {"DSTPU_PUT_CHUNK_BYTES": str(1 << 29)}),  # smaller slabs
-        (16, 512, {}),                                   # halved budget
+    # r5: fp8 KV halves the pool vs bf16 — the r4 24-request wall was a
+    # KV-pool compile OOM at ~7.3 GiB, so the fp8 points probe PAST it
+    points = [(int(n), 1024, {}, kd) for n, kd in (
+        (16, ""), (16, "fp8"), (24, "fp8"), (32, "fp8"), (24, ""),
+    )] if os.environ.get("DSTPU_FRONTIER_R5", "1") == "1" else [
+        (4, 1024, {}, ""),
+        (6, 1024, {}, ""),
+        (8, 1024, {}, ""),
+        (16, 1024, {}, ""),
+        (16, 1024, {"DSTPU_PUT_CHUNK_BYTES": str(1 << 29)}, ""),
+        (16, 512, {}, ""),
     ]
-    for n, budget, env_extra in points:
+    for n, budget, env_extra, kd in points:
         env = dict(os.environ, **env_extra)
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
-                 "--child", str(n), str(budget)],
+                 "--child", str(n), str(budget), kd],
                 capture_output=True, text=True, timeout=2400, env=env)
         except subprocess.TimeoutExpired as e:
-            print(json.dumps({"point": [n, budget, env_extra],
+            print(json.dumps({"point": [n, budget, kd or "bf16", env_extra],
                               "error": f"timeout; tail: {str(e.stdout)[-200:]}"}),
                   flush=True)
             continue
@@ -75,11 +84,11 @@ def main():
             except json.JSONDecodeError:
                 continue
         if got is None:
-            print(json.dumps({"point": [n, budget, env_extra],
+            print(json.dumps({"point": [n, budget, kd or "bf16", env_extra],
                               "error": (r.stderr or r.stdout or "")[-400:]}),
                   flush=True)
         else:
-            got["point"] = [n, budget, env_extra]
+            got["point"] = [n, budget, kd or "bf16", env_extra]
             print(json.dumps(got), flush=True)
 
 

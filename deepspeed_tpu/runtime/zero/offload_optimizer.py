@@ -101,25 +101,55 @@ class OffloadedOptimizerRunner:
         ``last_stall_s``/``last_compute_s`` so callers can report the
         paging-stall fraction (time blocked on NVMe fences / step time —
         what the pipelined swapper exists to drive toward zero)."""
+        for _ in self.step_iter(grads, lr):
+            pass
+        return self.master
+
+    def step_iter(self, grads: List[np.ndarray], lr: Optional[float] = None):
+        """Generator form of :meth:`step`: yields ``(i, master_i)`` as each
+        chunk's update lands, so the caller can begin the H2D param push of
+        completed chunks WHILE later chunks are still paging/stepping (the
+        reference's overlap of optimizer work with adjacent phases,
+        stage_1_and_2.py:1005 — here host compute overlaps device upload)."""
         import time
         assert len(grads) == len(self.master)
         self.step_count += 1
-        t0 = time.perf_counter()
+        # last_compute_s accumulates ONLY this generator's own work
+        # segments — consumer time between yields (the engine's H2D pushes)
+        # must not inflate "host optimizer wall time", or stall_frac =
+        # stall/compute deflates in the flattering direction
+        self.last_compute_s = 0.0
+        self.last_stall_s = 0.0
+        seg = time.perf_counter()
         flat_grads = [np.ascontiguousarray(g, np.float32).reshape(-1) for g in grads]
         if self._swapper is None:
             for i, g in enumerate(flat_grads):
                 self._apply(i, g, self._state[i], lr, self.step_count)
-            self.last_stall_s = 0.0
+                self.last_compute_s += time.perf_counter() - seg
+                yield i, self.master[i]
+                seg = time.perf_counter()
         else:
             self._swapper.take_stall()  # reset
             keys = [self._key(i) for i in range(len(self.master))]
-            for i, (key, buf) in enumerate(
-                    self._swapper.swap_groups(keys, self._buffers)):
+            it = self._swapper.swap_groups(keys, self._buffers)
+            i = 0
+            while True:
+                try:
+                    key, buf = next(it)
+                except StopIteration:
+                    # swap_groups' exhaustion path fences the tail
+                    # write-backs (finish_writes) — that stall belongs to
+                    # THIS step, not the next one's reset
+                    self.last_stall_s += self._swapper.take_stall()
+                    self.last_compute_s += time.perf_counter() - seg
+                    break
                 n = self._slots * self.master[i].size
                 self._apply(i, flat_grads[i], buf[:n], lr, self.step_count)
-            self.last_stall_s = self._swapper.take_stall()
-        self.last_compute_s = time.perf_counter() - t0
-        return self.master
+                self.last_stall_s += self._swapper.take_stall()
+                self.last_compute_s += time.perf_counter() - seg
+                yield i, self.master[i]
+                seg = time.perf_counter()
+                i += 1
 
     # -- checkpoint support --------------------------------------------------
     def state_dict(self) -> Dict:

@@ -26,11 +26,15 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SEQS = (2048, 4096, 8192)
-PATHS = ("xla", "flash")
+# r5: "flash" now auto-takes the GQA-native splash kernel for grouped-query
+# models; "repeat" pins the old broadcast-K/V stock kernel for the A/B
+PATHS = ("xla", "flash", "repeat")
 
 
 def run_single(seq: int, path: str, offload: bool) -> None:
-    os.environ["DSTPU_PALLAS_FLASH"] = "1" if path == "flash" else "0"
+    os.environ["DSTPU_PALLAS_FLASH"] = "0" if path == "xla" else "1"
+    if path == "repeat":
+        os.environ["DSTPU_SPLASH"] = "0"
     import time
 
     import jax

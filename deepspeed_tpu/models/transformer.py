@@ -517,7 +517,16 @@ class TransformerLM:
         alternating = c.remat and c.remat_policy == "alternating"
         if c.remat and not alternating:
             policy = None
-            if c.remat_policy and c.remat_policy not in ("full", "nothing_saveable"):
+            if c.remat_policy == "attention_only":
+                # recompute ONLY the [B, H, S, S] attention buffers (named
+                # "attn_big" in ops/transformer/attention.py) — ~1% extra
+                # FLOPs instead of full remat's 33%, while removing exactly
+                # the buffers whose no-remat residuals blow compile memory
+                # at bert/gpt2 bench dims
+                policy = jax.checkpoint_policies \
+                    .save_anything_except_these_names("attn_big")
+            elif c.remat_policy and c.remat_policy not in ("full",
+                                                           "nothing_saveable"):
                 policy = getattr(jax.checkpoint_policies, c.remat_policy)
             block_fn = jax.checkpoint(block_fn, policy=policy)
 

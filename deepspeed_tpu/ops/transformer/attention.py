@@ -15,6 +15,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 
 def alibi_slopes(num_heads: int):
@@ -69,6 +70,10 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     vt = v.transpose(0, 2, 1, 3)
     logits = jnp.einsum("bhgqd,bhkd->bhgqk", qt, kt,
                         preferred_element_type=jnp.float32) * scale
+    # named for the attention-only remat policy (models/transformer.py
+    # "attention_only"): the [B, H, Sq, Sk] buffers are the ONLY tensors
+    # recomputed in backward — everything else is saved
+    logits = checkpoint_name(logits, "attn_big")
     q_pos = jnp.arange(Sq)[:, None] + (k_len - Sq)
     k_pos = jnp.arange(k_len)[None, :]
     if alibi is not None:
@@ -87,6 +92,7 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
         logits = jnp.where(seg_mask[:, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = checkpoint_name(probs, "attn_big")
     out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, vt)
     return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
 

@@ -335,7 +335,7 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
     }
 
 
-N_TPU_RUNS = 9  # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 10  # build_runs(on_tpu=True) length — asserted in child mode
 
 
 def _probe_backend() -> str:
@@ -671,6 +671,25 @@ def _run_configs():
                                    + " | ".join(diags))
             return line
         runs.append(serving_7b_run)
+
+        def serving_moe_run():
+            # MoE SERVING (VERDICT r4 next #6): a mixtral-architecture
+            # model (8 experts, top-2, gated-SiLU, GQA) scaled to one
+            # chip's HBM, served through the ragged continuous-batching
+            # engine under the arrival protocol with SLA accounting —
+            # reference: cutlass MoE GEMM + top_k_gating ragged path
+            # (inference/v2/kernels/ragged_ops/ragged_ops.cpp:20-47).
+            return bench_serving(
+                mixtral_model("mixtral-8x7b", dtype=jnp.bfloat16,
+                              remat=False, num_layers=8, hidden_size=1024,
+                              intermediate_size=3584, num_heads=16,
+                              num_kv_heads=4, max_seq_len=1024,
+                              vocab_size=32000),
+                n_requests=6, prompt_len=512, max_new=64,
+                token_budget=1024, peak_tflops=peak,
+                label="mixtral-arch 8e top2 scaled MoE, ",
+                stagger_s=0.6, decode_burst=8)
+        runs.append(serving_moe_run)
     else:  # smoke path for hosts without a chip
         runs.append(lambda: bench_train(
             "gpt2-tiny ZeRO-1 cpu-smoke",

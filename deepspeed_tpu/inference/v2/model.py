@@ -68,6 +68,20 @@ class RaggedInferenceModel:
         # program (forces the XLA path; the stock Pallas kernel has no bias)
         self._alibi = (jnp.asarray(model._alibi_slopes)
                        if model._alibi_slopes is not None else None)
+        # MoE serving routes DROPLESS: capacity_factor = num_experts makes
+        # capacity == token count, so no token is ever dropped — the
+        # training path's capacity cropping is a throughput/regularization
+        # trade that would make generation depend on how requests are
+        # batched (and diverge from HF/reference inference semantics; the
+        # reference's inference top_k_gating is dropless,
+        # ragged_ops.cpp:20-47)
+        if c.moe is not None:
+            import dataclasses as _dc
+            self._moe_serve = _dc.replace(
+                model._moe, capacity_factor=float(c.moe.num_experts),
+                min_capacity=1)
+        else:
+            self._moe_serve = None
 
     # -- shared pieces ------------------------------------------------------
     def _embed(self, params: Params, tokens: jax.Array, positions: jax.Array) -> jax.Array:
@@ -109,7 +123,7 @@ class RaggedInferenceModel:
         """MLP over the PRE-NORMED input h."""
         c, m = self.config, self.model
         if c.moe is not None:
-            out, _ = m._moe(block["moe"], h[None, :, :])
+            out, _ = self._moe_serve(block["moe"], h[None, :, :])
             return out[0]
         if c.activation == "silu_gated":
             gate = nn.silu(m._block_layers["gate_proj"](block["gate_proj"], h))

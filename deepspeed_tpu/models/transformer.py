@@ -522,7 +522,12 @@ class TransformerLM:
                 # "attn_big" in ops/transformer/attention.py) — ~1% extra
                 # FLOPs instead of full remat's 33%, while removing exactly
                 # the buffers whose no-remat residuals blow compile memory
-                # at bert/gpt2 bench dims
+                # at bert/gpt2 bench dims. NOTE: only the XLA attention
+                # path names those tensors; under a Pallas kernel path
+                # (which never materializes S^2 buffers in the first
+                # place) this policy degrades to save-everything — i.e.
+                # no-remat memory minus the scores, which is the
+                # analogous behavior, not a blowup.
                 policy = jax.checkpoint_policies \
                     .save_anything_except_these_names("attn_big")
             elif c.remat_policy and c.remat_policy not in ("full",

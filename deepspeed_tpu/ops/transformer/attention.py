@@ -49,7 +49,9 @@ def sliding_window_allowed(q_pos: jax.Array, k_pos: jax.Array,
 def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
                    scale: Optional[float], segment_ids: Optional[jax.Array],
                    alibi: Optional[jax.Array] = None,
-                   window: Optional[jax.Array] = None) -> jax.Array:
+                   window: Optional[jax.Array] = None,
+                   q_offset: Optional[jax.Array] = None,
+                   q_segment_ids: Optional[jax.Array] = None) -> jax.Array:
     """Reference-semantics attention in pure XLA, GQA-NATIVE: K/V keep
     their kv_heads — query heads are grouped for the contractions, so
     grouped-query models never materialize a repeated KV.
@@ -74,7 +76,11 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     # "attention_only"): the [B, H, Sq, Sk] buffers are the ONLY tensors
     # recomputed in backward — everything else is saved
     logits = checkpoint_name(logits, "attn_big")
-    q_pos = jnp.arange(Sq)[:, None] + (k_len - Sq)
+    # q_offset: absolute position of q row 0 (the chunked path passes the
+    # chunk's start); default = bottom-right alignment for Sq < k_len
+    if q_offset is None:
+        q_offset = k_len - Sq
+    q_pos = jnp.arange(Sq)[:, None] + q_offset
     k_pos = jnp.arange(k_len)[None, :]
     if alibi is not None:
         # bias = slope * (key_pos - query_pos): row-shifted form of HF
@@ -89,12 +95,70 @@ def _xla_attention(q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
             mask = mask & sliding_window_allowed(q_pos, k_pos, window)
         logits = jnp.where(mask[None, None, None], logits, -1e30)
     if segment_ids is not None:
-        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        q_seg = q_segment_ids if q_segment_ids is not None else segment_ids
+        seg_mask = q_seg[:, :, None] == segment_ids[:, None, :]
         logits = jnp.where(seg_mask[:, None, None], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     probs = checkpoint_name(probs, "attn_big")
     out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, vt)
     return out.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+
+
+def _xla_attention_chunked(q: jax.Array, k: jax.Array, v: jax.Array,
+                           causal: bool, scale: Optional[float],
+                           segment_ids: Optional[jax.Array],
+                           alibi: Optional[jax.Array] = None,
+                           window: Optional[jax.Array] = None,
+                           chunk: int = 1024) -> jax.Array:
+    """Query-chunked XLA attention: the long-context path.
+
+    Identical math to :func:`_xla_attention`, but a ``lax.scan`` over
+    query chunks bounds the materialized scores to [B, H, chunk, S_k]
+    instead of [B, H, S, S] — the buffer that makes plain XLA a compile
+    OOM at seq >= 4096 full depth. Keeps XLA's fused-matmul attention
+    speed (measured +24% over the Pallas flash kernel at 2k, r4), paying
+    masked-out key flops instead of kernel inefficiency: measured 4k/8k
+    full-depth (tools/longseq_ab.py r5), chunked-XLA beats both the
+    stock flash kernel and splash at micro-batch 1.
+    """
+    B, Sq, H, D = q.shape
+    if Sq % chunk:
+        # keep the memory bound: shrink to the largest divisor of Sq
+        # rather than silently re-materializing the full [B, H, S, S]
+        # buffer this path exists to avoid
+        c = chunk
+        while c > 1 and Sq % c:
+            c -= 1
+        chunk = c
+        if chunk < 128:  # degenerate (prime-ish Sq): one-shot is honest
+            return _xla_attention(q, k, v, causal, scale, segment_ids,
+                                  alibi, window)
+    nc = Sq // chunk
+    qc = q.reshape(B, nc, chunk, H, D).transpose(1, 0, 2, 3, 4)
+    sq_c = None
+    if segment_ids is not None:
+        sq_c = (segment_ids.reshape(B, nc, chunk)
+                .transpose(1, 0, 2))  # [nc, B, chunk]
+    # bottom-right causal alignment, same contract as _xla_attention:
+    # q row 0 sits at absolute position k_len - Sq
+    offsets = (k.shape[1] - Sq) + jnp.arange(nc, dtype=jnp.int32) * chunk
+
+    if segment_ids is not None:
+        def body(_, args):
+            qi, off, sqi = args
+            return None, _xla_attention(qi, k, v, causal, scale,
+                                        segment_ids, alibi, window,
+                                        q_offset=off, q_segment_ids=sqi)
+        xs = (qc, offsets, sq_c)
+    else:
+        def body(_, args):
+            qi, off = args
+            return None, _xla_attention(qi, k, v, causal, scale, None,
+                                        alibi, window, q_offset=off)
+        xs = (qc, offsets)
+    _, outs = jax.lax.scan(body, None, xs)
+    # outs [nc, B, chunk, H, D] -> [B, Sq, H, D]
+    return outs.transpose(1, 0, 2, 3, 4).reshape(B, Sq, H, D)
 
 
 @functools.lru_cache(None)
@@ -197,6 +261,17 @@ def flash_attention(q: jax.Array,
     ``window`` (0 = global) is the causal sliding window — XLA path only.
     """
     head_dim = q.shape[-1]
+    # Long-seq default (r5, tools/longseq_ab.py): query-chunked XLA — the
+    # XLA attention path's speed with bounded score memory. The Pallas
+    # kernels remain selectable: DSTPU_PALLAS_FLASH=1 forces them;
+    # DSTPU_LONGSEQ_ATTN=pallas routes long-seq to them.
+    if (q.shape[1] >= FLASH_DEFAULT_MIN_SEQ
+            and os.environ.get("DSTPU_PALLAS_FLASH", "") != "1"
+            and os.environ.get("DSTPU_LONGSEQ_ATTN", "chunked") == "chunked"
+            and jax.default_backend() != "cpu"):
+        _log_path_once("xla_chunked")
+        return _xla_attention_chunked(q, k, v, causal, scale, segment_ids,
+                                      alibi_slopes, window)
     # head_dim 64 (gpt2) is supported by the stock kernel — Mosaic pads the
     # lane dim; requiring %128 hid the Pallas path from the benched model
     if (_pallas_flash_available(q.shape[1]) and segment_ids is None

@@ -141,6 +141,55 @@ class TestSplitFuseBatching:
             np.testing.assert_array_equal(got, want[:len(got)])
 
 
+class TestMoEServing:
+    """MoE models through the ragged continuous-batching engine (VERDICT
+    r4 next #6: mixtral routes through inference/v2/model.py but no MoE
+    model had serving coverage)."""
+
+    def test_mixtral_prefill_matches_dropless_forward(self):
+        """Serving routes DROPLESS (capacity == tokens): generation must
+        not depend on how requests are batched. The reference is the same
+        weights applied through a dropless-configured model — the training
+        path's capacity cropping (cf=1.25) is a different, batch-shape-
+        dependent function."""
+        import dataclasses
+        from deepspeed_tpu.models import mixtral_model
+        m = mixtral_model("mixtral-tiny", dtype=jnp.float32, remat=False,
+                          max_seq_len=64)
+        eng = InferenceEngineV2(m, config=tiny_config())
+        rng = np.random.default_rng(21)
+        toks = rng.integers(0, m.config.vocab_size, size=23)
+        out = eng.put([81], [toks])
+        m_dropless = mixtral_model(
+            "mixtral-tiny", dtype=jnp.float32, remat=False, max_seq_len=64,
+            moe=dataclasses.replace(m.config.moe,
+                                    capacity_factor=float(
+                                        m.config.moe.num_experts),
+                                    min_capacity=1))
+        logits, _ = jax.jit(m_dropless.apply)(eng.params,
+                                              jnp.asarray(toks)[None, :])
+        ref = np.asarray(logits[0])[-1]
+        np.testing.assert_allclose(out[0], ref, rtol=2e-4, atol=2e-4)
+        eng.flush(81)
+
+    def test_mixtral_continuous_batching_decode(self):
+        from deepspeed_tpu.models import mixtral_model
+        m = mixtral_model("mixtral-tiny", dtype=jnp.float32, remat=False,
+                          max_seq_len=64)
+        eng = InferenceEngineV2(m, config=tiny_config())
+        rng = np.random.default_rng(22)
+        prompts = [rng.integers(0, m.config.vocab_size, size=n)
+                   for n in (7, 12, 9)]
+        outs = generate(eng, prompts, max_new_tokens=6)
+        assert all(len(o) == 6 for o in outs), outs
+        # each sequence's continuation must match its solo greedy run
+        for p, got in zip(prompts, outs):
+            eng2 = InferenceEngineV2(m, config=tiny_config())
+            eng2.params = eng.params
+            solo = generate(eng2, [p], max_new_tokens=6)[0]
+            np.testing.assert_array_equal(got, solo)
+
+
 class TestFP8KVCache:
 
     def test_fp8_kv_close_to_f32(self):

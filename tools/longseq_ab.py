@@ -26,13 +26,21 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SEQS = (2048, 4096, 8192)
-# r5: "flash" now auto-takes the GQA-native splash kernel for grouped-query
-# models; "repeat" pins the old broadcast-K/V stock kernel for the A/B
-PATHS = ("xla", "flash", "repeat")
+# r5: "flash" = GQA-native splash kernel; "repeat" = old broadcast-K/V
+# stock kernel; "chunked" = query-chunked XLA (the r5 default long-seq path)
+PATHS = ("xla", "flash", "repeat", "chunked")
 
 
-def run_single(seq: int, path: str, offload: bool) -> None:
-    os.environ["DSTPU_PALLAS_FLASH"] = "0" if path == "xla" else "1"
+def run_single(seq: int, path: str, offload: bool, micro: int = 1) -> None:
+    if path == "chunked":
+        os.environ.pop("DSTPU_PALLAS_FLASH", None)
+        os.environ["DSTPU_LONGSEQ_ATTN"] = "chunked"
+    else:
+        os.environ["DSTPU_PALLAS_FLASH"] = "0" if path == "xla" else "1"
+        # 'xla' must measure the PLAIN one-shot path (its compile-OOM at
+        # 4k+ is a documented datapoint) — without this the router's
+        # chunked default would silently substitute at seq >= 4096
+        os.environ["DSTPU_LONGSEQ_ATTN"] = "off"
     if path == "repeat":
         os.environ["DSTPU_SPLASH"] = "0"
     import time
@@ -49,13 +57,14 @@ def run_single(seq: int, path: str, offload: bool) -> None:
     def sync(x):
         return float(jax.device_get(jnp.ravel(x)[0]))
 
-    name = f"{seq}/{path}" + ("/offload" if offload else "")
+    name = f"{seq}/{path}" + ("/offload" if offload else "") + \
+        (f"/micro{micro}" if micro != 1 else "")
     try:
         topo_mod.reset()
         model = llama_model("tinyllama-1.1b", dtype=jnp.bfloat16, remat=True,
                             max_seq_len=seq)
         cfg = {
-            "train_micro_batch_size_per_gpu": 1,
+            "train_micro_batch_size_per_gpu": micro,
             "optimizer": {"type": "adamw",
                           "params": {"lr": 1e-4, "weight_decay": 0.01}},
             "bf16": {"enabled": True},
@@ -72,7 +81,7 @@ def run_single(seq: int, path: str, offload: bool) -> None:
             cfg["data_types"]["optimizer_moment_dtype"] = "bf16"
         engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
         batch = {"input_ids": np.random.default_rng(0).integers(
-            0, model.config.vocab_size, size=(1, seq))}
+            0, model.config.vocab_size, size=(micro, seq))}
         first = sync(engine.train_batch(batch))  # compile + settle
         sync(engine.train_batch(batch))
     except Exception as e:  # noqa: BLE001 — an OOM here is the datapoint
@@ -92,7 +101,7 @@ def run_single(seq: int, path: str, offload: bool) -> None:
         best = min(best, time.perf_counter() - t0)
     kind = jax.devices()[0].device_kind
     peak = PEAK_TFLOPS.get(kind)
-    tok_s = seq * steps / best
+    tok_s = micro * seq * steps / best
     ach = tok_s * _flops_per_token(model.config, seq) / 1e12
     print(json.dumps({
         "variant": name, "best_window_s": round(best, 3),
@@ -109,8 +118,11 @@ def run_single(seq: int, path: str, offload: bool) -> None:
 def main():
     if "--single" in sys.argv:
         i = sys.argv.index("--single")
+        micro = 1
+        if "--micro" in sys.argv:
+            micro = int(sys.argv[sys.argv.index("--micro") + 1])
         run_single(int(sys.argv[i + 1]), sys.argv[i + 2],
-                   "--offload" in sys.argv)
+                   "--offload" in sys.argv, micro=micro)
         return
     from ab_common import run_interleaved
     variants = [f"{s}/{p}" for s in SEQS for p in PATHS]

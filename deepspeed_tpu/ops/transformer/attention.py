@@ -143,22 +143,43 @@ def _xla_attention_chunked(q: jax.Array, k: jax.Array, v: jax.Array,
     # q row 0 sits at absolute position k_len - Sq
     offsets = (k.shape[1] - Sq) + jnp.arange(nc, dtype=jnp.int32) * chunk
 
-    if segment_ids is not None:
-        def body(_, args):
-            qi, off, sqi = args
-            return None, _xla_attention(qi, k, v, causal, scale,
-                                        segment_ids, alibi, window,
-                                        q_offset=off, q_segment_ids=sqi)
-        xs = (qc, offsets, sq_c)
+    unroll = os.environ.get("DSTPU_CHUNK_UNROLL", "1") == "1"
+    if unroll:
+        # UNROLLED chunk loop (default): a lax.scan here nests inside the
+        # model's layer scan + remat, which crashes this environment's
+        # remote compile helper (HTTP 500) at 4k full depth; the unrolled
+        # form is the same program repeated nc times and compiles. Bonus:
+        # offsets are static, so each causal chunk STATICALLY slices K/V
+        # to its visible prefix — the flash-style flop skip (half the
+        # attention flops on average), no kernel needed.
+        base = k.shape[1] - Sq
+        outs = []
+        for i in range(nc):
+            off = base + i * chunk
+            end = off + chunk if causal else k.shape[1]
+            outs.append(_xla_attention(
+                qc[i], k[:, :end], v[:, :end], causal, scale,
+                segment_ids[:, :end] if segment_ids is not None else None,
+                alibi, window, q_offset=off,
+                q_segment_ids=(sq_c[i] if sq_c is not None else None)))
+        out = jnp.stack(outs)
     else:
-        def body(_, args):
-            qi, off = args
-            return None, _xla_attention(qi, k, v, causal, scale, None,
-                                        alibi, window, q_offset=off)
-        xs = (qc, offsets)
-    _, outs = jax.lax.scan(body, None, xs)
-    # outs [nc, B, chunk, H, D] -> [B, Sq, H, D]
-    return outs.transpose(1, 0, 2, 3, 4).reshape(B, Sq, H, D)
+        if segment_ids is not None:
+            def body(_, args):
+                qi, off, sqi = args
+                return None, _xla_attention(qi, k, v, causal, scale,
+                                            segment_ids, alibi, window,
+                                            q_offset=off, q_segment_ids=sqi)
+            xs = (qc, offsets, sq_c)
+        else:
+            def body(_, args):
+                qi, off = args
+                return None, _xla_attention(qi, k, v, causal, scale, None,
+                                            alibi, window, q_offset=off)
+            xs = (qc, offsets)
+        _, out = jax.lax.scan(body, None, xs)
+    # [nc, B, chunk, H, D] -> [B, Sq, H, D]
+    return out.transpose(1, 0, 2, 3, 4).reshape(B, Sq, H, D)
 
 
 @functools.lru_cache(None)

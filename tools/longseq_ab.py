@@ -125,7 +125,10 @@ def main():
                    "--offload" in sys.argv, micro=micro)
         return
     from ab_common import run_interleaved
-    variants = [f"{s}/{p}" for s in SEQS for p in PATHS]
+    # "chunked" only routes at seq >= 4096 (FLASH_DEFAULT_MIN_SEQ); below
+    # that it would silently duplicate the plain-xla datapoint
+    variants = [f"{s}/{p}" for s in SEQS for p in PATHS
+                if not (p == "chunked" and s < 4096)]
 
     def mk_cmd(name):
         seq, path = name.split("/")

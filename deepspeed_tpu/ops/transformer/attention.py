@@ -122,6 +122,20 @@ def _xla_attention_chunked(q: jax.Array, k: jax.Array, v: jax.Array,
     stock flash kernel and splash at micro-batch 1.
     """
     B, Sq, H, D = q.shape
+    # Auto-size the chunk so the per-chunk fp32 score transient
+    # [B, H, chunk, S_k] stays under ~512 MB — larger transients crash
+    # this environment's remote compile helper at 8k/micro>1 (measured:
+    # 1 GB per-chunk scores 500s, 512 MB compiles). DSTPU_CHUNK_Q
+    # overrides.
+    env_chunk = os.environ.get("DSTPU_CHUNK_Q")
+    if env_chunk:
+        chunk = int(env_chunk)
+    else:
+        budget = 512 * 1024 * 1024
+        per_row = H * k.shape[1] * 4  # fp32 logits bytes per (b, q-row)
+        cap = max(128, budget // max(B * per_row, 1))
+        while chunk > cap:
+            chunk //= 2
     if Sq % chunk:
         # keep the memory bound: shrink to the largest divisor of Sq
         # rather than silently re-materializing the full [B, H, S, S]

@@ -31,7 +31,8 @@ SEQS = (2048, 4096, 8192)
 PATHS = ("xla", "flash", "repeat", "chunked")
 
 
-def run_single(seq: int, path: str, offload: bool, micro: int = 1) -> None:
+def run_single(seq: int, path: str, offload: bool, micro: int = 1,
+               remat: str = "full") -> None:
     if path == "chunked":
         os.environ.pop("DSTPU_PALLAS_FLASH", None)
         os.environ["DSTPU_LONGSEQ_ATTN"] = "chunked"
@@ -58,11 +59,14 @@ def run_single(seq: int, path: str, offload: bool, micro: int = 1) -> None:
         return float(jax.device_get(jnp.ravel(x)[0]))
 
     name = f"{seq}/{path}" + ("/offload" if offload else "") + \
-        (f"/micro{micro}" if micro != 1 else "")
+        (f"/micro{micro}" if micro != 1 else "") + \
+        (f"/{remat}" if remat != "full" else "")
     try:
         topo_mod.reset()
-        model = llama_model("tinyllama-1.1b", dtype=jnp.bfloat16, remat=True,
-                            max_seq_len=seq)
+        model = llama_model(
+            "tinyllama-1.1b", dtype=jnp.bfloat16, remat=True,
+            max_seq_len=seq,
+            **({"remat_policy": remat} if remat != "full" else {}))
         cfg = {
             "train_micro_batch_size_per_gpu": micro,
             "optimizer": {"type": "adamw",
@@ -121,8 +125,11 @@ def main():
         micro = 1
         if "--micro" in sys.argv:
             micro = int(sys.argv[sys.argv.index("--micro") + 1])
+        remat = "full"
+        if "--remat" in sys.argv:
+            remat = sys.argv[sys.argv.index("--remat") + 1]
         run_single(int(sys.argv[i + 1]), sys.argv[i + 2],
-                   "--offload" in sys.argv, micro=micro)
+                   "--offload" in sys.argv, micro=micro, remat=remat)
         return
     from ab_common import run_interleaved
     # "chunked" only routes at seq >= 4096 (FLASH_DEFAULT_MIN_SEQ); below

@@ -335,7 +335,7 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
     }
 
 
-N_TPU_RUNS = 10  # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 11  # build_runs(on_tpu=True) length — asserted in child mode
 
 
 def _probe_backend() -> str:
@@ -360,6 +360,28 @@ def _last_metric_line(stdout: str):
             continue
         if isinstance(parsed, dict) and "metric" in parsed:
             return parsed
+    return None
+
+
+def _serving_subprocess(env_extra, timeout, diags):
+    """Run tools/bench_7b_serving.py with env overrides; parse its last
+    metric line. ONE copy of the subprocess protocol for every serving
+    line (512-prompt, long-context); failures append to ``diags``."""
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "bench_7b_serving.py")
+    env = dict(os.environ, **env_extra)
+    try:
+        r = subprocess.run([sys.executable, script], timeout=timeout,
+                           capture_output=True, text=True, env=env)
+    except subprocess.TimeoutExpired as e:
+        diags.append(f"timeout after {timeout}s; partial stdout: "
+                     f"{str(e.stdout)[-200:]}")
+        return None
+    parsed = _last_metric_line(r.stdout)
+    if parsed is not None:
+        return parsed
+    diags.append(f"rc={r.returncode}: {(r.stderr or r.stdout or '')[-300:]}")
     return None
 
 
@@ -645,40 +667,34 @@ def _run_configs():
             # timeout: the weight stream + 32-layer compiles take many
             # minutes through the remote-device tunnel, and a compile-
             # helper stall must not hang the other bench lines.
-            import subprocess
-            script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                  "tools", "bench_7b_serving.py")
-
             diags = []
-
-            def attempt(env_extra, tmo):
-                env = dict(os.environ, **env_extra)
-                try:
-                    r = subprocess.run([sys.executable, script], timeout=tmo,
-                                       capture_output=True, text=True,
-                                       env=env)
-                except subprocess.TimeoutExpired as e:
-                    diags.append(f"timeout after {tmo}s; partial stdout: "
-                                 f"{str(e.stdout)[-200:]}")
-                    return None
-                parsed = _last_metric_line(r.stdout)
-                if parsed is not None:
-                    return parsed
-                diags.append(f"rc={r.returncode}: "
-                             f"{(r.stderr or r.stdout)[-300:]}")
-                return None
-
-            line = attempt({}, 2400)
+            line = _serving_subprocess({}, 2400, diags)
             if line is None:
                 # 7B stalled/failed — a fresh subprocess serves the
                 # fallback full-depth architecture so the line exists
-                line = attempt({"DSTPU_7B_SKIP": "1"}, 1200)
+                line = _serving_subprocess({"DSTPU_7B_SKIP": "1"}, 1200,
+                                           diags)
             if line is None:
                 raise RuntimeError("full-depth serving bench failed in "
                                    "both subprocess attempts: "
                                    + " | ".join(diags))
             return line
         runs.append(serving_7b_run)
+
+        def serving_longctx_run():
+            # LONG-CONTEXT serving (VERDICT r4 next #9): llama2-7b int4 +
+            # fp8 KV at 4096-token prompts — flash-style chunked prefill
+            # through the ragged engine + paged decode, TTFT/SLA per
+            # request. Own subprocess like the 512-prompt line.
+            diags = []
+            line = _serving_subprocess(
+                {"DSTPU_7B_PROMPT": "4096", "DSTPU_7B_REQS": "4",
+                 "DSTPU_7B_SKIP_FALLBACK": "1"}, 2400, diags)
+            if line is None:
+                raise RuntimeError("long-context serving bench failed: "
+                                   + " | ".join(diags))
+            return line
+        runs.append(serving_longctx_run)
 
         def serving_moe_run():
             # MoE SERVING (VERDICT r4 next #6): a mixtral-architecture

@@ -55,6 +55,16 @@ from .zero.partition import ZeroPartitionPlan
 DATA_SPEC = P(BATCH_AXES)  # batches shard their leading dim over both dp axes
 
 
+def _norm_dt(value) -> str:
+    """Normalize a data_types.* knob to the param-stream runner's
+    vocabulary, preserving unsupported values so IT rejects them loudly."""
+    if value in (None, "fp32", "float32"):
+        return "fp32"
+    if value in ("bf16", "bfloat16"):
+        return "bf16"
+    return str(value)
+
+
 class DeepSpeedEngine:
 
     def __init__(self,
@@ -306,7 +316,16 @@ class DeepSpeedEngine:
                 buffer_count=pc.buffer_count,
                 nvme_path=pc.nvme_path,
                 device=self._param_offload_device,
-                seed=seed, init_params=init_params)
+                seed=seed, init_params=init_params,
+                # the same precision knobs the device optimizer honors:
+                # bf16 moments (stochastic-rounded store) and bf16 grad
+                # accumulators halve the HOST state — what fits a 7B-dims
+                # paged train state in 125 GB RAM. Raw values pass through
+                # so the runner rejects fp16 loudly instead of a silent
+                # fp32 downgrade.
+                moment_dtype=_norm_dt(
+                    config.data_types_optimizer_moment_dtype),
+                grad_acc_dtype=_norm_dt(config.data_types_grad_accum_dtype))
             self.state = {"params": None, "opt": None,
                           "loss_scale": self._loss_scale_state()}
         else:

@@ -79,7 +79,9 @@ class ParamStreamRunner:
                  nvme_path: Optional[str] = None,
                  device: str = "cpu",
                  seed: int = 42,
-                 init_params: Optional[Any] = None):
+                 init_params: Optional[Any] = None,
+                 moment_dtype: str = "fp32",
+                 grad_acc_dtype: str = "fp32"):
         c = model.config
         if c.moe is not None:
             raise ValueError("offload_param.paged_training does not support "
@@ -165,18 +167,39 @@ class ParamStreamRunner:
             raise ValueError(f"paged_training host optimizer supports "
                              f"adam/adamw/lion/adagrad, got '{opt_type}'")
         # masters: globals flat fp32 per leaf; blocks [L, size] so layer k's
-        # slice steps independently
+        # slice steps independently. Moments/grad-accumulators can store
+        # bf16 to halve host RAM (the knob that fits a 7B-dims host state
+        # in 125 GB): moments use STOCHASTIC ROUNDING on the store (same
+        # EMA-freeze argument as runtime/optimizers._sr_to_bf16 — with
+        # beta2=0.999 the per-step v increment is below bf16 resolution),
+        # grad accumulators round deterministically (wire is bf16 anyway;
+        # exact at gas=1).
+        if moment_dtype not in ("fp32", "bf16"):
+            raise ValueError(f"moment_dtype must be fp32|bf16, got "
+                             f"{moment_dtype!r}")
+        if grad_acc_dtype not in ("fp32", "bf16"):
+            raise ValueError(f"grad_acc_dtype must be fp32|bf16, got "
+                             f"{grad_acc_dtype!r}")
+        import ml_dtypes
+        self._bf16 = np.dtype(ml_dtypes.bfloat16)
+        self._mdt = np.float32 if moment_dtype == "fp32" else self._bf16
+        self._gadt = np.float32 if grad_acc_dtype == "fp32" else self._bf16
+        # SR noise generators are PER THREAD (numpy Generators are not
+        # thread-safe; the optimizer pool runs 4 workers) — each worker
+        # spawns an independent child stream off one SeedSequence
+        self._sr_seed = np.random.SeedSequence(seed ^ 0x51AB)
+        self._sr_local = threading.local()
         self._gmaster = [np.ascontiguousarray(l, np.float32).reshape(-1)
                          for l in self._gstore]
         self._bmaster = [np.ascontiguousarray(l, np.float32)
                          .reshape(self.num_layers, -1) for l in self._bstore]
-        self._gm = [[np.zeros_like(m) for m in self._gmaster]
+        self._gm = [[np.zeros(m.shape, self._mdt) for m in self._gmaster]
                     for _ in range(self._slots)]
-        self._bm = [[np.zeros_like(m) for m in self._bmaster]
+        self._bm = [[np.zeros(m.shape, self._mdt) for m in self._bmaster]
                     for _ in range(self._slots)]
-        # fp32 gradient accumulators, zeroed after each applied step
-        self._ggrad = [np.zeros_like(m) for m in self._gmaster]
-        self._bgrad = [np.zeros_like(m) for m in self._bmaster]
+        # gradient accumulators, zeroed after each applied step
+        self._ggrad = [np.zeros(m.shape, self._gadt) for m in self._gmaster]
+        self._bgrad = [np.zeros(m.shape, self._gadt) for m in self._bmaster]
 
         # -- shardings ---------------------------------------------------
         specs = self.model.specs()
@@ -383,60 +406,97 @@ class ParamStreamRunner:
     # ------------------------------------------------------------------
     # gradient landing (IO thread)
     # ------------------------------------------------------------------
+    @staticmethod
+    def _acc_into(acc: np.ndarray, g32: np.ndarray) -> None:
+        """acc += g32 across storage dtypes (bf16 acc upcasts, adds,
+        rounds back — exact at gas=1 since the wire is bf16 anyway)."""
+        if acc.dtype == np.float32:
+            acc += g32
+        else:
+            acc[...] = (acc.astype(np.float32) + g32).astype(acc.dtype)
+
     def _land_block_grads(self, k: int, db_leaves):
         host = jax.device_get(db_leaves)
         for acc, g in zip(self._bgrad, host):
-            acc[k] += np.asarray(g, np.float32).reshape(-1)
+            self._acc_into(acc[k], np.asarray(g, np.float32).reshape(-1))
 
     def _land_global_grads(self, dg_leaves):
         host = jax.device_get(dg_leaves)
         for acc, g in zip(self._ggrad, host):
-            acc += np.asarray(g, np.float32).reshape(-1)
+            self._acc_into(acc, np.asarray(g, np.float32).reshape(-1))
 
     def _accumulated_sqnorm(self) -> float:
         """||accumulated grad||² over every unit — computed on the HOST
         after all landings so the clip norm is of the actual applied
         gradient, not a sum of per-micro norms (those differ under
-        gas > 1)."""
+        gas > 1). Row-wise so a bf16 accumulator upcasts one layer at a
+        time, never the whole stack."""
         sq = 0.0
         for acc in self._ggrad:
-            sq += float(acc @ acc)
+            a = acc.astype(np.float32) if acc.dtype != np.float32 else acc
+            sq += float(a @ a)
         for acc in self._bgrad:
-            flat = acc.reshape(-1)
-            sq += float(flat @ flat)
+            for row in acc:
+                r = (row.astype(np.float32) if row.dtype != np.float32
+                     else row)
+                sq += float(r @ r)
         return sq
 
     # ------------------------------------------------------------------
     # host optimizer step (cpu pool; futures gate next step's fetches)
     # ------------------------------------------------------------------
+    def _sr_gen(self) -> np.random.Generator:
+        g = getattr(self._sr_local, "gen", None)
+        if g is None:
+            with self._lock:
+                child = self._sr_seed.spawn(1)[0]
+            g = np.random.default_rng(child)
+            self._sr_local.gen = g
+        return g
+
+    def _np_sr_bf16(self, x32: np.ndarray) -> np.ndarray:
+        """Stochastically round fp32 → bf16 on the host (numpy twin of
+        runtime/optimizers._sr_to_bf16): add uniform low bits, truncate."""
+        bits = np.ascontiguousarray(x32, np.float32).view(np.uint32)
+        noise = self._sr_gen().integers(0, 1 << 16, size=bits.shape,
+                                        dtype=np.uint32)
+        return ((bits + noise) >> 16).astype(np.uint16).view(self._bf16)
+
     def _host_step_unit(self, unit: int, mult: float, lr: float, step: int):
         if unit == GLOBALS_UNIT:
             for parts in zip(self._gmaster, self._ggrad, self._gstore,
                              *self._gm):
                 master, grad, store = parts[0], parts[1], parts[2]
-                slots = parts[3:]
-                if mult != 1.0:
-                    np.multiply(grad, mult, out=grad)
-                self._step_leaf(master, grad, slots, lr, step)
+                self._step_one(master, grad, parts[3:], mult, lr, step)
                 store[...] = master.reshape(store.shape).astype(store.dtype)
-                grad[...] = 0.0
             return
         k = unit - 1
         for i, (master, grad, store) in enumerate(
                 zip(self._bmaster, self._bgrad, self._bstore)):
-            mrow, grow = master[k], grad[k]
-            if mult != 1.0:
-                np.multiply(grow, mult, out=grow)
             slots = [self._bm[s][i][k] for s in range(self._slots)]
-            self._step_leaf(mrow, grow, slots, lr, step)
-            store[k] = mrow.reshape(store.shape[1:]).astype(store.dtype)
-            grow[...] = 0.0
+            self._step_one(master[k], grad[k], slots, mult, lr, step)
+            store[k] = master[k].reshape(store.shape[1:]).astype(store.dtype)
 
-    def _step_leaf(self, master, grad, slots, lr, step):
+    def _step_one(self, master, grad, slots, mult, lr, step):
+        """One leaf/row update across storage dtypes: bf16 grad/moments
+        widen to fp32 scratch for the C++ kernel; moments SR back."""
+        g32 = (grad if grad.dtype == np.float32
+               else grad.astype(np.float32))
+        if mult != 1.0:
+            np.multiply(g32, np.float32(mult), out=g32)
+        narrow = slots and slots[0].dtype != np.float32
+        s32 = ([np.ascontiguousarray(s, np.float32) for s in slots]
+               if narrow else list(slots))
         if self._slots == 2:
-            self._opt.step(master, grad, slots[0], slots[1], step=step, lr=lr)
+            self._opt.step(master, g32, s32[0], s32[1], step=step, lr=lr)
+        elif self._slots == 1:
+            self._opt.step(master, g32, s32[0], lr=lr)
         else:
-            self._opt.step(master, grad, slots[0], lr=lr)
+            self._opt.step(master, g32, lr=lr)
+        if narrow:
+            for dst, src in zip(slots, s32):
+                dst[...] = self._np_sr_bf16(src)
+        grad[...] = 0
 
     # ------------------------------------------------------------------
     # the paged train step
@@ -556,17 +616,34 @@ class ParamStreamRunner:
                                                       list(self._bstore))
         return tree
 
+    def _save_arr(self, a: np.ndarray) -> np.ndarray:
+        # npz has no bf16: persist the raw 2-byte payload as uint16 (same
+        # convention as the quant cache, engine_v2.py)
+        return a.view(np.uint16) if a.dtype == self._bf16 else a
+
+    def _load_into(self, dst: np.ndarray, src) -> None:
+        src = np.asarray(src)
+        if src.dtype == np.uint16:
+            # uint16 is ALWAYS a persisted-bf16 payload — reinterpret
+            # before any numeric cast (a bf16-state checkpoint loaded
+            # into an fp32-state runner must not astype raw bit patterns)
+            src = src.view(self._bf16)
+        if src.dtype != dst.dtype:
+            dst[...] = src.astype(dst.dtype)
+        else:
+            dst[...] = src
+
     def state_dict(self) -> Dict[str, Any]:
         self.fence()
         out: Dict[str, Any] = {"step": self.step_count}
         for i, name in enumerate(self._gnames):
             out[f"g_master/{name}"] = self._gmaster[i]
             for s in range(self._slots):
-                out[f"g_m{s}/{name}"] = self._gm[s][i]
+                out[f"g_m{s}/{name}"] = self._save_arr(self._gm[s][i])
         for i, name in enumerate(self._bnames):
             out[f"b_master/{name}"] = self._bmaster[i]
             for s in range(self._slots):
-                out[f"b_m{s}/{name}"] = self._bm[s][i]
+                out[f"b_m{s}/{name}"] = self._save_arr(self._bm[s][i])
         return out
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
@@ -575,13 +652,13 @@ class ParamStreamRunner:
         for i, name in enumerate(self._gnames):
             self._gmaster[i][...] = sd[f"g_master/{name}"]
             for s in range(self._slots):
-                self._gm[s][i][...] = sd[f"g_m{s}/{name}"]
+                self._load_into(self._gm[s][i], sd[f"g_m{s}/{name}"])
             self._gstore[i][...] = self._gmaster[i].reshape(
                 self._gstore[i].shape).astype(self._gstore[i].dtype)
         for i, name in enumerate(self._bnames):
             self._bmaster[i][...] = sd[f"b_master/{name}"]
             for s in range(self._slots):
-                self._bm[s][i][...] = sd[f"b_m{s}/{name}"]
+                self._load_into(self._bm[s][i], sd[f"b_m{s}/{name}"])
             self._bstore[i][...] = self._bmaster[i].reshape(
                 self._bstore[i].shape).astype(self._bstore[i].dtype)
 

@@ -184,6 +184,45 @@ class TestMechanics:
         assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
 
 
+class TestNarrowHostState:
+
+    def test_bf16_moments_and_acc_track_fp32(self, eight_devices):
+        """bf16 host moments (SR store) + bf16 grad accumulators: the
+        loss trajectory must track the fp32-state paged engine closely —
+        this is the knob that fits a 7B-dims host state in 125 GB RAM."""
+        m = _model()
+        init = _shared_init(m)
+        cfg16 = _cfg(True)
+        cfg16["data_types"] = {"optimizer_moment_dtype": "bf16",
+                               "grad_accum_dtype": "bf16"}
+        e32, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True), model_parameters=init)
+        e16, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=cfg16, model_parameters=init)
+        rs = e16._param_stream
+        assert rs._mdt != np.float32 and rs._gadt != np.float32
+        b = _batch(seed=2)
+        l32 = [float(e32.train_batch(b)) for _ in range(6)]
+        l16 = [float(e16.train_batch(b)) for _ in range(6)]
+        np.testing.assert_allclose(l16, l32, rtol=3e-2)
+        assert l16[-1] < l16[0]
+
+    def test_bf16_state_checkpoint_roundtrip(self, eight_devices, tmp_path):
+        m = _model()
+        cfg = _cfg(True)
+        cfg["data_types"] = {"optimizer_moment_dtype": "bf16"}
+        e1, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+        b = _batch(seed=0)
+        for _ in range(2):
+            e1.train_batch(b)
+        e1.save_checkpoint(str(tmp_path))
+        cont = [float(e1.train_batch(b)) for _ in range(2)]
+        e2, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=cfg)
+        e2.load_checkpoint(str(tmp_path))
+        resumed = [float(e2.train_batch(b)) for _ in range(2)]
+        np.testing.assert_allclose(resumed, cont, rtol=1e-4, atol=1e-5)
+
+
 class TestRejections:
 
     def test_fp16_rejected(self, eight_devices):

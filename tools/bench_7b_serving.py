@@ -43,12 +43,18 @@ def run(arch: str, n_requests: int, token_budget: int):
         kv = "fp8"
     # request ARRIVAL spacing (FastGen benches an arrival process, not a
     # burst): ~ one 512-token prefill wave, so each arrival's prefill runs
-    # in its own wave and every request's own-clock TTFT meets the SLA
-    stagger = float(os.environ.get("DSTPU_STAGGER_S", "0.6"))
+    # in its own wave and every request's own-clock TTFT meets the SLA.
+    # Long-context runs (DSTPU_7B_PROMPT=4096) stretch the stagger with
+    # the prompt so each longer prefill still fits its arrival gap.
+    prompt_len = int(os.environ.get("DSTPU_7B_PROMPT", "512"))
+    stagger = float(os.environ.get("DSTPU_STAGGER_S",
+                                   str(0.6 * prompt_len / 512)))
+    if prompt_len != 512:
+        label += f"{prompt_len}-tok prompts, "
     return bench_serving(
-        None, n_requests=n_requests, prompt_len=512, max_new=64,
-        token_budget=token_budget, peak_tflops=peak, model_path=path,
-        quantization=quant, label=label, stagger_s=stagger,
+        None, n_requests=n_requests, prompt_len=prompt_len, max_new=64,
+        token_budget=max(token_budget, prompt_len), peak_tflops=peak,
+        model_path=path, quantization=quant, label=label, stagger_s=stagger,
         decode_burst=8 if stagger > 0 else None,
         # fp8 KV pages (r5): halves the pool vs bf16 — the lever that
         # broke the 24-request wall (tools/serving_frontier.py r5: 32
@@ -63,6 +69,10 @@ def main():
                 ("tinyllama-1.1b", 16, 2048)]
     if os.environ.get("DSTPU_7B_SKIP") == "1":
         attempts = attempts[1:]
+    if os.environ.get("DSTPU_7B_SKIP_FALLBACK") == "1":
+        # long-context caller: a tinyllama 512-prompt line would be
+        # mislabeled as the 4k-prompt result — fail loudly instead
+        attempts = attempts[:1]
     for arch, reqs, budget in attempts:
         try:
             line = run(arch, reqs, budget)

@@ -28,6 +28,8 @@ def main():
     ap.add_argument("--seq", type=int, default=256)
     ap.add_argument("--layers", type=int, default=0)
     ap.add_argument("--cpu", action="store_true", help="force CPU mesh")
+    ap.add_argument("--narrow-state", action="store_true",
+                    help="bf16 moments (SR) + bf16 grad accumulators")
     args = ap.parse_args()
 
     if args.cpu:
@@ -52,7 +54,7 @@ def main():
     print(f"model {args.preset}: {n_params / 1e6:.1f}M params "
           f"({model.config.num_layers} layers)", flush=True)
 
-    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+    cfg = {
         "train_micro_batch_size_per_gpu": args.batch,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-4}},
         "bf16": {"enabled": True},
@@ -60,7 +62,14 @@ def main():
         "zero_optimization": {
             "stage": 3,
             "offload_param": {"device": "cpu", "paged_training": True}},
-    })
+    }
+    if args.preset == "llama7b-dims" or args.narrow_state:
+        # 7B-dims host state: fp32 master 27 GB + bf16 SR moments 27 +
+        # bf16 grad acc 13.5 + bf16 store 13.5 ≈ 81 GB — fits 125 GB RAM
+        # (fp32 everything would need ~121 GB plus temporaries)
+        cfg["data_types"] = {"optimizer_moment_dtype": "bf16",
+                             "grad_accum_dtype": "bf16"}
+    eng, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
     rs = eng._param_stream
     rng = np.random.default_rng(0)
     batch = {"input_ids": rng.integers(

@@ -83,14 +83,17 @@ def init_distributed(dist_backend: Optional[str] = None,
         else:
             # mpirun-launched jobs (reference ``mpi_discovery``, comm.py:673):
             # one command line cannot bake a per-process id, so identity
-            # comes from the MPI runtime — OpenMPI's OMPI_COMM_WORLD_RANK or
-            # the PMI vars MPICH/Intel MPI set. Size fallback likewise.
-            for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK"):
+            # comes from the MPI runtime — OpenMPI's OMPI_COMM_WORLD_RANK,
+            # the PMI vars MPICH/Intel MPI set, or MVAPICH's
+            # MV2_COMM_WORLD_RANK (mpirun_rsh). Size fallback likewise.
+            for var in ("OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                        "MV2_COMM_WORLD_RANK"):
                 if os.environ.get(var):
                     kwargs["process_id"] = int(os.environ[var])
                     break
             if "num_processes" not in kwargs:
-                for var in ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE"):
+                for var in ("OMPI_COMM_WORLD_SIZE", "PMI_SIZE",
+                            "MV2_COMM_WORLD_SIZE"):
                     if os.environ.get(var):
                         kwargs["num_processes"] = int(os.environ[var])
                         break

@@ -51,7 +51,8 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="ssh",
                         choices=["ssh", "local", "popen", "slurm",
-                                 "openmpi", "mpich", "impi"],
+                                 "openmpi", "mpich", "impi", "pdsh",
+                                 "mvapich"],
                         help="remote exec method ('popen' spawns one local "
                              "process per hostfile entry — the reference "
                              "launch.py per-rank spawner, for single-host "
@@ -65,7 +66,7 @@ def parse_args(args=None):
                              "(e.g. '--partition=tpu --time=2:00:00')")
     parser.add_argument("--launcher_args", type=str, default="",
                         help="extra arguments spliced into the mpirun "
-                             "command (openmpi/mpich/impi launchers)")
+                             "command (openmpi/mpich/impi/pdsh/mvapich launchers)")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--elastic_training", action="store_true",
                         help="supervise workers through the elastic agent: "
@@ -292,6 +293,21 @@ def build_srun_command(args, active: Dict[str, List[int]],
     return cmd
 
 
+def _launch_env_kvs(args, active: Dict[str, List[int]],
+                    exports: Dict[str, str]) -> Dict[str, str]:
+    """The launch env every multi-node builder ships: collected exports
+    minus any leaked JAX_PROCESS_ID (identity must come from the runtime
+    or a per-host substitution), plus coordinator/size/world-info."""
+    hosts = sorted(active.keys())
+    master = args.master_addr or hosts[0]
+    env_kvs = dict(exports)
+    env_kvs.pop("JAX_PROCESS_ID", None)
+    env_kvs["JAX_COORDINATOR_ADDRESS"] = f"{master}:{args.master_port}"
+    env_kvs["JAX_NUM_PROCESSES"] = str(len(hosts))
+    env_kvs["DSTPU_WORLD_INFO"] = encode_world_info(active)
+    return env_kvs
+
+
 def build_mpirun_command(args, active: Dict[str, List[int]],
                          exports: Dict[str, str]) -> List[str]:
     """mpirun command for MPI-scheduled fleets (reference
@@ -303,15 +319,7 @@ def build_mpirun_command(args, active: Dict[str, List[int]],
     mpi_discovery parity)."""
     hosts = sorted(active.keys())
     n = len(hosts)
-    master = args.master_addr or hosts[0]
-    env_kvs = dict(exports)
-    # a leaked JAX_PROCESS_ID (manual single-process test, .deepspeed_env)
-    # would give every rank identity 0 — init_distributed prefers it over
-    # the MPI runtime's rank vars
-    env_kvs.pop("JAX_PROCESS_ID", None)
-    env_kvs["JAX_COORDINATOR_ADDRESS"] = f"{master}:{args.master_port}"
-    env_kvs["JAX_NUM_PROCESSES"] = str(n)
-    env_kvs["DSTPU_WORLD_INFO"] = encode_world_info(active)
+    env_kvs = _launch_env_kvs(args, active, exports)
     if args.launcher == "openmpi":
         # --host h:1 caps one slot per node; -x FOO=bar sets + forwards
         cmd = ["mpirun", "-np", str(n),
@@ -330,6 +338,69 @@ def build_mpirun_command(args, active: Dict[str, List[int]],
             cmd += ["-genv", k, str(v)]
     cmd += [sys.executable, args.user_script] + args.user_args
     return cmd
+
+
+def build_pdsh_command(args, active: Dict[str, List[int]],
+                       exports: Dict[str, str]) -> List[str]:
+    """pdsh fan-out (reference ``PDSHRunner.get_cmd``,
+    multinode_runner.py:51): ONE pdsh invocation runs the command on every
+    host; per-host identity comes from pdsh's ``%n`` substitution (the
+    target's 0-based position in the -w list), which becomes
+    JAX_PROCESS_ID remotely. ``-S`` propagates the largest remote exit
+    code; ``-f 1024`` fans out in parallel."""
+    hosts = sorted(active.keys())
+    env_kvs = _launch_env_kvs(args, active, exports)
+
+    # pdsh treats % as a substitution char — escape any literal % in
+    # values AND in the user command so a stray TPU_…=50% or a user arg
+    # like --log-format=%h cannot be rewritten by pdsh
+    def pq(v: str) -> str:
+        return shlex.quote(str(v)).replace("%", "%%")
+
+    env_str = " ".join(f"{k}={pq(v)}" for k, v in sorted(env_kvs.items()))
+    remote = (f"{env_str} JAX_PROCESS_ID=%n "
+              f"{pq(sys.executable)} {pq(args.user_script)} "
+              + " ".join(map(pq, args.user_args))).strip()
+    cmd = ["pdsh", "-S", "-f", "1024", "-w", ",".join(hosts)]
+    if args.launcher_args:
+        cmd += shlex.split(args.launcher_args)
+    return cmd + [remote]
+
+
+def build_mvapich_command(args, active: Dict[str, List[int]],
+                          exports: Dict[str, str]) -> List[str]:
+    """mpirun_rsh command filling the reference ``MVAPICHRunner`` slot
+    (multinode_runner.py:374 — the reference drives hydra mpirun there;
+    mpirun_rsh is MVAPICH's own native launcher, with hosts listed
+    positionally and env as K=V args before the program). Rank identity
+    from MV2_COMM_WORLD_RANK (read by ``init_distributed``'s MPI
+    discovery alongside PMI_RANK)."""
+    hosts = sorted(active.keys())
+    env_kvs = _launch_env_kvs(args, active, exports)
+    cmd = ["mpirun_rsh", "-np", str(len(hosts))]
+    if args.launcher_args:
+        cmd += shlex.split(args.launcher_args)
+    cmd += hosts
+    # quote: mpirun_rsh re-serializes the command over ssh, so a
+    # multi-word value (XLA_FLAGS='-a -b') must survive the remote shell
+    cmd += [f"{k}={shlex.quote(str(v))}" for k, v in sorted(env_kvs.items())]
+    return cmd + [sys.executable, args.user_script] + args.user_args
+
+
+def _run_pdsh(args, active: Dict[str, List[int]]) -> int:
+    cmd = build_pdsh_command(args, active, _collect_env_exports())
+    # ssh transport: pdsh's compiled-in default rcmd module is often rsh,
+    # which no TPU-VM fleet runs (reference PDSHRunner sets the same,
+    # multinode_runner.py:74)
+    env = dict(os.environ, PDSH_RCMD_TYPE=os.environ.get(
+        "PDSH_RCMD_TYPE", "ssh"))
+    return _spawn_and_forward(cmd, "pdsh", env=env)
+
+
+def _run_mvapich(args, active: Dict[str, List[int]]) -> int:
+    cmd = build_mvapich_command(args, active, _collect_env_exports())
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PROCESS_ID"}
+    return _spawn_and_forward(cmd, "mpirun_rsh", env=env)
 
 
 def _run_mpi(args, active: Dict[str, List[int]]) -> int:
@@ -362,7 +433,8 @@ def main(args=None) -> int:
                 "--launcher slurm needs a hostfile or an active SLURM "
                 "allocation (SLURM_NNODES)")
         resource_pool = {f"slurm-node-{i}": 1 for i in range(n)}
-    if args.launcher in ("openmpi", "mpich", "impi") and not resource_pool:
+    if args.launcher in ("openmpi", "mpich", "impi", "pdsh",
+                         "mvapich") and not resource_pool:
         # silently degrading the requested multi-host job to one local
         # process would be the worst failure mode
         raise ValueError(f"--launcher {args.launcher} needs a hostfile "
@@ -378,6 +450,10 @@ def main(args=None) -> int:
         return _run_slurm(args, active)
     if args.launcher in ("openmpi", "mpich", "impi"):
         return _run_mpi(args, active)
+    if args.launcher == "pdsh":
+        return _run_pdsh(args, active)
+    if args.launcher == "mvapich":
+        return _run_mvapich(args, active)
     if len(active) == 1 and not args.force_multi:
         return _run_local(args)
     return _run_ssh(args, active)

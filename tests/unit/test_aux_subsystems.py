@@ -120,6 +120,42 @@ def test_openmpi_runner_command_line():
     assert cmd[-3:] == ["train.py", "--lr", "0.1"]
 
 
+def test_pdsh_runner_command_line():
+    """--launcher pdsh: one pdsh fan-out, per-host identity via %n
+    (reference PDSHRunner.get_cmd, multinode_runner.py:51)."""
+    from deepspeed_tpu.launcher.runner import build_pdsh_command, parse_args
+    args = parse_args(["--launcher", "pdsh", "--master_port", "6007",
+                       "train.py", "--lr", "0.1"])
+    active = {"tpu-host-1": [0], "tpu-host-0": [0]}
+    cmd = build_pdsh_command(args, active, {"TPU_NAME": "pod"})
+    assert cmd[:4] == ["pdsh", "-S", "-f", "1024"]
+    assert cmd[cmd.index("-w") + 1] == "tpu-host-0,tpu-host-1"
+    remote = cmd[-1]
+    assert "JAX_PROCESS_ID=%n" in remote        # pdsh rank substitution
+    assert "JAX_COORDINATOR_ADDRESS=tpu-host-0:6007" in remote
+    assert "JAX_NUM_PROCESSES=2" in remote
+    assert "TPU_NAME=pod" in remote
+    assert remote.rstrip().endswith("train.py --lr 0.1")
+
+
+def test_mvapich_runner_command_line():
+    """--launcher mvapich: mpirun_rsh with positional hosts + K=V env
+    (reference MVAPICHRunner.get_cmd, multinode_runner.py:160)."""
+    from deepspeed_tpu.launcher.runner import (build_mvapich_command,
+                                               parse_args)
+    args = parse_args(["--launcher", "mvapich", "train.py"])
+    active = {"h1": [0], "h0": [0], "h2": [0]}
+    cmd = build_mvapich_command(args, active, {"TPU_NAME": "pod"})
+    assert cmd[:3] == ["mpirun_rsh", "-np", "3"]
+    assert cmd[3:6] == ["h0", "h1", "h2"]       # positional host list
+    kvs = [c for c in cmd if "=" in c and not c.startswith("-")]
+    assert "JAX_COORDINATOR_ADDRESS=h0:29500" in kvs
+    assert "JAX_NUM_PROCESSES=3" in kvs
+    assert "TPU_NAME=pod" in kvs
+    assert not any(k.startswith("JAX_PROCESS_ID=") for k in kvs)
+    assert cmd[-1] == "train.py"
+
+
 def test_mpich_impi_runner_command_line():
     """mpich/impi use the hydra CLI: -ppn 1 + -genv K V pairs (reference
     MPICHRunner/IMPIRunner, multinode_runner.py:70,117)."""

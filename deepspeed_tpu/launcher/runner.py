@@ -358,7 +358,10 @@ def build_pdsh_command(args, active: Dict[str, List[int]],
         return shlex.quote(str(v)).replace("%", "%%")
 
     env_str = " ".join(f"{k}={pq(v)}" for k, v in sorted(env_kvs.items()))
-    remote = (f"{env_str} JAX_PROCESS_ID=%n "
+    # cd to the launch cwd first: ssh/pdsh land in $HOME, where a relative
+    # user_script does not exist (reference PDSHRunner prepends the same)
+    remote = (f"cd {pq(os.path.abspath(os.curdir))}; "
+              f"{env_str} JAX_PROCESS_ID=%n "
               f"{pq(sys.executable)} {pq(args.user_script)} "
               + " ".join(map(pq, args.user_args))).strip()
     cmd = ["pdsh", "-S", "-f", "1024", "-w", ",".join(hosts)]
@@ -381,10 +384,11 @@ def build_mvapich_command(args, active: Dict[str, List[int]],
     if args.launcher_args:
         cmd += shlex.split(args.launcher_args)
     cmd += hosts
-    # quote: mpirun_rsh re-serializes the command over ssh, so a
-    # multi-word value (XLA_FLAGS='-a -b') must survive the remote shell
+    # quote EVERYTHING that rides mpirun_rsh's re-serialized ssh command
+    # line: env values (XLA_FLAGS='-a -b') and user args ('my run') alike
     cmd += [f"{k}={shlex.quote(str(v))}" for k, v in sorted(env_kvs.items())]
-    return cmd + [sys.executable, args.user_script] + args.user_args
+    return (cmd + [sys.executable, shlex.quote(args.user_script)]
+            + [shlex.quote(a) for a in args.user_args])
 
 
 def _run_pdsh(args, active: Dict[str, List[int]]) -> int:

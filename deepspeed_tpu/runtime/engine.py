@@ -1321,8 +1321,17 @@ class DeepSpeedEngine:
         self._jit_micro_step = None
         self._jit_apply_step = None
 
+    def _reject_paged(self, op: str) -> None:
+        if self._param_stream is not None:
+            raise RuntimeError(
+                f"{op}() is not available with offload_param.paged_training "
+                "— the paged step fuses forward/backward/apply around the "
+                "per-layer param pipeline; use train_batch() (training) or "
+                "eval_batch() (loss only)")
+
     def forward(self, batch: Dict[str, Any]):
         """Compute loss (and gradients — fused; see module docstring)."""
+        self._reject_paged("forward")
         self._require_params("forward")
         self._ensure_grad_acc()
         # retraces (new shapes) must see THIS engine's mesh, not whichever
@@ -1349,6 +1358,7 @@ class DeepSpeedEngine:
     def backward(self, loss=None):
         """Gradients were produced in forward; this marks the micro-step
         boundary (reference engine.backward, engine.py:1922)."""
+        self._reject_paged("backward")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
         self.micro_steps += 1
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
@@ -1359,6 +1369,7 @@ class DeepSpeedEngine:
 
     def step(self):
         """Apply the optimizer at accumulation boundaries (engine.py:2120)."""
+        self._reject_paged("step")
         self._require_params("step")
         if not self.is_gradient_accumulation_boundary():
             return

@@ -236,6 +236,14 @@ class TestRejections:
         with pytest.raises(ValueError, match="remove offload_optimizer"):
             deepspeed_tpu.initialize(model=_model(), config=cfg)
 
+    def test_forward_step_rejected(self, eight_devices):
+        eng, _, _, _ = deepspeed_tpu.initialize(model=_model(),
+                                                config=_cfg(True))
+        with pytest.raises(RuntimeError, match="train_batch"):
+            eng.forward(_batch())
+        with pytest.raises(RuntimeError, match="train_batch"):
+            eng.step()
+
     def test_moe_rejected(self, eight_devices):
         from deepspeed_tpu.models import mixtral_model
         m = mixtral_model("mixtral-tiny", max_seq_len=32, vocab_size=128,

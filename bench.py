@@ -8,15 +8,23 @@ line):
   [0] GPT-2 125M, ZeRO-1, bf16                 -> tokens/sec + MFU
   [1] Llama-2-7B-dims (layer-scaled), ZeRO-2   -> tokens/sec + MFU
   [2] Llama dims (layer-scaled), ZeRO-3 + NVMe -> tokens/sec + MFU
-      optimizer offload paging through dstpu_aio
+      optimizer offload paging through dstpu_aio (pipelined swapper)
   [3] Mixtral-style MoE (layer-scaled), ZeRO-2 -> tokens/sec + MFU
-  [+] BERT-large MLM seq 128 (the reference's "fastest BERT training"
-      headline config)                         -> tokens/sec + MFU
-  [+] GPT-2-large FULL architecture (36 layers, published dims, no
-      scaling), ZeRO-1                         -> tokens/sec + MFU
-  [4] FULL-DEPTH llama2-7b (32 layers, real dims) int8 WOQ served from a
-      real-format HF checkpoint dir via build_hf_engine + continuous
-      batching                                 -> output tok/s + TTFT
+  [4] BERT-large MLM seq 128 (the reference's "fastest BERT training"
+      headline config), attention_only remat   -> tokens/sec + MFU
+  [5] GPT-2-large FULL architecture (36 layers, published dims, no
+      scaling), ZeRO-1, attention_only remat   -> tokens/sec + MFU
+  [6] FULL-DEPTH TinyLlama-1.1B on-chip training (bf16 moments)
+                                               -> tokens/sec + MFU
+  [7] FULL-DEPTH TinyLlama-1.1B seq 4096 (query-chunked XLA attention,
+      Ulysses anchor)                          -> tokens/sec + MFU
+  [8] FULL-DEPTH llama2-7b (32 layers, real dims) int4 WOQ + fp8 KV,
+      16 requests, served from a real-format HF checkpoint dir via
+      build_hf_engine + continuous batching    -> output tok/s + TTFT
+  [9] llama2-7b long-context serving: 4096-token prompts, fp8 KV
+                                               -> output tok/s + TTFT
+  [10] Mixtral-architecture MoE serving (dropless routing, SLA fields)
+                                               -> output tok/s + TTFT
 
 Honest accounting:
 - Timing is synced by FETCHING data (device_get), not block_until_ready:

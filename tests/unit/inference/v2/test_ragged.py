@@ -141,3 +141,25 @@ class TestKVOffloadRestore:
         cache = BlockedKVCache(num_layers=1, num_kv_heads=1, head_dim=8,
                                num_blocks=32, block_size=4, dtype=np.float32)
         return DSStateManager(DeepSpeedTPStateManagerConfig(), cache)
+
+    def test_offload_restore_fp8_pages(self):
+        """Host offload of a NARROW (fp8) pool round-trips bit-exactly:
+        device_get/put must preserve the e4m3 payload."""
+        import jax.numpy as jnp
+        cache = BlockedKVCache(num_layers=1, num_kv_heads=2, head_dim=8,
+                               num_blocks=8, block_size=4,
+                               dtype=jnp.float8_e4m3fn)
+        rng = np.random.default_rng(3)
+        k = jnp.asarray(rng.normal(size=cache.k_pages.shape),
+                        jnp.float32).astype(jnp.float8_e4m3fn)
+        v = jnp.asarray(rng.normal(size=cache.v_pages.shape),
+                        jnp.float32).astype(jnp.float8_e4m3fn)
+        cache.update(k, v)
+        src, dst = [3, 5], [6, 1]
+        hk, hv = cache.offload(src)
+        want_k = np.asarray(k.astype(jnp.float32))[:, :, src]
+        cache.update(jnp.zeros_like(cache.k_pages),
+                     jnp.zeros_like(cache.v_pages))
+        cache.restore(hk, hv, dst)
+        got = np.asarray(cache.k_pages.astype(jnp.float32))
+        np.testing.assert_array_equal(got[:, :, dst], want_k)

@@ -343,7 +343,9 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
     }
 
 
-N_TPU_RUNS = 11  # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 11     # build_runs(on_tpu=True) length — asserted in child mode
+N_SERVING_RUNS = 3  # ... of which the LAST THREE are serving lines
+#                     (7B 512-prompt, 7B long-context, MoE) — one sample
 
 
 def _probe_backend() -> str:
@@ -461,17 +463,24 @@ def _dispatch_tpu() -> None:
     training config gets exactly TWO fresh-process samples and the
     better one is kept, because the tunnel occasionally stalls for the
     whole of a child's timed windows (observed: the MoE line at 14x
-    under its interleaved-A/B number). The serving config gets one
-    sample: its subprocess is ~40 min, has its own internal fallback
-    protocol, and its SLA numbers have been stable across rounds."""
+    under its interleaved-A/B number). Both samples' values ride the
+    line (sample_values) so the reader sees the noise window a number
+    sits in (VERDICT r4 weak #6: a committed 1.009 inside a ±20% band
+    is indistinguishable from below-bar without the spread). Serving
+    configs (the last N_SERVING_RUNS) get one sample each: a serving
+    subprocess is ~40 min, has its own internal fallback protocol, and
+    its SLA numbers have been stable across rounds."""
     lines = []
     for i in range(N_TPU_RUNS):
         line = _run_one_config(i)
-        if i != N_TPU_RUNS - 1:  # serving is the last config
+        if i < N_TPU_RUNS - N_SERVING_RUNS:
             second = _run_one_config(i)
+            vals = sorted([line.get("value", 0.0),
+                           second.get("value", 0.0)])
             if second.get("value", 0.0) > line.get("value", 0.0):
                 line = second
             line["samples"] = 2
+            line["sample_values"] = vals
         _emit(line)
         lines.append(line)
     _write_summary(lines)

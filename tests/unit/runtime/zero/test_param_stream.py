@@ -172,6 +172,19 @@ class TestMechanics:
         assert all(np.all(np.isfinite(np.asarray(l, np.float32)))
                    for l in leaves)
 
+    def test_sharded_mesh_dp_sp(self, eight_devices):
+        """Paged streaming with Ulysses sequence parallelism: the block
+        programs run ulysses_attention's all-to-alls inside the per-layer
+        jits over a dp=2 x sp=4 mesh."""
+        m = llama_model("llama2-tiny", max_seq_len=32, vocab_size=128,
+                        remat=False, dtype=jnp.float32, num_heads=4,
+                        num_kv_heads=4)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True, topology={"data": 2, "seq": 4}))
+        b = _batch(seed=0, batch=2, seq=32)
+        losses = [float(eng.train_batch(b)) for _ in range(3)]
+        assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
+
     def test_sharded_mesh_dp_tp(self, eight_devices):
         """Paged streaming over a dp=2 x tp=2 mesh: per-layer device_put
         scatters into the NamedShardings; grads come back reduced."""

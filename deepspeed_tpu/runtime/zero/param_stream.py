@@ -42,6 +42,7 @@ expert parallelism compose with the resident-param engine paths instead.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -86,15 +87,11 @@ class ParamStreamRunner:
         if c.moe is not None:
             raise ValueError("offload_param.paged_training does not support "
                              "MoE blocks (use the resident-param engine)")
-        if device == "nvme":
-            # loud, not silent: v1 streams from host RAM only; an NVMe param
-            # + optimizer-state store (AsyncPartitionedParameterSwapper
-            # composition) would otherwise appear to work while keeping
-            # everything in RAM
-            raise ValueError(
-                "offload_param.paged_training currently streams from host "
-                "RAM (device: cpu); NVMe-backed param streaming is not yet "
-                "wired — set offload_param.device: cpu")
+        # device == "nvme": the bf16 param store lives on DISK as one blob
+        # per unit, read ahead through the C++ AIO engine (reference
+        # AsyncPartitionedParameterSwapper, partitioned_param_swapper.py:36)
+        # and written back by the host optimizer step. Host RAM then holds
+        # only master/moments/grad-acc.
         self.model = model
         self.mesh = mesh
         self.param_dtype = param_dtype
@@ -231,12 +228,116 @@ class ParamStreamRunner:
         self._land_futs: List[Future] = []
         self._jits: Dict[Any, Any] = {}
 
+        # -- NVMe param store (reference partitioned_param_swapper.py:36):
+        # block-unit params live on disk as one bf16 blob per layer, read
+        # ahead through the C++ AIO engine; globals (embeddings/head —
+        # needed at both ends of every step) stay in RAM.
+        self._aio = None               # non-None IS the nvme-mode flag
+        self._nvme_pending = None  # (unit_index, buffer) of in-flight read
+        self._nvme_last = None
+        # write-behind cache: optimizer-pool threads STAGE updated blobs
+        # here (the AIO handle is not thread-safe — wait()'s pin-drop
+        # would free a buffer a pool thread just queued); ONLY the main
+        # thread queues AIO ops, flushing at step start / fetch / fence
+        self._nvme_dirty: Dict[int, np.ndarray] = {}
+        if device == "nvme":
+            import tempfile
+            from ...ops.aio import AsyncIOHandle
+            base = nvme_path or tempfile.gettempdir()
+            # per-instance subdir: two runners sharing an nvme_path must
+            # not clobber each other's store (same convention as
+            # offload_optimizer's opt_{id:x})
+            self._nvme_dir = os.path.join(base, f"pstream_{id(self):x}")
+            os.makedirs(self._nvme_dir, exist_ok=True)
+            self._aio = AsyncIOHandle(num_threads=2)
+            # blob layout: per-leaf (byte offset, nbytes, row shape);
+            # identical for every layer (leaves are stacked [L, ...])
+            self._blob_meta = []
+            off = 0
+            for leaf in self._bstore:
+                row = leaf[0]
+                self._blob_meta.append((off, row.nbytes, row.shape))
+                off += row.nbytes
+            assert off == self._block_bytes, (off, self._block_bytes)
+            self._bstore = None  # disk is canonical for block params
+            for k in range(self.num_layers):
+                # masters == store at init, so _pack_unit is exact — ONE
+                # definition of the blob layout
+                self._aio.sync_pwrite(self._pack_unit(k),
+                                      self._unit_path(k))
+
         log_dist(
             f"param-stream: {self.total_param_bytes / 1e9:.2f} GB params "
-            f"host-resident ({self.num_layers} blocks × "
+            f"{'NVMe' if device == 'nvme' else 'host'}-resident "
+            f"({self.num_layers} blocks × "
             f"{self._block_bytes / 1e6:.1f} MB + "
-            f"{self._global_bytes / 1e6:.1f} MB globals); steady-state "
-            f"device residency ≈ 2 block buffers + globals", ranks=[0])
+            f"{self._global_bytes / 1e6:.1f} MB globals in RAM); "
+            f"steady-state device residency ≈ 2 block buffers + globals",
+            ranks=[0])
+
+    def _unit_path(self, k: int) -> str:
+        return os.path.join(self._nvme_dir, f"unit{k}.bin")
+
+    def _pack_unit(self, k: int) -> np.ndarray:
+        """One layer's bf16 blob assembled from the masters — the single
+        definition of the blob layout (init write, step write-back, and
+        checkpoint rewrite all call this)."""
+        blob = np.empty(self._block_bytes, np.uint8)
+        for (o, nb, shape), m in zip(self._blob_meta, self._bmaster):
+            blob[o:o + nb] = (m[k].reshape(shape)
+                              .astype(self._np_dtype).reshape(-1)
+                              .view(np.uint8))
+        return blob
+
+    def _flush_nvme_dirty(self) -> None:
+        """MAIN THREAD ONLY: queue the staged write-backs. Called at step
+        start and at fence — pool threads never touch the AIO handle."""
+        with self._lock:
+            items = list(self._nvme_dirty.items())
+            self._nvme_dirty.clear()
+        for k, blob in items:
+            self._aio.async_pwrite(blob, self._unit_path(k))
+
+    def _nvme_take(self, k: int) -> np.ndarray:
+        """Blob for layer k (MAIN THREAD ONLY): a staged dirty blob serves
+        directly (its disk write is queued here, and reading from the
+        buffer while AIO writes it out is two readers — safe); otherwise
+        consume the in-flight prefetch or sync-read. Fresh buffers per
+        fetch — the device_put may still be reading the previous one
+        asynchronously. The aio.wait() fences every previously-queued
+        write, so a read can never race its own unit's write-back."""
+        L = self.num_layers
+        d = 1
+        if self._nvme_last is not None and k < self._nvme_last:
+            d = -1
+        self._nvme_last = k
+        with self._lock:
+            dirty = self._nvme_dirty.pop(k, None)
+        nxt = k + d
+        with self._lock:
+            nxt_dirty = nxt in self._nvme_dirty
+        # prefetch only units whose host step is fully done AND whose
+        # write-back (if any) was queued before the wait below — a unit
+        # still dirty will be served from RAM anyway
+        fut = self._unit_futs.get(1 + nxt)
+        can_prefetch = (0 <= nxt < L and not nxt_dirty
+                        and (fut is None or fut.done()))
+        pend, self._nvme_pending = self._nvme_pending, None
+        self._aio.wait()
+        if dirty is not None:
+            self._aio.async_pwrite(dirty, self._unit_path(k))
+            buf = dirty
+        elif pend is not None and pend[0] == k:
+            buf = pend[1]
+        else:
+            buf = np.empty(self._block_bytes, np.uint8)
+            self._aio.async_pread(buf, self._unit_path(k))
+            self._aio.wait()
+        if can_prefetch and nxt != k:
+            nbuf = np.empty(self._block_bytes, np.uint8)
+            self._aio.async_pread(nbuf, self._unit_path(nxt))
+            self._nvme_pending = (nxt, nbuf)
+        return buf
 
     # ------------------------------------------------------------------
     # device program cache (one compile per signature, reused every layer)
@@ -399,8 +500,14 @@ class ParamStreamRunner:
         """Device copy of layer k's params; waits for a pending host
         optimizer step of that layer first (the pipeline interlock)."""
         self._wait_unit(1 + k)
-        leaves = [jax.device_put(h[k], s)
-                  for h, s in zip(self._bstore, self._bshard)]
+        if self._aio is not None:
+            blob = self._nvme_take(k)
+            leaves = [jax.device_put(
+                blob[o:o + nb].view(self._np_dtype).reshape(shape), s)
+                for (o, nb, shape), s in zip(self._blob_meta, self._bshard)]
+        else:
+            leaves = [jax.device_put(h[k], s)
+                      for h, s in zip(self._bstore, self._bshard)]
         self._track(self._block_bytes)
         return leaves
 
@@ -475,6 +582,18 @@ class ParamStreamRunner:
                 store[...] = master.reshape(store.shape).astype(store.dtype)
             return
         k = unit - 1
+        if self._aio is not None:
+            for i, (master, grad) in enumerate(
+                    zip(self._bmaster, self._bgrad)):
+                slots = [self._bm[s][i][k] for s in range(self._slots)]
+                self._step_one(master[k], grad[k], slots, mult, lr, step)
+            # STAGE the write-back — this runs on a pool thread and the
+            # AIO handle is main-thread-only (wait()'s pin-drop would
+            # free a concurrently-queued buffer mid-write)
+            blob = self._pack_unit(k)
+            with self._lock:
+                self._nvme_dirty[k] = blob
+            return
         for i, (master, grad, store) in enumerate(
                 zip(self._bmaster, self._bgrad, self._bstore)):
             slots = [self._bm[s][i][k] for s in range(self._slots)]
@@ -515,6 +634,8 @@ class ParamStreamRunner:
         self.last_fetch_wait_s = 0.0
         windows = getattr(self.model, "_windows", None)
         wkey = windows is not None
+        if self._aio is not None:
+            self._flush_nvme_dirty()  # queue last step's staged write-backs
 
         losses = []
         dg_acc = None
@@ -586,6 +707,8 @@ class ParamStreamRunner:
         L = self.num_layers
         windows = getattr(self.model, "_windows", None)
         wkey = windows is not None
+        if self._aio is not None:
+            self._flush_nvme_dirty()
         keys = tuple(sorted(batch.keys()))
         with self.mesh:
             gleaves = self._fetch_globals()
@@ -608,17 +731,35 @@ class ParamStreamRunner:
     # state access / checkpointing
     # ------------------------------------------------------------------
     def fence(self):
-        """Complete every pending host optimizer step."""
+        """Complete every pending host optimizer step (and land the NVMe
+        write-backs they staged)."""
         for unit in list(self._unit_futs):
             self._wait_unit(unit)
+        if self._aio is not None:
+            self._flush_nvme_dirty()
+            self._aio.wait()
 
     def params_host_tree(self):
-        """Full parameter tree (host numpy, wire dtype) — state_dict/save."""
+        """Full parameter tree (host numpy, wire dtype) — state_dict/save.
+        Blocks rebuild from the fp32 masters (the store is bf16(master) by
+        construction), so the NVMe mode needs no disk round-trip."""
         self.fence()
         tree = jax.tree_util.tree_unflatten(self._gtreedef, list(self._gstore))
-        tree["blocks"] = jax.tree_util.tree_unflatten(self._btreedef,
-                                                      list(self._bstore))
+        if self._aio is not None:
+            bl = [m.reshape((self.num_layers,) + shape).astype(self._np_dtype)
+                  for m, (_, _, shape) in zip(self._bmaster, self._blob_meta)]
+        else:
+            bl = list(self._bstore)
+        tree["blocks"] = jax.tree_util.tree_unflatten(self._btreedef, bl)
         return tree
+
+    def _rewrite_nvme_store(self) -> None:
+        """Regenerate every unit blob from the masters (checkpoint load)."""
+        with self._lock:
+            self._nvme_dirty.clear()
+        for k in range(self.num_layers):
+            self._aio.async_pwrite(self._pack_unit(k), self._unit_path(k))
+        self._aio.wait()
 
     def _save_arr(self, a: np.ndarray) -> np.ndarray:
         # npz has no bf16: persist the raw 2-byte payload as uint16 (same
@@ -663,10 +804,20 @@ class ParamStreamRunner:
             self._bmaster[i][...] = sd[f"b_master/{name}"]
             for s in range(self._slots):
                 self._load_into(self._bm[s][i], sd[f"b_m{s}/{name}"])
-            self._bstore[i][...] = self._bmaster[i].reshape(
-                self._bstore[i].shape).astype(self._bstore[i].dtype)
+            if self._aio is None:
+                self._bstore[i][...] = self._bmaster[i].reshape(
+                    self._bstore[i].shape).astype(self._bstore[i].dtype)
+        if self._aio is not None:
+            self._rewrite_nvme_store()
 
     def close(self):
         self.fence()
         self._io.shutdown(wait=True)
         self._cpu.shutdown(wait=True)
+        if self._aio is not None:
+            self._aio.wait()
+            self._aio.close()
+            # the store is derivable from the masters — don't leak a
+            # model-sized blob directory per run
+            import shutil
+            shutil.rmtree(self._nvme_dir, ignore_errors=True)

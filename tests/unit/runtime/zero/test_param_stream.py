@@ -197,6 +197,61 @@ class TestMechanics:
         assert all(np.isfinite(losses)) and losses[-1] < losses[0], losses
 
 
+class TestNVMeParamStore:
+    """device=nvme: block params live on DISK as per-layer bf16 blobs read
+    ahead through the C++ AIO engine (reference
+    partitioned_param_swapper.py:36) — the full ZeRO-Infinity NVMe story,
+    not just host RAM."""
+
+    def _nvme_cfg(self, tmp_path):
+        cfg = _cfg(True)
+        cfg["zero_optimization"]["offload_param"] = {
+            "device": "nvme", "nvme_path": str(tmp_path),
+            "paged_training": True}
+        return cfg
+
+    def test_losses_match_ram_paged_engine(self, eight_devices, tmp_path):
+        m = _model()
+        init = _shared_init(m)
+        nv, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=self._nvme_cfg(tmp_path), model_parameters=init)
+        ram, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg(True), model_parameters=init)
+        rs = nv._param_stream
+        assert rs._bstore is None  # disk is canonical
+        import os as _os
+        assert _os.path.exists(rs._unit_path(0))
+        b = _batch(seed=0)
+        l_nv = [float(nv.train_batch(b)) for _ in range(4)]
+        l_ram = [float(ram.train_batch(b)) for _ in range(4)]
+        np.testing.assert_allclose(l_nv, l_ram, rtol=1e-4, atol=1e-5)
+
+    def test_checkpoint_roundtrip_nvme(self, eight_devices, tmp_path):
+        m = _model()
+        cfg = self._nvme_cfg(tmp_path / "swap")
+        e1, _, _, _ = deepspeed_tpu.initialize(model=m, config=cfg)
+        b = _batch(seed=1)
+        for _ in range(2):
+            e1.train_batch(b)
+        e1.save_checkpoint(str(tmp_path / "ckpt"))
+        cont = [float(e1.train_batch(b)) for _ in range(2)]
+        cfg2 = self._nvme_cfg(tmp_path / "swap2")
+        e2, _, _, _ = deepspeed_tpu.initialize(model=_model(), config=cfg2)
+        e2.load_checkpoint(str(tmp_path / "ckpt"))
+        resumed = [float(e2.train_batch(b)) for _ in range(2)]
+        np.testing.assert_allclose(resumed, cont, rtol=1e-4, atol=1e-5)
+
+    def test_eval_and_state_dict(self, eight_devices, tmp_path):
+        m = _model()
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=self._nvme_cfg(tmp_path))
+        eng.train_batch(_batch(seed=2))
+        assert np.isfinite(float(eng.eval_batch(_batch(seed=3))))
+        sd = eng.module_state_dict()
+        assert all(np.all(np.isfinite(np.asarray(l, np.float32)))
+                   for l in jax.tree.leaves(sd))
+
+
 class TestNarrowHostState:
 
     def test_bf16_moments_and_acc_track_fp32(self, eight_devices):

@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-5 measurement queue: waits for the axon tunnel to come back, then
+# runs the chip-bound measurements in priority order. Each step appends a
+# JSON line to /tmp/r5_queue.log. Usage: bash tools/r5_chip_queue.sh &
+set -u
+cd /root/repo
+export PYTHONPATH=/root/repo:/root/.axon_site
+LOG=/tmp/r5_queue.log
+
+probe() {
+    timeout 120 python -c "import jax; assert jax.devices()" 2>/dev/null
+}
+
+echo "$(date -u +%FT%TZ) waiting for tunnel" >> "$LOG"
+until probe; do sleep 120; done
+echo "$(date -u +%FT%TZ) tunnel up — starting queue" >> "$LOG"
+
+run() {  # run <label> <timeout> <cmd...>
+    local label=$1 tmo=$2; shift 2
+    echo "$(date -u +%FT%TZ) START $label" >> "$LOG"
+    timeout "$tmo" "$@" 2>&1 | grep -E '^\{' | tail -2 >> "$LOG"
+    echo "$(date -u +%FT%TZ) END $label (rc=$?)" >> "$LOG"
+}
+
+# 1-4: bench lines whose configs changed this round (fresh subprocesses)
+run "bert-attnonly       " 1800 python bench.py --one 4
+run "gpt2l-attnonly      " 2400 python bench.py --one 5
+run "nvme-pipelined      " 2400 python bench.py --one 2
+run "longctx-4096-chunked" 2400 python bench.py --one 7
+# 5: alternating-remat candidate for the seq-4096 line
+run "longseq-alt-remat   " 2400 python tools/longseq_ab.py --single 4096 chunked --remat alternating
+run "longseq-8k-chunked  " 2400 python tools/longseq_ab.py --single 8192 chunked
+# 6: serving smokes for the two new lines
+run "serving-longctx     " 2700 python bench.py --one 9
+run "serving-moe         " 2700 python bench.py --one 10
+echo "$(date -u +%FT%TZ) queue complete" >> "$LOG"

@@ -30,6 +30,12 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
 
     def __init__(self, *args, inference_config=None, **kwargs):
         super().__init__(*args, **kwargs)
+        if self._param_stream is not None:
+            raise ValueError(
+                "hybrid_engine does not compose with offload_param."
+                "paged_training: the generate side binds the device param "
+                "tree, which paged training never materializes — serve "
+                "from module_state_dict() via build_engine instead")
         self._inference_config = inference_config
         self._iv2 = None
         self._gen_step_of_params = -1

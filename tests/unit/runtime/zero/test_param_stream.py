@@ -318,3 +318,9 @@ class TestRejections:
                           remat=False)
         with pytest.raises(ValueError, match="MoE"):
             deepspeed_tpu.initialize(model=m, config=_cfg(True))
+
+    def test_hybrid_engine_rejected(self, eight_devices):
+        cfg = _cfg(True)
+        cfg["hybrid_engine"] = {"enabled": True}
+        with pytest.raises(ValueError, match="hybrid_engine"):
+            deepspeed_tpu.initialize(model=_model(), config=cfg)

@@ -324,3 +324,21 @@ class TestRejections:
         cfg["hybrid_engine"] = {"enabled": True}
         with pytest.raises(ValueError, match="hybrid_engine"):
             deepspeed_tpu.initialize(model=_model(), config=cfg)
+
+    def test_gnorm_matches_dense_under_gas(self, eight_devices):
+        """The clip norm is of the ACCUMULATED (mean-over-micros) gradient
+        — same convention as the resident engine (r5 review fix: summing
+        per-micro norms differs under gas > 1)."""
+        m = _model()
+        init = _shared_init(m)
+        paged, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=_cfg(True, gas=2, clip=1.0),
+            model_parameters=init)
+        dense, _, _, _ = deepspeed_tpu.initialize(
+            model=_model(), config=_cfg(False, gas=2, clip=1.0),
+            model_parameters=init)
+        batches = [_batch(seed=i) for i in range(2)]
+        paged.train_batch(iter(batches))
+        dense.train_batch(iter(batches))
+        np.testing.assert_allclose(paged.get_global_grad_norm(),
+                                   dense.get_global_grad_norm(), rtol=1e-3)

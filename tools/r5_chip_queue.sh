@@ -27,10 +27,11 @@ run "bert-attnonly       " 1800 python bench.py --one 4
 run "gpt2l-attnonly      " 2400 python bench.py --one 5
 run "nvme-pipelined      " 2400 python bench.py --one 2
 run "longctx-4096-chunked" 2400 python bench.py --one 7
+run "param-stream-125m    " 2400 python bench.py --one 8
 # 5: alternating-remat candidate for the seq-4096 line
 run "longseq-alt-remat   " 2400 python tools/longseq_ab.py --single 4096 chunked --remat alternating
 run "longseq-8k-chunked  " 2400 python tools/longseq_ab.py --single 8192 chunked
 # 6: serving smokes for the two new lines
-run "serving-longctx     " 2700 python bench.py --one 9
-run "serving-moe         " 2700 python bench.py --one 10
+run "serving-longctx     " 2700 python bench.py --one 10
+run "serving-moe         " 2700 python bench.py --one 11
 echo "$(date -u +%FT%TZ) queue complete" >> "$LOG"

@@ -1,0 +1,29 @@
+"""dstpu-lint: static analysis enforcing TPU-graph invariants.
+
+Two layers (see docs/STATIC_ANALYSIS.md):
+
+- **Layer A** (:mod:`.ast_rules`) — pure-AST rules, no jax import, runs on
+  every file: hidden host syncs, trace-time nondeterminism, Python
+  branching on traced values, undonated step jits, literal axis names.
+- **Layer B** (:mod:`.trace_harness`, :mod:`.entry_points`) —
+  ``trace_and_check`` traces real entry points via ``jax.make_jaxpr`` and
+  walks the jaxpr: collective axis binding/topology agreement, donation
+  aliasing, retrace-signature counting.
+
+Findings are structured (:mod:`.findings`), rules pluggable
+(:mod:`.registry`), and the gate diffs against ``tools/lint_baseline.json``
+(:mod:`.baseline`). CLI: ``dstpu lint`` / ``python tools/dstpu_lint.py``.
+"""
+
+from .findings import Finding, SEVERITY_ERROR, SEVERITY_WARNING  # noqa: F401
+from .registry import Rule, all_rules, ast_rule, register  # noqa: F401
+from .ast_rules import lint_file, lint_source  # noqa: F401
+
+__all__ = ["Finding", "Rule", "all_rules", "ast_rule", "register",
+           "lint_file", "lint_source", "trace_and_check"]
+
+
+def trace_and_check(*args, **kwargs):
+    """Lazy re-export: Layer B needs jax; Layer A users must not pay for it."""
+    from .trace_harness import trace_and_check as _tc
+    return _tc(*args, **kwargs)

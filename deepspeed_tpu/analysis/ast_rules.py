@@ -1,0 +1,429 @@
+"""Layer A: AST lint rules for TPU-graph invariants.
+
+Pure-Python static analysis — no jax import, safe to run on every file of
+the repo in milliseconds. The rules encode the failure modes that break
+"hot path stays inside XLA":
+
+- ``host-sync-in-trace``   device->host pulls inside traced code
+- ``nondet-in-trace``      Python-side nondeterminism baked in at trace time
+- ``traced-branch``        Python control flow on traced array values
+- ``missing-donate``       step/optimizer jits that don't donate their state
+- ``literal-axis-name``    collective axis names as bare string literals
+
+*Traced scope* is detected structurally: a function is considered traced if
+it (a) carries a ``jit``/``pjit``-style decorator, or (b) is passed (by
+name, anywhere in the module) to a tracing wrapper — ``jax.jit``,
+``shard_map``, ``jax.grad``, ``jax.vmap``, ``jax.lax.scan`` etc. Nested
+``def``s inside a traced function are traced too. This over-approximates
+(a helper traced in one call site may also run eagerly elsewhere) which is
+the right bias for an invariant gate; per-line suppression is
+``# dstpu: ignore[rule-id]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding, SEVERITY_ERROR, SEVERITY_WARNING, dedupe, sort_findings
+from .registry import LAYER_AST, Rule, ast_checkers, ast_rule
+
+# Keep in sync with runtime/topology.py MESH_AXES (not imported: Layer A must
+# not import jax, and topology pulls jax at module level).
+CANONICAL_AXIS_NAMES = ("pipe", "data", "mics", "expert", "seq", "model")
+
+# Callables that trace their function argument into a jaxpr.
+_TRACE_WRAPPERS = {
+    "jit", "pjit", "shard_map", "grad", "value_and_grad", "vmap", "pmap",
+    "checkpoint", "remat", "make_jaxpr", "scan", "fori_loop", "while_loop",
+    "cond", "switch", "custom_vjp", "custom_jvp", "eval_shape",
+}
+_JIT_NAMES = {"jit", "pjit"}
+
+# Collective call names (jax.lax primitives + the deepspeed_tpu.comm
+# frontend) whose axis arguments must use the canonical constants.
+_COLLECTIVE_FNS = {
+    "psum", "pmean", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "psum_scatter", "all_to_all", "axis_index", "axis_size", "all_reduce",
+    "reduce_scatter", "broadcast", "gather", "scatter", "reduce",
+    "all_to_all_single", "inference_all_reduce",
+}
+_AXIS_KWARGS = {"axis", "axes", "axis_name", "sequence_process_group"}
+
+_SUPPRESS_RE = re.compile(r"#\s*dstpu:\s*ignore(?:\[([a-z0-9_,\- ]+)\])?")
+_STEPPY_RE = re.compile(r"(step|update|apply|train|optim)", re.IGNORECASE)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'jax.lax.psum' for Attribute chains, 'psum' for Names, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_segment(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def _callee(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+class ModuleContext:
+    """Parsed module + traced-scope map handed to every Layer-A checker."""
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._traced_names = self._collect_traced_names()
+        self.traced_scopes = self._collect_traced_scopes()
+
+    # -- traced-scope discovery ------------------------------------------
+    def _collect_traced_names(self) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            seg = _last_segment(_callee(node))
+            if seg not in _TRACE_WRAPPERS:
+                continue
+            # functools.partial(jax.jit, fn) and jax.jit(fn) both put the
+            # traced callable in the positional args; scan/while take it
+            # first too.
+            for arg in node.args:
+                target = _last_segment(dotted_name(arg))
+                if target:
+                    names.add(target)
+        return names
+
+    def _has_trace_decorator(self, fn: ast.AST) -> bool:
+        for dec in getattr(fn, "decorator_list", []):
+            node = dec.func if isinstance(dec, ast.Call) else dec
+            if isinstance(dec, ast.Call) and _last_segment(_callee(dec)) == "partial":
+                for a in dec.args:
+                    if _last_segment(dotted_name(a)) in _TRACE_WRAPPERS:
+                        return True
+            if _last_segment(dotted_name(node)) in _TRACE_WRAPPERS:
+                return True
+        return False
+
+    def _collect_traced_scopes(self) -> List[ast.AST]:
+        scopes = []
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if node.name in self._traced_names or self._has_trace_decorator(node):
+                    scopes.append(node)
+            elif isinstance(node, ast.Lambda):
+                pass  # lambdas are traced via their wrapper call; handled below
+        # lambdas passed directly to trace wrappers
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call) and \
+                    _last_segment(_callee(node)) in _TRACE_WRAPPERS:
+                for arg in node.args:
+                    if isinstance(arg, ast.Lambda):
+                        scopes.append(arg)
+        return scopes
+
+    # -- helpers ----------------------------------------------------------
+    def traced_walk(self) -> Iterable[Tuple[ast.AST, ast.AST]]:
+        """(scope, node) for every node inside a traced scope."""
+        for scope in self.traced_scopes:
+            for node in ast.walk(scope):
+                yield scope, node
+
+    def scope_params(self, scope: ast.AST) -> Set[str]:
+        args = scope.args
+        names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+        if args.vararg:
+            names.append(args.vararg.arg)
+        if args.kwarg:
+            names.append(args.kwarg.arg)
+        return set(names)
+
+    def suppressed(self, line: int, rule_id: str) -> bool:
+        if not 1 <= line <= len(self.lines):
+            return False
+        m = _SUPPRESS_RE.search(self.lines[line - 1])
+        if not m:
+            return False
+        if m.group(1) is None:
+            return True  # bare '# dstpu: ignore' silences everything
+        ids = {s.strip() for s in m.group(1).split(",")}
+        return rule_id in ids
+
+
+def _finding(rule: Rule, ctx: ModuleContext, node: ast.AST, message: str) -> Finding:
+    return Finding(rule_id=rule.rule_id, path=ctx.path,
+                   line=getattr(node, "lineno", 0), severity=rule.severity,
+                   message=message, fix_hint=rule.fix_hint)
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-trace
+# ---------------------------------------------------------------------------
+HOST_SYNC = Rule(
+    rule_id="host-sync-in-trace", layer=LAYER_AST, severity=SEVERITY_ERROR,
+    description="Device->host pull (float()/.item()/np.asarray/print/"
+                "jax.device_get) inside traced code blocks the XLA pipeline",
+    fix_hint="keep the value on device (jnp ops); move host readout outside "
+             "the jit boundary, or use jax.debug.print for tracing output",
+)
+
+_NP_PULLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+             "onp.asarray", "onp.array"}
+_DEVICE_GET = {"jax.device_get", "device_get"}
+
+
+@ast_rule(HOST_SYNC)
+def check_host_sync(ctx: ModuleContext):
+    for scope, node in ctx.traced_walk():
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee(node)
+        seg = _last_segment(name)
+        if seg == "print" and name == "print":
+            yield _finding(HOST_SYNC, ctx, node,
+                           "print() in traced code runs at trace time only "
+                           "(or forces a host sync on a tracer)")
+        elif seg == "item":
+            yield _finding(HOST_SYNC, ctx, node,
+                           ".item() forces a device->host transfer inside "
+                           "traced code")
+        elif name in _NP_PULLS:
+            yield _finding(HOST_SYNC, ctx, node,
+                           f"{name}() materializes a tracer on host inside "
+                           "traced code")
+        elif name in _DEVICE_GET:
+            yield _finding(HOST_SYNC, ctx, node,
+                           "jax.device_get inside traced code is a hidden "
+                           "host sync")
+        elif name in ("float", "int", "bool") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in ctx.scope_params(scope):
+                yield _finding(HOST_SYNC, ctx, node,
+                               f"{name}() on traced argument "
+                               f"{arg.id!r} concretizes a tracer")
+
+
+# ---------------------------------------------------------------------------
+# nondet-in-trace
+# ---------------------------------------------------------------------------
+NONDET = Rule(
+    rule_id="nondet-in-trace", layer=LAYER_AST, severity=SEVERITY_ERROR,
+    description="Python-side nondeterminism (time.time, random.*, "
+                "datetime.now) inside traced code is frozen at trace time",
+    fix_hint="thread randomness through jax.random keys / pass timestamps "
+             "in as arguments",
+)
+
+_NONDET_EXACT = {
+    "time.time", "time.perf_counter", "time.monotonic", "time.time_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow", "uuid.uuid4", "os.urandom",
+}
+_NONDET_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+@ast_rule(NONDET)
+def check_nondet(ctx: ModuleContext):
+    for _scope, node in ctx.traced_walk():
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee(node)
+        if not name:
+            continue
+        if name in _NONDET_EXACT or any(name.startswith(p) for p in _NONDET_PREFIXES):
+            yield _finding(NONDET, ctx, node,
+                           f"{name}() in traced code is evaluated once at "
+                           "trace time and baked into the graph")
+
+
+# ---------------------------------------------------------------------------
+# traced-branch
+# ---------------------------------------------------------------------------
+TRACED_BRANCH = Rule(
+    rule_id="traced-branch", layer=LAYER_AST, severity=SEVERITY_ERROR,
+    description="Python if/while on a traced array value raises "
+                "TracerBoolConversionError or silently branches at trace time",
+    fix_hint="use jax.lax.cond / jnp.where / jax.lax.select on device values",
+)
+
+_ARRAY_NS_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "lax.")
+
+
+def _contains_array_call(expr: ast.AST) -> Optional[str]:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = _callee(node)
+            if name and any(name.startswith(p) for p in _ARRAY_NS_PREFIXES):
+                return name
+    return None
+
+
+@ast_rule(TRACED_BRANCH)
+def check_traced_branch(ctx: ModuleContext):
+    for _scope, node in ctx.traced_walk():
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            name = _contains_array_call(node.test)
+            if name:
+                kind = {"If": "if", "While": "while",
+                        "IfExp": "conditional expression"}[type(node).__name__]
+            else:
+                continue
+            yield _finding(TRACED_BRANCH, ctx, node,
+                           f"Python {kind} branches on {name}(...) — a traced "
+                           "array value")
+        elif isinstance(node, ast.Assert):
+            name = _contains_array_call(node.test)
+            if name:
+                yield _finding(TRACED_BRANCH, ctx, node,
+                               f"assert on {name}(...) concretizes a traced "
+                               "value (and vanishes under -O)")
+
+
+# ---------------------------------------------------------------------------
+# missing-donate
+# ---------------------------------------------------------------------------
+MISSING_DONATE = Rule(
+    rule_id="missing-donate", layer=LAYER_AST, severity=SEVERITY_WARNING,
+    description="jit of a step/update/apply function without donate_argnums "
+                "doubles peak HBM: input state and output state coexist",
+    fix_hint="pass donate_argnums=(0,) (or donate_argnames) for the state "
+             "argument of step/optimizer jits",
+)
+
+
+@ast_rule(MISSING_DONATE)
+def check_missing_donate(ctx: ModuleContext):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_segment(_callee(node)) not in _JIT_NAMES:
+            continue
+        if not node.args:
+            continue
+        target = _last_segment(dotted_name(node.args[0]))
+        if not target or not _STEPPY_RE.search(target):
+            continue
+        kw = {k.arg for k in node.keywords if k.arg}
+        if not ({"donate_argnums", "donate_argnames"} & kw):
+            yield _finding(MISSING_DONATE, ctx, node,
+                           f"jit({target}) on a step/optimizer path without "
+                           "donate_argnums/donate_argnames")
+
+
+# ---------------------------------------------------------------------------
+# literal-axis-name
+# ---------------------------------------------------------------------------
+LITERAL_AXIS = Rule(
+    rule_id="literal-axis-name", layer=LAYER_AST, severity=SEVERITY_WARNING,
+    description="Bare mesh-axis string literal at a collective call site; "
+                "axis names must come from deepspeed_tpu.utils.groups "
+                "constants so topology refactors stay atomic",
+    fix_hint="import DATA_AXIS/MODEL_AXIS/EXPERT_AXIS/SEQ_AXIS/PIPE_AXIS/"
+             "MICS_AXIS (or the compound *_AXES tuples) from "
+             "deepspeed_tpu.utils.groups",
+)
+
+
+def _literal_axis_values(node: ast.AST) -> List[str]:
+    """Canonical-axis string constants in an axis-argument expression."""
+    out = []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value in CANONICAL_AXIS_NAMES:
+            out.append(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for elt in node.elts:
+            out.extend(_literal_axis_values(elt))
+    return out
+
+
+# axis_index/axis_size take the axis as their FIRST argument; every other
+# collective takes the operand first and the axis second.
+_AXIS_ARG0_FNS = {"axis_index", "axis_size"}
+
+
+@ast_rule(LITERAL_AXIS)
+def check_literal_axis(ctx: ModuleContext):
+    # collective call sites: positional axis args + axis kwargs
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                _last_segment(_callee(node)) in _COLLECTIVE_FNS:
+            start = 0 if _last_segment(_callee(node)) in _AXIS_ARG0_FNS else 1
+            exprs = list(node.args[start:]) + \
+                [k.value for k in node.keywords if k.arg in _AXIS_KWARGS]
+            for expr in exprs:
+                for val in _literal_axis_values(expr):
+                    yield _finding(LITERAL_AXIS, ctx, node,
+                                   f"collective called with literal axis "
+                                   f"{val!r}")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # literal axis defaults in signatures (axis: AxisNames = "data")
+            args = node.args
+            pos = args.posonlyargs + args.args
+            for arg, default in zip(pos[len(pos) - len(args.defaults):],
+                                    args.defaults):
+                if arg.arg in _AXIS_KWARGS:
+                    for val in _literal_axis_values(default):
+                        yield Finding(
+                            rule_id=LITERAL_AXIS.rule_id, path=ctx.path,
+                            line=default.lineno, severity=LITERAL_AXIS.severity,
+                            message=f"parameter {arg.arg!r} of "
+                                    f"{node.name}() defaults to literal axis "
+                                    f"{val!r}",
+                            fix_hint=LITERAL_AXIS.fix_hint)
+            for arg, default in zip(args.kwonlyargs, args.kw_defaults):
+                if default is not None and arg.arg in _AXIS_KWARGS:
+                    for val in _literal_axis_values(default):
+                        yield Finding(
+                            rule_id=LITERAL_AXIS.rule_id, path=ctx.path,
+                            line=default.lineno, severity=LITERAL_AXIS.severity,
+                            message=f"parameter {arg.arg!r} of "
+                                    f"{node.name}() defaults to literal axis "
+                                    f"{val!r}",
+                            fix_hint=LITERAL_AXIS.fix_hint)
+        elif isinstance(node, ast.ClassDef):
+            # dataclass-style field defaults: `axis: str = "data"`
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                        and isinstance(stmt.target, ast.Name) \
+                        and stmt.target.id in _AXIS_KWARGS:
+                    for val in _literal_axis_values(stmt.value):
+                        yield Finding(
+                            rule_id=LITERAL_AXIS.rule_id, path=ctx.path,
+                            line=stmt.lineno, severity=LITERAL_AXIS.severity,
+                            message=f"field {stmt.target.id!r} of class "
+                                    f"{node.name} defaults to literal axis "
+                                    f"{val!r}",
+                            fix_hint=LITERAL_AXIS.fix_hint)
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def lint_source(path: str, source: str) -> List[Finding]:
+    try:
+        ctx = ModuleContext(path, source)
+    except SyntaxError as e:
+        return [Finding(rule_id="syntax-error", path=path, line=e.lineno or 0,
+                        severity=SEVERITY_ERROR, message=str(e.msg))]
+    findings: List[Finding] = []
+    for rule_id, checker in ast_checkers().items():
+        for f in checker(ctx):
+            if not ctx.suppressed(f.line, rule_id):
+                findings.append(f)
+    return sort_findings(dedupe(findings))
+
+
+def lint_file(path: str) -> List[Finding]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return lint_source(path, fh.read())

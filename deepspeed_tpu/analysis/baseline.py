@@ -1,0 +1,92 @@
+"""Baseline (grandfathering) for lint findings.
+
+The suite fails CI on any finding NOT present in the checked-in baseline
+(``tools/lint_baseline.json``). The workflow:
+
+- new violation      -> CI fails; fix it (preferred) or suppress inline
+- grandfathered one  -> listed in the baseline; fix it and regenerate with
+  ``dstpu lint --write-baseline`` so the file only ever shrinks
+- baseline entry whose finding no longer fires -> reported as *stale* so
+  the file cannot rot
+
+Keys are ``path::rule_id::message`` (no line numbers — those shift on every
+unrelated edit and would churn the file)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .findings import Finding, sort_findings
+
+
+TRACE_PREFIX = "<trace:"
+
+
+def split_layers(findings: List[Finding]) -> Tuple[List[Finding], List[Finding]]:
+    """-> (ast_findings, jaxpr_findings), by the ``<trace:...>`` path marker.
+
+    The two layers don't always run together (the jaxpr audit needs a
+    working JAX), so baseline diffs must only cover the layers that actually
+    ran — otherwise an AST-only run reports grandfathered jaxpr entries as
+    stale, and ``--write-baseline`` silently drops them."""
+    ast = [f for f in findings if not f.path.startswith(TRACE_PREFIX)]
+    jaxpr = [f for f in findings if f.path.startswith(TRACE_PREFIX)]
+    return ast, jaxpr
+
+
+def default_baseline_path() -> str:
+    # tools/lint_baseline.json at the repo root (two levels up from here)
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "lint_baseline.json")
+
+
+def load_baseline(path: str) -> List[Finding]:
+    if not os.path.exists(path):
+        return []
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return [Finding.from_dict(d) for d in data.get("findings", [])]
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    data = {
+        "comment": "Grandfathered dstpu-lint findings. Shrink, never grow: "
+                   "fix the finding and regenerate with "
+                   "`dstpu lint --write-baseline`.",
+        "findings": [f.to_dict() for f in sort_findings(findings)],
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def diff_against_baseline(findings: List[Finding], baseline: List[Finding]
+                          ) -> Tuple[List[Finding], List[Finding]]:
+    """-> (new_findings, stale_baseline_entries)."""
+    # multiset semantics: two identical findings on different lines of one
+    # file need two baseline entries
+    def multiset(fs: List[Finding]) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in fs:
+            out[f.baseline_key()] = out.get(f.baseline_key(), 0) + 1
+        return out
+
+    base = multiset(baseline)
+    new: List[Finding] = []
+    for f in sort_findings(findings):
+        k = f.baseline_key()
+        if base.get(k, 0) > 0:
+            base[k] -= 1
+        else:
+            new.append(f)
+    cur = multiset(findings)
+    stale: List[Finding] = []
+    for f in sort_findings(baseline):
+        k = f.baseline_key()
+        if cur.get(k, 0) > 0:
+            cur[k] -= 1
+        else:
+            stale.append(f)
+    return new, stale

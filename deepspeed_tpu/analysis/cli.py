@@ -1,0 +1,181 @@
+"""``dstpu lint`` — CLI driver for the static analysis suite.
+
+Exit codes: 0 = clean against the baseline, 1 = new findings (or stale
+baseline entries), 2 = usage error. The fast AST layer runs on every
+invocation; the jaxpr layer (``--jaxpr``) traces the real engine/ZeRO/MoE/
+sequence entry points and needs a working JAX (use ``JAX_PLATFORMS=cpu``
+off-accelerator).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from . import ast_rules
+from .baseline import (default_baseline_path, diff_against_baseline,
+                       load_baseline, split_layers, write_baseline)
+from .findings import Finding, SEVERITY_ERROR, sort_findings
+from .registry import all_rules, is_known
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def collect_py_files(paths: List[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+        else:
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                out.extend(os.path.join(dirpath, f)
+                           for f in filenames if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def _relpath(path: str) -> str:
+    try:
+        rel = os.path.relpath(path, os.path.dirname(_package_root()))
+        return rel if not rel.startswith("..") else path
+    except ValueError:
+        return path
+
+
+def run_ast_layer(paths: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in collect_py_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        findings.extend(ast_rules.lint_source(_relpath(path), source))
+    return sort_findings(findings)
+
+
+def run_jaxpr_layer(entry_names=None) -> List[Finding]:
+    from .entry_points import audit_entry_points
+    return audit_entry_points(entry_names)
+
+
+def render(findings: List[Finding], fix_hints: bool) -> str:
+    lines = []
+    for f in findings:
+        lines.append(f"{f.location}: [{f.rule_id}] {f.severity}: {f.message}")
+        if fix_hints and f.fix_hint:
+            lines.append(f"    hint: {f.fix_hint}")
+    return "\n".join(lines)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dstpu lint",
+        description="TPU-graph invariant linter (AST layer + jaxpr audit)")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the "
+                             "deepspeed_tpu package)")
+    parser.add_argument("--jaxpr", action="store_true",
+                        help="also run the jaxpr entry-point audits "
+                             "(traces engine/ZeRO/MoE/sequence paths)")
+    parser.add_argument("--entry", action="append", default=None,
+                        help="restrict --jaxpr to the named entry points")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: tools/lint_baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding; ignore the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="regenerate the baseline from current findings")
+    parser.add_argument("--fix-hints", action="store_true",
+                        help="print a fix hint under every finding, plus the "
+                             "rule reference")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        from . import trace_harness  # noqa: F401 — registers Layer-B rules
+        for rule in all_rules():
+            print(f"{rule.rule_id:26} [{rule.layer}/{rule.severity}] "
+                  f"{rule.description}")
+        return 0
+
+    paths = args.paths or [_package_root()]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"dstpu lint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings = run_ast_layer(paths)
+    if args.jaxpr:
+        try:
+            findings += run_jaxpr_layer(args.entry)
+        except ValueError as e:
+            print(f"dstpu lint: {e}", file=sys.stderr)
+            return 2
+    findings = sort_findings(findings)
+
+    baseline_path = args.baseline or default_baseline_path()
+    if args.write_baseline:
+        # An AST-only run must not erase grandfathered jaxpr entries: keep
+        # the baseline slice for the layer that did not run.
+        kept = ([] if args.jaxpr
+                else split_layers(load_baseline(baseline_path))[1])
+        write_baseline(baseline_path, findings + kept)
+        print(f"wrote {len(findings) + len(kept)} finding(s) to "
+              f"{baseline_path}"
+              + (f" ({len(kept)} jaxpr entr"
+                 f"{'y' if len(kept) == 1 else 'ies'} carried over)"
+                 if kept else ""))
+        return 0
+
+    baseline = [] if args.no_baseline else load_baseline(baseline_path)
+    if not args.jaxpr:
+        # Layer B did not run; its baseline entries are neither matchable
+        # nor stale here.
+        baseline = split_layers(baseline)[0]
+    new, stale = diff_against_baseline(findings, baseline)
+
+    if args.as_json:
+        import json
+        print(json.dumps({"findings": [f.to_dict() for f in findings],
+                          "new": [f.to_dict() for f in new],
+                          "stale_baseline": [f.to_dict() for f in stale]},
+                         indent=2))
+    else:
+        report = new if not args.no_baseline else findings
+        if report:
+            print(render(report, args.fix_hints))
+        if stale:
+            print(f"\n{len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (finding no longer "
+                  "fires) — regenerate with --write-baseline:")
+            for f in stale:
+                print(f"  {f.path}: [{f.rule_id}] {f.message}")
+        grandfathered = len(findings) - len(new)
+        print(f"\ndstpu lint: {len(findings)} finding(s), "
+              f"{grandfathered} grandfathered, {len(new)} new, "
+              f"{len(stale)} stale baseline")
+        if args.fix_hints and new:
+            seen = sorted({f.rule_id for f in new if is_known(f.rule_id)})
+            if seen:
+                print("\nrule reference:")
+                for rid in seen:
+                    from .registry import get
+                    rule = get(rid)
+                    print(f"  {rid}: {rule.description}")
+
+    has_blocking = bool(new) or bool(stale)
+    return 1 if has_blocking else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,59 @@
+"""Structured lint findings.
+
+The unit every layer of the suite speaks: Layer A (AST rules,
+``ast_rules.py``) and Layer B (jaxpr audit, ``trace_harness.py``) both emit
+:class:`Finding` records, the baseline (``baseline.py``) diffs them, and the
+CLI (``cli.py``) renders them. A finding is keyed for baseline purposes by
+``(path, rule_id, message)`` — line numbers shift on every unrelated edit,
+so they are display-only and never part of the identity.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule_id: str
+    path: str          # repo-relative where possible
+    line: int          # 1-indexed; 0 = whole-file / trace-level finding
+    severity: str      # SEVERITY_ERROR | SEVERITY_WARNING
+    message: str
+    fix_hint: str = ""
+
+    @property
+    def location(self) -> str:
+        return f"{self.path}:{self.line}" if self.line else self.path
+
+    def baseline_key(self) -> str:
+        return f"{self.path}::{self.rule_id}::{self.message}"
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: Dict) -> "Finding":
+        return Finding(rule_id=d["rule_id"], path=d["path"],
+                       line=int(d.get("line", 0)),
+                       severity=d.get("severity", SEVERITY_WARNING),
+                       message=d.get("message", ""),
+                       fix_hint=d.get("fix_hint", ""))
+
+
+def sort_findings(findings: List[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule_id, f.message))
+
+
+def dedupe(findings: List[Finding]) -> List[Finding]:
+    seen, out = set(), []
+    for f in findings:
+        k = (f.path, f.line, f.rule_id, f.message)
+        if k not in seen:
+            seen.add(k)
+            out.append(f)
+    return out

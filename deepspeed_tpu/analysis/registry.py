@@ -1,0 +1,67 @@
+"""Pluggable rule registry.
+
+Every rule — AST (Layer A) or jaxpr (Layer B) — registers a :class:`Rule`
+descriptor here. The CLI's ``--fix-hints`` and the docs table are generated
+from this registry, and suppression comments (``# dstpu: ignore[rule-id]``)
+are validated against it, so adding a rule is: write the checker, register
+the descriptor, add fixtures. Nothing else to touch.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional
+
+LAYER_AST = "ast"
+LAYER_JAXPR = "jaxpr"
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    layer: str           # LAYER_AST | LAYER_JAXPR
+    severity: str        # default severity of findings from this rule
+    description: str     # one-liner for docs / --fix-hints
+    fix_hint: str        # how to fix, rendered with the finding
+
+    def __post_init__(self):
+        assert self.layer in (LAYER_AST, LAYER_JAXPR), self.layer
+
+
+_RULES: Dict[str, Rule] = {}
+# Layer-A checkers: fn(module_ctx) -> iterable[Finding]; registered per rule
+# so the linter discovers them from the registry rather than a hardcoded list.
+_AST_CHECKERS: Dict[str, Callable] = {}
+
+
+def register(rule: Rule, checker: Optional[Callable] = None) -> Rule:
+    if rule.rule_id in _RULES:
+        raise ValueError(f"duplicate rule id {rule.rule_id!r}")
+    _RULES[rule.rule_id] = rule
+    if checker is not None:
+        _AST_CHECKERS[rule.rule_id] = checker
+    return rule
+
+
+def ast_rule(rule: Rule):
+    """Decorator form for Layer-A checkers."""
+    def wrap(fn):
+        register(rule, fn)
+        return fn
+    return wrap
+
+
+def get(rule_id: str) -> Rule:
+    return _RULES[rule_id]
+
+
+def is_known(rule_id: str) -> bool:
+    return rule_id in _RULES
+
+
+def all_rules() -> List[Rule]:
+    return [_RULES[k] for k in sorted(_RULES)]
+
+
+def ast_checkers() -> Dict[str, Callable]:
+    return dict(_AST_CHECKERS)

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.groups import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
+from ..utils.jax_compat import axis_size as _compat_axis_size
 from ..utils.logging import logger
 
 AxisNames = Union[str, Sequence[str]]
@@ -151,7 +153,7 @@ def barrier(name: str = "deepspeed_tpu_barrier") -> None:
 
 # -- in-mesh collectives (call inside shard_map / pjit) ----------------------
 
-def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = "data", group=None):
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = DATA_AXIS, group=None):
     """psum/pmax/pmin over named axes (reference comm.py:466 all_reduce)."""
     _record("all_reduce", tensor, axis)
     if op in (ReduceOp.SUM, ReduceOp.AVG):
@@ -166,14 +168,14 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = "data", gr
     raise ValueError(f"Unsupported reduce op {op}")
 
 
-def all_gather(tensor, axis: AxisNames = "data", tensor_axis: int = 0, tiled: bool = True):
+def all_gather(tensor, axis: AxisNames = DATA_AXIS, tensor_axis: int = 0, tiled: bool = True):
     """Concatenate shards along ``tensor_axis`` (reference all_gather_into_tensor,
     comm.py:308)."""
     _record("all_gather", tensor, axis)
     return jax.lax.all_gather(tensor, axis, axis=tensor_axis, tiled=tiled)
 
 
-def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = "data", scatter_axis: int = 0):
+def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = DATA_AXIS, scatter_axis: int = 0):
     """Sum then scatter shards (reference reduce_scatter_tensor, comm.py:257)."""
     _record("reduce_scatter", tensor, axis)
     out = jax.lax.psum_scatter(tensor, axis, scatter_dimension=scatter_axis, tiled=True)
@@ -182,14 +184,14 @@ def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = "data"
     return out
 
 
-def all_to_all(tensor, axis: AxisNames = "seq", split_axis: int = 0, concat_axis: int = 0):
+def all_to_all(tensor, axis: AxisNames = SEQ_AXIS, split_axis: int = 0, concat_axis: int = 0):
     """All-to-all resharding (reference all_to_all_single, comm.py:388) — the
     primitive behind Ulysses sequence parallelism and MoE dispatch."""
     _record("all_to_all", tensor, axis)
     return jax.lax.all_to_all(tensor, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
 
 
-def broadcast(tensor, src: int = 0, axis: AxisNames = "data"):
+def broadcast(tensor, src: int = 0, axis: AxisNames = DATA_AXIS):
     """Broadcast from ``src`` index along axis (reference comm.py:221).
 
     all_gather + static index: one gather's bandwidth ((n-1)/n · size per
@@ -200,7 +202,7 @@ def broadcast(tensor, src: int = 0, axis: AxisNames = "data"):
     return jax.lax.all_gather(tensor, axis)[src]
 
 
-def ppermute(tensor, perm, axis: AxisNames = "pipe"):
+def ppermute(tensor, perm, axis: AxisNames = PIPE_AXIS):
     """Point-to-point ring/permutation transfer — the TPU equivalent of the
     reference's pipeline ``p2p.send/recv`` (runtime/pipe/p2p.py:50,71)."""
     _record("ppermute", tensor, axis)
@@ -208,7 +210,7 @@ def ppermute(tensor, perm, axis: AxisNames = "pipe"):
 
 
 def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM,
-           axis: AxisNames = "data"):
+           axis: AxisNames = DATA_AXIS):
     """Reduce toward ``dst`` (reference comm.py reduce). SPMD has no cheap
     rooted reduce — every device computes the psum; non-dst members get
     zeros so the contract (result valid only on dst) still holds and XLA
@@ -230,7 +232,7 @@ def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM,
                      jnp.zeros_like(out))
 
 
-def gather(tensor, dst: int = 0, axis: AxisNames = "data", tensor_axis: int = 0):
+def gather(tensor, dst: int = 0, axis: AxisNames = DATA_AXIS, tensor_axis: int = 0):
     """Gather shards to ``dst`` (reference comm.py gather): all_gather with
     the same only-valid-on-dst contract (zeros elsewhere)."""
     _record("gather", tensor, axis)
@@ -239,7 +241,7 @@ def gather(tensor, dst: int = 0, axis: AxisNames = "data", tensor_axis: int = 0)
                      jnp.zeros_like(out))
 
 
-def scatter(tensor, src: int = 0, axis: AxisNames = "data", tensor_axis: int = 0):
+def scatter(tensor, src: int = 0, axis: AxisNames = DATA_AXIS, tensor_axis: int = 0):
     """Scatter ``src``'s shards across the axis (reference comm.py scatter):
     broadcast from src, then each member takes its static slice."""
     _record("scatter", tensor, axis)
@@ -257,7 +259,7 @@ def scatter(tensor, src: int = 0, axis: AxisNames = "data", tensor_axis: int = 0
     return jax.lax.dynamic_slice_in_dim(full, idx, k, axis=tensor_axis)
 
 
-def all_to_all_single(tensor, axis: AxisNames = "seq", split_axis: int = 0,
+def all_to_all_single(tensor, axis: AxisNames = SEQ_AXIS, split_axis: int = 0,
                       concat_axis: int = 0):
     """Alias of :func:`all_to_all` (reference all_to_all_single,
     comm.py:388 — the tensor-form API)."""
@@ -265,7 +267,7 @@ def all_to_all_single(tensor, axis: AxisNames = "seq", split_axis: int = 0,
                       concat_axis=concat_axis)
 
 
-def send(tensor, dst: int, axis: AxisNames = "pipe"):
+def send(tensor, dst: int, axis: AxisNames = PIPE_AXIS):
     """Rooted two-sided p2p has no XLA/SPMD primitive — every device runs
     the same program, so transfers are expressed as permutations. Rejected
     loudly rather than silently mis-mapped (reference pipe p2p.send)."""
@@ -275,7 +277,7 @@ def send(tensor, dst: int, axis: AxisNames = "pipe"):
         "pipeline next-stage transfer: perm=[(i, i+1), ...]")
 
 
-def recv(tensor, src: int, axis: AxisNames = "pipe"):
+def recv(tensor, src: int, axis: AxisNames = PIPE_AXIS):
     """See :func:`send` — same story in the receive direction (reference
     pipe p2p.recv signature: (tensor, src))."""
     raise NotImplementedError(
@@ -324,12 +326,10 @@ def axis_index(axis: AxisNames):
 
 
 def axis_size(axis: AxisNames) -> int:
-    if isinstance(axis, (tuple, list)):
-        return int(np.prod([jax.lax.axis_size(a) for a in axis]))
-    return jax.lax.axis_size(axis)
+    return _compat_axis_size(axis)
 
 
-def inference_all_reduce(tensor, axis: AxisNames = "model"):
+def inference_all_reduce(tensor, axis: AxisNames = MODEL_AXIS):
     """Low-latency TP allreduce (reference comm.py:500) — same psum on TPU;
     XLA already picks the latency-optimal ICI algorithm."""
     _record("inference_all_reduce", tensor, axis)

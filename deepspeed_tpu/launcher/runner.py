@@ -425,6 +425,11 @@ def _run_slurm(args, active: Dict[str, List[int]]) -> int:
 
 
 def main(args=None) -> int:
+    argv = sys.argv[1:] if args is None else list(args)
+    if argv and argv[0] == "lint":
+        # `dstpu lint ...` — the static analysis suite, not a launch.
+        from ..analysis.cli import main as lint_main
+        return lint_main(argv[1:])
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
     if args.elastic_training:

@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 from ..nn import layers as nn
 from ..ops.transformer.attention import flash_attention
 from ..runtime.topology import BATCH_AXES, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..utils.jax_compat import with_sharding_constraint
 from ..sequence.layer import ulysses_attention
 
 Params = Dict[str, Any]
@@ -49,10 +50,7 @@ ACTIVATIONS = {
 
 
 def _c(x, spec):
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, TypeError, RuntimeError):
-        return x
+    return with_sharding_constraint(x, spec)
 
 
 def masked_cross_entropy(logits: jax.Array, labels: jax.Array,

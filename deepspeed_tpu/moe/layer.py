@@ -25,16 +25,14 @@ from jax.sharding import PartitionSpec as P
 
 from ..runtime import topology as topo_mod
 from ..runtime.topology import BATCH_AXES, DATA_AXIS, EXPERT_AXIS
+from ..utils.jax_compat import with_sharding_constraint
 from .sharded_moe import capacity as _capacity, top_k_gating_indices
 
 Params = Dict[str, Any]
 
 
 def _c(x, spec):
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, TypeError, RuntimeError):
-        return x
+    return with_sharding_constraint(x, spec)
 
 
 @dataclasses.dataclass(frozen=True)

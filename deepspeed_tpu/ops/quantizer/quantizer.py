@@ -25,6 +25,9 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ...utils.groups import DATA_AXIS
+from ...utils.jax_compat import axis_size
+
 
 def quantize_blockwise(x: jax.Array, num_bits: int = 8, group_size: int = 256,
                        symmetric: bool = True) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -88,7 +91,7 @@ def dequantize_blockwise(q: jax.Array, scale: jax.Array, zero: jax.Array,
     return out.astype(dtype)
 
 
-def quantized_all_gather(x: jax.Array, axis: str = "data", num_bits: int = 8,
+def quantized_all_gather(x: jax.Array, axis: str = DATA_AXIS, num_bits: int = 8,
                          group_size: int = 256) -> jax.Array:
     """ZeRO++ qwZ-style all-gather: quantize the local shard, gather int8
     over the mesh axis, dequantize (reference quantized weights all-gather,
@@ -104,7 +107,7 @@ def quantized_all_gather(x: jax.Array, axis: str = "data", num_bits: int = 8,
     q_g = jax.lax.all_gather(q, axis, axis=0, tiled=True)
     s_g = jax.lax.all_gather(scale, axis, axis=0, tiled=True)
     z_g = jax.lax.all_gather(zero, axis, axis=0, tiled=True)
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     # Each shard's segment carries its own group padding at its tail; slice
     # per segment, not once at the end (segments are x.size rounded up to a
     # group multiple).
@@ -114,13 +117,13 @@ def quantized_all_gather(x: jax.Array, axis: str = "data", num_bits: int = 8,
     return out.reshape((x.shape[0] * n,) + x.shape[1:]).astype(x.dtype)
 
 
-def quantized_reduce_scatter(x: jax.Array, axis: str = "data", num_bits: int = 8,
+def quantized_reduce_scatter(x: jax.Array, axis: str = DATA_AXIS, num_bits: int = 8,
                              group_size: int = 256) -> jax.Array:
     """ZeRO++ qgZ-style gradient reduction (reference
     ``all_to_all_quant_reduce``, coalesced_collectives.py:31): quantize,
     all-to-all the shards, dequantize, local-sum. Trades ICI bytes for
     quantization error exactly like the reference."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     assert x.shape[0] % n == 0
     # Quantize each destination chunk separately so the all-to-all splits on
     # exact chunk boundaries even when chunk size is not a group multiple

@@ -29,6 +29,8 @@ from typing import Any, Callable, Optional
 
 import jax
 
+from ..topology import MODEL_AXIS
+
 _CONFIG = {
     "partition_activations": False,
     "contiguous_memory_optimization": False,
@@ -120,7 +122,7 @@ def model_parallel_reconfigure_tp_seed(seed: int):
     per-TP-rank folded key instead of mutating global RNG state."""
     base = jax.random.PRNGKey(seed)
     try:
-        idx = jax.lax.axis_index("model")
+        idx = jax.lax.axis_index(MODEL_AXIS)
         return jax.random.fold_in(base, idx)
     except Exception:
         return base

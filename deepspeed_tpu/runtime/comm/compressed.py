@@ -22,6 +22,8 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+from ...utils.jax_compat import axis_size
+
 
 def error_state(numel: int, axis_size: int) -> Tuple[jax.Array, jax.Array]:
     """Zero-initialized (worker_error, server_error) for a flat tensor of
@@ -47,7 +49,7 @@ def compressed_allreduce(x: jax.Array,
     ~1-bit-per-element traffic exactly like the reference's
     all_to_all + allgather pipeline (nccl.py:51-130).
     """
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     orig_shape, orig_dtype = x.shape, x.dtype
     flat = x.astype(jnp.float32).reshape(-1)
     numel = flat.size

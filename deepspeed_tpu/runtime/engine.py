@@ -884,7 +884,7 @@ class DeepSpeedEngine:
     # runtime/fp16/onebit + runtime/comm/nccl.py backends)
     # ------------------------------------------------------------------
     def _build_onebit_jits(self, shardings, rep):
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         from .topology import DATA_AXIS as AX
         mesh = self.mesh
         gas = self.gradient_accumulation_steps
@@ -993,7 +993,7 @@ class DeepSpeedEngine:
         return None, ()
 
     def _build_zeropp_micro(self):
-        from jax import shard_map
+        from ..utils.jax_compat import shard_map
         from .topology import MICS_AXIS
         from ..ops.quantizer.quantizer import (quantized_all_gather,
                                                quantized_reduce_scatter)
@@ -1560,8 +1560,13 @@ class DeepSpeedEngine:
                             lambda m: m.astype(dtype), new_master)
                         return new_params, new_opt
 
+                    # donate the optimizer state: it is replaced by the
+                    # returned tree, and without donation the fp32 moments
+                    # exist twice at peak (device-partition leaves are the
+                    # large ones under Twin-Flow)
                     self._jit_offload_devstep = jax.jit(
-                        dev_step, out_shardings=(dev_param_sh, opt_sh))
+                        dev_step, donate_argnums=(1,),
+                        out_shardings=(dev_param_sh, opt_sh))
                 with self.mesh:
                     dev_params, self.state["opt"] = \
                         self._jit_offload_devstep(

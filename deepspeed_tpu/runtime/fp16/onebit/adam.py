@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ...comm.compressed import compressed_allreduce, error_state
+from ...topology import DATA_AXIS
 
 Params = Any
 OptState = Dict[str, Any]
@@ -40,7 +41,7 @@ class OnebitAdam:
     eps: float = 1e-8
     weight_decay: float = 0.0
     freeze_step: int = 100
-    axis: str = "data"
+    axis: str = DATA_AXIS
     axis_size: int = 1
 
     name = "onebit_adam"

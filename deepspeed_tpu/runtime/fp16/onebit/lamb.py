@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from ...comm.compressed import compressed_allreduce, error_state
+from ...topology import DATA_AXIS
 
 Params = Any
 OptState = Dict[str, Any]
@@ -35,7 +36,7 @@ class OnebitLamb:
     max_coeff: float = 10.0
     min_coeff: float = 0.01
     coeff_beta: float = 0.9   # EMA for the frozen trust coefficient
-    axis: str = "data"
+    axis: str = DATA_AXIS
     axis_size: int = 1
 
     name = "onebit_lamb"

@@ -19,6 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from ...comm.compressed import compressed_allreduce, error_state
+from ...topology import DATA_AXIS
 
 Params = Any
 OptState = Dict[str, Any]
@@ -33,7 +34,7 @@ class ZeroOneAdam:
     var_freeze_step: int = 100
     var_update_scaler: int = 16     # variance refresh interval
     local_step_scaler: int = 4      # momentum sync interval (local steps between)
-    axis: str = "data"
+    axis: str = DATA_AXIS
     axis_size: int = 1
 
     name = "zero_one_adam"

@@ -14,6 +14,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.jax_compat import axis_size
+
 
 class SparseTensor:
 
@@ -56,7 +58,7 @@ def sparse_allreduce(st: SparseTensor, axis: str) -> SparseTensor:
     """Average sparse grads over a mesh axis by gathering indices+values
     (reference ``sparse_allreduce_bucket``, engine.py:2462). Call inside
     shard_map; duplicate indices resolve additively at densify time."""
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.all_gather(st.indices, axis, axis=0, tiled=True)
     vals = jax.lax.all_gather(st.values / n, axis, axis=0, tiled=True)
     return SparseTensor(idx, vals, st.dense_shape)

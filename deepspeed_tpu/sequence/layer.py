@@ -29,20 +29,17 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from .. import comm
 from ..runtime import topology as topo_mod
-from ..runtime.topology import BATCH_AXES, DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..utils.groups import BATCH_AXES, MODEL_AXIS, SEQ_AXIS
+from ..utils.jax_compat import shard_map, with_sharding_constraint
 from ..utils.logging import logger
 
 
 def _constraint(x: jax.Array, spec: P) -> jax.Array:
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, TypeError, RuntimeError):  # outside a mesh context
-        return x
+    return with_sharding_constraint(x, spec)
 
 
 # spec of activations [B, S, H, D] while sequence-sharded (outside attention)

@@ -26,10 +26,9 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-
 from ..runtime import topology as topo_mod
-from ..runtime.topology import SEQ_AXIS
+from ..utils.groups import SEQ_AXIS
+from ..utils.jax_compat import shard_map
 from .layer import SEQ_SHARDED
 
 NEG_INF = -1e30

@@ -14,10 +14,18 @@ from __future__ import annotations
 from typing import Optional, Tuple, Union
 
 from ..runtime import topology as topo
-from ..runtime.topology import (DATA_AXIS, DENSE_GRAD_AXES, EXPERT_AXIS, EXPERT_GRAD_AXES,
-                                MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, MeshTopology, TopologyConfig)
+from ..runtime.topology import (BATCH_AXES, DATA_AXIS, DENSE_GRAD_AXES, EXPERT_AXIS,
+                                EXPERT_GRAD_AXES, MESH_AXES, MICS_AXIS, MODEL_AXIS,
+                                PIPE_AXIS, SEQ_AXIS, MeshTopology, TopologyConfig)
 
 GroupHandle = Union[str, Tuple[str, ...]]
+
+# Canonical mesh-axis names. Every axis argument handed to a collective —
+# jax.lax or the deepspeed_tpu.comm frontend — must come from these (or the
+# compound tuples above), never from a bare string literal: `dstpu lint`
+# rule ``literal-axis-name`` enforces it against its own jax-free copy
+# (analysis/ast_rules.py), which a unit test keeps in sync with this one.
+CANONICAL_AXIS_NAMES: Tuple[str, ...] = MESH_AXES
 
 
 def initialize(ep_size: int = 1, mpu=None, sp_size: int = 1, tp_size: int = 1,

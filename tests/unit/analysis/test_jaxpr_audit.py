@@ -1,0 +1,174 @@
+"""Layer-B jaxpr audit: trace a toy pjit step on the 8-device CPU mesh and
+assert the collective-axis and donation checks (a) catch seeded violations
+with the right rule IDs and (b) pass clean code."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu.analysis.trace_harness import (JaxprAuditor, check_retrace,
+                                                  trace_and_check)
+from deepspeed_tpu.runtime import topology as topo_mod
+from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
+from deepspeed_tpu.utils.jax_compat import shard_map
+
+
+def ids(findings):
+    return [f.rule_id for f in findings]
+
+
+def _toy_step(mesh):
+    """A miniature train step: grad psum over the data axis inside
+    shard_map, state returned with the same structure (donatable)."""
+
+    def step(state, batch):
+        def shard(s, b):
+            g = jnp.mean(b) * jnp.ones_like(s)
+            g = jax.lax.psum(g, DATA_AXIS)
+            return s - 1e-3 * g
+
+        return shard_map(shard, mesh=mesh,
+                         in_specs=(P(), P(DATA_AXIS)),
+                         out_specs=P(), check_vma=False)(state, batch)
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# collective-axis checks
+# ---------------------------------------------------------------------------
+
+def test_clean_step_has_no_findings(eight_devices):
+    topo = topo_mod.initialize(TopologyConfig(data=8), force=True)
+    step = _toy_step(topo.mesh)
+    state = jnp.zeros((4, 4), jnp.float32)
+    batch = jnp.zeros((8, 4), jnp.float32)
+    findings = trace_and_check(step, state, batch, donate_argnums=(0,),
+                               name="toy-step")
+    assert findings == []
+
+
+def test_non_canonical_mesh_axis_flagged(eight_devices):
+    mesh = Mesh(np.array(jax.devices()[:8]), ("my_private_axis",))
+
+    def step(x):
+        return shard_map(lambda v: jax.lax.psum(v, "my_private_axis"),
+                         mesh=mesh, in_specs=P("my_private_axis"),
+                         out_specs=P(), check_vma=False)(x)
+
+    findings = trace_and_check(step, jnp.zeros((8,), jnp.float32),
+                               name="bad-axis", topology_sizes={})
+    assert "non-canonical-axis" in ids(findings)
+
+
+def test_private_mesh_size_mismatch_flagged(eight_devices):
+    # global topology says data=8; a locally built 4-device mesh silently
+    # halves the collective group — exactly what topology-mismatch is for
+    topo_mod.initialize(TopologyConfig(data=8), force=True)
+    mesh = Mesh(np.array(jax.devices()[:4]), (DATA_AXIS,))
+
+    def step(x):
+        return shard_map(lambda v: jax.lax.psum(v, DATA_AXIS), mesh=mesh,
+                         in_specs=P(DATA_AXIS), out_specs=P(),
+                         check_vma=False)(x)
+
+    findings = trace_and_check(step, jnp.zeros((8,), jnp.float32),
+                               name="mismatch")
+    assert "topology-mismatch" in ids(findings)
+
+
+def test_unbound_collective_axis_flagged(eight_devices):
+    # a psum whose axis has no shard_map binding in the jaxpr (traced under
+    # an ambient axis_env, as a stray pmap-style helper would be)
+    jaxpr = jax.make_jaxpr(lambda x: jax.lax.psum(x, DATA_AXIS),
+                           axis_env=[(DATA_AXIS, 8)])(
+        jnp.zeros((4,), jnp.float32))
+    auditor = JaxprAuditor("stray-psum", topology_sizes={})
+    auditor.walk(jaxpr.jaxpr)
+    assert ids(auditor.findings) == ["unbound-collective-axis"]
+
+
+def test_bound_axis_not_reported_outside_its_scope(eight_devices):
+    topo = topo_mod.initialize(TopologyConfig(data=8), force=True)
+    step = _toy_step(topo.mesh)
+    closed = jax.make_jaxpr(step)(jnp.zeros((4, 4), jnp.float32),
+                                  jnp.zeros((8, 4), jnp.float32))
+    auditor = JaxprAuditor("toy-step")
+    auditor.walk(closed.jaxpr)
+    assert auditor.findings == []
+
+
+# ---------------------------------------------------------------------------
+# donation checks
+# ---------------------------------------------------------------------------
+
+def test_donated_buffer_without_matching_output_flagged(eight_devices):
+    def reduce_loss(state):
+        return jnp.sum(state)  # scalar out: nothing to alias the donation to
+
+    findings = trace_and_check(reduce_loss, jnp.zeros((64, 64), jnp.float32),
+                               donate_argnums=(0,), name="bad-donate")
+    assert "donation-unusable" in ids(findings)
+
+
+def test_undonated_accumulator_flagged(eight_devices):
+    def step(state, lr):
+        return state * (1.0 - lr)  # same-shaped output, input not donated
+
+    findings = trace_and_check(step, jnp.zeros((64, 64), jnp.float32),
+                               jnp.float32(0.1), name="no-donate",
+                               big_bytes=1024)
+    assert "undonated-accumulator" in ids(findings)
+
+
+def test_properly_donated_state_is_clean(eight_devices):
+    def step(state, lr):
+        return state * (1.0 - lr)
+
+    findings = trace_and_check(step, jnp.zeros((64, 64), jnp.float32),
+                               jnp.float32(0.1), donate_argnums=(0,),
+                               name="donated", big_bytes=1024)
+    assert findings == []
+
+
+def test_donation_over_pytree_state(eight_devices):
+    # state is a dict of two leaves; donation maps fn-level argnums to the
+    # flat invars via leaf counts
+    def step(state, batch):
+        g = jnp.mean(batch)
+        return {k: v - g for k, v in state.items()}
+
+    state = {"w": jnp.zeros((32, 32), jnp.float32),
+             "b": jnp.zeros((256,), jnp.float32)}
+    batch = jnp.zeros((8,), jnp.float32)
+    clean = trace_and_check(step, state, batch, donate_argnums=(0,),
+                            name="tree-donated", big_bytes=512)
+    assert clean == []
+    dirty = trace_and_check(step, state, batch, name="tree-undonated",
+                            big_bytes=512)
+    assert ids(dirty).count("undonated-accumulator") == 2
+
+
+# ---------------------------------------------------------------------------
+# retrace signatures
+# ---------------------------------------------------------------------------
+
+def test_retrace_stable_shapes_clean(eight_devices):
+    a = jnp.zeros((8, 16), jnp.float32)
+    b = jnp.ones((8, 16), jnp.float32)  # same shape/dtype: same signature
+    assert check_retrace("stable", [(a,), (b,)]) == []
+
+
+def test_retrace_varying_shapes_flagged(eight_devices):
+    sets = [(jnp.zeros((8, n), jnp.float32),) for n in (16, 17, 18)]
+    findings = check_retrace("ragged", sets)
+    assert ids(findings) == ["retrace-hazard"]
+    assert "3 distinct trace signatures" in findings[0].message
+
+
+def test_retrace_static_arg_change_flagged(eight_devices):
+    x = jnp.zeros((8,), jnp.float32)
+    findings = check_retrace("static-churn", [(x, True), (x, False)])
+    assert ids(findings) == ["retrace-hazard"]

@@ -1,0 +1,52 @@
+"""CI gate: the repo must lint clean.
+
+This is `dstpu lint` running inside the tier-1 pytest invocation — the fast
+AST layer over the whole package diffed against the checked-in baseline,
+plus the jaxpr audits over the real traced entry points (the conftest
+already pins JAX_PLATFORMS=cpu with an 8-device host mesh). A failure here
+means a new TPU-graph invariant violation: fix it (preferred) or suppress
+with `# dstpu: ignore[rule-id]`; never grow tools/lint_baseline.json.
+"""
+
+import os
+
+import pytest
+
+from deepspeed_tpu.analysis.baseline import (default_baseline_path,
+                                             diff_against_baseline,
+                                             load_baseline, split_layers)
+from deepspeed_tpu.analysis.cli import run_ast_layer
+from deepspeed_tpu.analysis.entry_points import ENTRY_POINTS, audit_entry_points
+
+PACKAGE = os.path.join(os.path.dirname(default_baseline_path()), os.pardir,
+                       "deepspeed_tpu")
+
+
+def _render(findings):
+    return "\n".join(f"{f.location}: [{f.rule_id}] {f.message}"
+                     for f in findings)
+
+
+def test_ast_layer_clean_against_baseline():
+    findings = run_ast_layer([os.path.normpath(PACKAGE)])
+    baseline = split_layers(load_baseline(default_baseline_path()))[0]
+    new, stale = diff_against_baseline(findings, baseline)
+    assert not new, f"new dstpu-lint findings:\n{_render(new)}"
+    assert not stale, (
+        "stale baseline entries (fixed findings still grandfathered) — "
+        f"regenerate with `dstpu lint --write-baseline`:\n{_render(stale)}")
+
+
+def test_baseline_stays_small():
+    # the grandfather list only ever shrinks; 5 is the hard cap it started
+    # under and nothing may push it back up
+    assert len(load_baseline(default_baseline_path())) <= 5
+
+
+@pytest.mark.parametrize("entry", sorted(ENTRY_POINTS))
+def test_jaxpr_entry_point_clean(entry):
+    findings = audit_entry_points([entry])
+    baseline = [f for f in split_layers(load_baseline(default_baseline_path()))[1]
+                if f.path == f"<trace:{entry}>"]
+    new, _ = diff_against_baseline(findings, baseline)
+    assert not new, f"jaxpr audit findings:\n{_render(new)}"

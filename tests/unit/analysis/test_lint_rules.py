@@ -1,0 +1,436 @@
+"""Layer-A AST rules: one positive (fires) and one negative (stays quiet)
+fixture per rule, plus suppression, baseline diffing, and the registry
+contract. Pure AST — no jax needed, no mesh fixture."""
+
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.analysis import lint_source
+from deepspeed_tpu.analysis.baseline import diff_against_baseline
+from deepspeed_tpu.analysis.findings import Finding
+from deepspeed_tpu.analysis.registry import Rule, all_rules, register
+
+
+def lint(src):
+    return lint_source("fixture.py", textwrap.dedent(src))
+
+
+def rule_ids(src):
+    return [f.rule_id for f in lint(src)]
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-trace
+# ---------------------------------------------------------------------------
+
+def test_host_sync_item_in_jitted_fn_fires():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(state, batch):
+        loss = compute(state, batch)
+        log(loss.item())
+        return state
+    """
+    assert "host-sync-in-trace" in rule_ids(src)
+
+
+def test_host_sync_print_and_device_get_fire():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        print(x)
+        y = jax.device_get(x)
+        return y
+    """
+    ids = rule_ids(src)
+    assert ids.count("host-sync-in-trace") == 2
+
+
+def test_host_sync_np_asarray_in_shard_map_target_fires():
+    src = """
+    import numpy as np
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    def inner(x):
+        return np.asarray(x)
+
+    wrapped = shard_map(inner, mesh=m, in_specs=s, out_specs=s)
+    """
+    assert "host-sync-in-trace" in rule_ids(src)
+
+
+def test_host_sync_float_on_traced_param_fires():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(lr, grads):
+        return float(lr)
+    """
+    assert "host-sync-in-trace" in rule_ids(src)
+
+
+def test_host_sync_outside_traced_scope_quiet():
+    src = """
+    import numpy as np
+
+    def eval_log(metrics):
+        print(metrics)
+        return float(np.asarray(metrics).mean())
+    """
+    assert rule_ids(src) == []
+
+
+def test_host_sync_jax_debug_print_quiet():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        jax.debug.print("x={x}", x=x)
+        return x
+    """
+    assert rule_ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# nondet-in-trace
+# ---------------------------------------------------------------------------
+
+def test_nondet_time_and_random_fire():
+    src = """
+    import jax, time, random
+
+    @jax.jit
+    def step(x):
+        t0 = time.time()
+        noise = random.random()
+        return x * noise + t0
+    """
+    assert rule_ids(src).count("nondet-in-trace") == 2
+
+
+def test_nondet_np_random_in_scan_body_fires():
+    src = """
+    import jax
+    import numpy as np
+
+    def body(carry, x):
+        return carry + np.random.randn(), None
+
+    out = jax.lax.scan(body, 0.0, xs)
+    """
+    assert "nondet-in-trace" in rule_ids(src)
+
+
+def test_nondet_outside_trace_quiet():
+    src = """
+    import time
+
+    def wall_clock_logger():
+        return time.time()
+    """
+    assert rule_ids(src) == []
+
+
+def test_jax_random_with_key_quiet():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(key, x):
+        return x + jax.random.normal(key, x.shape)
+    """
+    assert rule_ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# traced-branch
+# ---------------------------------------------------------------------------
+
+def test_traced_branch_if_on_jnp_fires():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        if jnp.any(jnp.isnan(x)):
+            return jnp.zeros_like(x)
+        return x
+    """
+    assert "traced-branch" in rule_ids(src)
+
+
+def test_traced_branch_while_and_assert_fire():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        while jnp.sum(x) > 0:
+            x = x - 1
+        assert jnp.all(x == 0)
+        return x
+    """
+    ids = rule_ids(src)
+    assert ids.count("traced-branch") == 2
+
+
+def test_lax_cond_quiet():
+    src = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x):
+        return jax.lax.cond(jnp.sum(x) > 0, lambda v: v - 1, lambda v: v, x)
+    """
+    assert rule_ids(src) == []
+
+
+def test_python_branch_on_static_config_quiet():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x, *, use_bias=True):
+        if use_bias:
+            x = x + 1
+        return x
+    """
+    assert rule_ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# missing-donate
+# ---------------------------------------------------------------------------
+
+def test_missing_donate_on_step_jit_fires():
+    src = """
+    import jax
+
+    def train_step(state, batch):
+        return state
+
+    step = jax.jit(train_step)
+    """
+    assert "missing-donate" in rule_ids(src)
+
+
+def test_donated_step_jit_quiet():
+    src = """
+    import jax
+
+    def train_step(state, batch):
+        return state
+
+    step = jax.jit(train_step, donate_argnums=(0,))
+    """
+    assert rule_ids(src) == []
+
+
+def test_non_step_jit_quiet():
+    src = """
+    import jax
+
+    def forward(params, x):
+        return x
+
+    fwd = jax.jit(forward)
+    """
+    assert rule_ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# literal-axis-name
+# ---------------------------------------------------------------------------
+
+def test_literal_axis_in_collective_call_fires():
+    src = """
+    import jax
+
+    def grad_sync(g):
+        return jax.lax.psum(g, "data")
+    """
+    assert "literal-axis-name" in rule_ids(src)
+
+
+def test_literal_axis_kwarg_and_tuple_fire():
+    src = """
+    import jax
+    from deepspeed_tpu.comm import comm
+
+    def sync(g):
+        g = comm.all_reduce(g, axis=("data", "mics"))
+        return jax.lax.all_gather(g, axis_name="model")
+    """
+    assert rule_ids(src).count("literal-axis-name") == 3
+
+
+def test_literal_axis_signature_default_fires():
+    src = """
+    import jax
+
+    def all_reduce(x, axis="data"):
+        return jax.lax.psum(x, axis)
+    """
+    assert "literal-axis-name" in rule_ids(src)
+
+
+def test_literal_axis_dataclass_field_fires():
+    src = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Optim:
+        lr: float = 1e-3
+        axis: str = "data"
+    """
+    assert "literal-axis-name" in rule_ids(src)
+
+
+def test_axis_constant_from_groups_quiet():
+    src = """
+    import jax
+    from deepspeed_tpu.utils.groups import DATA_AXIS
+
+    def grad_sync(g):
+        return jax.lax.psum(g, DATA_AXIS)
+    """
+    assert rule_ids(src) == []
+
+
+def test_non_canonical_string_not_flagged_by_layer_a():
+    # Layer A only polices the canonical names; ad-hoc axes are Layer B's
+    # non-canonical-axis finding (it knows the real mesh).
+    src = """
+    import jax
+
+    def f(x):
+        return jax.lax.psum(x, "my_private_axis")
+    """
+    assert rule_ids(src) == []
+
+
+def test_literal_axis_in_non_collective_call_quiet():
+    src = """
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.concatenate([x, x], axis=0)
+    """
+    assert rule_ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression + syntax errors
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_by_rule_id():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        print(x)  # dstpu: ignore[host-sync-in-trace]
+        return x
+    """
+    assert rule_ids(src) == []
+
+
+def test_bare_suppression_silences_all():
+    src = """
+    import jax, time
+
+    @jax.jit
+    def step(x):
+        return x * time.time()  # dstpu: ignore
+    """
+    assert rule_ids(src) == []
+
+
+def test_suppression_for_other_rule_does_not_apply():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        print(x)  # dstpu: ignore[nondet-in-trace]
+        return x
+    """
+    assert "host-sync-in-trace" in rule_ids(src)
+
+
+def test_syntax_error_is_a_finding():
+    findings = lint_source("broken.py", "def f(:\n")
+    assert [f.rule_id for f in findings] == ["syntax-error"]
+
+
+# ---------------------------------------------------------------------------
+# baseline diffing
+# ---------------------------------------------------------------------------
+
+def _f(path="a.py", rule="host-sync-in-trace", msg="m", line=1):
+    return Finding(rule_id=rule, path=path, line=line, severity="error",
+                   message=msg)
+
+
+def test_baseline_grandfathers_known_finding():
+    new, stale = diff_against_baseline([_f()], [_f(line=99)])
+    assert new == [] and stale == []  # line number is not identity
+
+
+def test_baseline_reports_new_finding():
+    new, stale = diff_against_baseline([_f(), _f(msg="other")], [_f()])
+    assert [f.message for f in new] == ["other"] and stale == []
+
+
+def test_baseline_reports_stale_entry():
+    new, stale = diff_against_baseline([], [_f()])
+    assert new == [] and [f.message for f in stale] == ["m"]
+
+
+def test_baseline_multiset_semantics():
+    # two identical findings need two baseline entries
+    new, _ = diff_against_baseline([_f(line=1), _f(line=2)], [_f()])
+    assert len(new) == 1
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_registry_has_all_layer_a_rules():
+    ids = {r.rule_id for r in all_rules()}
+    assert {"host-sync-in-trace", "nondet-in-trace", "traced-branch",
+            "missing-donate", "literal-axis-name"} <= ids
+
+
+def test_registry_has_layer_b_rules():
+    import deepspeed_tpu.analysis.trace_harness  # noqa: F401 - registers on import
+    ids = {r.rule_id for r in all_rules()}
+    assert {"unbound-collective-axis", "non-canonical-axis",
+            "topology-mismatch", "donation-unusable",
+            "undonated-accumulator", "retrace-hazard"} <= ids
+
+
+def test_duplicate_rule_id_rejected():
+    rule = all_rules()[0]
+    with pytest.raises(ValueError):
+        register(Rule(rule_id=rule.rule_id, layer="ast", severity="error",
+                      description="dup", fix_hint=""))
+
+
+def test_canonical_axis_names_in_sync_with_groups():
+    # ast_rules keeps a jax-free copy of the canonical axis names so Layer A
+    # never imports jax; this pins it to the real topology constants.
+    from deepspeed_tpu.analysis.ast_rules import CANONICAL_AXIS_NAMES as lint_axes
+    from deepspeed_tpu.utils.groups import CANONICAL_AXIS_NAMES as real_axes
+    assert set(lint_axes) == set(real_axes)

@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from deepspeed_tpu.utils.jax_compat import shard_map
 
 from deepspeed_tpu.ops.quantizer import (dequantize_blockwise, quantize_blockwise,
                                          quantized_all_gather, quantized_reduce_scatter)

@@ -116,7 +116,7 @@ class TestSparseTensor:
         np.testing.assert_array_equal(np.asarray(st.to_dense()), x)
 
     def test_sparse_allreduce_matches_dense(self, eight_devices):
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
         from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, sparse_allreduce
         mesh = Mesh(np.array(jax.devices()), ("data",))

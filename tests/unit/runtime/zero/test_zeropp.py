@@ -121,7 +121,7 @@ class TestZeroPlusPlus:
         (the engine-level micro steps use different partitioning strategies
         whose other collectives would drown the signal)."""
         import functools
-        from jax import shard_map
+        from deepspeed_tpu.utils.jax_compat import shard_map
         from jax.sharding import Mesh, PartitionSpec as P
         from deepspeed_tpu.ops.quantizer import quantized_reduce_scatter
 

@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Standalone entry for the dstpu static analysis suite.
+
+    python tools/dstpu_lint.py deepspeed_tpu/            # fast AST layer
+    python tools/dstpu_lint.py --jaxpr                   # + jaxpr audits
+    python tools/dstpu_lint.py --write-baseline          # regenerate baseline
+    python tools/dstpu_lint.py --fix-hints --no-baseline # full report + hints
+
+Same engine as `dstpu lint`; exit 0 means clean against
+tools/lint_baseline.json."""
+
+import os
+import sys
+
+try:
+    from deepspeed_tpu.analysis.cli import main
+except ModuleNotFoundError:  # source checkout without install
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.realpath(__file__))))
+    from deepspeed_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,12 +129,40 @@ def audit_ulysses_attention() -> List[Finding]:
                            q, q, q, name="ulysses-attention")
 
 
+def audit_flash_kernel() -> List[Finding]:
+    """The in-repo Pallas flash training kernel (r6 tentpole,
+    ops/transformer/pallas_flash.py): the jaxpr audit covers the wrapper's
+    graph — the kernel must bind no collective and alias no donation. The
+    scalar-prefetch contract (``q_offset``/``window`` are OPERANDS, not
+    static config) is enforced by tracing them as ABSTRACT i32 scalars
+    here: a regression that bakes either into the kernel's static
+    configuration cannot concretize a tracer and surfaces as a hard
+    trace-failed finding (and the numerics side is pinned by
+    tests/unit/ops/test_pallas_flash.py::test_traced_q_offset_and_window,
+    which feeds one jitted trace multiple values)."""
+    import jax.numpy as jnp
+    from deepspeed_tpu.ops.transformer.pallas_flash import \
+        flash_attention_kernel
+
+    q = jnp.zeros((1, 64, 4, 16), jnp.float32)
+    k = jnp.zeros((1, 64, 2, 16), jnp.float32)
+
+    def fn(q, k, v, off, w):
+        return flash_attention_kernel(q, k, v, causal=True, q_offset=off,
+                                      window=w, interpret=True)
+
+    i32 = lambda x: jnp.asarray(x, jnp.int32)
+    return trace_and_check(fn, q, k, k, i32(0), i32(0),
+                           name="flash-attention-kernel")
+
+
 ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
     "engine-train-step": audit_engine_step,
     "zero-gather-partition": audit_zero_gather_partition,
     "moe-dispatch": audit_moe_dispatch,
     "ring-attention": audit_ring_attention,
     "ulysses-attention": audit_ulysses_attention,
+    "flash-attention-kernel": audit_flash_kernel,
 }
 
 

@@ -521,11 +521,16 @@ class TransformerLM:
                 # FLOPs instead of full remat's 33%, while removing exactly
                 # the buffers whose no-remat residuals blow compile memory
                 # at bert/gpt2 bench dims. NOTE: only the XLA attention
-                # path names those tensors; under a Pallas kernel path
-                # (which never materializes S^2 buffers in the first
-                # place) this policy degrades to save-everything — i.e.
-                # no-remat memory minus the scores, which is the
-                # analogous behavior, not a blowup.
+                # path names those tensors. Under the in-repo Pallas flash
+                # kernel (ops/transformer/pallas_flash.py) no S^2 buffer
+                # exists to recompute: the kernel's custom-VJP residuals
+                # are O(S) — q/k/v, the output, and the row LSE — and this
+                # save-everything-else policy saves exactly those, so the
+                # backward re-runs only the blockwise tile recomputation
+                # already priced into the flash backward. The LSE residual
+                # REPLACES the attn_big checkpoint: same memory contract
+                # (no quadratic residual), enforced by the kernel instead
+                # of the remat namer.
                 policy = jax.checkpoint_policies \
                     .save_anything_except_these_names("attn_big")
             elif c.remat_policy and c.remat_policy not in ("full",

@@ -1,1 +1,3 @@
 from .attention import flash_attention  # noqa: F401
+from .pallas_flash import (  # noqa: F401
+    flash_attention_kernel, flash_attention_with_lse, merge_partials)

@@ -2,9 +2,10 @@
 
 The training-attention slot of the reference's kernel stack
 (``csrc/transformer/softmax_kernels.cu`` + inference ``blocked_flash``). On
-TPU the hot path is a Pallas flash-attention kernel (MXU-tiled, fp32
-accumulation); off-TPU (CPU test meshes) we fall back to a pure-XLA
-implementation with identical semantics so tests validate numerics everywhere.
+TPU the long-sequence hot path is the in-repo Pallas flash-attention kernel
+pair (``pallas_flash.py`` — MXU-tiled, fp32 accumulation, blockwise fwd AND
+bwd); off-TPU (CPU test meshes) we fall back to a pure-XLA implementation
+with identical semantics so tests validate numerics everywhere.
 """
 
 from __future__ import annotations
@@ -205,6 +206,18 @@ def _flash_kernel_importable() -> bool:
         return False
 
 
+def attn_mode() -> str:
+    """The validated ``DSTPU_ATTN`` value — ONE reader shared by this
+    dispatch and ring attention so no caller can silently accept a typo
+    ("XLA", "chunked"): an escape hatch that ignores a misspelling is no
+    escape hatch at all."""
+    mode = os.environ.get("DSTPU_ATTN", "")
+    if mode not in ("", "xla", "pallas"):
+        raise ValueError(f"DSTPU_ATTN must be ''|'xla'|'pallas', got "
+                         f"{mode!r}")
+    return mode
+
+
 # At and above this query length the flash kernel is the DEFAULT: the
 # XLA path's materialized scores ([B, H, S, S] fp32, 2.1 GiB per unit
 # batch at 4k) fail to compile next to a full-depth train state —
@@ -288,28 +301,68 @@ def flash_attention(q: jax.Array,
                     window: Optional[jax.Array] = None) -> jax.Array:
     """Multi-head attention, [B, S, H, D] layout, GQA-aware.
 
-    Dispatches to a Pallas TPU flash kernel when shapes allow, else XLA.
-    Grouped-query models take the GQA-native splash kernel (K/V loaded
-    once per kv head — no broadcast); matched-head models take the stock
-    flash kernel. The XLA path consumes GQA natively.
+    Long sequences (>= FLASH_DEFAULT_MIN_SEQ on TPU) dispatch to the
+    IN-REPO Pallas flash kernel pair (pallas_flash.py: blockwise forward
+    and backward, GQA-native, full feature matrix — causal, sliding
+    window, segment ids, ALiBi, q_offset); ``DSTPU_ATTN=xla`` is the
+    escape hatch back to query-chunked XLA and ``DSTPU_ATTN=pallas``
+    forces the kernel at any length (interpret mode off-TPU). Short
+    sequences keep the one-shot XLA path (measured faster at <= 2k). The
+    legacy stock/splash-kernel knobs remain honored — see
+    docs/LONG_CONTEXT.md for the full decision table.
     ``alibi_slopes`` [num_heads] adds the ALiBi positional bias (bloom);
-    ``window`` (0 = global) is the causal sliding window — XLA path only.
+    ``window`` (0 = global) is the causal sliding window.
     """
     head_dim = q.shape[-1]
-    # Long-seq default (r5, tools/longseq_ab.py): query-chunked XLA — the
-    # XLA attention path's speed with bounded score memory. The Pallas
-    # kernels remain selectable: DSTPU_PALLAS_FLASH=1 forces them;
-    # DSTPU_LONGSEQ_ATTN=pallas routes long-seq to them.
+    # Path selection (docs/LONG_CONTEXT.md). DSTPU_ATTN is the primary
+    # switch: '' (auto) routes long sequences to the IN-REPO Pallas flash
+    # kernel pair (ops/transformer/pallas_flash.py — blockwise fwd+bwd,
+    # full feature matrix: causal/GQA/window/segment-ids/ALiBi/q_offset);
+    # 'xla' is the escape hatch back to the round-5 chunked-XLA path;
+    # 'pallas' forces the in-repo kernel at ANY length (interpret mode on
+    # CPU test meshes). The legacy knobs (DSTPU_LONGSEQ_ATTN,
+    # DSTPU_PALLAS_FLASH) still steer the round-5 routes when set.
+    mode = attn_mode()
+    if mode != "xla":
+        from . import pallas_flash as _pf
+        on_cpu = jax.default_backend() == "cpu"
+        force = mode == "pallas"
+        # force mode runs the kernel wherever it CAN run (interpret mode
+        # relaxes the 128-wide k-tile requirement to plain divisibility)
+        kernel_ok = _pf.supports(q.shape, k.shape,
+                                 compiled=not (force and on_cpu))
+        auto = (mode == "" and q.shape[1] >= FLASH_DEFAULT_MIN_SEQ
+                and not on_cpu
+                and os.environ.get("DSTPU_LONGSEQ_ATTN") is None
+                and os.environ.get("DSTPU_PALLAS_FLASH", "") != "1")
+        if kernel_ok and (force or auto):
+            _log_path_once("pallas_flash_inrepo")
+            return _pf.flash_attention_kernel(
+                q, k, v, causal=causal, scale=scale,
+                segment_ids=segment_ids, alibi_slopes=alibi_slopes,
+                window=window)
+        if force:
+            # an explicit DSTPU_ATTN=pallas that cannot be honored must
+            # not pass silently (round-1 review: perf regressions hide in
+            # silent fallbacks)
+            _log_path_once(f"xla (DSTPU_ATTN=pallas REFUSED: shapes "
+                           f"q={q.shape} k={k.shape} unsupported)")
+    # Long-seq XLA fallback (r5, tools/longseq_ab.py): query-chunked XLA —
+    # the XLA attention path's speed with bounded score memory.
     if (q.shape[1] >= FLASH_DEFAULT_MIN_SEQ
-            and os.environ.get("DSTPU_PALLAS_FLASH", "") != "1"
-            and os.environ.get("DSTPU_LONGSEQ_ATTN", "chunked") == "chunked"
+            and (mode == "xla"
+                 or os.environ.get("DSTPU_PALLAS_FLASH", "") != "1")
+            and (mode == "xla"
+                 or os.environ.get("DSTPU_LONGSEQ_ATTN", "chunked")
+                 == "chunked")
             and jax.default_backend() != "cpu"):
         _log_path_once("xla_chunked")
         return _xla_attention_chunked(q, k, v, causal, scale, segment_ids,
                                       alibi_slopes, window)
     # head_dim 64 (gpt2) is supported by the stock kernel — Mosaic pads the
     # lane dim; requiring %128 hid the Pallas path from the benched model
-    if (_pallas_flash_available(q.shape[1]) and segment_ids is None
+    if (mode != "xla" and _pallas_flash_available(q.shape[1])
+            and segment_ids is None
             and alibi_slopes is None and window is None and head_dim % 64 == 0
             and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0):
         num_q_heads, num_kv_heads = q.shape[2], k.shape[2]

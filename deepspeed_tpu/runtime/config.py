@@ -213,11 +213,18 @@ class DeepSpeedConfig:
             "seq_parallel_communication_data_type", "fp32")
         self.data_types_grad_accum_dtype: Optional[str] = pd.get("data_types", {}).get(
             "grad_accum_dtype") if isinstance(pd.get("data_types"), dict) else None
-        # stored precision of the Adam/Lion moments (compute stays fp32) —
-        # TPU-native extension of the memory knob below; "bf16" halves
-        # optimizer memory from 12 to 8 bytes/param
+        # stored precision of the Adam/Lion FIRST moments (compute stays
+        # fp32) — TPU-native extension of the memory knob below
         self.data_types_optimizer_moment_dtype: Optional[str] = pd.get(
             "data_types", {}).get("optimizer_moment_dtype") \
+            if isinstance(pd.get("data_types"), dict) else None
+        # SECOND moments (exp_avg_sq / adagrad sum_sq) keep fp32 unless
+        # narrowed here EXPLICITLY: under beta2=0.999 the per-step EMA
+        # increment sits below bf16 resolution, so narrowing v is a
+        # convergence tradeoff (stochastically-rounded store; see
+        # runtime/optimizers.py docstring) taken only for HBM
+        self.data_types_optimizer_moment_sq_dtype: Optional[str] = pd.get(
+            "data_types", {}).get("optimizer_moment_sq_dtype") \
             if isinstance(pd.get("data_types"), dict) else None
         # reference config.py:171 get_fp16_master_weights_and_grads_enabled:
         # store master weights in the model dtype (here bf16) instead of fp32

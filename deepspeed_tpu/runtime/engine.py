@@ -119,6 +119,21 @@ class DeepSpeedEngine:
             raise ValueError(
                 "data_types.optimizer_moment_dtype must be bf16/fp16/fp32, got "
                 f"{config.data_types_optimizer_moment_dtype!r}")
+        # second moments narrow ONLY through this explicit knob — bf16
+        # stores freeze a beta2=0.999 EMA without stochastic rounding, so
+        # moment_dtype alone no longer touches exp_avg_sq (ADVICE r4;
+        # tradeoff documented in runtime/optimizers.py)
+        if config.data_types_optimizer_moment_sq_dtype in ("bf16",
+                                                           "bfloat16"):
+            _opt_dtypes["moment_sq_dtype"] = jnp.bfloat16
+        elif config.data_types_optimizer_moment_sq_dtype in ("fp16",
+                                                             "float16"):
+            _opt_dtypes["moment_sq_dtype"] = jnp.float16
+        elif config.data_types_optimizer_moment_sq_dtype not in (
+                None, "fp32", "float32"):
+            raise ValueError(
+                "data_types.optimizer_moment_sq_dtype must be bf16/fp16/"
+                f"fp32, got {config.data_types_optimizer_moment_sq_dtype!r}")
         if _opt_dtypes:
             if config.zero_config.offload_optimizer is not None:
                 # the host runner steps flat fp32 chunks through the C++ SIMD
@@ -1945,13 +1960,20 @@ class DeepSpeedEngine:
                 m["shape"], m["sharding"], arrs))
         self.state["params"] = jax.tree_util.tree_unflatten(
             self._pcache["treedef"], leaves)
+        if nvme:
+            # fence the H2D transfers BEFORE pooling: device_put may alias
+            # or still be streaming the host buffer after returning, and a
+            # pooled buffer would be overwritten by the next same-size
+            # swap_in's async_pread mid-transfer (ADVICE r4). Once every
+            # leaf is ready no consumer of the host memory remains, so the
+            # buffers can re-enter the free list (donate=True) and the
+            # steady-state page-out/page-in cycle allocates no new host
+            # memory (reference SwapBufferManager reuse).
+            jax.block_until_ready(self.state["params"])
         for m in self._pcache["meta"]:
             for name, _ in m["pieces"]:
                 if nvme:
-                    # no donate: device_put above may still be reading the
-                    # host buffer asynchronously — dropping (not pooling)
-                    # lets refcounting keep it alive until the transfer lands
-                    swapper.release(name)
+                    swapper.release(name, donate=True)
                 else:
                     self._param_host_store.pop(name, None)
         self._pcache = None

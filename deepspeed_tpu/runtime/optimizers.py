@@ -13,14 +13,25 @@ machinery exists to do by hand. A Pallas fused step over flat shards exists in
 All optimizers keep fp32 master state by default; the engine decides how
 states are sharded (ZeRO) by placing sharding constraints on the pytrees.
 
-``master_dtype`` / ``moment_dtype`` narrow the STORED precision of the
-master copy and the Adam moments (the update itself always computes in
-fp32). This is the TPU analog of the reference's
-``fp16_master_weights_and_grads`` knob (reference config.py:171,
-zero/stage_1_and_2.py:232), which halves optimizer memory to fit larger
-models on one device: storing moments in bf16 cuts an AdamW state from
-12 bytes/param to 8, the difference between a full-depth 1.1B model
-fitting in 16 GB HBM and not.
+``master_dtype`` / ``moment_dtype`` / ``moment_sq_dtype`` narrow the STORED
+precision of the master copy, the FIRST moments, and the SECOND moments
+respectively (the update itself always computes in fp32). This is the TPU
+analog of the reference's ``fp16_master_weights_and_grads`` knob (reference
+config.py:171, zero/stage_1_and_2.py:232), which halves optimizer memory to
+fit larger models on one device.
+
+Convergence tradeoff (ADVICE r4): the second moment is the risky slot.
+With beta2=0.999 the per-step EMA increment ``(1-b2)*(g^2 - v)`` is ~2^-10
+of ``v`` — below bf16's ~2^-8 resolution — so a round-to-nearest bf16
+store FREEZES ``v`` and silently misscales the effective lr, which is why
+``moment_dtype`` deliberately narrows only ``exp_avg`` (first moments are
+~2^-3-per-step objects, far above bf16 resolution) and ``exp_avg_sq``
+stays fp32 unless ``moment_sq_dtype`` opts in explicitly. The bf16 store
+is stochastically rounded (see :func:`_sr_to_bf16`), which keeps the EMA
+tracking in expectation (validated over a 400-step horizon in
+tests/unit/runtime/test_opt_state_dtype.py), but SR adds variance to the
+denominator — opt in only when the memory is what lets the model fit (the
+full-depth bench configs do, and say so).
 """
 
 from __future__ import annotations
@@ -87,24 +98,28 @@ class Optimizer:
     min_coeff: float = 0.01
     # sgd
     momentum: float = 0.0
-    # stored precision of master params / moments (None = fp32); compute
-    # is always fp32 — see module docstring
+    # stored precision of master params / first moments / second moments
+    # (None = fp32); compute is always fp32. moment_dtype narrows ONLY the
+    # first moments — second moments freeze under bf16 rounding (module
+    # docstring) and require the explicit moment_sq_dtype opt-in.
     master_dtype: Optional[Any] = None
     moment_dtype: Optional[Any] = None
+    moment_sq_dtype: Optional[Any] = None
 
     def init(self, params: Params) -> OptState:
         mdt = self.master_dtype or jnp.float32
         sdt = self.moment_dtype or jnp.float32
+        sqdt = self.moment_sq_dtype or jnp.float32
         master = jax.tree.map(lambda x: x.astype(mdt), params)
         state: OptState = {"step": jnp.zeros((), jnp.int32), "master": master}
         if self.name in ("adam", "adamw", "lamb", "onebit_adam", "onebit_lamb",
                          "zero_one_adam", "muadam", "muadamw"):
             state["exp_avg"] = _tree_zeros_like(params, dtype=sdt)
-            state["exp_avg_sq"] = _tree_zeros_like(params, dtype=sdt)
+            state["exp_avg_sq"] = _tree_zeros_like(params, dtype=sqdt)
         elif self.name in ("lion", "momentum_sgd"):
             state["exp_avg"] = _tree_zeros_like(params, dtype=sdt)
         elif self.name == "adagrad":
-            state["sum_sq"] = _tree_zeros_like(params, dtype=sdt)
+            state["sum_sq"] = _tree_zeros_like(params, dtype=sqdt)
         elif self.name == "sgd":
             if self.momentum > 0:
                 state["exp_avg"] = _tree_zeros_like(params, dtype=sdt)
@@ -206,10 +221,12 @@ class Optimizer:
             raise ValueError(f"Unknown optimizer '{self.name}'")
         mdt = self.master_dtype or f32
         sdt = self.moment_dtype or f32
+        sqdt = self.moment_sq_dtype or f32
         new_state["master"] = jax.tree.map(lambda x: x.astype(mdt), new_master)
-        for i, key in enumerate(("exp_avg", "exp_avg_sq", "sum_sq")):
+        slot_dtypes = {"exp_avg": sdt, "exp_avg_sq": sqdt, "sum_sq": sqdt}
+        for i, (key, dt) in enumerate(slot_dtypes.items()):
             if key in new_state:
-                new_state[key] = _narrow_state_tree(new_state[key], sdt, step, i + 1)
+                new_state[key] = _narrow_state_tree(new_state[key], dt, step, i + 1)
         return new_master, new_state
 
 

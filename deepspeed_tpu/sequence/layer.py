@@ -21,6 +21,14 @@ Two equivalent TPU implementations are provided:
 2. ``DistributedAttention`` — the **explicit** form for ``shard_map`` users,
    API-compatible with the reference class: all-to-all via
    ``deepspeed_tpu.comm.all_to_all`` with (scatter_idx, gather_idx) semantics.
+
+The local attention both forms wrap is ``attention.flash_attention``, whose
+long-sequence default is the in-repo Pallas flash kernel
+(``ops/transformer/pallas_flash.py``) — the post-all-to-all call sees the
+FULL sequence with heads scattered, exactly the regime where the blockwise
+kernel (O(S) memory, MXU-aligned tiles) replaces chunked XLA. GQA divides
+cleanly: the all-to-all requires ``kv_heads % (tp*sp) == 0`` and the kernel
+is GQA-native at any resulting ratio.
 """
 
 from __future__ import annotations

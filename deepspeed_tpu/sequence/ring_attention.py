@@ -15,9 +15,16 @@ TPU form: one ``shard_map`` region; a static ``fori_loop`` of sp steps,
 each step = one [s_local × s_local] attention tile (MXU work) overlapped by
 XLA with the next ``ppermute`` hop over ICI. fp32 running max/denominator;
 GQA native (no KV repeat); exact causal masking by global block positions
-(blocks strictly in the future contribute nothing and their tile result is
-discarded via the mask — the classic unbalanced-causal-ring tradeoff,
-accepted for simplicity over zigzag scheduling).
+(blocks strictly in the future contribute nothing — the classic
+unbalanced-causal-ring tradeoff, accepted for simplicity over zigzag
+scheduling).
+
+Two per-hop bodies (r6): the default rides the in-repo Pallas flash kernel
+(``ops/transformer/pallas_flash.py``) — each hop is one blockwise kernel
+call returning (output, row LSE), and hops combine by EXACT partial-softmax
+accumulation (``merge_partials``), so no [s, s] score buffer exists per hop
+and past hops are never re-normalized. ``DSTPU_ATTN=xla`` (or non-128-tile
+local shards) restores the round-5 pure-XLA online-softmax body below.
 """
 
 from __future__ import annotations
@@ -79,6 +86,47 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
     return out.transpose(0, 3, 1, 2, 4).reshape(B, s, H, D).astype(q.dtype)
 
 
+def _ring_local_flash(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
+                      causal: bool, scale: float,
+                      interpret: bool) -> jax.Array:
+    """Per-device body riding the in-repo Pallas flash kernel: each hop is
+    ONE blockwise kernel call over the resident Q shard and the k/v shard
+    currently passing by, and hops combine by accumulating the kernel's
+    partial softmax state (normalized output + row LSE) — no per-hop
+    [s, s] score materialization, no re-normalization of past hops
+    (``pallas_flash.merge_partials`` is exact). Causality across shards is
+    the kernel's ``q_offset``: the resident q rows start ``(r - owner) *
+    s`` after the passing k rows; hops entirely in the future come back as
+    (0, MASK_VALUE) partials that merge to a no-op."""
+    from ..ops.transformer.pallas_flash import (MASK_VALUE,
+                                                flash_attention_with_lse,
+                                                merge_partials)
+    r = jax.lax.axis_index(SEQ_AXIS)
+    B, s, H, D = q.shape
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+    # fp32 cross-hop carry: merging in the input dtype would re-round the
+    # running output once per hop (the XLA body's accumulator is fp32 too)
+    o0 = jnp.zeros((B, s, H, D), jnp.float32)
+    lse0 = jnp.full((B, H, s), MASK_VALUE, jnp.float32)
+
+    def step(i, carry):
+        o, lse, k_cur, v_cur = carry
+        owner = (r - i) % sp                      # origin rank of k_cur
+        # non-causal hops ignore positions entirely — pass a literal 0 so
+        # axis_index never reaches the kernel as a dead operand (an unused
+        # partition-id in the shard_map body trips the SPMD partitioner)
+        o_h, lse_h = flash_attention_with_lse(
+            q, k_cur, v_cur, causal=causal, scale=scale,
+            q_offset=(r - owner) * s if causal else 0, interpret=interpret)
+        o, lse = merge_partials(o, lse, o_h.astype(jnp.float32), lse_h)
+        k_cur = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
+        v_cur = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+        return o, lse, k_cur, v_cur
+
+    o, _, _, _ = jax.lax.fori_loop(0, sp, step, (o0, lse0, k, v))
+    return o.astype(q.dtype)
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    causal: bool = True,
                    scale: Optional[float] = None) -> jax.Array:
@@ -101,7 +149,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     from ..runtime.topology import BATCH_AXES, MODEL_AXIS
 
-    local = functools.partial(_ring_local, sp=sp, causal=causal, scale=scale)
+    # Per-hop attention implementation: the in-repo Pallas flash kernel
+    # (partial-softmax state accumulated across hops) wherever it can run —
+    # compiled on TPU for MXU-aligned local shards, interpret mode when
+    # forced (DSTPU_ATTN=pallas, the CPU test path). DSTPU_ATTN=xla keeps
+    # the round-5 pure-XLA online-softmax body.
+    from ..ops.transformer.attention import attn_mode
+    mode = attn_mode()
+    s_local = q.shape[1] // sp
+    on_cpu = jax.default_backend() == "cpu"
+    # same shape gate as attention.py's dispatch, on the PER-SHARD shapes
+    # the hops will see (head counts divide uniformly under any further
+    # model-axis sharding, so the global ratio is representative)
+    from ..ops.transformer import pallas_flash as _pf
+    local_ok = _pf.supports(
+        (q.shape[0], s_local) + q.shape[2:],
+        (k.shape[0], s_local) + k.shape[2:],
+        compiled=not on_cpu)
+    use_flash = (mode != "xla" and local_ok
+                 and (mode == "pallas" or not on_cpu))
+    if use_flash:
+        local = functools.partial(_ring_local_flash, sp=sp, causal=causal,
+                                  scale=scale, interpret=on_cpu)
+    else:
+        local = functools.partial(_ring_local, sp=sp, causal=causal,
+                                  scale=scale)
     batch_axes = BATCH_AXES if isinstance(BATCH_AXES, tuple) else (BATCH_AXES,)
     batch_deg = 1
     for a in batch_axes:

@@ -378,6 +378,47 @@ class TestParamOffload:
         l1, l2 = float(eng.train_batch(b)), float(ctl.train_batch(b))
         assert abs(l1 - l2) < 1e-5, (l1, l2)
 
+    def test_reload_pools_swap_buffers_after_fence(self, tmp_path):
+        """reload_param_cache donates the swap-in buffers back to the pool
+        ONLY after fencing the device transfers (ADVICE r4 use-after-
+        release): a second page-out/page-in cycle must reuse the pooled
+        host memory (no fresh allocation) without corrupting the uploaded
+        params."""
+        b = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+        eng = self._engine(tmp_path, device="nvme")
+        l0 = float(eng.train_batch(b))
+        eng.offload_param_cache()
+        eng.reload_param_cache()
+        sw = eng._param_swapper
+        pooled = sw.available_swap_in_buffers()
+        assert pooled > 0  # fenced buffers re-entered the free list
+        eng.offload_param_cache()
+        eng.reload_param_cache()  # second cycle reuses the pooled buffers
+        assert sw.available_swap_in_buffers() == pooled
+        # the flip stayed lossless through buffer reuse
+        l1 = float(eng.train_batch(b))
+        assert np.isfinite(l1) and l1 < l0 + 1.0, (l0, l1)
+
+    def test_overflow_gnorm_is_zero_not_nan(self):
+        """fp16 overflow in the host offload step: sq-norm is inf, and
+        (inf ** 0.5) * 0.0 is NaN in Python floats — the reported grad
+        norm must be 0.0 like the device path (ADVICE r4)."""
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128,
+                       remat=False)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1,
+                                  "offload_optimizer": {"device": "cpu"}},
+            # scale 2^40 overflows fp16 grads on the first step
+            "fp16": {"enabled": True, "initial_scale_power": 40},
+        }, seed=7)
+        b = {"input_ids": np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+        eng.train_batch(b)
+        assert eng.skipped_steps >= 1  # the step did overflow
+        gnorm = eng._last_grad_norm
+        assert gnorm == 0.0 and not np.isnan(gnorm), gnorm
+
     def test_footprint_fits_synthetic_device_cap(self):
         """ZeRO-Infinity's memory claim: with optimizer on host and params
         pageable, device bytes fit a cap the non-offload config exceeds."""

@@ -86,6 +86,7 @@ def main():
     else:
         if args.moment_dtype == "bf16":
             cfg["data_types"]["optimizer_moment_dtype"] = "bf16"
+            cfg["data_types"]["optimizer_moment_sq_dtype"] = "bf16"
         note += ", bf16 moments + fp32 master on chip"
 
     print(json.dumps({"preset": args.preset, "params_m": n_params / 1e6,

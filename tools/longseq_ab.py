@@ -26,17 +26,25 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 SEQS = (2048, 4096, 8192)
-# r5: "flash" = GQA-native splash kernel; "repeat" = old broadcast-K/V
-# stock kernel; "chunked" = query-chunked XLA (the r5 default long-seq path)
-PATHS = ("xla", "flash", "repeat", "chunked")
+# r6: "inrepo" = the in-repo Pallas flash kernel pair (the r6 default
+# long-seq path, pallas_flash.py); r5: "flash" = GQA-native splash kernel;
+# "repeat" = old broadcast-K/V stock kernel; "chunked" = query-chunked XLA
+# (the r5 default long-seq path)
+PATHS = ("xla", "flash", "repeat", "chunked", "inrepo")
 
 
 def run_single(seq: int, path: str, offload: bool, micro: int = 1,
                remat: str = "full") -> None:
-    if path == "chunked":
+    if path == "inrepo":
+        os.environ["DSTPU_ATTN"] = "pallas"
+    elif path == "chunked":
+        # a DSTPU_ATTN inherited from the caller's shell would silently
+        # reroute every legacy arm — each arm owns the full env
+        os.environ.pop("DSTPU_ATTN", None)
         os.environ.pop("DSTPU_PALLAS_FLASH", None)
         os.environ["DSTPU_LONGSEQ_ATTN"] = "chunked"
     else:
+        os.environ.pop("DSTPU_ATTN", None)
         os.environ["DSTPU_PALLAS_FLASH"] = "0" if path == "xla" else "1"
         # 'xla' must measure the PLAIN one-shot path (its compile-OOM at
         # 4k+ is a documented datapoint) — without this the router's
@@ -83,6 +91,7 @@ def run_single(seq: int, path: str, offload: bool, micro: int = 1,
                 "stage": 3, "offload_optimizer": {"device": "cpu"}}
         else:
             cfg["data_types"]["optimizer_moment_dtype"] = "bf16"
+            cfg["data_types"]["optimizer_moment_sq_dtype"] = "bf16"
         engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
         batch = {"input_ids": np.random.default_rng(0).integers(
             0, model.config.vocab_size, size=(micro, seq))}

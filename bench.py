@@ -24,12 +24,16 @@ line):
       fwd+bwd tokens/sec vs the chunked-XLA path -> tokens/sec + ratio
   [10] GPT-2 125M with ZeRO-Infinity param STREAMING (paged_training:
       params host-resident, paged per layer)   -> residency + tokens/sec
-  [11] FULL-DEPTH llama2-7b (32 layers, real dims) int4 WOQ + fp8 KV,
+  [11] GPT-2 125M ZeRO-3, layer-granular OVERLAP schedule (pipelined
+      per-layer gather/reduce-scatter inside the scan) vs the barrier
+      schedule (overlap_comm false, fresh subprocess denominator)
+                                               -> tokens/sec + ratio
+  [12] FULL-DEPTH llama2-7b (32 layers, real dims) int4 WOQ + fp8 KV,
       16 requests, served from a real-format HF checkpoint dir via
       build_hf_engine + continuous batching    -> output tok/s + TTFT
-  [12] llama2-7b long-context serving: 4096-token prompts, fp8 KV
+  [13] llama2-7b long-context serving: 4096-token prompts, fp8 KV
                                                -> output tok/s + TTFT
-  [13] Mixtral-architecture MoE serving (dropless routing, SLA fields)
+  [14] Mixtral-architecture MoE serving (dropless routing, SLA fields)
                                                -> output tok/s + TTFT
 
 Honest accounting:
@@ -439,7 +443,7 @@ def bench_attn_32k(peak_tflops):
     return line
 
 
-N_TPU_RUNS = 14     # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 15     # build_runs(on_tpu=True) length — asserted in child mode
 N_SERVING_RUNS = 3  # ... of which the LAST THREE are serving lines
 #                     (7B 512-prompt, 7B long-context, MoE) — one sample
 
@@ -522,9 +526,52 @@ def _offload_denominator():
                       cfg, 4, 512, max(6, steps // 5), REF_MFU_ZERO3, peak))
 
 
+def _zero_overlap_cfg(overlap: bool = True):
+    return {
+        "train_micro_batch_size_per_gpu": 8,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        # explicit overlap_comm: true routes plain stage 3 onto the
+        # explicit shard_map micro with the pipelined schedule; the
+        # denominator keeps the SAME config and forces the barrier
+        # schedule via DSTPU_ZERO_OVERLAP=0 (schedule-only A/B)
+        "zero_optimization": {"stage": 3, "overlap_comm": overlap},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "data_types": {"grad_accum_dtype": "bf16"},
+    }
+
+
+def _zero_overlap_denominator():
+    """Child mode: the SAME gpt2-125m stage-3 model through the SAME
+    explicit shard_map micro but with the whole-tree BARRIER schedule, in
+    a fresh process (HBM isolation) — the honest denominator for the
+    overlap line's ratio. The kill switch (not overlap_comm: false) holds
+    the micro-step implementation fixed: plain stage 3 without an explicit
+    overlap_comm would take the declarative jit path, a different
+    compilation whose delta is not the schedule's."""
+    os.environ["DSTPU_ZERO_OVERLAP"] = "0"
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import gpt2_model
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
+    steps = 30 if on_tpu else 3
+    _emit(bench_train(
+        "gpt2-125m ZeRO-3 barrier (denominator)",
+        gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+        _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3, peak))
+
+
 def main():
     if "--offload-denominator" in sys.argv:
         return _offload_denominator()
+    if "--zero-overlap-denominator" in sys.argv:
+        return _zero_overlap_denominator()
     if "--one" not in sys.argv and _probe_backend() not in ("cpu",):
         return _dispatch_tpu()  # client-free parent
     return _run_configs()
@@ -817,6 +864,36 @@ def _run_configs():
                 note=", params paged per layer (host-resident)")
             return line
         runs.append(param_stream_run)
+
+        def zero_overlap_run():
+            # Layer-granular ZeRO overlap (ISSUE 3 tentpole): the gpt2-125m
+            # ZeRO line at stage 3 with the pipelined per-layer schedule —
+            # layer l+1's param all-gather issued during layer l's forward,
+            # layer l's grad reduce-scatter during layer l-1's backward
+            # (models/transformer.py scan_blocks_pipelined). The barrier
+            # schedule runs in its OWN subprocess as the denominator (same
+            # explicit micro, DSTPU_ZERO_OVERLAP=0 — see
+            # _zero_overlap_denominator), same isolation as the NVMe line.
+            line = bench_train(
+                "gpt2-125m ZeRO-3 overlap bf16",
+                gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+                _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3,
+                peak, note=", layer-granular pipelined schedule")
+            import subprocess
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--zero-overlap-denominator"],
+                    capture_output=True, text=True, timeout=2400)
+                bar_line = _last_metric_line(r.stdout)
+            except subprocess.TimeoutExpired:
+                bar_line = None
+            if bar_line and bar_line.get("value"):
+                line["vs_overlap_off"] = round(
+                    line["value"] / bar_line["value"], 3)
+                line["overlap_off_tokens_per_sec"] = bar_line["value"]
+            return line
+        runs.append(zero_overlap_run)
 
         def serving_7b_run():
             # FULL-DEPTH llama2-7b (32 layers, real dims) at int8 WOQ

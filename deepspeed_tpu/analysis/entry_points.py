@@ -61,20 +61,54 @@ def audit_engine_step() -> List[Finding]:
 
 
 def audit_zero_gather_partition() -> List[Finding]:
-    """ZeRO++ micro step — the explicit param all-gather / gradient
-    reduce-scatter path (engine._build_zeropp_micro): every collective must
-    ride the canonical dp axes and the donated grad accumulator must alias."""
+    """ZeRO++ micro step — the whole-tree BARRIER schedule, the
+    ``overlap_comm: false`` escape hatch (engine._build_zeropp_micro_barrier):
+    every collective must ride the canonical dp axes and the donated grad
+    accumulator must alias."""
     engine = _tiny_engine(config_extra={"zero_optimization": {
         "stage": 3, "stage3_param_persistence_threshold": 0,
-        "zero_quantized_weights": True}})
+        "zero_quantized_weights": True, "overlap_comm": False}})
     assert engine._zeropp, "config did not enable the ZeRO++ path"
     batch = _batch(engine)
     micro = engine._build_zeropp_micro()
+    assert not engine._overlap_active, \
+        "overlap_comm: false must select the barrier schedule"
     with engine.mesh:
         return trace_and_check(
             micro, engine.state["grad_acc"],
             engine.state["loss_scale"]["cur_scale"], engine.state["params"],
             batch, donate_argnums=(0,), name="zero-gather-partition")
+
+
+def audit_zeropp_micro_overlap() -> List[Finding]:
+    """The layer-granular pipelined ZeRO++ micro step (ISSUE 3 tentpole,
+    engine._build_zeropp_micro_overlap + models/transformer.py
+    scan_blocks_pipelined + runtime/zero/overlap.py): double-buffered
+    param prefetch in the forward scan carry, backward-interleaved
+    gradient reduce-scatter. The audit enforces axis binding (every
+    collective in both scan bodies rides canonical dp axes), donation
+    aliasing on the grad accumulator, and a stable retrace signature —
+    the schedule recompiling per step would erase the win it exists for."""
+    engine = _tiny_engine(config_extra={"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 0,
+        "zero_quantized_weights": True, "zero_quantized_gradients": True}})
+    assert engine._zeropp, "config did not enable the ZeRO++ path"
+    batch = _batch(engine)
+    micro = engine._build_zeropp_micro()
+    assert engine._overlap_active, (
+        "overlap_comm (stage-3 default true) must select the pipelined "
+        f"schedule; fell back: {engine._overlap_fallback}")
+    gacc = engine.state["grad_acc"]
+    scale = engine.state["loss_scale"]["cur_scale"]
+    with engine.mesh:
+        findings = trace_and_check(
+            micro, gacc, scale, engine.state["params"], batch,
+            donate_argnums=(0,), name="zeropp-micro-overlap")
+    findings += check_retrace(
+        "zeropp-micro-overlap",
+        [(gacc, scale, engine.state["params"], batch),
+         (gacc, scale, engine.state["params"], batch)])
+    return findings
 
 
 def audit_moe_dispatch() -> List[Finding]:
@@ -159,6 +193,7 @@ def audit_flash_kernel() -> List[Finding]:
 ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
     "engine-train-step": audit_engine_step,
     "zero-gather-partition": audit_zero_gather_partition,
+    "zeropp-micro-overlap": audit_zeropp_micro_overlap,
     "moe-dispatch": audit_moe_dispatch,
     "ring-attention": audit_ring_attention,
     "ulysses-attention": audit_ulysses_attention,

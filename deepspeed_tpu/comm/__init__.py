@@ -3,4 +3,5 @@ from .comm import (ReduceOp, all_gather, all_reduce, all_to_all,  # noqa: F401
                    broadcast, configure, gather, get_local_rank, get_rank,
                    get_world_size, inference_all_reduce, init_distributed,
                    is_initialized, log_summary, monitored_barrier, ppermute,
-                   recv, reduce, reduce_scatter, scatter, send)
+                   record_collective, recv, reduce, reduce_scatter, scatter,
+                   send)

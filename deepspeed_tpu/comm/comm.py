@@ -128,6 +128,23 @@ def _record(op_name: str, x, axis: AxisNames) -> None:
         _COMMS_LOGGER.append(op_name, size, axis)
 
 
+def record_collective(op_name: str, nbytes: int, axis: AxisNames,
+                      overlapped: Optional[bool] = None,
+                      count: int = 1) -> None:
+    """Record a collective issued through raw ``jax.lax`` primitives (the
+    ZeRO micro schedules build their own gathers/scatters) with its
+    schedule class: ``overlapped=True`` means the launch is issued
+    concurrently with independent compute (the pipelined layer schedule's
+    in-scan prefetch/reduce-scatter), ``False`` means it sits on the
+    critical path (barrier schedule, edge-of-step gathers). ``count`` is
+    the executions-per-step of one trace site (a scan body traces once but
+    launches per iteration). Feeds the overlapped/exposed split column of
+    :func:`log_summary`. No-op unless a CommsLogger is configured."""
+    if _COMMS_LOGGER is not None:
+        _COMMS_LOGGER.append(op_name, int(nbytes), axis,
+                             overlapped=overlapped, count=count)
+
+
 # -- process-level queries ---------------------------------------------------
 
 def get_rank() -> int:

@@ -494,6 +494,142 @@ class TransformerLM:
         (x2, _, aux), _ = self._block_fn(attn_mask, carry, packed)
         return x2, aux
 
+    def scan_blocks_pipelined(self, blocks: Params, x: jax.Array,
+                              positions: jax.Array, *, gather, scatter,
+                              keep: Optional[jax.Array] = None,
+                              attn_mask: Optional[jax.Array] = None,
+                              layers_per_step: int = 1,
+                              comm_scope=None):
+        """Layer-granular ZeRO overlap schedule over SHARDED stacked block
+        params (the engine's pipelined ZeRO++/stage-3 micro step; see
+        runtime/zero/overlap.py for the comm half).
+
+        Forward: a scan whose carry holds the NEXT layer's gathered (full)
+        params — iteration *l* issues the all-gather of layer *l+1*'s shard
+        via ``gather`` while computing layer *l* with the already-gathered
+        buffer (double-buffered prefetch; the buffer is dead after use, so
+        at most two layers' full params are live). Per-layer inputs are
+        saved as the only activation residuals.
+
+        Backward (returned ``pullback(dx, daux)``): a hand-written reverse
+        scan that re-gathers each layer's params (prefetched one iteration
+        ahead, like ZeRO-3's backward re-fetch), recomputes the block from
+        its saved input (layer-granular remat — the only memory-sane choice
+        when saved residuals must not contain full params), and carries the
+        just-computed full layer gradients so ``scatter`` (reduce-scatter)
+        of layer *l*'s grads is issued during layer *l−1*'s backward
+        compute. Gradients come back dp-sharded, fp32, dp-averaged.
+
+        ``layers_per_step=2`` is the half-remat ('alternating') variant's
+        shape: the schedule pipelines two-layer bundles — half the
+        collective launches (bigger buckets) and half the saved boundary
+        activations, at the same per-layer recompute.
+
+        ``comm_scope(k)`` (optional) is entered around each scan so the
+        comm layer can account its in-body collectives as executing ``k``
+        times per step (a scan body traces once but launches per
+        iteration) — the engine passes the TreeComm's ``trace_executions``.
+
+        Returns ``(x_out, moe_aux_sum, pullback)``.
+        """
+        import contextlib
+        scope = comm_scope or (lambda k: contextlib.nullcontext())
+        c = self.config
+        L = c.num_layers
+        lps = int(layers_per_step)
+        if lps < 1 or L % lps:
+            raise ValueError(f"layers_per_step={lps} must divide "
+                             f"num_layers={L}")
+        n_steps = L // lps
+        keep = (jnp.ones((L,), c.dtype) if keep is None
+                else keep.astype(c.dtype))
+        windows = (jnp.asarray(self._windows, jnp.int32)
+                   if self._windows is not None else None)
+        bundle = lambda a: a.reshape((n_steps, lps) + a.shape[1:])
+        blocksb = jax.tree.map(bundle, blocks)
+        keepb = bundle(keep)
+        winb = bundle(windows) if windows is not None else None
+        take = lambda t, i: jax.tree.map(lambda a: a[i], t)
+
+        def unit_call(bp, xx, kb, wb):
+            aux = jnp.zeros((), jnp.float32)
+            for j in range(lps):
+                blk = jax.tree.map(lambda a: a[j], bp)
+                w = None if wb is None else wb[j]
+                xx, a = self.block_apply(blk, xx, positions, keep=kb[j],
+                                         attn_mask=attn_mask, window=w)
+                aux = aux + a
+            return xx, aux
+
+        # xs slot s prefetches step s+1's shard; the last slot re-gathers
+        # the final step, seeding the backward's first full buffer for free
+        nxt = jax.tree.map(lambda a: jnp.concatenate([a[1:], a[-1:]], axis=0),
+                           blocksb)
+        xs = {"shard": nxt, "keep": keepb}
+        if winb is not None:
+            xs["win"] = winb
+        pf0 = gather(take(blocksb, 0))
+
+        def fwd_body(carry, xs_s):
+            xx, pf, aux_acc = carry
+            nf = gather(xs_s["shard"])  # independent of the compute below
+            y, aux = unit_call(pf, xx, xs_s["keep"], xs_s.get("win"))
+            return (y, nf, aux_acc + aux), xx
+
+        with scope(n_steps):
+            (x_out, pf_last, aux_sum), acts = jax.lax.scan(
+                fwd_body, (x, pf0, jnp.zeros((), jnp.float32)), xs)
+
+        def pullback(dx_out, daux):
+            daux_ = jnp.asarray(daux, jnp.float32)
+            wb_last = None if winb is None else winb[-1]
+            # peel the last step: its full params came out of the forward
+            # scan's final carry, so no zero-valued first scatter and no
+            # branch inside the reverse scan
+            _, vjp_last = jax.vjp(
+                lambda p, xx: unit_call(p, xx, keepb[-1], wb_last),
+                pf_last, acts[-1])
+            dp, dx = vjp_last((dx_out, daux_))
+            unbundle = lambda t: jax.tree.map(
+                lambda a: a.reshape((L,) + a.shape[2:]), t)
+            if n_steps == 1:
+                ds0 = scatter(dp)
+                return unbundle(jax.tree.map(lambda a: a[None], ds0)), dx
+            pb0 = gather(take(blocksb, n_steps - 2))
+            # reverse prefetch: slot s carries step s-1's shard (slot 0 a
+            # dead self-gather — the price of one scan body shape)
+            prv = jax.tree.map(
+                lambda a: jnp.concatenate([a[:1], a[:-1]],
+                                          axis=0)[:n_steps - 1], blocksb)
+            xs_b = {"shard": prv, "act": acts[:n_steps - 1],
+                    "keep": keepb[:n_steps - 1]}
+            if winb is not None:
+                xs_b["win"] = winb[:n_steps - 1]
+
+            def bwd_body(carry, xs_s):
+                dxx, pb, pending = carry
+                # layer l+1's grads reduce-scatter while layer l computes
+                ds_prev = scatter(pending)
+                nb = gather(xs_s["shard"])
+                _, vjp_f = jax.vjp(
+                    lambda p, xx: unit_call(p, xx, xs_s["keep"],
+                                            xs_s.get("win")),
+                    pb, xs_s["act"])
+                dp_s, dxx_new = vjp_f((dxx, daux_))
+                return (dxx_new, nb, dp_s), ds_prev
+
+            with scope(n_steps - 1):
+                (dx0, _, pending0), ds_stack = jax.lax.scan(
+                    bwd_body, (dx, pb0, dp), xs_b, reverse=True)
+            ds0 = scatter(pending0)  # flush step 0's grads
+            # ds_stack[s] holds step s+1's sharded grads; step 0 is ds0
+            dblocksb = jax.tree.map(
+                lambda h, t: jnp.concatenate([h[None], t], axis=0),
+                ds0, ds_stack)
+            return unbundle(dblocksb), dx0
+
+        return x_out, aux_sum, pullback
+
     def apply(self, params: Params, input_ids: jax.Array,
               layer_mask: Optional[jax.Array] = None,
               token_type_ids: Optional[jax.Array] = None,
@@ -577,25 +713,46 @@ class TransformerLM:
             return x, aux
         return self.head(params, x), aux
 
+    # The three loss ingredients are separate methods because the ZeRO
+    # overlap schedule (engine._build_zeropp_micro_overlap) composes the
+    # loss around its own embed/blocks/head vjp pipeline — both schedules
+    # MUST share these definitions or `overlap_comm` would silently change
+    # the training objective.
+    def derive_labels(self, batch: Dict[str, jax.Array]) -> jax.Array:
+        """Explicit labels, or the causal next-token shift (-100 = ignore)."""
+        labels = batch.get("labels")
+        if labels is not None:
+            return labels
+        if not self.config.causal:
+            raise ValueError("encoder (MLM) training requires explicit "
+                             "labels — next-token shift is meaningless "
+                             "bidirectionally")
+        return jnp.pad(batch["input_ids"][:, 1:], ((0, 0), (0, 1)),
+                       constant_values=-100)
+
+    def head_loss(self, params: Params, x: jax.Array, labels: jax.Array,
+                  extra_mask: Optional[jax.Array] = None) -> jax.Array:
+        """Final norm + LM/MLM head + masked cross-entropy over the last
+        block's output (the differentiated tail of the overlap schedule)."""
+        return masked_cross_entropy(self.head(params, x), labels,
+                                    extra_mask=extra_mask)
+
+    def combine_aux(self, loss: jax.Array, aux: jax.Array) -> jax.Array:
+        """Fold the accumulated MoE aux loss into the objective."""
+        if self.config.moe is not None:
+            loss = loss + self.config.moe.aux_loss_coef * aux / self.config.num_layers
+        return loss
+
     def loss(self, params: Params, batch: Dict[str, jax.Array]) -> jax.Array:
         """Cross-entropy: next-token for causal LMs (labels derived by shift
         when absent), masked-LM for encoders (labels required, -100 = ignore).
         batch: input_ids [B,S], optional labels/loss_mask/token_type_ids/
         attention_mask."""
-        input_ids = batch["input_ids"]
-        labels = batch.get("labels")
-        if labels is None:
-            if not self.config.causal:
-                raise ValueError("encoder (MLM) training requires explicit "
-                                 "labels — next-token shift is meaningless "
-                                 "bidirectionally")
-            labels = jnp.pad(input_ids[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-        logits, aux = self.apply(params, input_ids,
+        labels = self.derive_labels(batch)
+        logits, aux = self.apply(params, batch["input_ids"],
                                  layer_mask=batch.get("layer_mask"),
                                  token_type_ids=batch.get("token_type_ids"),
                                  attention_mask=batch.get("attention_mask"))
         loss = masked_cross_entropy(logits, labels,
                                     extra_mask=batch.get("loss_mask"))
-        if self.config.moe is not None:
-            loss = loss + self.config.moe.aux_loss_coef * aux / self.config.num_layers
-        return loss
+        return self.combine_aux(loss, aux)

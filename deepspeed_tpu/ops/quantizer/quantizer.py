@@ -29,6 +29,44 @@ from ...utils.groups import DATA_AXIS
 from ...utils.jax_compat import axis_size
 
 
+def gather_in_row_chunks(gather_one, x: jax.Array, n: int,
+                         n_chunks: int) -> jax.Array:
+    """Split a shard's leading dim into ``n_chunks`` equal launches of
+    ``gather_one`` (a tiled all-gather over ``n`` members) and interleave
+    the per-chunk results back into the single-launch layout
+    (concat-over-members of the whole shard). THE chunk-layout invariant of
+    the ZeRO overlap schedule — shared by the quantized and plain
+    collectives so it lives in exactly one place."""
+    if x.shape[0] % n_chunks:
+        raise ValueError(f"n_chunks={n_chunks} must divide the shard's "
+                         f"leading dim {x.shape[0]}")
+    ck = x.shape[0] // n_chunks
+    parts = [gather_one(x[c * ck:(c + 1) * ck]) for c in range(n_chunks)]
+    # parts[c] is concat-over-members of chunk c; interleave back to
+    # concat-over-members of the whole shard: [n, C, ck, ...] -> rows
+    stacked = jnp.stack([p.reshape((n, ck) + x.shape[1:]) for p in parts],
+                        axis=1)
+    return stacked.reshape((n * x.shape[0],) + x.shape[1:])
+
+
+def scatter_in_row_chunks(scatter_one, x: jax.Array, n: int,
+                          n_chunks: int) -> jax.Array:
+    """Split a reduce-scatter input ([n*s0, ...]) along the DESTINATION
+    rows into ``n_chunks`` equal launches of ``scatter_one`` — each launch
+    scatters a slice of every member's output; output layout matches the
+    single launch. Companion of :func:`gather_in_row_chunks`."""
+    s0 = x.shape[0] // n
+    if s0 % n_chunks:
+        raise ValueError(f"n_chunks={n_chunks} must divide the output's "
+                         f"leading dim {s0}")
+    ck = s0 // n_chunks
+    xr = x.reshape((n, s0) + x.shape[1:])
+    parts = [scatter_one(
+                 xr[:, c * ck:(c + 1) * ck].reshape((n * ck,) + x.shape[1:]))
+             for c in range(n_chunks)]
+    return jnp.concatenate(parts, axis=0)
+
+
 def quantize_blockwise(x: jax.Array, num_bits: int = 8, group_size: int = 256,
                        symmetric: bool = True) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Quantize to int8 storage (int4 packed 2/byte).
@@ -92,11 +130,25 @@ def dequantize_blockwise(q: jax.Array, scale: jax.Array, zero: jax.Array,
 
 
 def quantized_all_gather(x: jax.Array, axis: str = DATA_AXIS, num_bits: int = 8,
-                         group_size: int = 256) -> jax.Array:
+                         group_size: int = 256, n_chunks: int = 1) -> jax.Array:
     """ZeRO++ qwZ-style all-gather: quantize the local shard, gather int8
     over the mesh axis, dequantize (reference quantized weights all-gather,
     ``partition_parameters.py:1101`` + quantizer kernels). Call inside
-    shard_map; halves (int8) or quarters (int4) the gather bytes on ICI."""
+    shard_map; halves (int8) or quarters (int4) the gather bytes on ICI.
+
+    ``n_chunks > 1`` splits the shard's leading dim into that many equal
+    launches (the layer-granular overlap schedule's ``allgather_bucket_size``
+    pipelining: a huge leaf becomes several smaller gathers the scheduler
+    can slide under compute). The reassembled result is laid out exactly
+    like the unchunked gather; numerics may differ at quantization-group
+    boundaries when the chunk size is not a group multiple."""
+    if n_chunks > 1:
+        if x.shape[0] % n_chunks:  # validate BEFORE touching the mesh axis
+            raise ValueError(f"n_chunks={n_chunks} must divide the shard's "
+                             f"leading dim {x.shape[0]}")
+        return gather_in_row_chunks(
+            lambda c: quantized_all_gather(c, axis, num_bits, group_size),
+            x, axis_size(axis), n_chunks)
     # Effective group size: never pad a small shard up to a full group —
     # the padding would travel the wire. int4 packs two values per byte, so
     # its groups must stay even.
@@ -118,13 +170,21 @@ def quantized_all_gather(x: jax.Array, axis: str = DATA_AXIS, num_bits: int = 8,
 
 
 def quantized_reduce_scatter(x: jax.Array, axis: str = DATA_AXIS, num_bits: int = 8,
-                             group_size: int = 256) -> jax.Array:
+                             group_size: int = 256, n_chunks: int = 1) -> jax.Array:
     """ZeRO++ qgZ-style gradient reduction (reference
     ``all_to_all_quant_reduce``, coalesced_collectives.py:31): quantize,
     all-to-all the shards, dequantize, local-sum. Trades ICI bytes for
-    quantization error exactly like the reference."""
+    quantization error exactly like the reference.
+
+    ``n_chunks > 1`` splits along the DESTINATION rows (each member's 1/n
+    output) into equal launches — the ``reduce_bucket_size`` pipelining of
+    the overlap schedule. Output layout matches the unchunked call."""
     n = axis_size(axis)
     assert x.shape[0] % n == 0
+    if n_chunks > 1:
+        return scatter_in_row_chunks(
+            lambda c: quantized_reduce_scatter(c, axis, num_bits, group_size),
+            x, n, n_chunks)
     # Quantize each destination chunk separately so the all-to-all splits on
     # exact chunk boundaries even when chunk size is not a group multiple
     # (padding lives at each chunk's tail; zeros quantize exactly under

@@ -266,6 +266,28 @@ class DeepSpeedEngine:
                 raise ValueError("ZeRO++ and 1-bit optimizers are mutually "
                                  "exclusive compression schemes")
 
+        # -- layer-granular overlap schedule (runtime/zero/overlap.py) ------
+        # ZeRO++ engines take it whenever overlap_comm is true (the stage-3
+        # default). Plain stage-3 engines switch from the declarative path
+        # to the explicit pipelined shard_map micro only on an EXPLICIT
+        # `overlap_comm: true` — same pure-dp envelope as ZeRO++, and none
+        # of the engine modes that own their own micro structure.
+        t = self.topology
+        self._stage3_overlap = (
+            not self._zeropp and zc.stage == 3
+            and bool(zc.overlap_comm)
+            and bool(getattr(zc, "overlap_comm_explicit", False))
+            and (t.model_parallel_size * t.sequence_parallel_size
+                 * t.pipe_parallel_size * t.expert_parallel_size) == 1
+            and self._offload_device == "none"
+            and not self._paged_training
+            and self._onebit_opt is None)
+        # every engine mode that steps through the explicit shard_map
+        # micro (ZeRO++ barrier or pipelined, stage-3 pipelined)
+        self._explicit_micro = self._zeropp or self._stage3_overlap
+        self._overlap_active = False      # set when the micro is built
+        self._overlap_fallback = ""       # reason the overlap path was skipped
+
         # -- ZeRO plan -------------------------------------------------------
         param_specs = model.specs()
         shapes = jax.eval_shape(lambda: model.init(jax.random.PRNGKey(0), self.param_dtype))
@@ -315,7 +337,7 @@ class DeepSpeedEngine:
         # difference between a 3B step compiling on one chip and OOM)
         self._gradacc_lazy = (
             config.gradient_accumulation_steps == 1
-            and not self._zeropp
+            and not self._explicit_micro
             and self._onebit_opt is None
             and os.environ.get("DSTPU_FUSED_STEP", "1") != "0")
         if self._paged_training:
@@ -996,33 +1018,17 @@ class DeepSpeedEngine:
     @staticmethod
     def _dp_axes_in(spec):
         """(dim, dp_axes) of the ZeRO-sharded dim of ``spec`` (or (None, ()))."""
-        from .topology import EXPERT_AXIS, MICS_AXIS, SEQ_AXIS
-        dp_set = (DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS)
-        for dim, entry in enumerate(spec):
-            if entry is None:
-                continue
-            ax = entry if isinstance(entry, (tuple, list)) else (entry,)
-            dp = tuple(a for a in ax if a in dp_set)
-            if dp:
-                return dim, dp
-        return None, ()
+        from .zero.partition import dp_axes_in
+        return dp_axes_in(spec)
 
-    def _build_zeropp_micro(self):
-        from ..utils.jax_compat import shard_map
+    def _zeropp_micro_env(self):
+        """The shared geometry of both explicit micro schedules."""
         from .topology import MICS_AXIS
-        from ..ops.quantizer.quantizer import (quantized_all_gather,
-                                               quantized_reduce_scatter)
-
         zc = self.config.zero_config
-        mesh = self.mesh
-        gas = self.gradient_accumulation_steps
-        model = self.model
-        grad_dtype = self.grad_dtype
         hpz = zc.zero_hpz_partition_size > 1
         all_dp = tuple(a for a in (DATA_AXIS, MICS_AXIS)
                        if self.topology.axis_size(a) > 1) or (DATA_AXIS,)
         n_dp = self.topology.axis_size(all_dp)
-
         param_specs = self.zero_plan.param_spec_tree()
         grad_specs = self.zero_plan.grad_spec_tree()
         # hpZ: the micro step reads from the SECONDARY partition — sharded
@@ -1034,6 +1040,65 @@ class DeepSpeedEngine:
                 is_leaf=lambda s: isinstance(s, P))
         else:
             gather_src_specs = param_specs
+        return zc, all_dp, n_dp, param_specs, grad_specs, gather_src_specs
+
+    def _zero_overlap_eligibility(self, grad_specs) -> str:
+        """'' when the layer-granular schedule can run, else the reason
+        for falling back to the barrier schedule."""
+        if os.environ.get("DSTPU_ZERO_OVERLAP", "1") == "0":
+            return "DSTPU_ZERO_OVERLAP=0"
+        for attr in ("embed", "block_apply", "head", "scan_blocks_pipelined",
+                     "derive_labels", "head_loss", "combine_aux"):
+            if not hasattr(self.model, attr):
+                return (f"model {type(self.model).__name__} lacks .{attr} "
+                        "(TransformerLM family required)")
+        if not (isinstance(self._param_struct, dict)
+                and "blocks" in self._param_struct):
+            return "param tree has no stacked 'blocks' subtree"
+        # a block leaf dp-sharded over its LAYER dim has no per-layer shard
+        # to gather — the pipelined schedule cannot exist for it
+        for specs in (grad_specs["blocks"],
+                      self.zero_plan.param_spec_tree()["blocks"]):
+            for spec in jax.tree.leaves(specs,
+                                        is_leaf=lambda s: isinstance(s, P)):
+                dim, axes = self._dp_axes_in(spec)
+                axes = tuple(a for a in axes
+                             if self.topology.axis_size(a) > 1)
+                if axes and dim == 0:
+                    return (f"block leaf sharded over the layer dim ({spec})")
+        return ""
+
+    def _build_zeropp_micro(self):
+        """The explicit shard_map micro step. Dispatches between the
+        layer-granular pipelined schedule (overlap_comm true, default for
+        ZeRO++) and the whole-tree barrier schedule — ``overlap_comm:
+        false`` is an exact escape hatch back to the latter."""
+        zc = self.config.zero_config
+        self._overlap_active = False
+        if zc.overlap_comm:
+            reason = self._zero_overlap_eligibility(
+                self.zero_plan.grad_spec_tree())
+            if not reason:
+                self._overlap_active = True
+                self._overlap_fallback = ""
+                return self._build_zeropp_micro_overlap()
+            self._overlap_fallback = reason
+            log_dist(f"zero overlap_comm: falling back to the barrier "
+                     f"schedule ({reason})", ranks=[0])
+        return self._build_zeropp_micro_barrier()
+
+    def _build_zeropp_micro_barrier(self):
+        from ..utils.jax_compat import shard_map
+        from .. import comm as dist
+        from ..ops.quantizer.quantizer import (quantized_all_gather,
+                                               quantized_reduce_scatter)
+
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps
+        model = self.model
+        grad_dtype = self.grad_dtype
+        (zc, all_dp, n_dp, param_specs, grad_specs,
+         gather_src_specs) = self._zeropp_micro_env()
 
         def gather_full(x, spec):
             dim, axes = self._dp_axes_in(spec)
@@ -1043,6 +1108,10 @@ class DeepSpeedEngine:
             if not axes:
                 return x
             xm = jnp.moveaxis(x, dim, 0)
+            # whole-tree gather before the loss: fully EXPOSED collective
+            # time (what the overlap schedule exists to hide)
+            dist.record_collective("all_gather", x.size * x.dtype.itemsize,
+                                   axes, overlapped=False)
             if zc.zero_quantized_weights:
                 g = quantized_all_gather(xm, axis=axes)
             else:
@@ -1053,8 +1122,14 @@ class DeepSpeedEngine:
             dim, axes = self._dp_axes_in(spec)
             axes = tuple(a for a in axes if self.topology.axis_size(a) > 1)
             if dim is None or not axes:
+                dist.record_collective("all_reduce",
+                                       g.size * g.dtype.itemsize, all_dp,
+                                       overlapped=False)
                 return jax.lax.psum(g, all_dp) / n_dp
             gm = jnp.moveaxis(g.astype(jnp.float32), dim, 0)
+            dist.record_collective(
+                "all_to_all" if zc.zero_quantized_gradients
+                else "reduce_scatter", g.size * 4, axes, overlapped=False)
             if zc.zero_quantized_gradients:
                 r = quantized_reduce_scatter(gm, axis=axes)
             else:
@@ -1097,6 +1172,155 @@ class DeepSpeedEngine:
 
         return micro_step
 
+    def _build_zeropp_micro_overlap(self):
+        """The layer-granular pipelined micro step (ISSUE 3 tentpole).
+
+        Same shard_map signature and gradient math as the barrier schedule,
+        but the block-stack gather/compute/scatter is restructured around
+        the model's ``scan_blocks_pipelined``: layer *l+1*'s (optionally
+        quantized) all-gather is issued during layer *l*'s forward compute
+        from the scan carry (double-buffered, freed after use), the
+        backward re-gathers per layer with the same one-ahead prefetch, and
+        layer *l*'s gradient reduce-scatter is issued during layer *l−1*'s
+        backward compute. Collectives are bucket-planned
+        (``reduce_bucket_size``/``allgather_bucket_size``) so small leaves
+        fuse into one launch and huge leaves split for pipelining. The
+        embedding/head ("rest") leaves keep whole-tensor collectives at the
+        step's edges, where no compute exists to hide them.
+        """
+        from ..utils.jax_compat import shard_map
+        from .zero.overlap import build_tree_comm
+
+        mesh = self.mesh
+        gas = self.gradient_accumulation_steps
+        model = self.model
+        grad_dtype = self.grad_dtype
+        (zc, all_dp, n_dp, param_specs, grad_specs,
+         gather_src_specs) = self._zeropp_micro_env()
+        axis_sizes = dict(self.topology.mesh.shape)
+        is_p = lambda s: isinstance(s, P)
+
+        c = model.config
+        L = int(c.num_layers)
+        # half-remat variant: the 'alternating' scan pipelines two-layer
+        # bundles (half the launches and boundary activations)
+        lps = 2 if (getattr(c, "remat_policy", None) == "alternating"
+                    and L % 2 == 0 and L >= 2) else 1
+        n_steps = L // lps
+
+        def split(tree):
+            rest = {k: v for k, v in tree.items() if k != "blocks"}
+            return rest, tree["blocks"]
+
+        def bundle_tree(tree, drop_layer_dim):
+            """Stacked [L, ...] leaves -> per-step bundle view [lps, ...]:
+            specs drop the layer dim and gain a leading None; structs lose
+            the layer dim for the per-layer shape."""
+            if drop_layer_dim == "spec":
+                return jax.tree.map(lambda s: P(*((None,) + tuple(s)[1:])),
+                                    tree, is_leaf=is_p)
+            return jax.tree.map(
+                lambda l: jax.ShapeDtypeStruct((lps,) + tuple(l.shape)[1:],
+                                               l.dtype), tree)
+
+        rest_src_specs, blk_src_specs = split(gather_src_specs)
+        rest_grad_specs, blk_grad_specs = split(grad_specs)
+        rest_struct, blk_struct = split(self._param_struct)
+
+        blk_comm = build_tree_comm(
+            bundle_tree(blk_src_specs, "spec"),
+            bundle_tree(blk_grad_specs, "spec"),
+            bundle_tree(blk_struct, "struct"),
+            axis_sizes=axis_sizes, all_dp=all_dp, n_dp=n_dp,
+            quant_weights=zc.zero_quantized_weights,
+            quant_grads=zc.zero_quantized_gradients,
+            allgather_bucket=zc.allgather_bucket_size,
+            reduce_bucket=zc.reduce_bucket_size,
+            overlapped=True, name="blocks")
+        rest_comm = build_tree_comm(
+            rest_src_specs, rest_grad_specs, rest_struct,
+            axis_sizes=axis_sizes, all_dp=all_dp, n_dp=n_dp,
+            quant_weights=zc.zero_quantized_weights,
+            quant_grads=zc.zero_quantized_gradients,
+            allgather_bucket=zc.allgather_bucket_size,
+            reduce_bucket=zc.reduce_bucket_size,
+            overlapped=False, name="rest")
+        oversize = blk_comm.oversize + rest_comm.oversize
+        if oversize and not getattr(self, "_bucket_warned", False):
+            # warn ONCE instead of silently ignoring the knob (satellite):
+            # these leaves exceed the bucket even after the best split
+            self._bucket_warned = True
+            logger.warning(
+                f"zero bucket plan: {len(oversize)} leaves exceed "
+                f"allgather/reduce bucket sizes even after splitting "
+                f"(first: {oversize[0]}) — raise the bucket knobs or "
+                f"accept single oversized launches")
+        log_dist(f"zero overlap schedule: {L} layers x {lps}/step; "
+                 f"{blk_comm.plan_summary()}; {rest_comm.plan_summary()}",
+                 ranks=[0])
+
+        batch_rep = self._REPLICATED_BATCH_KEYS
+
+        def local_micro(param_shards, gacc_shards, scale, batch):
+            rest_shards, blocks = split(param_shards)
+            input_ids = batch["input_ids"]
+            # loss ingredients SHARED with model.loss (derive_labels /
+            # head_loss / combine_aux) so both schedules train the same
+            # objective by construction
+            labels = model.derive_labels(batch)
+            # edge-of-step leaves: gathered once, exposed (no compute yet)
+            rest_full = rest_comm.gather(rest_shards)
+            positions = jnp.arange(input_ids.shape[1])[None, :]
+
+            def embed_f(rf):
+                x, _ = model.embed(rf, input_ids,
+                                   batch.get("token_type_ids"))
+                return x
+            x0, embed_vjp = jax.vjp(embed_f, rest_full)
+
+            layer_mask = batch.get("layer_mask")
+            x_out, aux_sum, pullback = model.scan_blocks_pipelined(
+                blocks, x0, positions,
+                gather=blk_comm.gather, scatter=blk_comm.scatter,
+                keep=layer_mask, attn_mask=batch.get("attention_mask"),
+                layers_per_step=lps,
+                comm_scope=blk_comm.trace_executions)
+
+            def head_f(rf, xx):
+                return model.head_loss(rf, xx, labels,
+                                       extra_mask=batch.get("loss_mask"))
+            ce, head_vjp = jax.vjp(head_f, rest_full, x_out)
+            loss = model.combine_aux(ce, aux_sum)
+            s = (scale / gas).astype(jnp.float32)
+            drf_h, dx_out = head_vjp(s)
+            # d(loss)/d(aux) derived FROM combine_aux so a changed aux
+            # weighting can never drift between the two schedules
+            daux = s * jax.grad(
+                lambda a: model.combine_aux(jnp.zeros(()), a))(
+                    jnp.zeros(()))
+            dblocks, dx0 = pullback(dx_out, daux)
+            (drf_e,) = embed_vjp(dx0)
+            drest_full = jax.tree.map(jnp.add, drf_h, drf_e)
+            drest = rest_comm.scatter(drest_full)
+            grads = dict(drest)
+            grads["blocks"] = dblocks
+            gacc = jax.tree.map(lambda a, g: a + g.astype(grad_dtype),
+                                gacc_shards, grads)
+            return gacc, jax.lax.pmean(loss, all_dp)
+
+        gacc_specs = grad_specs
+
+        def micro_step(gacc_in, cur_scale, secondary, batch):
+            batch_specs = {k: (P() if k in batch_rep else P(BATCH_AXES))
+                           for k in batch}
+            sm = shard_map(local_micro, mesh=mesh,
+                           in_specs=(gather_src_specs, gacc_specs, P(),
+                                     batch_specs),
+                           out_specs=(gacc_specs, P()), check_vma=False)
+            return sm(secondary, gacc_in, cur_scale, batch)
+
+        return micro_step
+
     @staticmethod
     def _hpz_secondary_spec(spec: P) -> P:
         """Replace the ZeRO dp-sharding of a leaf with 'mics'-only sharding
@@ -1117,7 +1341,7 @@ class DeepSpeedEngine:
         """Rebuild the hpZ secondary partition from the primary params —
         the once-per-optimizer-step inter-group all-gather. The reshard jit
         is cached: this runs on the per-step hot path."""
-        if not getattr(self, "_zeropp", False):
+        if not getattr(self, "_explicit_micro", False):
             return
         if self.config.zero_config.zero_hpz_partition_size > 1:
             if getattr(self, "_jit_hpz_reshard", None) is None:
@@ -1152,7 +1376,7 @@ class DeepSpeedEngine:
                 in_shardings=(shardings, rep),
                 out_shardings=(shardings, rep, rep))
             return
-        if self._zeropp:
+        if self._explicit_micro:
             if getattr(self, "_secondary", None) is None:
                 self._refresh_secondary()
             if self._jit_micro_step is None:
@@ -1200,7 +1424,7 @@ class DeepSpeedEngine:
         their own dispatch structure. DSTPU_FUSED_STEP=0 opts out."""
         return (self.gradient_accumulation_steps == 1
                 and self._offload is None
-                and not self._zeropp
+                and not self._explicit_micro
                 and self._onebit_opt is None
                 and os.environ.get("DSTPU_FUSED_STEP", "1") != "0")
 
@@ -1358,7 +1582,7 @@ class DeepSpeedEngine:
         batch = self._prepare_batch(batch)
         self.timers(FORWARD_GLOBAL_TIMER).start()
         with self.mesh:
-            if self._zeropp:
+            if self._explicit_micro:
                 gacc, loss = self._jit_micro_step(
                     self.state["grad_acc"],
                     self.state["loss_scale"]["cur_scale"],
@@ -1422,7 +1646,7 @@ class DeepSpeedEngine:
             with self.mesh:
                 self.state["params"] = self.quantizer.quantize(
                     self.state["params"], bool(overflow), eigenvalues)
-        if self._zeropp:
+        if self._explicit_micro:
             self._refresh_secondary()
         if self.config.fp16.enabled and bool(overflow):
             # skipped update does not consume schedule (reference engine.py:2053)
@@ -1803,7 +2027,7 @@ class DeepSpeedEngine:
         """XLA's exact cost analysis of the compiled micro-step (the
         hook-based estimate of the reference's profiler.py:228)."""
         try:
-            if self._zeropp:
+            if self._explicit_micro:
                 args = (self.state["grad_acc"],
                         self.state["loss_scale"]["cur_scale"],
                         self._secondary, self._device_batch(batch))

@@ -99,7 +99,16 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     memory_efficient_linear: bool = True
 
     def __init__(self, **data):
+        explicit = data.get("overlap_comm") is not None
         super().__init__(**data)
+        # whether the user WROTE overlap_comm (vs the stage-3 default):
+        # ZeRO++'s shard_map micro takes the layer-granular overlap
+        # schedule whenever overlap_comm is true (default at stage 3);
+        # plain stage-3 engines switch from the declarative path to the
+        # explicit pipelined shard_map micro only on an EXPLICIT true, so
+        # existing stage-3 configs keep their compiled path (engine.py
+        # _stage3_overlap).
+        object.__setattr__(self, "overlap_comm_explicit", explicit)
         if self.overlap_comm is None:
             # reference defaults overlap_comm True for stage 3, False otherwise
             object.__setattr__(self, "overlap_comm", self.stage == 3)

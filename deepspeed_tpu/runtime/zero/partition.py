@@ -28,15 +28,103 @@ matching the reference's persistence behavior for tiny params.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..topology import (DENSE_GRAD_AXES, EXPERT_AXIS, EXPERT_GRAD_AXES, MICS_AXIS,
-                        MeshTopology)
+from ..topology import (DATA_AXIS, DENSE_GRAD_AXES, EXPERT_AXIS,
+                        EXPERT_GRAD_AXES, MICS_AXIS, MeshTopology, SEQ_AXIS)
 from .config import DeepSpeedZeroConfig
+
+
+def dp_axes_in(spec: P) -> Tuple[Optional[int], Tuple[str, ...]]:
+    """(dim, dp_axes) of the ZeRO-sharded dim of ``spec`` (or (None, ())).
+    Canonical home of the engine's ``_dp_axes_in`` — the overlap schedule
+    and the bucket planner need it without an engine handle."""
+    dp_set = (DATA_AXIS, MICS_AXIS, EXPERT_AXIS, SEQ_AXIS)
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        ax = entry if isinstance(entry, (tuple, list)) else (entry,)
+        dp = tuple(a for a in ax if a in dp_set)
+        if dp:
+            return dim, dp
+    return None, ()
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketEntry:
+    """One collective launch of the layer-granular overlap schedule:
+    either several small leaves FUSED into a single flat gather/scatter,
+    or one big leaf SPLIT into ``chunks`` pipelined launches."""
+    leaves: Tuple[int, ...]   # leaf indices (flatten order) in this launch
+    chunks: int = 1           # >1 only for single-leaf entries
+
+
+def plan_comm_buckets(sizes: Sequence[int], keys: Sequence[Any],
+                      extents: Sequence[Optional[int]], bucket_elems: int,
+                      max_chunks: int = 16
+                      ) -> Tuple[List[BucketEntry], List[int]]:
+    """Bucket plan for one launch set (gather OR reduce) over a leaf list.
+
+    ``sizes``: full (gathered) element counts. ``keys``: fuse-compatibility
+    key per leaf (mesh axes + dtype) — only same-key leaves share a launch.
+    ``extents``: the shard's leading extent after the dp dim is moved to
+    front (chunk boundaries must divide it); None marks a replicated leaf,
+    which never fuses or chunks (its "collective" is a psum).
+
+    Rules (the reference's reduce/allgather bucket semantics,
+    stage_1_and_2.py:1004 buckets + coalesced_collectives.py):
+    - a leaf with ``size >= bucket_elems`` stands alone, split into the
+      smallest divisor of its extent (capped at ``max_chunks``) that brings
+      each chunk under the bucket;
+    - smaller leaves pack greedily (in flatten order, per key) into fused
+      launches that stay under the bucket.
+
+    Returns (entries, oversize): ``oversize`` lists leaves that exceed the
+    bucket even after the best split — the caller warns once instead of
+    silently ignoring the knob.
+    """
+    bucket = int(bucket_elems)
+    entries: List[BucketEntry] = []
+    oversize: List[int] = []
+    open_groups: dict = {}  # key -> [idx list, total elems]
+
+    def close(key):
+        g = open_groups.pop(key, None)
+        if g:
+            entries.append(BucketEntry(leaves=tuple(g[0])))
+
+    for i, (sz, key, ext) in enumerate(zip(sizes, keys, extents)):
+        if ext is None or bucket <= 0:
+            entries.append(BucketEntry(leaves=(i,)))
+            continue
+        if sz >= bucket:
+            chunks = 1
+            for c in range(1, min(int(ext), max_chunks) + 1):
+                if ext % c == 0:
+                    chunks = c
+                    if sz / c <= bucket:
+                        break
+            if sz / chunks > bucket:
+                oversize.append(i)
+            entries.append(BucketEntry(leaves=(i,), chunks=chunks))
+            continue
+        g = open_groups.get(key)
+        if g is not None and g[1] + sz > bucket:
+            close(key)
+            g = None
+        if g is None:
+            open_groups[key] = [[i], sz]
+        else:
+            g[0].append(i)
+            g[1] += sz
+    for key in list(open_groups):
+        close(key)
+    return entries, oversize
 
 
 def flatten_spec_axes(spec: P) -> set:

@@ -34,33 +34,73 @@ def convert_size(size_bytes: int) -> str:
     return f"{round(size_bytes / 1024 ** i, 2)} {names[i]}"
 
 
+_SCHED_NAMES = {True: "overlapped", False: "exposed", None: "-"}
+
+
 class CommsLogger:
 
     def __init__(self, config=None):
         self.enabled = getattr(config, "enabled", True) if config is not None else True
         self.verbose = getattr(config, "verbose", False) if config is not None else False
         self.prof_ops = getattr(config, "prof_ops", []) if config is not None else []
-        # {op_name: {(size, axes): count}}
-        self.comms_dict: Dict[str, Dict[Tuple[int, str], int]] = defaultdict(lambda: defaultdict(int))
+        # {op_name: {(size, axes, overlapped): count}} — ``overlapped``
+        # classifies the launch's schedule: True = issued concurrently with
+        # independent compute (the layer-granular ZeRO overlap schedule's
+        # in-scan prefetch/reduce-scatter), False = on the critical path
+        # (barrier schedule, edge-of-step collectives), None = unclassified
+        # (generic comm frontend calls).
+        self.comms_dict: Dict[str, Dict[Tuple[int, str, object], int]] = \
+            defaultdict(lambda: defaultdict(int))
 
-    def append(self, op_name: str, size: int, axis) -> None:
+    def append(self, op_name: str, size: int, axis, overlapped=None,
+               count: int = 1) -> None:
         if not self.enabled:
             return
         if self.prof_ops and op_name not in self.prof_ops:
             return
-        key = (size, str(axis))
-        self.comms_dict[op_name][key] += 1
+        key = (size, str(axis), overlapped)
+        # count: executions per trace of this site (scan bodies trace once
+        # but launch per iteration) — the byte totals must reflect launches
+        self.comms_dict[op_name][key] += count
         if self.verbose:
-            logger.info(f"comm op: {op_name} | axes: {axis} | msg size: {convert_size(size)} (traced)")
+            logger.info(f"comm op: {op_name} | axes: {axis} | msg size: "
+                        f"{convert_size(size)} | sched: "
+                        f"{_SCHED_NAMES[overlapped]} (traced)")
+
+    def _sched_totals(self) -> Dict[object, int]:
+        """Traced bytes by schedule class (size x trace-count)."""
+        totals: Dict[object, int] = defaultdict(int)
+        for entries in self.comms_dict.values():
+            for (size, _axes, overlapped), count in entries.items():
+                totals[overlapped] += size * count
+        return totals
 
     def log_all(self, show_straggler: bool = False) -> None:
         if not self.comms_dict:
             logger.info("CommsLogger: no collectives recorded")
             return
-        lines = [f"{'Comm. Op':<22}{'Axes':<24}{'Message Size':<16}{'Trace Count':<12}"]
+        # Count = trace sites weighted by executions-per-step (scan-body
+        # collectives launch once per iteration of a single trace)
+        lines = [f"{'Comm. Op':<22}{'Axes':<24}{'Message Size':<16}"
+                 f"{'Sched':<12}{'Count':<12}"]
         for op_name, entries in sorted(self.comms_dict.items()):
-            for (size, axes), count in sorted(entries.items()):
-                lines.append(f"{op_name:<22}{axes:<24}{convert_size(size):<16}{count:<12}")
+            for (size, axes, overlapped), count in sorted(
+                    entries.items(), key=lambda kv: (kv[0][0], kv[0][1],
+                                                     str(kv[0][2]))):
+                lines.append(f"{op_name:<22}{axes:<24}"
+                             f"{convert_size(size):<16}"
+                             f"{_SCHED_NAMES[overlapped]:<12}{count:<12}")
+        totals = self._sched_totals()
+        ov, ex = totals.get(True, 0), totals.get(False, 0)
+        if ov or ex:
+            # under XLA per-op wall time is unobservable from Python; the
+            # honest split is traced BYTES by schedule class — overlapped
+            # bytes ride under compute, exposed bytes sit on the critical
+            # path (see docs/ZERO_OVERLAP.md)
+            frac = ov / max(ov + ex, 1)
+            lines.append(f"traced bytes: overlapped {convert_size(ov)} / "
+                         f"exposed {convert_size(ex)} "
+                         f"(overlapped fraction {frac:.2f})")
         logger.info("Communication summary (sizes recorded at trace time):\n" + "\n".join(lines))
 
     def reset(self) -> None:

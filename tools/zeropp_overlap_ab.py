@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""A/B: layer-granular pipelined ZeRO schedule (overlap_comm true) vs the
+whole-tree barrier schedule (overlap_comm false) on the gpt2-125m ZeRO-3
+line — the ISSUE 3 tentpole's measured win.
+
+Both variants run the SAME explicit shard_map micro step
+(engine._build_zeropp_micro); the only difference is the schedule: the
+overlap variant issues layer l+1's param all-gather during layer l's
+forward compute and layer l's gradient reduce-scatter during layer l-1's
+backward compute (models/transformer.py scan_blocks_pipelined), while the
+barrier variant gathers the whole tree before the loss and scatters all
+gradients after the backward. To hold the micro-step IMPLEMENTATION fixed
+(plain stage 3 with overlap_comm false would take the declarative jit
+path, a different compilation entirely), the barrier arm keeps
+`overlap_comm: true` and forces the barrier schedule with the
+DSTPU_ZERO_OVERLAP=0 kill switch. Pass --quant to A/B the ZeRO++
+quantized collectives (qwZ+qgZ) instead of fp32/bf16 ones.
+
+Two 125M stage-3 engines do not reliably fit HBM together, so
+interleaving is at PROCESS granularity via tools/ab_common.py:
+`--single <variant>` runs one engine (build + warmup + 4 best-of
+windows) and prints a JSON line; driver mode alternates subprocesses.
+
+Run:  python tools/zeropp_overlap_ab.py [--quant]
+      python tools/zeropp_overlap_ab.py --single overlap|barrier [--quant]
+"""
+
+import json
+import os
+import sys
+import time
+
+STEPS = 30
+
+
+def build(variant, quant):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2_model
+    from deepspeed_tpu.runtime import topology as topo_mod
+
+    topo_mod.reset()
+    model = gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True)
+    micro, seq = 8, 1024
+    if variant == "barrier":
+        # same explicit shard_map micro, barrier schedule (see docstring)
+        os.environ["DSTPU_ZERO_OVERLAP"] = "0"
+    zero = {"stage": 3, "overlap_comm": True}
+    if quant:
+        zero.update({"zero_quantized_weights": True,
+                     "zero_quantized_gradients": True})
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": zero,
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "data_types": {"grad_accum_dtype": "bf16"},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    ids = np.random.default_rng(0).integers(
+        0, model.config.vocab_size, size=(micro, seq))
+    return engine, {"input_ids": ids}, micro * seq
+
+
+def run_single(variant, quant):
+    import jax
+    import jax.numpy as jnp
+
+    def sync(x):
+        return float(jax.device_get(jnp.ravel(x)[0]))
+
+    try:
+        engine, batch, tokens = build(variant, quant)
+        sync(engine.train_batch(batch))  # compile + settle
+        if variant == "overlap" and not engine._overlap_active:
+            print(json.dumps({"variant": variant,
+                              "error": "overlap schedule did not engage: "
+                                       + engine._overlap_fallback}),
+                  flush=True)
+            return
+        if variant == "barrier" and engine._overlap_active:
+            print(json.dumps({"variant": variant,
+                              "error": "barrier arm unexpectedly took the "
+                                       "overlap schedule"}), flush=True)
+            return
+        sync(engine.train_batch(batch))
+        best = float("inf")
+        for _ in range(4):
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                loss = engine.train_batch(batch)
+            sync(loss)
+            leaf = jax.tree.leaves(engine.state["params"])[0]
+            sync(jnp.ravel(leaf)[0])
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({
+            "variant": variant, "quant": quant, "best_window_s": best,
+            "tokens_per_sec": round(tokens * STEPS / best, 1),
+            "overlap_active": bool(engine._overlap_active),
+        }), flush=True)
+    except Exception as e:  # noqa: BLE001 — a crashed variant is a result
+        print(json.dumps({"variant": variant,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+
+
+def main():
+    quant = "--quant" in sys.argv
+    if "--single" in sys.argv:
+        return run_single(sys.argv[sys.argv.index("--single") + 1], quant)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ab_common import run_interleaved
+
+    best = run_interleaved(
+        ["overlap", "barrier"],
+        lambda name: [sys.executable, os.path.abspath(__file__),
+                      "--single", name] + (["--quant"] if quant else []),
+        rounds=2, timeout=2400)
+    if "overlap" in best and "barrier" in best:
+        print(json.dumps({
+            "metric": "zero overlap speedup (tokens/sec ratio)",
+            "value": round(best["overlap"]["tokens_per_sec"]
+                           / best["barrier"]["tokens_per_sec"], 3),
+            "overlap_tokens_per_sec": best["overlap"]["tokens_per_sec"],
+            "barrier_tokens_per_sec": best["barrier"]["tokens_per_sec"],
+            "quant": quant,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

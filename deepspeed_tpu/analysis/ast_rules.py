@@ -407,6 +407,77 @@ def check_literal_axis(ctx: ModuleContext):
 
 
 # ---------------------------------------------------------------------------
+# telemetry-hot-path-sync
+# ---------------------------------------------------------------------------
+TELEMETRY_HOT_SYNC = Rule(
+    rule_id="telemetry-hot-path-sync", layer=LAYER_AST, severity=SEVERITY_ERROR,
+    description="Device sync (block_until_ready/effects_barrier/device_get) "
+                "or host-callback primitive in traced step code or in "
+                "telemetry/timer span hooks — telemetry must be zero-overhead "
+                "when off and fence-point-only when on",
+    fix_hint="sample at declared fence points via telemetry.clock.fence() "
+             "(the one sanctioned sync); never sync per phase/step in span "
+             "hooks; host callbacks (pure_callback/io_callback/"
+             "debug.callback) do not belong in step graphs",
+)
+
+_SYNC_CALLS = {"block_until_ready", "effects_barrier"}
+_HOST_CALLBACK_CALLS = {"pure_callback", "io_callback"}
+# jax.debug.callback's last attribute segment is just "callback" — too
+# generic to match by segment, so it matches on the dotted suffix
+_HOST_CALLBACK_DOTTED = ("debug.callback",)
+
+
+def _is_host_callback(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    return (_last_segment(name) in _HOST_CALLBACK_CALLS
+            or any(name == d or name.endswith("." + d)
+                   for d in _HOST_CALLBACK_DOTTED))
+# modules bound by the fence-point contract: every span/timer hook in them
+# runs on the per-step hot path of whoever enables telemetry
+_HOT_PATH_MODULES = ("deepspeed_tpu/telemetry/", "deepspeed_tpu/utils/timer.py")
+
+
+@ast_rule(TELEMETRY_HOT_SYNC)
+def check_telemetry_hot_sync(ctx: ModuleContext):
+    # 1) traced scopes anywhere in the repo: a sync or host-callback
+    #    primitive inside the step graph (host-sync-in-trace covers the
+    #    device_get/np.asarray pulls; this covers the rest)
+    for _scope, node in ctx.traced_walk():
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee(node)
+        seg = _last_segment(name)
+        if seg in _SYNC_CALLS:
+            yield _finding(TELEMETRY_HOT_SYNC, ctx, node,
+                           f"{seg}() inside traced code serializes the "
+                           "dispatch pipeline")
+        elif _is_host_callback(name):
+            yield _finding(TELEMETRY_HOT_SYNC, ctx, node,
+                           f"{name}() injects a host callback into the step "
+                           "graph — telemetry must stay host-side")
+    # 2) telemetry/timer modules: syncs allowed ONLY inside fence()
+    norm = ctx.path.replace("\\", "/")
+    if not any(m in norm for m in _HOT_PATH_MODULES):
+        return
+    fence_nodes = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == "fence":
+            fence_nodes.update(id(n) for n in ast.walk(node))
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or id(node) in fence_nodes:
+            continue
+        name = _callee(node)
+        seg = _last_segment(name)
+        if seg in _SYNC_CALLS or name in _DEVICE_GET:
+            yield _finding(TELEMETRY_HOT_SYNC, ctx, node,
+                           f"{seg}() in a telemetry/timer module outside "
+                           "clock.fence() — span hooks must never sync")
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 

@@ -190,6 +190,57 @@ def audit_flash_kernel() -> List[Finding]:
                            name="flash-attention-kernel")
 
 
+def audit_telemetry_off_parity() -> List[Finding]:
+    """The telemetry zero-overhead contract (docs/OBSERVABILITY.md): the
+    engine step entry point's jaxpr must be IDENTICAL with telemetry off
+    and on — instrumentation is host-side spans around dispatches, never
+    graph edits — and neither graph may contain a host-callback primitive
+    (the auditor's ``host-callback-in-graph`` rule covers that part)."""
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from deepspeed_tpu.telemetry import NULL_TELEMETRY, reset_telemetry
+
+    from .trace_harness import TELEMETRY_GRAPH_DRIFT, JaxprAuditor
+
+    lr = jnp.asarray(1e-3, jnp.float32)
+    # ONE engine, traced twice: telemetry enabled (handle + global live),
+    # then forced off — if the step graph consults either, the jaxprs
+    # diverge. One build keeps the audit cheap inside the tier-1 gate.
+    tmpdir = tempfile.mkdtemp(prefix="dstpu_telemetry_audit_")
+    try:
+        engine = _tiny_engine(config_extra={"telemetry": {
+            "enabled": True, "watchdog": {"enabled": False},
+            "trace": {"output_path": tmpdir}}})
+        assert engine.telemetry.enabled, \
+            "telemetry config block did not enable the subsystem"
+        batch = _batch(engine)
+        with engine.mesh:
+            jaxpr_on = jax.make_jaxpr(engine._train_step_fn)(
+                engine.state, batch, lr)
+    finally:
+        reset_telemetry()  # the audit must not leak a live recorder
+        import shutil
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    engine.telemetry = NULL_TELEMETRY
+    with engine.mesh:
+        jaxpr_off = jax.make_jaxpr(engine._train_step_fn)(
+            engine.state, batch, lr)
+    auditor = JaxprAuditor("telemetry-off-parity")
+    auditor.walk(jaxpr_on.jaxpr)
+    findings = auditor.findings
+    if str(jaxpr_off) != str(jaxpr_on):
+        findings.append(Finding(
+            rule_id=TELEMETRY_GRAPH_DRIFT.rule_id,
+            path="<trace:telemetry-off-parity>", line=0,
+            severity=SEVERITY_ERROR,
+            message="engine train-step jaxpr differs between telemetry "
+                    "disabled and enabled",
+            fix_hint=TELEMETRY_GRAPH_DRIFT.fix_hint))
+    return findings
+
+
 ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
     "engine-train-step": audit_engine_step,
     "zero-gather-partition": audit_zero_gather_partition,
@@ -198,6 +249,7 @@ ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
     "ring-attention": audit_ring_attention,
     "ulysses-attention": audit_ulysses_attention,
     "flash-attention-kernel": audit_flash_kernel,
+    "telemetry-off-parity": audit_telemetry_off_parity,
 }
 
 

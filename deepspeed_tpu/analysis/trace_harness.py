@@ -76,6 +76,29 @@ RETRACE_HAZARD = register(Rule(
     fix_hint="pad/bucket shapes to a fixed set and keep non-array arguments "
              "static and hashable"))
 
+HOST_CALLBACK_IN_GRAPH = register(Rule(
+    rule_id="host-callback-in-graph", layer=LAYER_JAXPR,
+    severity=SEVERITY_ERROR,
+    description="Host-callback primitive (pure_callback/io_callback/debug "
+                "callback) inside an audited step graph — stalls the XLA "
+                "pipeline per invocation and breaks the telemetry "
+                "zero-overhead contract",
+    fix_hint="keep observability host-side (telemetry span hooks around the "
+             "dispatch); remove the callback from traced code"))
+
+TELEMETRY_GRAPH_DRIFT = register(Rule(
+    rule_id="telemetry-graph-drift", layer=LAYER_JAXPR,
+    severity=SEVERITY_ERROR,
+    description="Enabling telemetry changed a step entry point's jaxpr — "
+                "the disabled/enabled paths must compile the identical "
+                "program (telemetry is host-side by contract)",
+    fix_hint="move the instrumentation outside the jit boundary; spans wrap "
+             "dispatches, they never enter traced code"))
+
+# primitives that call back into Python from inside the compiled program
+_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                   "callback"}
+
 # jaxpr primitive names that carry a mesh-axis parameter ('axes' on psum/
 # pmin/pmax, 'axis_name' on the rest — reduce_scatter is psum_scatter's
 # primitive name).
@@ -174,6 +197,9 @@ class JaxprAuditor:
                 mesh = getattr(sharding, "mesh", None)
                 if mesh is not None:
                     self._check_mesh(mesh, "with_sharding_constraint")
+            if prim in _CALLBACK_PRIMS:
+                self._emit(HOST_CALLBACK_IN_GRAPH,
+                           f"{prim} primitive inside the audited graph")
             if prim in _COLLECTIVE_PRIMS:
                 for axis in _eqn_axes(eqn):
                     if axis not in bound:

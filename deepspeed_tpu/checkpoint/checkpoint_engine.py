@@ -37,6 +37,14 @@ class CheckpointEngine:
         """Make ``tag`` durable; returns success."""
         return True
 
+    def submit(self, tag: str, fn) -> Optional[Future]:
+        """Run a whole checkpoint-write task. Synchronous engines run it
+        inline; the async engine queues it on the worker thread — the
+        task's internal ordering (data → meta → ``latest``) IS the commit
+        fence, since one task runs on one thread."""
+        fn()
+        return None
+
 
 class NpzCheckpointEngine(CheckpointEngine):
     """Synchronous npz persistence (the reference's TorchCheckpointEngine)."""
@@ -67,6 +75,23 @@ class AsyncCheckpointEngine(NpzCheckpointEngine):
         fut = self._pool.submit(super().save, staged, path)
         with self._lock:
             self._pending.append(fut)
+
+    def submit(self, tag: str, fn) -> Future:
+        """Queue a full checkpoint-write task (engine.save_checkpoint's
+        write-behind path). The caller must have staged all device data to
+        host already; the task records its duration as a telemetry
+        checkpoint span from the worker thread."""
+        from ..telemetry import get_telemetry
+
+        def run():
+            with get_telemetry().phase(f"checkpoint_write:{tag}",
+                                       phase="checkpoint"):
+                fn()
+
+        fut = self._pool.submit(run)
+        with self._lock:
+            self._pending.append(fut)
+        return fut
 
     def commit(self, tag: str) -> bool:
         with self._lock:

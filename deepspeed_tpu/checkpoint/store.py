@@ -59,67 +59,101 @@ def _owned_pieces(i: int, v) -> Dict[str, np.ndarray]:
     return out
 
 
+def stage_state(state) -> Tuple[list, Dict[str, np.ndarray]]:
+    """Pull the state to host NOW (device buffers may be donated by the
+    next step) — the synchronous half of a write-behind save. Returns
+    ``(sorted keys, host arrays by key)``."""
+    flat = _flatten_with_paths(state)
+    keys = sorted(flat.keys())
+    return keys, {k: np.asarray(jax.device_get(flat[k])) for k in keys}
+
+
+def write_latest(save_dir: str, tag: str) -> None:
+    """Atomically repoint ``latest`` — the commit point of a checkpoint.
+    Callers must only invoke this after every data file of ``tag`` is
+    durable (the async engine orders it last in the same worker task)."""
+    tmp = os.path.join(save_dir, f".latest.{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        f.write(tag)
+    os.replace(tmp, os.path.join(save_dir, "latest"))
+
+
+def write_staged(save_dir: str, tag: str, keys, host: Dict[str, np.ndarray],
+                 client_state: Dict[str, Any], save_latest: bool = True) -> None:
+    """Write an already-staged (host-resident) single-process checkpoint:
+    data, then meta.json (the commit record), then — optionally — the
+    ``latest`` repoint. The IO half of a write-behind save; runs on the
+    async engine's worker thread."""
+    path = os.path.join(save_dir, tag)
+    os.makedirs(path, exist_ok=True)
+    # npz keys cannot contain some chars; index them
+    np.savez(os.path.join(path, "state.npz"),
+             **{f"leaf_{i}": host[k] for i, k in enumerate(keys)})
+    # an elastic restart may re-save a tag previously written at
+    # another process count — stale rank files must not shadow this
+    import glob as _glob
+    for f in _glob.glob(os.path.join(path, "state.rank*.npz")):
+        os.remove(f)
+    meta = {
+        "keys": keys,
+        "dtypes": {k: str(host[k].dtype) for k in keys},
+        "shapes": {k: list(host[k].shape) for k in keys},
+        "num_shard_files": 0,
+        "client_state": client_state,
+    }
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f, indent=2, default=str)
+    if save_latest:
+        write_latest(save_dir, tag)
+
+
 def save_checkpoint(save_dir: str, tag: str, state, client_state: Dict[str, Any],
                     save_latest: bool = True) -> None:
+    pcount = jax.process_count()
+    if pcount == 1:
+        keys, host = stage_state(state)
+        write_staged(save_dir, tag, keys, host, client_state,
+                     save_latest=save_latest)
+        return
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
     flat = _flatten_with_paths(state)
     keys = sorted(flat.keys())
-    pcount = jax.process_count()
-    if pcount == 1:
-        host = {k: np.asarray(jax.device_get(v)) for k, v in flat.items()}
-        # npz keys cannot contain some chars; index them
-        np.savez(os.path.join(path, "state.npz"),
-                 **{f"leaf_{i}": host[k] for i, k in enumerate(keys)})
-        # an elastic restart may re-save a tag previously written at
-        # another process count — stale rank files must not shadow this
-        import glob as _glob
-        for f in _glob.glob(os.path.join(path, "state.rank*.npz")):
-            os.remove(f)
-        dtypes = {k: str(host[k].dtype) for k in keys}
-        shapes = {k: list(host[k].shape) for k in keys}
-    else:
-        # multi-host: remote shards are not addressable — every process
-        # writes its replica-0 pieces; the union across rank files tiles
-        # each leaf exactly once
-        pieces: Dict[str, np.ndarray] = {}
-        for i, k in enumerate(keys):
-            v = flat[k]
-            if hasattr(v, "addressable_shards"):
-                pieces.update(_owned_pieces(i, v))
-            elif jax.process_index() == 0:  # host scalars/ndarrays
-                pieces[f"leaf_{i}__full"] = np.asarray(v)
-        np.savez(os.path.join(path, f"state.rank{jax.process_index()}.npz"),
-                 **pieces)
-        dtypes = {k: str(np.dtype(flat[k].dtype)) for k in keys}
-        shapes = {k: list(np.shape(flat[k])) for k in keys}
-        # commit fence: every rank's shard file must be on disk before rank
-        # 0 writes meta.json and repoints `latest` — otherwise a crash in
-        # the window leaves `latest` naming an unreadable checkpoint
-        from ..comm import comm as _comm
-        _comm.barrier()
-        if jax.process_index() == 0:
-            single = os.path.join(path, "state.npz")
-            if os.path.exists(single):  # stale single-process format
-                os.remove(single)
-    if pcount == 1 or jax.process_index() == 0:
+    # multi-host: remote shards are not addressable — every process
+    # writes its replica-0 pieces; the union across rank files tiles
+    # each leaf exactly once
+    pieces: Dict[str, np.ndarray] = {}
+    for i, k in enumerate(keys):
+        v = flat[k]
+        if hasattr(v, "addressable_shards"):
+            pieces.update(_owned_pieces(i, v))
+        elif jax.process_index() == 0:  # host scalars/ndarrays
+            pieces[f"leaf_{i}__full"] = np.asarray(v)
+    np.savez(os.path.join(path, f"state.rank{jax.process_index()}.npz"),
+             **pieces)
+    # commit fence: every rank's shard file must be on disk before rank
+    # 0 writes meta.json and repoints `latest` — otherwise a crash in
+    # the window leaves `latest` naming an unreadable checkpoint
+    from ..comm import comm as _comm
+    _comm.barrier()
+    if jax.process_index() == 0:
+        single = os.path.join(path, "state.npz")
+        if os.path.exists(single):  # stale single-process format
+            os.remove(single)
         meta = {
             "keys": keys,
-            "dtypes": dtypes,
-            "shapes": shapes,
-            "num_shard_files": pcount if pcount > 1 else 0,
+            "dtypes": {k: str(np.dtype(flat[k].dtype)) for k in keys},
+            "shapes": {k: list(np.shape(flat[k])) for k in keys},
+            "num_shard_files": pcount,
             "client_state": client_state,
         }
         with open(os.path.join(path, "meta.json"), "w") as f:
             json.dump(meta, f, indent=2, default=str)
         if save_latest:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(tag)
-    if pcount > 1:
-        # second fence: non-zero ranks must not return (and possibly
-        # load_checkpoint) until rank 0 has committed meta.json/latest
-        from ..comm import comm as _comm
-        _comm.barrier()
+            write_latest(save_dir, tag)
+    # second fence: non-zero ranks must not return (and possibly
+    # load_checkpoint) until rank 0 has committed meta.json/latest
+    _comm.barrier()
 
 
 def _np_dtype(name: str) -> np.dtype:

@@ -50,6 +50,13 @@ _INITIALIZED = False
 _COMMS_LOGGER = None  # set by configure()
 
 
+def _telemetry():
+    """The process-global telemetry (NULL object when disabled) — comm
+    records feed its trace/overlap metrics alongside the CommsLogger."""
+    from ..telemetry import get_telemetry
+    return get_telemetry()
+
+
 def init_distributed(dist_backend: Optional[str] = None,
                      auto_mpi_discovery: bool = True,
                      distributed_port: int = 29500,
@@ -123,9 +130,14 @@ def configure(config=None, comms_logger=None) -> None:
 
 
 def _record(op_name: str, x, axis: AxisNames) -> None:
+    tele = _telemetry()
+    if _COMMS_LOGGER is None and not tele.enabled:
+        return
+    size = int(np.prod(jnp.shape(x))) * jnp.result_type(x).itemsize
     if _COMMS_LOGGER is not None:
-        size = int(np.prod(jnp.shape(x))) * jnp.result_type(x).itemsize
         _COMMS_LOGGER.append(op_name, size, axis)
+    if tele.enabled:
+        tele.record_collective(op_name, size, axis)
 
 
 def record_collective(op_name: str, nbytes: int, axis: AxisNames,
@@ -139,10 +151,25 @@ def record_collective(op_name: str, nbytes: int, axis: AxisNames,
     critical path (barrier schedule, edge-of-step gathers). ``count`` is
     the executions-per-step of one trace site (a scan body traces once but
     launches per iteration). Feeds the overlapped/exposed split column of
-    :func:`log_summary`. No-op unless a CommsLogger is configured."""
+    :func:`log_summary` and the telemetry trace/overlap-efficiency metric
+    (docs/OBSERVABILITY.md). No-op unless a CommsLogger or telemetry is
+    configured."""
     if _COMMS_LOGGER is not None:
         _COMMS_LOGGER.append(op_name, int(nbytes), axis,
                              overlapped=overlapped, count=count)
+    tele = _telemetry()
+    if tele.enabled:
+        tele.record_collective(op_name, int(nbytes), axis,
+                               overlapped=overlapped, count=count)
+
+
+def comms_log_tail(n: int = 12) -> str:
+    """The last ``n`` recorded collectives, formatted — the watchdog's
+    comms dump: when a step stalls, the ops recorded closest to the hang
+    point the finger at the wedged collective group."""
+    if _COMMS_LOGGER is None:
+        return ""
+    return _COMMS_LOGGER.tail(n)
 
 
 # -- process-level queries ---------------------------------------------------

@@ -586,12 +586,15 @@ class InferenceEngineV2:
             self._burst_fns[key] = jax.jit(
                 functools.partial(self._model.decode_burst, num_steps=num_steps),
                 donate_argnums=(1, 2))
-        with self.mesh:
-            toks, k_pages, v_pages = self._burst_fns[key](
-                self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
-                jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(tables), jax.random.PRNGKey(seed),
-                jnp.asarray(temps))
+        from ...telemetry import get_telemetry
+        with get_telemetry().phase("decode_burst", phase="serving",
+                                   sequences=len(batch_uids), k=num_steps):
+            with self.mesh:
+                toks, k_pages, v_pages = self._burst_fns[key](
+                    self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(tables), jax.random.PRNGKey(seed),
+                    jnp.asarray(temps))
         self.kv_cache.update(k_pages, v_pages)
         for seq in seqs:
             seq.post_forward(num_steps)
@@ -651,14 +654,18 @@ class InferenceEngineV2:
             bt = seq.blocks[:mpp]
             p_tables[i, :len(bt)] = bt
 
-        with self.mesh:
-            logits, k_pages, v_pages = self._ragged_fn(
-                self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
-                jnp.asarray(d_tokens), jnp.asarray(d_positions),
-                jnp.asarray(d_context), jnp.asarray(d_tables),
-                jnp.asarray(p_tokens), jnp.asarray(p_positions),
-                jnp.asarray(p_valid), jnp.asarray(p_history),
-                jnp.asarray(p_tables))
+        from ...telemetry import get_telemetry
+        with get_telemetry().phase("ragged_dispatch", phase="serving",
+                                   decode=len(decode), prefill=len(prefill),
+                                   prefill_tokens=int(p_valid.sum())):
+            with self.mesh:
+                logits, k_pages, v_pages = self._ragged_fn(
+                    self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
+                    jnp.asarray(d_tokens), jnp.asarray(d_positions),
+                    jnp.asarray(d_context), jnp.asarray(d_tables),
+                    jnp.asarray(p_tokens), jnp.asarray(p_positions),
+                    jnp.asarray(p_valid), jnp.asarray(p_history),
+                    jnp.asarray(p_tables))
         self.kv_cache.update(k_pages, v_pages)
         for uid, chunk in wave:
             sm.get_sequence(uid).post_forward(len(chunk))

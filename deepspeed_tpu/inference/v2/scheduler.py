@@ -42,6 +42,11 @@ class ContinuousBatchingScheduler:
                  max_prefills_per_wave: Optional[int] = None,
                  kv_host_offload: bool = True):
         self.engine = engine
+        # serving telemetry (queue depth, occupancy, per-token latency
+        # percentiles): the process-global recorder — a NULL object unless
+        # an engine configured it or DSTPU_TELEMETRY=1
+        from deepspeed_tpu.telemetry import maybe_enable_from_env
+        maybe_enable_from_env()
         self.token_budget = token_budget or engine.config.state_manager.max_ragged_batch_size
         # preemption stashes KV to host RAM (engine.offload_sequence) and
         # resumes by restore — no re-prefill. False restores the old
@@ -204,10 +209,13 @@ class ContinuousBatchingScheduler:
         ``DSTPU_SCHED_LOG=1`` prints one line per wave (kind, per-request
         token counts, wall ms) — the serving analog of the comms logger."""
         import os
+        from deepspeed_tpu.telemetry import clock, get_telemetry
+        tele = get_telemetry()
         log = os.environ.get("DSTPU_SCHED_LOG") == "1"
         if log:
             import time as _t
             _t0 = _t.perf_counter()
+        _w0 = clock.now()
         # restore offloaded sequences as KV pressure relents — they were
         # running before anything queued, so they outrank new prefills
         self._restore_offloaded()
@@ -217,6 +225,11 @@ class ContinuousBatchingScheduler:
                 print(f"[sched] burst tokens={burst} "
                       f"running={len(self._running)} "
                       f"ms={(_t.perf_counter() - _t0) * 1e3:.0f}", flush=True)
+            if tele.enabled:
+                tele.record_wave(
+                    "burst", tokens=burst, duration_s=clock.now() - _w0,
+                    queue_depth=len(self._queue), running=len(self._running),
+                    occupancy=burst / max(self.token_budget, 1))
             return burst
         uids: List[int] = []
         tokens: List[np.ndarray] = []
@@ -266,6 +279,14 @@ class ContinuousBatchingScheduler:
             return 0
 
         logits = self.engine.put(uids, tokens)
+        if tele.enabled:
+            n_tokens = sum(len(t) for t in tokens)
+            kind = ("mixed" if decode_reqs and prefill_reqs
+                    else "decode" if decode_reqs else "prefill")
+            tele.record_wave(
+                kind, tokens=n_tokens, duration_s=clock.now() - _w0,
+                queue_depth=len(self._queue), running=len(self._running),
+                occupancy=n_tokens / max(self.token_budget, 1))
         if log:
             print(f"[sched] wave decode={len(decode_reqs)} "
                   f"prefill={[len(tokens[uids.index(r.uid)]) for r in prefill_reqs]} "

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import List, Tuple
+from typing import Dict, List, Tuple
 
 from ..utils.logging import logger
 
@@ -84,14 +84,19 @@ class csvMonitor(Monitor):
             os.makedirs(self.output_path, exist_ok=True)
 
     def write_events(self, event_list: List[Event]) -> None:
-        for tag, value, step in event_list:
+        # group by tag: one open/close per FILE per flush, not per event —
+        # a telemetry flush writes dozens of rows across a handful of tags
+        by_tag: Dict[str, List[Event]] = {}
+        for event in event_list:
+            by_tag.setdefault(event[0], []).append(event)
+        for tag, events in by_tag.items():
             fname = os.path.join(self.output_path, tag.replace("/", "_") + ".csv")
             is_new = not os.path.exists(fname)
             with open(fname, "a", newline="") as f:
                 w = csv.writer(f)
                 if is_new:
                     w.writerow(["step", tag])
-                w.writerow([step, float(value)])
+                w.writerows([step, float(value)] for _, value, step in events)
 
 
 class MonitorMaster(Monitor):

@@ -192,6 +192,10 @@ class DeepSpeedConfig:
             csv_monitor=CSVConfig(**pd.get("csv_monitor", {})),
         )
         self.comms_config = CommsLoggerConfig(**pd.get("comms_logger", {}))
+        # telemetry subsystem (telemetry/): off by default; the
+        # DSTPU_TELEMETRY env var overrides either way at build time
+        from ..telemetry.config import TelemetryConfig
+        self.telemetry_config = TelemetryConfig(**pd.get("telemetry", {}))
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}))
         self.flops_profiler_config = FlopsProfilerConfig(**pd.get("flops_profiler", {}))

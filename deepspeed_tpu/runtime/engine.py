@@ -374,6 +374,7 @@ class DeepSpeedEngine:
         self.micro_steps = 0
         self._cached_grads = None
         self._cached_loss = None
+        self._last_prepared_batch = None  # abstract struct for MFU flops
         self.gradient_accumulation_steps = config.gradient_accumulation_steps
         self.train_micro_batch_size_per_gpu = config.train_micro_batch_size_per_gpu
         self.train_batch_size = config.train_batch_size
@@ -390,6 +391,31 @@ class DeepSpeedEngine:
 
         from ..profiling.flops_profiler.profiler import FlopsProfiler
         self.flops_profiler = FlopsProfiler(model=model, ds_engine=self)
+
+        # -- telemetry (telemetry/): span tracing, MFU/goodput, memory
+        #    watermarks, stall watchdog. Disabled (the default) this is the
+        #    NULL object — every hook a constant no-op, nothing in traced
+        #    code (enforced by the telemetry-off-parity Layer-B audit). The
+        #    MonitorMaster is ONE sink of the derived metrics; a JSONL sink
+        #    feeds tools/trace_view.py. ---------------------------------
+        self.telemetry = self._build_telemetry()
+        self._step_tokens = 0       # host-counted tokens of the open step
+
+        # -- checkpoint engine: sync npz writes, or write-behind when
+        #    checkpoint: {async_save: true} (the previously-dead
+        #    AsyncCheckpointEngine) — see save_checkpoint ---------------
+        self._ckpt_async = bool(self.config.checkpoint_config.get(
+            "async_save", False))
+        if self._ckpt_async and jax.process_count() > 1:
+            log_dist("checkpoint.async_save: multi-host saves keep the "
+                     "synchronous barrier path (per-rank shard files need "
+                     "the collective commit fence)", ranks=[0])
+            self._ckpt_async = False
+        from ..checkpoint.checkpoint_engine import (AsyncCheckpointEngine,
+                                                    NpzCheckpointEngine)
+        self.checkpoint_engine = (AsyncCheckpointEngine()
+                                  if self._ckpt_async
+                                  else NpzCheckpointEngine())
 
         # curriculum learning (reference engine.py:339,1813: difficulty ->
         # forward kwargs; here difficulty == sequence length truncation)
@@ -434,6 +460,63 @@ class DeepSpeedEngine:
         self._jit_micro_step = None
         self._jit_apply_step = None
         self._jit_train_step = None
+
+    # ------------------------------------------------------------------
+    # telemetry construction
+    # ------------------------------------------------------------------
+    def _build_telemetry(self):
+        from ..telemetry import JsonlMetricsSink, build_telemetry
+        cfg = self.config.telemetry_config
+        sinks = [self.monitor] if self.monitor.enabled else []
+        tele = build_telemetry(cfg, sinks=sinks)
+        if not tele.enabled:
+            return tele
+        if tele.flush_every <= 1 and (cfg is None or not cfg.flush_interval):
+            tele.flush_every = max(1, self.config.steps_per_print)
+        if jax.process_index() == 0:
+            os.makedirs(tele.output_dir, exist_ok=True)
+            tele.sinks.append(JsonlMetricsSink(
+                os.path.join(tele.output_dir, "metrics.jsonl")))
+        # model FLOPs for MFU resolve lazily at the first flush, through
+        # the SAME cost-analysis machinery the flops profiler reports — the
+        # two surfaces cannot disagree about the model's arithmetic. The
+        # paged-training runner owns its own step programs (no engine jit
+        # to cost), so MFU stays unavailable there rather than erroring.
+        if self._param_stream is None:
+            tele.set_flops_fn(self._telemetry_flops)
+        if tele.watchdog is not None:
+            from .. import comm as dist
+            tele.watchdog.dump_fns.append(lambda: dist.comms_log_tail())
+        return tele
+
+    def _telemetry_flops(self) -> float:
+        """Model FLOPs per optimizer step for the MFU metric, from the
+        same XLA cost-analysis machinery the flops profiler reports.
+        Engines on the split path cost the micro step x accumulation
+        steps (the profiler's exact number); gas==1 fused engines cost
+        the one fused program (fwd+bwd+update — the arithmetic the step
+        actually runs). Needs one traced batch; raises until a step ran."""
+        if self._last_prepared_batch is None:
+            raise RuntimeError("no batch seen yet")
+        if self._fused_step_eligible() and \
+                not jax.tree.leaves(self.state["grad_acc"]):
+            self._build_fused_jit()
+            args = (self.state, self._last_prepared_batch,
+                    jax.ShapeDtypeStruct((), jnp.float32))
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
+            cost = self._jit_train_step.lower(
+                *abstract).compile().cost_analysis()
+            if isinstance(cost, list):
+                cost = cost[0] if cost else {}
+            flops = float(cost.get("flops", 0.0))
+        else:
+            self._build_jits()
+            flops = self._micro_step_flops(self._last_prepared_batch) \
+                * self.gradient_accumulation_steps
+        if flops <= 0:
+            raise RuntimeError("cost analysis returned no flops")
+        return flops
 
     # ------------------------------------------------------------------
     # 1-bit optimizer construction
@@ -1446,17 +1529,28 @@ class DeepSpeedEngine:
         """Host-side batch pipeline shared by forward() and the fused step:
         validation, curriculum truncation, PLD layer mask, device placement,
         and the MoQ eigenvalue batch capture."""
-        self._validate_batch(batch)
-        if self.curriculum_scheduler is not None:
-            batch = self._apply_curriculum(batch)
-        if self.progressive_layer_drop is not None and "layer_mask" not in batch:
-            self.progressive_layer_drop.update_state(self.global_steps)
-            batch = dict(batch)
-            batch["layer_mask"] = self.progressive_layer_drop.layer_mask(
-                self._pld_rng, self.model.config.num_layers)
-        batch = self._device_batch(batch)
+        with self.telemetry.phase("prepare_batch", phase="data",
+                                  step=self.global_steps):
+            self._validate_batch(batch)
+            if self.curriculum_scheduler is not None:
+                batch = self._apply_curriculum(batch)
+            if self.progressive_layer_drop is not None and "layer_mask" not in batch:
+                self.progressive_layer_drop.update_state(self.global_steps)
+                batch = dict(batch)
+                batch["layer_mask"] = self.progressive_layer_drop.layer_mask(
+                    self._pld_rng, self.model.config.num_layers)
+            batch = self._device_batch(batch)
         if self.quantizer is not None and self.quantizer.eigenvalue_enabled:
             self._last_batch = batch  # MoQ eigenvalue pass reuses it
+        if self.telemetry.enabled:
+            # host-side token accounting (global batch) + the abstract
+            # batch the MFU flops resolution lowers against
+            ids = batch.get("input_ids")
+            if ids is not None:
+                self._step_tokens += int(np.prod(ids.shape))
+            self._last_prepared_batch = {
+                k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                for k, v in batch.items()}
         return batch
 
     def _train_batch_fused(self, batch) -> jax.Array:
@@ -1466,14 +1560,18 @@ class DeepSpeedEngine:
         dispatch is accounted to the step timer."""
         topo_mod.set_topology(self.topology)
         self._build_fused_jit()
-        # prepare BEFORE the timer: a rejected batch must not leave the
-        # step timer running into the next call (same rule as forward())
+        # prepare BEFORE the timer AND the telemetry step span: a rejected
+        # batch must not leave the step timer running — or the watchdog
+        # armed — into the next call (same rule as forward())
         batch = self._prepare_batch(batch)
+        self.telemetry.step_begin(self.global_steps)
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = jnp.asarray(self.lr_scheduler.get_lr(), jnp.float32)
-        with self.mesh:
-            self.state, loss, overflow, gnorm = self._jit_train_step(
-                self.state, batch, lr)
+        with self.telemetry.phase("fused_dispatch", phase="step",
+                                  step=self.global_steps):
+            with self.mesh:
+                self.state, loss, overflow, gnorm = self._jit_train_step(
+                    self.state, batch, lr)
         self._cached_loss = loss
         self.micro_steps += 1
         self._post_step(overflow, gnorm)
@@ -1577,19 +1675,23 @@ class DeepSpeedEngine:
         # engine was constructed last
         topo_mod.set_topology(self.topology)
         self._build_jits()
-        # prepare before the timer: a rejected batch must not leave
-        # FORWARD_GLOBAL_TIMER running into the next step
+        # prepare before the timer and the telemetry step span: a rejected
+        # batch must not leave FORWARD_GLOBAL_TIMER running — or the
+        # watchdog armed — into the next step
         batch = self._prepare_batch(batch)
+        self.telemetry.step_begin(self.global_steps)
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        with self.mesh:
-            if self._explicit_micro:
-                gacc, loss = self._jit_micro_step(
-                    self.state["grad_acc"],
-                    self.state["loss_scale"]["cur_scale"],
-                    self._secondary, batch)
-                self.state["grad_acc"] = gacc
-            else:
-                self.state, loss = self._jit_micro_step(self.state, batch)
+        with self.telemetry.phase("micro_dispatch", phase="fwd",
+                                  step=self.global_steps):
+            with self.mesh:
+                if self._explicit_micro:
+                    gacc, loss = self._jit_micro_step(
+                        self.state["grad_acc"],
+                        self.state["loss_scale"]["cur_scale"],
+                        self._secondary, batch)
+                    self.state["grad_acc"] = gacc
+                else:
+                    self.state, loss = self._jit_micro_step(self.state, batch)
         self._cached_loss = loss
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
@@ -1599,7 +1701,11 @@ class DeepSpeedEngine:
         boundary (reference engine.backward, engine.py:1922)."""
         self._reject_paged("backward")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        self.micro_steps += 1
+        # gradients were fused into the forward dispatch; this span marks
+        # the micro boundary so the trace shows accumulation structure
+        with self.telemetry.phase("micro_boundary", phase="bwd",
+                                  step=self.global_steps):
+            self.micro_steps += 1
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return self._cached_loss
 
@@ -1615,11 +1721,14 @@ class DeepSpeedEngine:
         self._build_jits()
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = jnp.asarray(self.lr_scheduler.get_lr(), jnp.float32)
-        if self._offload is not None:
-            overflow, gnorm = self._apply_step_offload(float(lr))
-        else:
-            with self.mesh:
-                self.state, overflow, gnorm = self._jit_apply_step(self.state, lr)
+        with self.telemetry.phase("apply_step", phase="optimizer",
+                                  step=self.global_steps):
+            if self._offload is not None:
+                overflow, gnorm = self._apply_step_offload(float(lr))
+            else:
+                with self.mesh:
+                    self.state, overflow, gnorm = self._jit_apply_step(
+                        self.state, lr)
         self._post_step(overflow, gnorm)
 
     def _post_step(self, overflow, gnorm) -> None:
@@ -1657,6 +1766,15 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
         self._last_grad_norm = gnorm
+        if self.telemetry.enabled:
+            tokens, self._step_tokens = self._step_tokens, 0
+            # global_steps already incremented; the open span began at N
+            self.telemetry.step_end(self.global_steps - 1, tokens=tokens)
+            if self.global_steps % self.telemetry.flush_every == 0:
+                # fence point: derived metrics (step percentiles, MFU,
+                # goodput, overlap efficiency, memory watermarks) to every
+                # sink — the monitor's 3-scalar flush grew into this
+                self.telemetry.flush(self.global_steps)
         if self.monitor.enabled and self.global_steps % self.config.steps_per_print == 0:
             self.monitor.write_events([
                 ("Train/lr", self.lr_scheduler.get_lr(), self.global_steps),
@@ -1965,18 +2083,31 @@ class DeepSpeedEngine:
             batches = [data_iter_or_batch] * gas
         else:
             batches = [next(data_iter_or_batch) for _ in range(gas)]
-        for b in batches:
-            self._validate_batch(b)
-        if self.curriculum_scheduler is not None:
-            batches = [self._apply_curriculum(b) for b in batches]
-        dev = [self._device_batch(b) for b in batches]
+        # prepare before the step span: a rejected batch must not leave
+        # the watchdog armed (same rule as the fused/split paths)
+        with self.telemetry.phase("prepare_batch", phase="data",
+                                  step=self.global_steps):
+            for b in batches:
+                self._validate_batch(b)
+            if self.curriculum_scheduler is not None:
+                batches = [self._apply_curriculum(b) for b in batches]
+            dev = [self._device_batch(b) for b in batches]
+        self.telemetry.step_begin(self.global_steps)
         lr = float(self.lr_scheduler.get_lr())
-        loss = self._param_stream.train_step(dev, lr)
+        with self.telemetry.phase("paged_step", phase="step",
+                                  step=self.global_steps):
+            loss = self._param_stream.train_step(dev, lr)
         self.micro_steps += gas
         self.global_steps += 1
         self.lr_scheduler.step()
         self._last_grad_norm = self._param_stream.last_grad_norm
         self.tput_timer.stop(global_step=True)
+        if self.telemetry.enabled:
+            tokens = sum(int(np.prod(b["input_ids"].shape))
+                         for b in dev if "input_ids" in b)
+            self.telemetry.step_end(self.global_steps - 1, tokens=tokens)
+            if self.global_steps % self.telemetry.flush_every == 0:
+                self.telemetry.flush(self.global_steps)
         return loss
 
     def train_batch(self, data_iter_or_batch) -> jax.Array:
@@ -2025,14 +2156,19 @@ class DeepSpeedEngine:
 
     def _micro_step_flops(self, batch) -> float:
         """XLA's exact cost analysis of the compiled micro-step (the
-        hook-based estimate of the reference's profiler.py:228)."""
+        hook-based estimate of the reference's profiler.py:228). ``batch``
+        leaves may be arrays or ``ShapeDtypeStruct``s (the telemetry MFU
+        path keeps only the abstract batch)."""
         try:
+            dev_batch = (batch if all(isinstance(v, jax.ShapeDtypeStruct)
+                                      for v in batch.values())
+                         else self._device_batch(batch))
             if self._explicit_micro:
                 args = (self.state["grad_acc"],
                         self.state["loss_scale"]["cur_scale"],
-                        self._secondary, self._device_batch(batch))
+                        self._secondary, dev_batch)
             else:
-                args = (self.state, self._device_batch(batch))
+                args = (self.state, dev_batch)
             abstract = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
             cost = self._jit_micro_step.lower(*abstract).compile().cost_analysis()
@@ -2275,47 +2411,95 @@ class DeepSpeedEngine:
             "lr_scheduler": self.lr_scheduler.state_dict(),
         })
         if self._param_stream is not None:
-            self._save_checkpoint_paged(save_dir, tag, client_state,
-                                        save_latest)
+            with self.telemetry.checkpoint_span("save_checkpoint", tag=tag):
+                self._save_checkpoint_paged(save_dir, tag, client_state,
+                                            save_latest)
             return
         if self.quantizer is not None:
             client_state["moq_quantizer"] = self.quantizer.state_dict()
-        _save(save_dir, tag, self.state, client_state, save_latest=save_latest)
-        if self._offload is not None:
-            # Name-keyed flat layout: master/state are this host's local
-            # segments plus span metadata, so readers (zero_to_fp32) can
-            # slice params out by NAME instead of positional guessing.
-            sd = self._offload.state_dict()
-            lay = self._offload_layout
-            np.savez(self._offload_ckpt_path(os.path.join(save_dir, tag)),
-                     step=sd["step"],
-                     master_flat=np.concatenate(
-                         [m.reshape(-1) for m in sd["master"]]),
-                     state_flat=np.concatenate(
-                         [s.reshape(-1) for s in sd["state"]]),
-                     names=np.array(self._offload_names),
-                     sizes=np.array(lay["sizes"], np.int64),
-                     total=lay["total"],
-                     chunk_elems=self._OFFLOAD_CHUNK_ELEMS,
-                     # per-leaf 2-D flat form: dp dim first, model dim (if
-                     # any) major of the second (-1 = absent)
-                     shard_dims=np.array(
-                         [-1 if lay[0] is None else lay[0]
-                          for lay in self._offload_layouts], np.int64),
-                     mp_dims=np.array(
-                         [-1 if lay[2] is None else lay[2]
-                          for lay in self._offload_layouts], np.int64),
-                     span_leaf=np.array(
-                         [i for i, _, _, _ in self._offload_spans], np.int64),
-                     span_starts=np.array(
-                         [k for _, k, _, _ in self._offload_spans], np.int64),
-                     span_lens=np.array(
-                         [int(np.prod(sh))
-                          for _, _, sh, _ in self._offload_spans], np.int64),
-                     span_shapes=np.array(
-                         [sh for _, _, sh, _ in self._offload_spans],
-                         np.int64))
+        if self._ckpt_async:
+            # Write-behind (the Nebula slot, checkpoint_engine.py): the
+            # synchronous part is ONLY the host staging — the next step may
+            # donate these device buffers. IO runs on the engine's worker;
+            # `latest` repoints LAST in the same task, so a reader never
+            # sees the tag before its data+meta are durable (the commit
+            # fence). load_checkpoint commits pending saves first.
+            from ..checkpoint.store import stage_state, write_latest, \
+                write_staged
+            # a still-in-flight previous save would interleave file writes
+            self.checkpoint_engine.commit(tag)
+            with self.telemetry.checkpoint_span("checkpoint_stage", tag=tag):
+                keys, host = stage_state(self.state)
+                sidecar = (self._offload_sidecar_arrays()
+                           if self._offload is not None else None)
+
+            def _write():
+                write_staged(save_dir, tag, keys, host, client_state,
+                             save_latest=False)
+                if sidecar is not None:
+                    np.savez(self._offload_ckpt_path(
+                        os.path.join(save_dir, tag)), **sidecar)
+                if save_latest:
+                    write_latest(save_dir, tag)
+
+            self.checkpoint_engine.submit(tag, _write)
+            log_dist(f"staged checkpoint {save_dir}/{tag} "
+                     "(async write-behind)", ranks=[0])
+            return
+        with self.telemetry.checkpoint_span("save_checkpoint", tag=tag):
+            # offload engines defer the `latest` repoint until the sidecar
+            # is durable too — same commit-fence ordering as the async
+            # branch (a crash between repoint and sidecar write must not
+            # leave `latest` naming an unloadable checkpoint)
+            defer_latest = save_latest and self._offload is not None
+            _save(save_dir, tag, self.state, client_state,
+                  save_latest=save_latest and not defer_latest)
+            if self._offload is not None:
+                np.savez(self._offload_ckpt_path(os.path.join(save_dir, tag)),
+                         **self._offload_sidecar_arrays())
+            if defer_latest:
+                from .. import comm as dist
+                from ..checkpoint.store import write_latest
+                dist.barrier()  # every rank's sidecar on disk first
+                if jax.process_index() == 0:
+                    write_latest(save_dir, tag)
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+
+    def _offload_sidecar_arrays(self) -> Dict[str, Any]:
+        """Host arrays of the offload optimizer sidecar file. Name-keyed
+        flat layout: master/state are this host's local segments plus span
+        metadata, so readers (zero_to_fp32) can slice params out by NAME
+        instead of positional guessing."""
+        sd = self._offload.state_dict()
+        lay = self._offload_layout
+        return dict(
+            step=sd["step"],
+            master_flat=np.concatenate(
+                [m.reshape(-1) for m in sd["master"]]),
+            state_flat=np.concatenate(
+                [s.reshape(-1) for s in sd["state"]]),
+            names=np.array(self._offload_names),
+            sizes=np.array(lay["sizes"], np.int64),
+            total=lay["total"],
+            chunk_elems=self._OFFLOAD_CHUNK_ELEMS,
+            # per-leaf 2-D flat form: dp dim first, model dim (if
+            # any) major of the second (-1 = absent)
+            shard_dims=np.array(
+                [-1 if lay[0] is None else lay[0]
+                 for lay in self._offload_layouts], np.int64),
+            mp_dims=np.array(
+                [-1 if lay[2] is None else lay[2]
+                 for lay in self._offload_layouts], np.int64),
+            span_leaf=np.array(
+                [i for i, _, _, _ in self._offload_spans], np.int64),
+            span_starts=np.array(
+                [k for _, k, _, _ in self._offload_spans], np.int64),
+            span_lens=np.array(
+                [int(np.prod(sh))
+                 for _, _, sh, _ in self._offload_spans], np.int64),
+            span_shapes=np.array(
+                [sh for _, _, sh, _ in self._offload_spans],
+                np.int64))
 
     def save_16bit_model(self, save_dir: str, save_filename: str = "pytorch_model.npz") -> None:
         """Gathered bit16 weights for deployment (reference
@@ -2332,13 +2516,16 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_optimizer_states: bool = True) -> Tuple[Optional[str], Dict[str, Any]]:
+        # an in-flight async save must land before `latest` is read —
+        # the load side of the write-behind commit fence
+        self.checkpoint_engine.commit(tag or "")
         if self._param_stream is not None:
             return self._load_checkpoint_paged(load_dir, tag,
                                                load_optimizer_states)
         self._require_params("load_checkpoint")
         from ..checkpoint.store import load_checkpoint as _load
         shardings = self._state_shardings()
-        with self.mesh:
+        with self.telemetry.checkpoint_span("load_checkpoint"), self.mesh:
             state, client_state, tag = _load(load_dir, tag, self.state, shardings,
                                              load_optimizer_states=load_optimizer_states)
         if state is None:

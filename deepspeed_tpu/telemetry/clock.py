@@ -1,0 +1,57 @@
+"""The telemetry clock: host timestamps plus *fence-point* sampling.
+
+Under XLA every dispatch is asynchronous; a host timestamp taken mid-step
+measures dispatch, not compute. The old timers resolved this by calling
+``jax.effects_barrier()`` on every start/stop — a device sync **per phase
+per step**, serializing the very pipeline the schedules exist to fill.
+
+The telemetry contract inverts that: the hot path only ever calls
+:func:`now` (a ``perf_counter`` read), and device synchronization is
+confined to :func:`fence` — called at *declared* fence points (metric
+flushes, report boundaries, checkpoint edges), never inside span hooks or
+per-step code. Because the XLA dispatch queue backpressures, host
+timestamps drift-bounded by at most one queue depth between fences; the
+fence re-anchors them. The ``telemetry-hot-path-sync`` lint rule enforces
+that this module's :func:`fence` stays the only sanctioned sync.
+"""
+
+from __future__ import annotations
+
+import time
+
+# observability of the observability: how many fences ran and where the
+# last one came from — a fence count growing per-step means somebody is
+# syncing on the hot path.
+_FENCE_COUNT = 0
+_LAST_FENCE_REASON = ""
+
+
+def now() -> float:
+    """Monotonic host timestamp in seconds. Never syncs."""
+    return time.perf_counter()
+
+
+def fence(reason: str) -> float:
+    """Drain outstanding device work, then return :func:`now`.
+
+    The ONLY sanctioned device sync in the telemetry subsystem. Call it at
+    fence points (flush/report/checkpoint boundaries) to re-anchor host
+    timestamps to device completion; never per phase or per step.
+    """
+    global _FENCE_COUNT, _LAST_FENCE_REASON
+    try:
+        import jax
+        jax.effects_barrier()
+    except Exception:  # pragma: no cover - jax not importable / no backend
+        pass
+    _FENCE_COUNT += 1
+    _LAST_FENCE_REASON = reason
+    return now()
+
+
+def fence_count() -> int:
+    return _FENCE_COUNT
+
+
+def last_fence_reason() -> str:
+    return _LAST_FENCE_REASON

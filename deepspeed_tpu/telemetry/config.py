@@ -1,0 +1,68 @@
+"""Telemetry config block.
+
+One ``telemetry`` JSON block gates the whole subsystem (see
+``docs/OBSERVABILITY.md``). The hard contract: ``enabled: false`` (the
+default) injects **nothing** — no host callbacks, no device syncs, no
+allocations on the step path; the engine holds a ``NullTelemetry`` whose
+every hook is a no-op. ``DSTPU_TELEMETRY=0|1`` overrides the config either
+way, so a hung production run can be re-launched with tracing on (or a
+noisy one silenced) without editing configs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class TraceConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    # directory for trace/metric exports; empty → ./dstpu_telemetry
+    output_path: str = ""
+    # bounded span buffer — the recorder drops the oldest events past this
+    # (and counts the drops) instead of growing without bound in a long run
+    max_events: int = 100_000
+
+
+class MetricsConfig(DeepSpeedConfigModel):
+    # rolling window (steps) for percentiles / MFU / goodput
+    window: int = 128
+
+
+class MemoryConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+
+
+class WatchdogConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    # a step is stalled when it exceeds deadline_factor x rolling median
+    # step time (never less than min_deadline_s — warmup/compile steps are
+    # legitimately slow)
+    deadline_factor: float = 3.0
+    min_deadline_s: float = 60.0
+    poll_s: float = 1.0
+
+
+class TelemetryConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    # flush derived metrics to the sinks every N optimizer steps
+    # (0 → follow the engine's steps_per_print)
+    flush_interval: int = 0
+    trace: TraceConfig = Field(default_factory=TraceConfig)
+    metrics: MetricsConfig = Field(default_factory=MetricsConfig)
+    memory: MemoryConfig = Field(default_factory=MemoryConfig)
+    watchdog: WatchdogConfig = Field(default_factory=WatchdogConfig)
+
+
+def telemetry_enabled(config: Optional[TelemetryConfig]) -> bool:
+    """Resolve the on/off gate: DSTPU_TELEMETRY env wins over the config."""
+    env = os.environ.get("DSTPU_TELEMETRY", "").strip().lower()
+    if env in ("0", "off", "false"):
+        return False
+    if env in ("1", "on", "true"):
+        return True
+    return bool(config is not None and config.enabled)

@@ -1,0 +1,105 @@
+"""Memory telemetry: compiled-HLO analysis + live-buffer watermarks.
+
+Two complementary views, both host-side:
+
+1. **Static** — :func:`compiled_memory_report` asks XLA what a compiled
+   entry point *will* use (``Compiled.memory_analysis()``: argument /
+   output / temp / alias bytes). This is exact, per-program, and free of
+   timing: the right tool for "does this step fit" before a 3B run OOMs
+   forty minutes in.
+2. **Dynamic** — :meth:`MemoryTracker.sample` sums the process's live
+   ``jax.Array`` buffers (per-shard addressable bytes, so replication is
+   counted the way HBM pays for it) and, where the runtime exposes it,
+   the allocator's ``memory_stats()`` (``bytes_in_use`` /
+   ``peak_bytes_in_use``). Sampling walks host-side bookkeeping only — no
+   device sync — but it IS O(live arrays), so the telemetry facade calls
+   it at fence points (flush/checkpoint boundaries) only, per the
+   ``telemetry-hot-path-sync`` contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+
+
+def compiled_memory_report(compiled) -> Optional[Dict[str, float]]:
+    """Byte sizes from an XLA ``Compiled``'s ``memory_analysis()``;
+    None when the backend doesn't expose it (CPU host platform often
+    doesn't)."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    fields = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes")
+    out = {}
+    for f in fields:
+        v = getattr(mem, f, None)
+        if v is not None:
+            out[f] = float(v)
+    return out or None
+
+
+def lower_and_report(jitfn, *abstract_args) -> Optional[Dict[str, float]]:
+    """Lower+compile ``jitfn`` on abstract avals and report its memory
+    analysis. Compilation is cached by signature, so calling this for a
+    shape the step already ran is near-free; a NEW shape pays one compile
+    — call it per entry point, not per step."""
+    try:
+        compiled = jitfn.lower(*abstract_args).compile()
+    except Exception:
+        return None
+    return compiled_memory_report(compiled)
+
+
+class MemoryTracker:
+    """Live-buffer watermark sampling at fence points."""
+
+    def __init__(self):
+        self.peak_live_bytes = 0
+        self.last_live_bytes = 0
+        self.last_allocator: Dict[str, int] = {}
+        self.samples = 0
+
+    @staticmethod
+    def _live_bytes() -> int:
+        total = 0
+        for arr in jax.live_arrays():
+            shards = getattr(arr, "addressable_shards", None)
+            if shards:
+                try:
+                    total += sum(s.data.nbytes for s in shards)
+                    continue
+                except Exception:  # deleted/donated mid-walk
+                    continue
+            total += getattr(arr, "nbytes", 0)
+        return total
+
+    @staticmethod
+    def _allocator_stats() -> Dict[str, int]:
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            return {}
+        if not stats:
+            return {}
+        return {k: int(v) for k, v in stats.items()
+                if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")}
+
+    def sample(self, tag: str = "") -> Dict[str, Any]:
+        """Take one watermark sample. Fence-point use only (O(live
+        arrays) host walk; never a device sync)."""
+        live = self._live_bytes()
+        self.samples += 1
+        self.last_live_bytes = live
+        self.peak_live_bytes = max(self.peak_live_bytes, live)
+        self.last_allocator = self._allocator_stats()
+        out = {"tag": tag, "live_bytes": live,
+               "peak_live_bytes": self.peak_live_bytes}
+        out.update(self.last_allocator)
+        return out

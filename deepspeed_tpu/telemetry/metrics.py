@@ -1,0 +1,192 @@
+"""Derived-metrics engine: step percentiles, tokens/sec, MFU, goodput.
+
+Definitions (documented in docs/OBSERVABILITY.md):
+
+- **step time p50/p90/p99** — host wall time per optimizer step over a
+  rolling window.
+- **tokens/sec** — tokens consumed by the window's steps / window wall
+  time.
+- **MFU** — ``model_flops_per_step / (step_time * peak_flops_total)``.
+  The numerator is the SAME number the flops profiler reports (XLA's
+  ``cost_analysis()`` of the compiled micro step × accumulation steps), so
+  the two surfaces can never disagree about the model's arithmetic; the
+  denominator comes from the per-platform peak table below
+  (``DSTPU_PEAK_FLOPS`` overrides, e.g. for a downclocked pod).
+- **goodput** — productive fraction of wall time:
+  ``productive / (productive + lost)`` where *lost* is stall overrun
+  (time beyond the watchdog deadline on flagged steps), checkpoint pauses,
+  and any other explicitly-reported non-productive time. A healthy run
+  sits near 1.0; goodput diverging from 1.0 while step p50 stays flat
+  means the loss is BETWEEN steps, not in them.
+- **overlap efficiency** — overlapped / (overlapped + exposed) traced
+  collective bytes from ``dist.record_collective`` (see
+  docs/ZERO_OVERLAP.md: under XLA the honest unit is bytes by schedule
+  class, not per-op wall time).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from typing import Dict, List, Optional
+
+# Peak dense bf16/fp16 FLOPs per chip (marketing peaks; MFU is a ratio
+# against the roofline, so the convention just has to be stated). Keyed by
+# substrings of ``jax.devices()[0].device_kind`` lowercased.
+PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12),
+    ("v5p", 459e12),
+    ("v5e", 197e12),         # also matches "tpu v5 lite"
+    ("v5 lite", 197e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+    ("cpu", 1e12),           # nominal: keeps MFU finite on host-mesh runs
+)
+
+
+def peak_flops_per_device(device_kind: Optional[str] = None) -> float:
+    """Per-device peak from the table; ``DSTPU_PEAK_FLOPS`` (per-device,
+    in FLOPs) overrides for platforms the table mislabels."""
+    env = os.environ.get("DSTPU_PEAK_FLOPS")
+    if env:
+        return float(env)
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # pragma: no cover - no backend
+            return 1e12
+    kind = (device_kind or "").lower()
+    for key, peak in PEAK_FLOPS_BY_KIND:
+        if key in kind:
+            return peak
+    return 1e12
+
+
+def percentile(sorted_vals: List[float], p: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class LatencyHistogram:
+    """Bounded sample reservoir for serving latencies (per-token /
+    per-wave). Keeps the newest ``cap`` samples — serving percentiles are
+    about the current regime, not the whole run."""
+
+    def __init__(self, cap: int = 4096):
+        self._samples: deque = deque(maxlen=cap)
+
+    def record(self, seconds: float) -> None:
+        self._samples.append(float(seconds))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def percentiles(self, ps=(50, 90, 99)) -> Dict[str, float]:
+        vals = sorted(self._samples)
+        return {f"p{p}": percentile(vals, p) for p in ps}
+
+
+class MetricsEngine:
+
+    def __init__(self, window: int = 128):
+        self.window = max(2, int(window))
+        self._durations: deque = deque(maxlen=self.window)
+        self._tokens: deque = deque(maxlen=self.window)
+        self.steps = 0
+        self.total_tokens = 0
+        # goodput accounting (seconds)
+        self.productive_s = 0.0
+        self.stall_lost_s = 0.0
+        self.checkpoint_lost_s = 0.0
+        self.stalled_steps = 0
+        # comm schedule-class byte totals (trace-time records)
+        self.comm_overlapped_bytes = 0
+        self.comm_exposed_bytes = 0
+        # model arithmetic for MFU — set once by the engine from the flops
+        # profiler's cost-analysis machinery
+        self.model_flops_per_step: float = 0.0
+        self.peak_flops_total: float = 0.0
+        # serving
+        self.token_latency = LatencyHistogram()
+        self.wave_latency = LatencyHistogram()
+
+    # -- feeding ---------------------------------------------------------
+    def record_step(self, duration_s: float, tokens: int = 0,
+                    stall_excess_s: float = 0.0) -> None:
+        self.steps += 1
+        self._durations.append(float(duration_s))
+        self._tokens.append(int(tokens))
+        self.total_tokens += int(tokens)
+        self.productive_s += max(0.0, duration_s - stall_excess_s)
+        if stall_excess_s > 0.0:
+            self.stall_lost_s += stall_excess_s
+            self.stalled_steps += 1
+
+    def record_checkpoint_pause(self, seconds: float) -> None:
+        self.checkpoint_lost_s += max(0.0, float(seconds))
+
+    def record_comm(self, nbytes: int, overlapped: Optional[bool],
+                    count: int = 1) -> None:
+        if overlapped is True:
+            self.comm_overlapped_bytes += int(nbytes) * int(count)
+        elif overlapped is False:
+            self.comm_exposed_bytes += int(nbytes) * int(count)
+
+    # -- derived ---------------------------------------------------------
+    def step_percentiles(self, ps=(50, 90, 99)) -> Dict[str, float]:
+        vals = sorted(self._durations)
+        return {f"p{p}": percentile(vals, p) for p in ps}
+
+    def mean_step_s(self) -> float:
+        if not self._durations:
+            return 0.0
+        return sum(self._durations) / len(self._durations)
+
+    def tokens_per_sec(self) -> float:
+        wall = sum(self._durations)
+        return (sum(self._tokens) / wall) if wall > 0 else 0.0
+
+    def mfu(self) -> float:
+        step = self.mean_step_s()
+        if step <= 0 or self.model_flops_per_step <= 0 \
+                or self.peak_flops_total <= 0:
+            return 0.0
+        return self.model_flops_per_step / (step * self.peak_flops_total)
+
+    def goodput(self) -> float:
+        lost = self.stall_lost_s + self.checkpoint_lost_s
+        total = self.productive_s + lost
+        return (self.productive_s / total) if total > 0 else 1.0
+
+    def overlap_efficiency(self) -> Optional[float]:
+        total = self.comm_overlapped_bytes + self.comm_exposed_bytes
+        if total == 0:
+            return None
+        return self.comm_overlapped_bytes / total
+
+    def summary(self) -> Dict[str, float]:
+        out = {
+            "steps": float(self.steps),
+            "step_time_mean_s": self.mean_step_s(),
+            "tokens_per_sec": self.tokens_per_sec(),
+            "goodput": self.goodput(),
+            "stalled_steps": float(self.stalled_steps),
+        }
+        out.update({f"step_time_{k}_s": v
+                    for k, v in self.step_percentiles().items()})
+        if self.model_flops_per_step > 0:
+            out["mfu"] = self.mfu()
+            out["model_flops_per_step"] = self.model_flops_per_step
+        ov = self.overlap_efficiency()
+        if ov is not None:
+            out["comm_overlap_efficiency"] = ov
+        if len(self.token_latency):
+            out.update({f"token_latency_{k}_s": v for k, v in
+                        self.token_latency.percentiles().items()})
+        return out

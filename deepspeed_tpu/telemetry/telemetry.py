@@ -1,0 +1,344 @@
+"""The telemetry facade: one object the engines talk to.
+
+Composes the recorder (trace.py), derived metrics (metrics.py), memory
+tracker (memory.py) and stall watchdog (watchdog.py) behind a small hook
+API, and fans derived metrics out to *sinks* — ``MonitorMaster``
+(TensorBoard/W&B/CSV) is one sink among several; a JSONL sink writes the
+same events for offline tooling (``tools/trace_view.py``).
+
+The zero-overhead-when-off contract lives here: a disabled engine holds
+:data:`NULL_TELEMETRY`, whose every hook is a constant no-op — no
+buffers, no locks, no threads, and (enforced by lint + the Layer-B
+``telemetry-off-parity`` audit) nothing injected into traced step code.
+Telemetry is HOST-side either way; enabling it must never change a jaxpr.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import log_dist, logger
+from . import clock
+from .config import TelemetryConfig, telemetry_enabled
+from .memory import MemoryTracker
+from .metrics import MetricsEngine, peak_flops_per_device
+from .trace import (NULL_SPAN, PHASE_CHECKPOINT, PHASE_SERVING, PHASE_STEP,
+                    TraceRecorder)
+from .watchdog import StallWatchdog
+
+
+class JsonlMetricsSink:
+    """Append derived-metric events to ``metrics.jsonl`` (rank 0)."""
+
+    enabled = True
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def write_events(self, event_list) -> None:
+        import json
+        with self._lock, open(self.path, "a") as f:
+            for tag, value, step in event_list:
+                f.write(json.dumps({"tag": tag, "value": float(value),
+                                    "step": int(step)}) + "\n")
+
+
+class Telemetry:
+
+    enabled = True
+
+    def __init__(self, config: Optional[TelemetryConfig] = None,
+                 sinks: Optional[List[Any]] = None,
+                 rank: int = 0, n_devices: int = 1):
+        self.config = config or TelemetryConfig(enabled=True)
+        self.rank = rank
+        self.flush_every = max(1, self.config.flush_interval or 1)
+        self.output_dir = self.config.trace.output_path or "./dstpu_telemetry"
+        self.trace = TraceRecorder(max_events=self.config.trace.max_events)
+        self.metrics = MetricsEngine(window=self.config.metrics.window)
+        self.metrics.peak_flops_total = peak_flops_per_device() * n_devices
+        self.memory = MemoryTracker() if self.config.memory.enabled else None
+        wd = self.config.watchdog
+        self.watchdog = StallWatchdog(
+            deadline_factor=wd.deadline_factor,
+            min_deadline_s=wd.min_deadline_s, poll_s=wd.poll_s,
+            dump_fns=[self._dump_spans], on_stall=self._on_stall,
+        ) if wd.enabled else None
+        self.sinks: List[Any] = [s for s in (sinks or [])
+                                 if getattr(s, "enabled", True)]
+        self._step_span = None
+        self._flops_fn: Optional[Callable[[], float]] = None
+        self._flops_attempts = 0
+        self._closed = False
+
+    # -- spans -----------------------------------------------------------
+    def phase(self, name: str, phase: Optional[str] = None,
+              step: Optional[int] = None, **args):
+        return self.trace.span(name, phase=phase or name, step=step, **args)
+
+    # -- train-step lifecycle -------------------------------------------
+    def step_begin(self, step: int) -> None:
+        if self._step_span is not None:
+            if self._step_span.step == step:  # split fwd/bwd path re-enters
+                return
+            # a rejected batch / raised step abandoned its span — close it
+            # so it neither leaks in the live stacks nor skews this step
+            self.trace.end(self._step_span)
+        self._step_span = self.trace.span("train_step", phase=PHASE_STEP,
+                                          step=step)
+        if self.watchdog is not None:
+            self.watchdog.step_begin(step)
+
+    def step_end(self, step: int, tokens: int = 0) -> None:
+        span = self._step_span
+        if span is None:
+            return
+        self._step_span = None
+        self.trace.end(span)
+        dur = span.t1 - span.t0
+        excess = (self.watchdog.step_end(step, dur)
+                  if self.watchdog is not None else 0.0)
+        self.metrics.record_step(dur, tokens=tokens, stall_excess_s=excess)
+
+    def checkpoint_span(self, name: str = "checkpoint", **args):
+        """Checkpoint phases pause the watchdog (a long save is a pause,
+        not a stall) and charge goodput's checkpoint account on exit."""
+        tele = self
+
+        class _CkptSpan:
+            def __enter__(self):
+                if tele.watchdog is not None:
+                    tele.watchdog.pause()
+                self._span = tele.trace.span(name, phase=PHASE_CHECKPOINT,
+                                             **args)
+                return self._span
+
+            def __exit__(self, *exc):
+                tele.trace.end(self._span)
+                tele.metrics.record_checkpoint_pause(
+                    self._span.t1 - self._span.t0)
+
+        return _CkptSpan()
+
+    # -- comm records (dist.record_collective feed) ----------------------
+    def record_collective(self, op: str, nbytes: int, axes,
+                          overlapped: Optional[bool] = None,
+                          count: int = 1) -> None:
+        self.trace.comm(op, nbytes, axes, overlapped, count)
+        self.metrics.record_comm(nbytes, overlapped, count)
+
+    # -- serving ---------------------------------------------------------
+    def record_wave(self, kind: str, tokens: int, duration_s: float,
+                    queue_depth: int = 0, running: int = 0,
+                    occupancy: float = 0.0) -> None:
+        self.trace.instant(f"wave:{kind}", phase=PHASE_SERVING,
+                           tokens=tokens, queue_depth=queue_depth,
+                           running=running, occupancy=round(occupancy, 4),
+                           dur_ms=round(duration_s * 1e3, 3))
+        self.metrics.wave_latency.record(duration_s)
+        if tokens > 0:
+            self.metrics.token_latency.record(duration_s / tokens)
+
+    # -- MFU plumbing ----------------------------------------------------
+    def set_flops_fn(self, fn: Callable[[], float]) -> None:
+        """Lazy model-FLOPs source (the engine's cost-analysis helper) —
+        evaluated once, at the first flush, off the hot path."""
+        self._flops_fn = fn
+
+    _FLOPS_MAX_ATTEMPTS = 3
+
+    def _resolve_flops(self) -> None:
+        if (self.metrics.model_flops_per_step > 0 or self._flops_fn is None
+                or self._flops_attempts >= self._FLOPS_MAX_ATTEMPTS):
+            return
+        self._flops_attempts += 1
+        try:
+            self.metrics.model_flops_per_step = float(self._flops_fn())
+        except Exception as e:  # noqa: BLE001 - MFU is best-effort; a
+            # transient failure (compile under memory pressure) retries at
+            # the next flushes before giving up for good
+            last = self._flops_attempts >= self._FLOPS_MAX_ATTEMPTS
+            logger.warning(
+                f"telemetry: model-FLOPs resolution failed ({e}); "
+                + ("MFU unavailable" if last
+                   else f"retrying at the next flush "
+                        f"({self._flops_attempts}/{self._FLOPS_MAX_ATTEMPTS})"))
+
+    # -- flush / export --------------------------------------------------
+    def flush(self, step: int) -> List:
+        """Fence point: re-anchor the clock, sample memory, compute the
+        derived metrics, and write them to every sink. Returns the event
+        list (also recorded as trace counter tracks)."""
+        clock.fence("telemetry-flush")
+        self._resolve_flops()
+        events = [(f"Telemetry/{k}", v, step)
+                  for k, v in self.metrics.summary().items()]
+        if self.memory is not None:
+            sample = self.memory.sample(tag=f"step{step}")
+            events += [(f"Telemetry/memory/{k}", float(v), step)
+                       for k, v in sample.items() if k != "tag"]
+        for tag, value, s in events:
+            self.trace.metric(tag, value, step=s)
+        for sink in self.sinks:
+            try:
+                sink.write_events(events)
+            except Exception as e:  # noqa: BLE001 - a broken sink must not
+                logger.warning(f"telemetry sink {type(sink).__name__} "
+                               f"failed: {e}")          # kill the training loop
+        return events
+
+    def export(self) -> Dict[str, str]:
+        """Write the trace exports; returns {kind: path}."""
+        os.makedirs(self.output_dir, exist_ok=True)
+        chrome = os.path.join(self.output_dir,
+                              f"trace.rank{self.rank}.chrome.json")
+        jsonl = os.path.join(self.output_dir, f"trace.rank{self.rank}.jsonl")
+        self.trace.export_chrome_trace(chrome)
+        self.trace.export_jsonl(jsonl)
+        return {"chrome": chrome, "jsonl": jsonl}
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        try:
+            # final flush so serving-only processes (which never hit the
+            # training engine's per-step flush) still land their derived
+            # metrics — latency percentiles included — in the exports
+            if self.metrics.steps or len(self.metrics.wave_latency):
+                self.flush(self.metrics.steps)
+            paths = self.export()
+            log_dist(f"telemetry: trace exported to {paths['chrome']}",
+                     ranks=[0])
+        except Exception as e:  # noqa: BLE001 - exit paths must not raise
+            logger.warning(f"telemetry export failed: {e}")
+
+    # -- watchdog plumbing ----------------------------------------------
+    def _dump_spans(self) -> str:
+        lines = []
+        for tid, stack in self.trace.active_stacks().items():
+            chain = " > ".join(f"{name}({open_s:.1f}s)"
+                               for name, open_s in stack)
+            lines.append(f"  thread {tid}: {chain}")
+        return ("live span stacks:\n" + "\n".join(lines)) if lines \
+            else "live span stacks: <none>"
+
+    def _on_stall(self, step: int, elapsed: float) -> None:
+        self.trace.instant("stall", phase=PHASE_STEP, step=step,
+                           elapsed_s=round(elapsed, 3))
+
+
+class NullTelemetry:
+    """The disabled path: every hook is a constant no-op. No state, no
+    threads, no syncs — and nothing for traced code to capture."""
+
+    enabled = False
+    watchdog = None
+    memory = None
+
+    def phase(self, name, phase=None, step=None, **args):
+        return NULL_SPAN
+
+    def checkpoint_span(self, name="checkpoint", **args):
+        return NULL_SPAN
+
+    def step_begin(self, step):
+        pass
+
+    def step_end(self, step, tokens=0):
+        pass
+
+    def record_collective(self, op, nbytes, axes, overlapped=None, count=1):
+        pass
+
+    def record_wave(self, *a, **k):
+        pass
+
+    def set_flops_fn(self, fn):
+        pass
+
+    def flush(self, step):
+        return []
+
+    def export(self):
+        return {}
+
+    def close(self):
+        pass
+
+
+NULL_TELEMETRY = NullTelemetry()
+
+_GLOBAL: Optional[Telemetry] = None
+
+
+def get_telemetry():
+    """The process-global telemetry (NULL when none configured) — how
+    code without an engine handle (comm frontend, inference scheduler)
+    reaches the active recorder."""
+    return _GLOBAL if _GLOBAL is not None else NULL_TELEMETRY
+
+
+def set_telemetry(tele: Optional[Telemetry]) -> None:
+    global _GLOBAL
+    if _GLOBAL is not None and tele is not _GLOBAL:
+        _GLOBAL.close()
+    _GLOBAL = tele
+
+
+def reset_telemetry() -> None:
+    """Drop the global WITHOUT the close-time export — the test harness's
+    between-test cleanup (a closing export would litter the cwd)."""
+    global _GLOBAL
+    if _GLOBAL is not None:
+        if _GLOBAL.watchdog is not None:
+            _GLOBAL.watchdog.stop()
+        _GLOBAL._closed = True
+        _GLOBAL = None
+
+
+def build_telemetry(config: Optional[TelemetryConfig],
+                    sinks: Optional[List[Any]] = None,
+                    make_global: bool = True):
+    """Engine front door: NULL when disabled (config + DSTPU_TELEMETRY
+    env), else a live Telemetry registered as the process global."""
+    if not telemetry_enabled(config):
+        return NULL_TELEMETRY
+    try:
+        import jax
+        rank, n_dev = jax.process_index(), jax.device_count()
+    except Exception:  # pragma: no cover - no backend
+        rank, n_dev = 0, 1
+    tele = Telemetry(config=config, sinks=sinks if rank == 0 else [],
+                     rank=rank, n_devices=n_dev)
+    if make_global:
+        set_telemetry(tele)
+        _register_atexit_once()
+    return tele
+
+
+_ATEXIT_REGISTERED = False
+
+
+def _register_atexit_once() -> None:
+    """One process-wide hook closing whatever the CURRENT global is at
+    exit — per-instance registration would pin every Telemetry (and its
+    event deque) ever built for the process lifetime."""
+    global _ATEXIT_REGISTERED
+    if _ATEXIT_REGISTERED:
+        return
+    _ATEXIT_REGISTERED = True
+    import atexit
+    atexit.register(lambda: _GLOBAL is not None and _GLOBAL.close())
+
+
+def maybe_enable_from_env() -> None:
+    """Serving entry points call this: DSTPU_TELEMETRY=1 with no engine
+    in the process still gets a default recorder."""
+    if _GLOBAL is None and telemetry_enabled(None):
+        build_telemetry(TelemetryConfig(enabled=True))

@@ -1,0 +1,135 @@
+"""Stall watchdog: flag a step that blows its rolling deadline BEFORE the
+hang becomes a silent loss of a pod-slice.
+
+A background daemon thread polls the currently-open step. The deadline is
+``deadline_factor x`` the rolling median step time, floored at
+``min_deadline_s`` — and the dog stays silent until at least one step has
+COMPLETED, because the very first step carries the whole XLA compile
+(routinely minutes at scale) and no deadline is meaningful without a
+baseline. On first overrun of a step it dumps, once:
+
+- the live span stacks from the trace recorder (which phase is stuck —
+  data loader? checkpoint commit? the dispatch itself?),
+- the comms-log tail (the last collectives recorded — a wedged collective
+  on a lost host shows up here),
+
+and records the stall so goodput accounting charges the overrun. The
+watchdog never touches the device: it reads host timestamps and host
+bookkeeping only, so a truly wedged XLA runtime cannot wedge the dog too.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, List, Optional
+
+from . import clock
+from ..utils.logging import logger
+
+
+class StallWatchdog:
+
+    def __init__(self,
+                 deadline_factor: float = 3.0,
+                 min_deadline_s: float = 60.0,
+                 poll_s: float = 1.0,
+                 dump_fns: Optional[List[Callable[[], str]]] = None,
+                 on_stall: Optional[Callable[[int, float], None]] = None):
+        self.deadline_factor = float(deadline_factor)
+        self.min_deadline_s = float(min_deadline_s)
+        self.poll_s = max(0.01, float(poll_s))
+        self.dump_fns = list(dump_fns or [])
+        self.on_stall = on_stall
+        self._durations: deque = deque(maxlen=64)
+        self._lock = threading.Lock()
+        self._cur_step: Optional[int] = None
+        self._cur_start = 0.0
+        self._fired_step: Optional[int] = None
+        self.stall_count = 0
+        self.last_stall_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- engine hooks ----------------------------------------------------
+    def step_begin(self, step: int) -> None:
+        with self._lock:
+            self._cur_step = step
+            self._cur_start = clock.now()
+        self._ensure_thread()
+
+    def step_end(self, step: int, duration_s: float) -> float:
+        """Close the step; returns the stall overrun in seconds (0 when
+        the step met its deadline) for goodput accounting."""
+        with self._lock:
+            self._cur_step = None
+            deadline = self._deadline_locked()
+            self._durations.append(float(duration_s))
+        if self._fired_step == step:
+            return max(0.0, duration_s - deadline)
+        return 0.0
+
+    def pause(self) -> None:
+        """Suspend deadline checks (checkpoint pauses are accounted as
+        checkpoint time, not stalls)."""
+        with self._lock:
+            self._cur_step = None
+
+    # -- internals -------------------------------------------------------
+    def _deadline_locked(self) -> float:
+        if not self._durations:
+            return self.min_deadline_s
+        vals = sorted(self._durations)
+        median = vals[len(vals) // 2]
+        return max(self.min_deadline_s, self.deadline_factor * median)
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="dstpu-telemetry-watchdog", daemon=True)
+            self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                step = self._cur_step
+                if step is None or self._fired_step == step:
+                    continue
+                if not self._durations:
+                    # no completed step yet: the first step carries the
+                    # whole XLA compile, routinely minutes at scale — a
+                    # deadline is only meaningful once a baseline exists
+                    continue
+                elapsed = clock.now() - self._cur_start
+                deadline = self._deadline_locked()
+                if elapsed <= deadline:
+                    continue
+                self._fired_step = step
+                self.stall_count += 1
+                self.last_stall_step = step
+            self._fire(step, elapsed, deadline)
+
+    def _fire(self, step: int, elapsed: float, deadline: float) -> None:
+        lines = [f"STALL: step {step} running {elapsed:.1f}s "
+                 f"(deadline {deadline:.1f}s = max({self.min_deadline_s}, "
+                 f"{self.deadline_factor} x rolling median))"]
+        for fn in self.dump_fns:
+            try:
+                dump = fn()
+            except Exception as e:  # noqa: BLE001 - dump must never raise
+                dump = f"<dump failed: {type(e).__name__}: {e}>"
+            if dump:
+                lines.append(dump)
+        logger.error("\n".join(lines))
+        if self.on_stall is not None:
+            try:
+                self.on_stall(step, elapsed)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5 * self.poll_s)
+            self._thread = None

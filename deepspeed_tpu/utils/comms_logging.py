@@ -11,7 +11,7 @@ measured step time.
 
 from __future__ import annotations
 
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Dict, Tuple
 
 from .logging import logger
@@ -51,6 +51,10 @@ class CommsLogger:
         # (generic comm frontend calls).
         self.comms_dict: Dict[str, Dict[Tuple[int, str, object], int]] = \
             defaultdict(lambda: defaultdict(int))
+        # newest records in arrival order — the stall watchdog's comms
+        # tail (telemetry/watchdog.py): when a step hangs, the ops closest
+        # to the hang are the diagnostic
+        self.recent: deque = deque(maxlen=32)
 
     def append(self, op_name: str, size: int, axis, overlapped=None,
                count: int = 1) -> None:
@@ -62,6 +66,7 @@ class CommsLogger:
         # count: executions per trace of this site (scan bodies trace once
         # but launch per iteration) — the byte totals must reflect launches
         self.comms_dict[op_name][key] += count
+        self.recent.append((op_name, size, str(axis), overlapped, count))
         if self.verbose:
             logger.info(f"comm op: {op_name} | axes: {axis} | msg size: "
                         f"{convert_size(size)} | sched: "
@@ -74,6 +79,21 @@ class CommsLogger:
             for (size, _axes, overlapped), count in entries.items():
                 totals[overlapped] += size * count
         return totals
+
+    def sched_totals(self) -> Tuple[int, int]:
+        """(overlapped_bytes, exposed_bytes) — the split telemetry's
+        overlap-efficiency metric is derived from."""
+        totals = self._sched_totals()
+        return totals.get(True, 0), totals.get(False, 0)
+
+    def tail(self, n: int = 12) -> str:
+        """The newest <= n records, formatted for the watchdog dump."""
+        if not self.recent:
+            return "comms log tail: <empty>"
+        lines = [f"  {op:<18}{axes:<20}{convert_size(size):<12}"
+                 f"{_SCHED_NAMES[ov]:<12}x{count}"
+                 for op, size, axes, ov, count in list(self.recent)[-n:]]
+        return "comms log tail (newest last):\n" + "\n".join(lines)
 
     def log_all(self, show_straggler: bool = False) -> None:
         if not self.comms_dict:

@@ -2,16 +2,27 @@
 
 TPU-native counterpart of the reference's ``deepspeed/utils/timer.py``
 (``SynchronizedWallClockTimer`` timer.py:43, ``ThroughputTimer`` timer.py:198).
-CUDA events do not exist here; synchronization is
-``jax.block_until_ready`` / ``jax.effects_barrier`` on demand. Timers default
-to *not* synchronizing (XLA dispatch is async) and only block when a reading
-is taken, mirroring the reference's lazy event elapsed computation.
+CUDA events do not exist here, and the original port's answer — a
+``jax.effects_barrier()`` on every start/stop — was a device sync per phase
+per step, serializing the async dispatch pipeline the overlap schedules
+exist to fill.
+
+All timestamps now route through the telemetry clock
+(``telemetry/clock.py``): ``start``/``stop`` are pure ``perf_counter``
+reads, and device synchronization happens only at *reading* fence points —
+``elapsed()``/``log()`` for the named timers, report boundaries for the
+throughput timer — via ``clock.fence()``, the one sanctioned sync (the
+``telemetry-hot-path-sync`` lint rule enforces this file stays clean).
+Because XLA's dispatch queue backpressures, per-step host timestamps track
+steady-state wall time; the fence at each reading re-anchors any drift
+before a number is reported.
 """
 
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
+
+from ..telemetry import clock
 
 FORWARD_MICRO_TIMER = "fwd_microstep"
 FORWARD_GLOBAL_TIMER = "fwd"
@@ -23,18 +34,12 @@ STEP_GLOBAL_TIMER = "step"
 TRAIN_BATCH_TIMER = "train_batch"
 
 
-def _sync():
-    try:
-        import jax
-        jax.effects_barrier()
-    except Exception:  # pragma: no cover
-        pass
-
-
 class _Timer:
 
     def __init__(self, name: str, synchronize: bool = True):
         self.name = name
+        # synchronize now means "fence before a reading is taken", not
+        # "sync every start/stop" — the hot path never blocks
         self.synchronize = synchronize
         self.started_ = False
         self.start_time = 0.0
@@ -44,17 +49,13 @@ class _Timer:
     def start(self):
         if self.started_:
             return
-        if self.synchronize:
-            _sync()
-        self.start_time = time.perf_counter()
+        self.start_time = clock.now()
         self.started_ = True
 
     def stop(self, record: bool = True):
         if not self.started_:
             return
-        if self.synchronize:
-            _sync()
-        delta = time.perf_counter() - self.start_time
+        delta = clock.now() - self.start_time
         self.elapsed_ += delta
         if record:
             self.records.append(delta)
@@ -67,6 +68,10 @@ class _Timer:
     def elapsed(self, reset: bool = True) -> float:
         was_started = self.started_
         if was_started:
+            if self.synchronize:
+                # reading fence point: drain the dispatch queue so the
+                # figure covers completed device work, off the hot path
+                clock.fence(f"timer:{self.name}")
             self.stop(record=False)
         value = self.elapsed_
         if reset:
@@ -98,10 +103,18 @@ class SynchronizedWallClockTimer:
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True, memory_breakdown=None, ranks=None):
         from .logging import log_dist
         assert normalizer > 0.0
+        # one fence for the whole reading, not one per timer
+        clock.fence("timer:log")
         parts = []
         for name in names:
             if name in self.timers:
-                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                timer = self.timers[name]
+                prev = timer.synchronize
+                timer.synchronize = False  # fenced above
+                try:
+                    elapsed = timer.elapsed(reset=reset) * 1000.0 / normalizer
+                finally:
+                    timer.synchronize = prev
                 parts.append(f"{name}: {elapsed:.2f}")
         if parts:
             log_dist("time (ms) | " + " | ".join(parts), ranks=ranks)
@@ -147,7 +160,13 @@ class NoopTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec + TFLOPS estimation (reference timer.py:198)."""
+    """Samples/sec + TFLOPS estimation (reference timer.py:198).
+
+    Per-step ``start``/``stop`` never sync; the clock fences once when
+    measurement begins (anchoring the window after warmup dispatches
+    drain) and once per report boundary, so each reported window's
+    cumulative time covers completed device work.
+    """
 
     def __init__(self, batch_size: int, start_step: int = 2, steps_per_output: Optional[int] = None, monitor_memory: bool = False, logging_fn=None):
         self.batch_size = max(1, batch_size)
@@ -172,8 +191,12 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.num_steps >= self.start_step:
-            _sync()
-            self.start_time = time.perf_counter()
+            if self.num_steps == self.start_step:
+                # measurement-window anchor: drain warmup/compile work so
+                # it is excluded from the throughput figure (fence point,
+                # runs once)
+                clock.fence("throughput:anchor")
+            self.start_time = clock.now()
 
     def stop(self, global_step: bool = False, report_speed: bool = True):
         if not self.started:
@@ -181,12 +204,16 @@ class ThroughputTimer:
         self.started = False
         self.num_steps += 1
         if self.num_steps > self.start_step:
-            _sync()
-            duration = time.perf_counter() - self.start_time
+            reporting = bool(global_step and self.steps_per_output
+                             and self.num_steps % self.steps_per_output == 0)
+            if reporting:
+                # report-boundary fence: the window's figure covers
+                # completed device work (fence point, once per window)
+                clock.fence("throughput:report")
+            duration = clock.now() - self.start_time
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
-            if global_step and self.steps_per_output and report_speed and \
-                    self.num_steps % self.steps_per_output == 0:
+            if reporting and report_speed:
                 if self.logging:
                     self.logging(
                         f"epoch step {self.num_steps}: "

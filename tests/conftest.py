@@ -33,6 +33,10 @@ def _reset_topology():
     topo_mod.reset()
     yield
     topo_mod.reset()
+    # a test that enabled telemetry must not leak its recorder (or its
+    # watchdog thread / close-time export) into the next test
+    from deepspeed_tpu.telemetry import reset_telemetry
+    reset_telemetry()
 
 
 @pytest.fixture

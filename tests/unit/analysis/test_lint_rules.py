@@ -374,6 +374,118 @@ def test_syntax_error_is_a_finding():
 
 
 # ---------------------------------------------------------------------------
+# telemetry-hot-path-sync
+# ---------------------------------------------------------------------------
+
+def test_sync_in_traced_scope_fires():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(state):
+        jax.block_until_ready(state)
+        jax.effects_barrier()
+        return state
+    """
+    assert rule_ids(src).count("telemetry-hot-path-sync") == 2
+
+
+def test_host_callback_in_traced_scope_fires():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(state):
+        jax.pure_callback(record, shape, state)
+        return state
+    """
+    assert "telemetry-hot-path-sync" in rule_ids(src)
+
+
+def test_debug_callback_in_traced_scope_fires():
+    # last segment is just 'callback' — matched on the dotted suffix
+    src = """
+    import jax
+
+    @jax.jit
+    def step(state):
+        jax.debug.callback(host_log, state)
+        return state
+    """
+    assert "telemetry-hot-path-sync" in rule_ids(src)
+
+
+def test_unrelated_callback_name_quiet():
+    src = """
+    import jax
+
+    @jax.jit
+    def step(state):
+        state = my.custom.callback(state)  # not a jax host callback
+        return state
+    """
+    assert "telemetry-hot-path-sync" not in rule_ids(src)
+
+
+def test_sync_in_telemetry_module_fires():
+    src = textwrap.dedent("""
+    import jax
+
+    def span_end(self, span):
+        jax.effects_barrier()
+        return now()
+    """)
+    findings = lint_source("deepspeed_tpu/telemetry/trace.py", src)
+    assert [f.rule_id for f in findings] == ["telemetry-hot-path-sync"]
+
+
+def test_sync_inside_fence_function_allowed():
+    src = textwrap.dedent("""
+    import jax
+
+    def fence(reason):
+        jax.effects_barrier()
+        return now()
+    """)
+    findings = lint_source("deepspeed_tpu/telemetry/clock.py", src)
+    assert findings == []
+
+
+def test_device_get_in_timer_module_fires():
+    src = textwrap.dedent("""
+    import jax
+
+    def stop(self):
+        jax.device_get(self.marker)
+    """)
+    findings = lint_source("deepspeed_tpu/utils/timer.py", src)
+    assert [f.rule_id for f in findings] == ["telemetry-hot-path-sync"]
+
+
+def test_sync_outside_trace_and_hot_modules_quiet():
+    src = """
+    import jax
+
+    def bench(engine, batch):
+        jax.block_until_ready(engine.train_batch(batch))
+    """
+    assert "telemetry-hot-path-sync" not in rule_ids(src)
+
+
+def test_shipped_telemetry_package_is_clean():
+    import glob
+    import os
+
+    from deepspeed_tpu.analysis.cli import run_ast_layer
+    pkg = os.path.join(os.path.dirname(__file__), os.pardir, os.pardir,
+                       os.pardir, "deepspeed_tpu")
+    paths = glob.glob(os.path.join(pkg, "telemetry", "*.py")) + \
+        [os.path.join(pkg, "utils", "timer.py")]
+    findings = run_ast_layer(sorted(paths))
+    assert findings == [], [f"{f.location}: {f.rule_id}" for f in findings]
+
+
+# ---------------------------------------------------------------------------
 # baseline diffing
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,134 @@
+"""Write-behind checkpointing (`checkpoint: {async_save: true}`): the
+AsyncCheckpointEngine is wired into engine.save_checkpoint; `latest` must
+repoint only after every data file of the tag is durable (commit fence),
+and load_checkpoint commits in-flight saves before reading `latest`."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint.checkpoint_engine import (AsyncCheckpointEngine,
+                                                        NpzCheckpointEngine)
+from deepspeed_tpu.models import gpt2_model
+
+TINY = dict(max_seq_len=32, vocab_size=256, remat=False)
+
+
+def _engine(async_save=True):
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    }
+    if async_save:
+        config["checkpoint"] = {"async_save": True}
+    model = gpt2_model("gpt2-tiny", **TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def _batch():
+    return {"input_ids": np.zeros((8, 16), dtype=np.int32)}
+
+
+@pytest.fixture(scope="module")
+def async_engine():
+    return _engine()
+
+
+def test_engine_selects_async_engine(async_engine):
+    assert isinstance(async_engine.checkpoint_engine, AsyncCheckpointEngine)
+    assert async_engine._ckpt_async
+
+
+def test_inflight_save_completes_before_load_sees_tag(async_engine, tmp_path,
+                                                      monkeypatch):
+    """The regression the satellite demands: hold the background write on
+    a gate — `latest` must be invisible while in flight, and a load must
+    block on the commit fence, then see the finished tag."""
+    engine = async_engine
+    engine.train_batch(_batch())
+    gate = threading.Event()
+    from deepspeed_tpu.checkpoint import store
+    real = store.write_staged
+
+    def gated(*a, **k):
+        gate.wait(timeout=30)
+        return real(*a, **k)
+
+    monkeypatch.setattr(store, "write_staged", gated)
+    steps = engine.global_steps
+    # save_checkpoint stages synchronously then returns with IO pending
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    latest = tmp_path / "latest"
+    assert not latest.exists(), "latest repointed before data was durable"
+    assert not (tmp_path / "t1" / "meta.json").exists()
+    gate.set()
+    # load commits the in-flight save first, then must find the tag
+    tag, client = engine.load_checkpoint(str(tmp_path))
+    assert tag == "t1"
+    assert latest.read_text() == "t1"
+    assert client["global_steps"] == steps
+
+
+def test_async_round_trip_preserves_state(async_engine, tmp_path):
+    engine = async_engine
+    engine.train_batch(_batch())
+    before = engine.module_state_dict()
+    steps = engine.global_steps
+    engine.save_checkpoint(str(tmp_path))
+    # mutate, then restore
+    engine.train_batch(_batch())
+    tag, _ = engine.load_checkpoint(str(tmp_path))
+    assert tag == f"global_step{steps}"
+    assert engine.global_steps == steps
+    after = engine.module_state_dict()
+    import jax
+    for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_consecutive_saves_commit_in_order(async_engine, tmp_path):
+    engine = async_engine
+    engine.save_checkpoint(str(tmp_path), tag="a")
+    engine.save_checkpoint(str(tmp_path), tag="b")  # waits out 'a' first
+    engine.checkpoint_engine.commit("b")
+    assert (tmp_path / "a" / "meta.json").exists()
+    assert (tmp_path / "b" / "meta.json").exists()
+    assert (tmp_path / "latest").read_text() == "b"
+
+
+def test_submit_runs_inline_on_sync_engine(tmp_path):
+    ran = []
+    NpzCheckpointEngine().submit("t", lambda: ran.append(1))
+    assert ran == [1]
+
+
+def test_async_submit_failure_surfaces_in_commit():
+    eng = AsyncCheckpointEngine()
+
+    def boom():
+        raise OSError("disk full")
+
+    eng.submit("t", boom)
+    assert eng.commit("t") is False
+    eng.close()
+
+
+def test_checkpoint_write_records_telemetry_span(tmp_path):
+    from deepspeed_tpu.telemetry import (TelemetryConfig, build_telemetry,
+                                         reset_telemetry)
+    tele = build_telemetry(TelemetryConfig(
+        enabled=True, watchdog={"enabled": False},
+        trace={"output_path": str(tmp_path)}))
+    try:
+        eng = AsyncCheckpointEngine()
+        eng.submit("t9", lambda: None)
+        eng.commit("t9")
+        spans = [e for e in tele.trace.events() if e["kind"] == "span"]
+        assert any(e["name"] == "checkpoint_write:t9"
+                   and e["phase"] == "checkpoint" for e in spans)
+    finally:
+        reset_telemetry()

@@ -1,0 +1,165 @@
+"""Monitor sinks (csv round-trip, one-open-per-flush, MonitorMaster
+fan-out + rank-0 guard) and the comms logger's overlapped/exposed split
+feeding telemetry trace records."""
+
+import builtins
+import csv
+
+import pytest
+
+from deepspeed_tpu.monitor.monitor import Monitor, MonitorMaster, csvMonitor
+from deepspeed_tpu.runtime.config import CSVConfig, MonitorConfig
+from deepspeed_tpu.utils.comms_logging import CommsLogger
+
+
+def _csv_cfg(tmp_path, enabled=True):
+    return CSVConfig(enabled=enabled, output_path=str(tmp_path),
+                     job_name="job")
+
+
+# ---------------------------------------------------------------------------
+# csvMonitor
+# ---------------------------------------------------------------------------
+
+def test_csv_round_trip(tmp_path):
+    mon = csvMonitor(_csv_cfg(tmp_path))
+    mon.write_events([("Train/loss", 2.5, 1), ("Train/lr", 0.1, 1)])
+    mon.write_events([("Train/loss", 2.0, 2)])
+    with open(tmp_path / "job" / "Train_loss.csv") as f:
+        rows = list(csv.reader(f))
+    assert rows == [["step", "Train/loss"], ["1", "2.5"], ["2", "2.0"]]
+    with open(tmp_path / "job" / "Train_lr.csv") as f:
+        assert list(csv.reader(f)) == [["step", "Train/lr"], ["1", "0.1"]]
+
+
+def test_csv_opens_each_file_once_per_flush(tmp_path, monkeypatch):
+    mon = csvMonitor(_csv_cfg(tmp_path))
+    opens = []
+    real_open = builtins.open
+
+    def counting_open(path, *a, **k):
+        opens.append(str(path))
+        return real_open(path, *a, **k)
+
+    monkeypatch.setattr(builtins, "open", counting_open)
+    # 6 events over 2 tags: exactly 2 opens (was 6 — one per event)
+    mon.write_events([("a", float(i), i) for i in range(3)]
+                     + [("b", float(i), i) for i in range(3)])
+    assert len(opens) == 2
+
+
+def test_csv_disabled_writes_nothing(tmp_path):
+    mon = csvMonitor(_csv_cfg(tmp_path / "off", enabled=False))
+    assert not (tmp_path / "off").exists()
+
+
+# ---------------------------------------------------------------------------
+# MonitorMaster
+# ---------------------------------------------------------------------------
+
+def _master_cfg(tmp_path, enabled=True):
+    return MonitorConfig(csv_monitor=_csv_cfg(tmp_path, enabled=enabled))
+
+
+def test_master_fans_out_to_enabled_sinks(tmp_path):
+    master = MonitorMaster(_master_cfg(tmp_path))
+    assert master.enabled
+
+    class Spy(Monitor):
+        def __init__(self):
+            super().__init__(None)
+            self.enabled = True
+            self.seen = []
+
+        def write_events(self, events):
+            self.seen.extend(events)
+
+    spy = Spy()
+    master.monitors.append(spy)
+    master.write_events([("t", 1.0, 0)])
+    assert spy.seen == [("t", 1.0, 0)]
+    assert (tmp_path / "job" / "t.csv").exists()
+
+
+def test_master_skips_disabled_sinks(tmp_path):
+    master = MonitorMaster(_master_cfg(tmp_path))
+
+    class Dead(Monitor):
+        def __init__(self):
+            super().__init__(None)
+            self.enabled = False
+
+        def write_events(self, events):
+            raise AssertionError("disabled sink must not be called")
+
+    master.monitors.append(Dead())
+    master.write_events([("t", 1.0, 0)])
+
+
+def test_master_rank0_guard(tmp_path, monkeypatch):
+    import jax
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    master = MonitorMaster(_master_cfg(tmp_path))
+    # non-zero ranks attach no sinks at all (the reference's rank-0 guard)
+    assert master.monitors == [] and not master.enabled
+
+
+# ---------------------------------------------------------------------------
+# comms logger split -> telemetry
+# ---------------------------------------------------------------------------
+
+def test_log_summary_overlapped_exposed_split(monkeypatch):
+    logger = CommsLogger()
+    logger.append("all_gather", 1000, ("data",), overlapped=True, count=3)
+    logger.append("reduce_scatter", 500, ("data",), overlapped=False)
+    ov, ex = logger.sched_totals()
+    assert (ov, ex) == (3000, 500)
+    lines = []
+    from deepspeed_tpu.utils import comms_logging as cl
+    monkeypatch.setattr(cl.logger, "info", lambda msg: lines.append(msg))
+    logger.log_all()
+    text = "\n".join(lines)
+    assert "overlapped" in text and "exposed" in text
+    assert "0.86" in text  # 3000/3500
+
+
+def test_comms_tail_formats_newest_records():
+    logger = CommsLogger()
+    for i in range(40):
+        logger.append("all_gather", 100 + i, ("data",), overlapped=True)
+    tail = logger.tail(5)
+    assert "all_gather" in tail and "overlapped" in tail
+    assert tail.count("\n") == 5  # header + 5 rows
+    assert "139" in tail  # newest record present
+
+
+def test_record_collective_feeds_telemetry_trace():
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.telemetry import (TelemetryConfig, build_telemetry,
+                                         reset_telemetry)
+    tele = build_telemetry(TelemetryConfig(
+        enabled=True, watchdog={"enabled": False}))
+    try:
+        dist.record_collective("all_gather", 2048, ("data",),
+                               overlapped=True, count=2)
+        dist.record_collective("reduce_scatter", 1024, ("data",),
+                               overlapped=False)
+        (g, s) = [e for e in tele.trace.events() if e["kind"] == "comm"]
+        assert g["phase"] == "gather" and g["bytes"] == 2048
+        assert s["phase"] == "scatter" and s["overlapped"] is False
+        assert tele.metrics.overlap_efficiency() == pytest.approx(4096 / 5120)
+    finally:
+        reset_telemetry()
+
+
+def test_comms_log_tail_helper_via_configured_logger():
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.comm import comm as comm_mod
+    logger = CommsLogger()
+    old = comm_mod._COMMS_LOGGER
+    try:
+        dist.configure(comms_logger=logger)
+        dist.record_collective("all_reduce", 64, ("data",), overlapped=False)
+        assert "all_reduce" in dist.comms_log_tail()
+    finally:
+        comm_mod._COMMS_LOGGER = old

@@ -1,0 +1,177 @@
+"""Engine integration (ISSUE 4 acceptance): a CPU-mesh train run with
+telemetry enabled produces a valid Chrome trace with per-phase spans, an
+overlap-efficiency metric derived from ``dist.record_collective``, an MFU
+consistent with the flops profiler's cost-analysis number, and the
+disabled path injects nothing (NULL object, no global, jaxpr parity is
+gated by the ``telemetry-off-parity`` lint entry point)."""
+
+import json
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import gpt2_model
+from deepspeed_tpu.telemetry import (NULL_TELEMETRY, get_telemetry,
+                                     reset_telemetry)
+
+TINY = dict(max_seq_len=32, vocab_size=256, remat=False)
+
+
+def _engine(tmp_path, extra=None, telemetry=True, **tele_kw):
+    config = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 100,
+    }
+    if telemetry:
+        config["telemetry"] = {
+            "enabled": True,
+            "watchdog": {"enabled": False},
+            "trace": {"output_path": str(tmp_path)},
+            **tele_kw,
+        }
+    config.update(extra or {})
+    model = gpt2_model("gpt2-tiny", **TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
+    return engine
+
+
+def _batch(batch=8, seq=16):
+    return {"input_ids": np.zeros((batch, seq), dtype=np.int32)}
+
+
+@pytest.fixture(scope="module")
+def traced_run(tmp_path_factory):
+    """One 3-step run with telemetry on, shared by the assertions below
+    (engine construction is the expensive part on the CPU mesh)."""
+    reset_telemetry()
+    tmp = tmp_path_factory.mktemp("tele")
+    engine = _engine(tmp)
+    for _ in range(3):
+        loss = engine.train_batch(_batch())
+    events = engine.telemetry.flush(engine.global_steps)
+    paths = engine.telemetry.export()
+    yield engine, float(loss), events, paths
+    reset_telemetry()
+
+
+def test_train_run_produces_phase_spans(traced_run):
+    engine, loss, _, paths = traced_run
+    assert np.isfinite(loss)
+    doc = json.load(open(paths["chrome"]))
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    cats = {e["cat"] for e in spans}
+    # per-phase spans: the host batch pipeline and the step dispatch at
+    # minimum (gather/scatter attribution rides on comm records)
+    assert {"data", "step"} <= cats
+    steps = {e["args"].get("step") for e in spans if e["name"] == "train_step"}
+    assert len(steps) >= 3
+
+
+def test_step_metrics_and_tokens(traced_run):
+    engine, _, events, _ = traced_run
+    m = engine.telemetry.metrics
+    assert m.steps == 3
+    # 8 x 16 tokens per step, counted host-side
+    assert m.total_tokens == 3 * 8 * 16
+    tags = {t for t, _, _ in events}
+    assert "Telemetry/step_time_p50_s" in tags
+    assert "Telemetry/tokens_per_sec" in tags
+    assert "Telemetry/goodput" in tags
+    assert "Telemetry/memory/live_bytes" in tags
+
+
+def test_mfu_consistent_with_flops_profiler(traced_run):
+    engine, _, events, _ = traced_run
+    by_tag = {t: v for t, v, _ in events}
+    assert by_tag.get("Telemetry/mfu", 0) > 0
+    # the acceptance bound: telemetry's FLOPs numerator within 1% of the
+    # flops profiler's machinery (same XLA cost analysis); here the step
+    # is one fused program, costed identically by both
+    tele_flops = engine.telemetry.metrics.model_flops_per_step
+    assert tele_flops == pytest.approx(engine._telemetry_flops(), rel=0.01)
+    # and the ratio definition holds exactly
+    m = engine.telemetry.metrics
+    assert by_tag["Telemetry/mfu"] == pytest.approx(
+        tele_flops / (m.mean_step_s() * m.peak_flops_total), rel=1e-6)
+
+
+def test_split_path_mfu_matches_profiler_micro_costing(tmp_path):
+    """gas=2 takes the split forward/backward path — telemetry's flops
+    must equal the profiler's micro-step costing x accumulation steps."""
+    engine = _engine(tmp_path, extra={"gradient_accumulation_steps": 2})
+    it = iter([_batch(), _batch(), _batch(), _batch()])
+    engine.train_batch(it)
+    flops = engine._telemetry_flops()
+    prof = engine._micro_step_flops(engine._last_prepared_batch)
+    assert prof > 0
+    assert flops == pytest.approx(prof * 2, rel=0.01)
+
+
+def test_overlap_efficiency_from_record_collective(tmp_path):
+    """A stage-3 ZeRO++ engine's pipelined schedule records overlapped
+    (in-scan) and exposed (edge-of-step) collectives at trace time; the
+    telemetry overlap metric derives from exactly those records."""
+    engine = _engine(tmp_path, extra={"zero_optimization": {
+        "stage": 3, "stage3_param_persistence_threshold": 0,
+        "zero_quantized_weights": True}})
+    assert engine._zeropp
+    engine.train_batch(_batch())
+    assert engine._overlap_active, engine._overlap_fallback
+    m = engine.telemetry.metrics
+    eff = m.overlap_efficiency()
+    assert eff is not None and 0.0 < eff < 1.0
+    assert m.comm_overlapped_bytes > 0 and m.comm_exposed_bytes > 0
+    comm_events = [e for e in engine.telemetry.trace.events()
+                   if e["kind"] == "comm"]
+    assert {e["overlapped"] for e in comm_events} >= {True, False}
+    assert "comm_overlap_efficiency" in m.summary()
+
+
+def test_disabled_engine_holds_null_object():
+    reset_telemetry()
+    model = gpt2_model("gpt2-tiny", **TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    })
+    assert engine.telemetry is NULL_TELEMETRY
+    assert get_telemetry() is NULL_TELEMETRY  # no global registered
+    engine.train_batch(_batch())  # hooks are no-ops end to end
+    assert engine.telemetry.flush(1) == []
+
+
+def test_env_gate_forces_off(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSTPU_TELEMETRY", "0")
+    engine = _engine(tmp_path)  # config says enabled — env wins
+    assert engine.telemetry is NULL_TELEMETRY
+
+
+def test_env_gate_forces_on(monkeypatch, tmp_path):
+    monkeypatch.setenv("DSTPU_TELEMETRY", "1")
+    monkeypatch.chdir(tmp_path)  # default output dir lands here
+    model = gpt2_model("gpt2-tiny", **TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+    })
+    assert engine.telemetry.enabled
+    reset_telemetry()
+
+
+def test_monitor_is_one_sink_among_several(tmp_path):
+    engine = _engine(tmp_path, extra={"csv_monitor": {
+        "enabled": True, "output_path": str(tmp_path / "csv"),
+        "job_name": "job"}})
+    sink_types = {type(s).__name__ for s in engine.telemetry.sinks}
+    assert sink_types == {"MonitorMaster", "JsonlMetricsSink"}
+    engine.train_batch(_batch())
+    engine.telemetry.flush(engine.global_steps)
+    csv_dir = tmp_path / "csv" / "job"
+    tags = {p.name for p in csv_dir.iterdir()}
+    assert "Telemetry_goodput.csv" in tags
+    jsonl = tmp_path / "metrics.jsonl"
+    lines = [json.loads(line) for line in open(jsonl)]
+    assert any(r["tag"] == "Telemetry/goodput" for r in lines)

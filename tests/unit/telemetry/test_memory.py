@@ -1,0 +1,43 @@
+"""Memory telemetry: compiled-HLO report + live-buffer watermarks."""
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.telemetry.memory import (MemoryTracker,
+                                            compiled_memory_report,
+                                            lower_and_report)
+
+
+def test_compiled_memory_report_shape():
+    compiled = jax.jit(lambda x: x @ x).lower(
+        jax.ShapeDtypeStruct((64, 64), jnp.float32)).compile()
+    report = compiled_memory_report(compiled)
+    # the CPU host backend may not expose memory_analysis; when it does,
+    # the report must carry byte fields
+    if report is not None:
+        assert all(k.endswith("_in_bytes") for k in report)
+        assert all(v >= 0 for v in report.values())
+
+
+def test_lower_and_report_accepts_abstract_args():
+    report = lower_and_report(jax.jit(lambda x: x + 1),
+                              jax.ShapeDtypeStruct((8,), jnp.float32))
+    assert report is None or isinstance(report, dict)
+
+
+def test_lower_and_report_swallow_bad_fn():
+    assert lower_and_report(jax.jit(lambda x: x), "not-an-aval") is None
+
+
+def test_live_bytes_watermark_tracks_allocations():
+    tracker = MemoryTracker()
+    base = tracker.sample("t0")["live_bytes"]
+    big = jnp.zeros((256, 1024), jnp.float32)  # 1 MiB
+    s1 = tracker.sample("t1")
+    assert s1["live_bytes"] >= base + big.nbytes
+    assert tracker.peak_live_bytes == s1["peak_live_bytes"]
+    del big
+    s2 = tracker.sample("t2")
+    # the watermark never regresses even after the buffer dies
+    assert s2["peak_live_bytes"] >= s1["live_bytes"]
+    assert tracker.samples == 3

@@ -1,0 +1,95 @@
+"""MetricsEngine: percentiles, tokens/sec, MFU, goodput, overlap split."""
+
+import pytest
+
+from deepspeed_tpu.telemetry.metrics import (LatencyHistogram, MetricsEngine,
+                                             peak_flops_per_device,
+                                             percentile)
+
+
+def test_percentile_nearest_rank():
+    vals = sorted([1.0, 2.0, 3.0, 4.0, 5.0])
+    assert percentile(vals, 50) == 3.0
+    assert percentile(vals, 0) == 1.0
+    assert percentile(vals, 100) == 5.0
+    assert percentile([], 50) == 0.0
+
+
+def test_step_percentiles_and_tokens_per_sec():
+    m = MetricsEngine(window=8)
+    for d in (0.1, 0.1, 0.1, 0.5):
+        m.record_step(d, tokens=100)
+    pcts = m.step_percentiles()
+    assert pcts["p50"] == pytest.approx(0.1)
+    assert pcts["p99"] == pytest.approx(0.5)
+    assert m.tokens_per_sec() == pytest.approx(400 / 0.8)
+
+
+def test_window_is_rolling():
+    m = MetricsEngine(window=2)
+    m.record_step(10.0)
+    m.record_step(0.1)
+    m.record_step(0.1)
+    assert m.mean_step_s() == pytest.approx(0.1)
+    assert m.steps == 3  # lifetime counter keeps counting
+
+
+def test_mfu_definition():
+    m = MetricsEngine()
+    m.record_step(0.5)
+    m.model_flops_per_step = 1e12
+    m.peak_flops_total = 8e12
+    # 1e12 flops in 0.5 s against an 8e12/s roofline => 0.25
+    assert m.mfu() == pytest.approx(0.25)
+
+
+def test_mfu_zero_when_unresolved():
+    m = MetricsEngine()
+    m.record_step(0.5)
+    assert m.mfu() == 0.0
+    assert "mfu" not in m.summary()
+
+
+def test_goodput_accounts_stalls_and_checkpoints():
+    m = MetricsEngine()
+    m.record_step(1.0)
+    m.record_step(3.0, stall_excess_s=2.0)  # 1 s productive, 2 s stall
+    m.record_checkpoint_pause(2.0)
+    # productive 2.0, lost 4.0
+    assert m.goodput() == pytest.approx(2.0 / 6.0)
+    assert m.stalled_steps == 1
+    assert m.summary()["goodput"] == pytest.approx(2.0 / 6.0)
+
+
+def test_overlap_efficiency_from_comm_records():
+    m = MetricsEngine()
+    assert m.overlap_efficiency() is None
+    m.record_comm(1000, overlapped=True, count=3)
+    m.record_comm(1000, overlapped=False)
+    m.record_comm(999, overlapped=None)  # unclassified: excluded
+    assert m.overlap_efficiency() == pytest.approx(3000 / 4000)
+    assert m.summary()["comm_overlap_efficiency"] == pytest.approx(0.75)
+
+
+def test_peak_flops_table_and_env_override(monkeypatch):
+    monkeypatch.delenv("DSTPU_PEAK_FLOPS", raising=False)
+    assert peak_flops_per_device("TPU v4") == 275e12
+    assert peak_flops_per_device("TPU v5 lite") == 197e12
+    assert peak_flops_per_device("TPU v5p chip") == 459e12
+    assert peak_flops_per_device("cpu") == 1e12
+    assert peak_flops_per_device("mystery") == 1e12
+    monkeypatch.setenv("DSTPU_PEAK_FLOPS", "123e12")
+    assert peak_flops_per_device("TPU v4") == 123e12
+
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram(cap=10)
+    for ms in range(1, 11):
+        h.record(ms / 1000)
+    p = h.percentiles()
+    assert p["p50"] == pytest.approx(0.006, abs=1e-3)
+    assert p["p99"] == pytest.approx(0.010, abs=1e-3)
+    # bounded: newest samples win
+    for _ in range(20):
+        h.record(0.001)
+    assert h.percentiles()["p99"] == pytest.approx(0.001)

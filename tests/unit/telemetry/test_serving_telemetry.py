@@ -1,0 +1,80 @@
+"""Serving telemetry: the continuous-batching scheduler records waves
+(kind, queue depth, occupancy) and per-token latency percentiles through
+the process-global recorder — with a stub engine, so no compile cost."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.telemetry import (TelemetryConfig, build_telemetry,
+                                     reset_telemetry)
+
+
+class _SM:
+    max_ragged_batch_size = 32
+
+
+class _Cfg:
+    state_manager = _SM()
+    decode_burst = 1
+
+
+class StubEngine:
+    """The scheduler-facing surface of InferenceEngineV2, no device."""
+
+    config = _Cfg()
+
+    def __init__(self):
+        self.flushed = []
+
+    def can_schedule(self, uids, lengths):
+        return True
+
+    def put(self, uids, tokens):
+        return np.zeros((len(uids), 16), np.float32)
+
+    def flush(self, uid):
+        self.flushed.append(uid)
+
+
+@pytest.fixture
+def tele(tmp_path):
+    t = build_telemetry(TelemetryConfig(
+        enabled=True, watchdog={"enabled": False},
+        trace={"output_path": str(tmp_path)}))
+    yield t
+    reset_telemetry()
+
+
+def test_scheduler_records_waves_and_latency(tele):
+    sched = ContinuousBatchingScheduler(StubEngine(), token_budget=32)
+    sched.submit(list(range(10)), max_new_tokens=3)
+    sched.submit(list(range(5)), max_new_tokens=2)
+
+    n = sched.step()  # pure prefill wave
+    assert n == 15
+    waves = [e for e in tele.trace.events() if e["kind"] == "instant"
+             and e["name"].startswith("wave:")]
+    assert waves[-1]["name"] == "wave:prefill"
+    assert waves[-1]["args"]["tokens"] == 15
+    assert waves[-1]["args"]["occupancy"] == pytest.approx(15 / 32, abs=1e-3)
+
+    n = sched.step()  # both sequences now decoding
+    assert n == 2
+    waves = [e for e in tele.trace.events() if e["kind"] == "instant"
+             and e["name"].startswith("wave:")]
+    assert waves[-1]["name"] == "wave:decode"
+    assert waves[-1]["args"]["running"] == 2
+
+    m = tele.metrics
+    assert len(m.token_latency) == 2 and len(m.wave_latency) == 2
+    p = m.token_latency.percentiles()
+    assert p["p50"] >= 0.0
+    assert "token_latency_p99_s" in m.summary()
+
+
+def test_scheduler_without_telemetry_is_unaffected():
+    reset_telemetry()
+    sched = ContinuousBatchingScheduler(StubEngine(), token_budget=32)
+    sched.submit([1, 2, 3], max_new_tokens=1)
+    assert sched.step() == 3

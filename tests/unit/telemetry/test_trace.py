@@ -1,0 +1,103 @@
+"""TraceRecorder: spans, comm/metric records, exports, bounded buffer."""
+
+import json
+import threading
+import time
+
+from deepspeed_tpu.telemetry.trace import (NULL_SPAN, PHASE_FWD,
+                                           PHASE_GATHER, PHASE_SCATTER,
+                                           TraceRecorder)
+
+
+def test_span_records_duration_and_phase():
+    rec = TraceRecorder()
+    with rec.span("fwd_dispatch", phase=PHASE_FWD, step=3, note="x"):
+        time.sleep(0.01)
+    (ev,) = rec.events()
+    assert ev["kind"] == "span" and ev["name"] == "fwd_dispatch"
+    assert ev["phase"] == PHASE_FWD and ev["step"] == 3
+    assert ev["dur"] >= 0.009
+    assert ev["args"] == {"note": "x"}
+
+
+def test_nested_spans_and_active_stack():
+    rec = TraceRecorder()
+    outer = rec.span("step", phase="step")
+    inner = rec.span("fwd", phase=PHASE_FWD)
+    stacks = rec.active_stacks()
+    (stack,) = stacks.values()
+    assert [name for name, _ in stack] == ["step", "fwd"]
+    rec.end(inner)
+    rec.end(outer)
+    assert rec.active_stacks() == {}
+    assert [e["name"] for e in rec.events()] == ["fwd", "step"]
+
+
+def test_comm_record_phase_attribution():
+    rec = TraceRecorder()
+    rec.comm("all_gather", 1024, ("data",), overlapped=True, count=4)
+    rec.comm("reduce_scatter", 512, ("data",), overlapped=False)
+    gather, scatter = rec.events()
+    assert gather["phase"] == PHASE_GATHER and gather["count"] == 4
+    assert scatter["phase"] == PHASE_SCATTER and scatter["overlapped"] is False
+
+
+def test_bounded_buffer_drops_oldest_and_counts():
+    rec = TraceRecorder(max_events=3)
+    for i in range(5):
+        rec.instant(f"e{i}")
+    assert [e["name"] for e in rec.events()] == ["e2", "e3", "e4"]
+    assert rec.dropped == 2
+
+
+def test_jsonl_export_round_trip(tmp_path):
+    rec = TraceRecorder()
+    with rec.span("s", phase=PHASE_FWD, step=1):
+        pass
+    rec.metric("mfu", 0.31, step=1)
+    path = str(tmp_path / "t.jsonl")
+    n = rec.export_jsonl(path)
+    lines = [json.loads(line) for line in open(path)]
+    assert n == len(lines) == 2
+    assert {r["kind"] for r in lines} == {"span", "metric"}
+
+
+def test_chrome_trace_export_is_valid_and_typed(tmp_path):
+    rec = TraceRecorder()
+    with rec.span("fwd", phase=PHASE_FWD, step=0):
+        pass
+    rec.comm("all_gather", 64, ("data",), overlapped=True)
+    rec.metric("goodput", 1.0, step=0)
+    path = str(tmp_path / "t.chrome.json")
+    rec.export_chrome_trace(path)
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    phs = {e["name"]: e["ph"] for e in events}
+    assert phs["fwd"] == "X"
+    assert phs["comm:all_gather"] == "i"
+    assert phs["goodput"] == "C"
+    span = next(e for e in events if e["ph"] == "X")
+    assert span["dur"] >= 0 and span["cat"] == PHASE_FWD
+
+
+def test_cross_thread_spans():
+    rec = TraceRecorder()
+
+    def worker():
+        with rec.span("bg_write", phase="checkpoint"):
+            time.sleep(0.005)
+
+    t = threading.Thread(target=worker)
+    with rec.span("main", phase="step"):
+        t.start()
+        t.join()
+    names = {e["name"] for e in rec.events()}
+    assert names == {"bg_write", "main"}
+    tids = {e["tid"] for e in rec.events()}
+    assert len(tids) == 2
+
+
+def test_null_span_is_reusable_noop():
+    with NULL_SPAN as s:
+        assert s is NULL_SPAN
+    assert NULL_SPAN.duration == 0.0

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Summarize a dstpu-telemetry trace JSONL for bench runs.
+
+Usage:
+    python tools/trace_view.py <trace.rank0.jsonl> [--top N] [--phase P]
+
+Reads the JSONL export (``Telemetry.export()``; one record per line — see
+deepspeed_tpu/telemetry/trace.py for the schema) and prints:
+
+- top spans by total time (count, total/mean/p50/p95 ms) grouped by name,
+- per-phase time breakdown,
+- comm overlap: overlapped/exposed traced bytes and the overlap fraction
+  (the ``record_collective`` schedule-class split, docs/ZERO_OVERLAP.md),
+- the last flushed derived metrics (MFU, goodput, tokens/sec, step
+  percentiles) from the metric records.
+
+Pure stdlib — runs anywhere the JSONL lands, no jax required.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def _pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[i]
+
+
+def _fmt_bytes(n):
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if n >= div:
+            return f"{n / div:.2f} {unit}"
+    return f"{n:.0f} B"
+
+
+def load(path):
+    records = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"warning: skipping malformed line {lineno}",
+                      file=sys.stderr)
+    return records
+
+
+def summarize(records, top=15, phase=None):
+    spans = [r for r in records if r.get("kind") == "span"]
+    if phase:
+        spans = [s for s in spans if s.get("phase") == phase]
+    by_name = defaultdict(list)
+    by_phase = defaultdict(float)
+    for s in spans:
+        by_name[s["name"]].append(s["dur"])
+        by_phase[s.get("phase", "other")] += s["dur"]
+
+    lines = []
+    if by_name:
+        lines.append(f"{'span':<28}{'count':>7}{'total ms':>12}"
+                     f"{'mean ms':>10}{'p50 ms':>10}{'p95 ms':>10}")
+        ranked = sorted(by_name.items(), key=lambda kv: -sum(kv[1]))[:top]
+        for name, durs in ranked:
+            sd = sorted(durs)
+            lines.append(f"{name:<28}{len(durs):>7}{sum(durs) * 1e3:>12.2f}"
+                         f"{sum(durs) / len(durs) * 1e3:>10.2f}"
+                         f"{_pct(sd, 50) * 1e3:>10.2f}"
+                         f"{_pct(sd, 95) * 1e3:>10.2f}")
+        lines.append("")
+        total = sum(by_phase.values())
+        lines.append("phase breakdown:")
+        for ph, t in sorted(by_phase.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {ph:<14}{t * 1e3:>12.2f} ms"
+                         f"  ({100 * t / max(total, 1e-12):.1f}%)")
+        lines.append("")
+
+    ov = ex = 0
+    for r in records:
+        if r.get("kind") != "comm":
+            continue
+        b = r["bytes"] * r.get("count", 1)
+        if r.get("overlapped") is True:
+            ov += b
+        elif r.get("overlapped") is False:
+            ex += b
+    if ov or ex:
+        lines.append(f"comm traced bytes: overlapped {_fmt_bytes(ov)} / "
+                     f"exposed {_fmt_bytes(ex)} "
+                     f"(overlap fraction {ov / max(ov + ex, 1):.2f})")
+        lines.append("")
+
+    # newest value per metric tag
+    metrics = {}
+    for r in records:
+        if r.get("kind") == "metric":
+            metrics[r["name"]] = r["value"]
+    if metrics:
+        lines.append("derived metrics (last flush):")
+        for name in sorted(metrics):
+            lines.append(f"  {name:<40}{metrics[name]:>14.6g}")
+    if not lines:
+        lines.append("no span/comm/metric records found "
+                     "(is this a telemetry JSONL export?)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="summarize a dstpu-telemetry trace JSONL")
+    parser.add_argument("path", help="trace.rank*.jsonl from Telemetry.export()")
+    parser.add_argument("--top", type=int, default=15,
+                        help="how many span groups to print (default 15)")
+    parser.add_argument("--phase", default=None,
+                        help="restrict the span table to one phase")
+    args = parser.parse_args(argv)
+    records = load(args.path)
+    print(summarize(records, top=args.top, phase=args.phase))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

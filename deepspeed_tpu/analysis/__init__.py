@@ -1,6 +1,6 @@
 """dstpu-lint: static analysis enforcing TPU-graph invariants.
 
-Two layers (see docs/STATIC_ANALYSIS.md):
+Three layers (see docs/STATIC_ANALYSIS.md):
 
 - **Layer A** (:mod:`.ast_rules`) — pure-AST rules, no jax import, runs on
   every file: hidden host syncs, trace-time nondeterminism, Python
@@ -9,6 +9,12 @@ Two layers (see docs/STATIC_ANALYSIS.md):
   ``trace_and_check`` traces real entry points via ``jax.make_jaxpr`` and
   walks the jaxpr: collective axis binding/topology agreement, donation
   aliasing, retrace-signature counting.
+- **Layer C** (:mod:`.spmd_audit`, :mod:`.lowering`, :mod:`.budgets`) —
+  lowers+compiles each entry point with its real mesh/shardings and
+  audits the post-SPMD artifact: partitioner-inserted collectives
+  (``implicit-reshard``), replicated large intermediates, full-param scan
+  residuals, donations XLA actually dropped, and compiled memory bytes
+  against the shrink-only ``tools/memory_budgets.json``.
 
 Findings are structured (:mod:`.findings`), rules pluggable
 (:mod:`.registry`), and the gate diffs against ``tools/lint_baseline.json``

@@ -22,18 +22,37 @@ from .findings import Finding, sort_findings
 
 
 TRACE_PREFIX = "<trace:"
+SPMD_PREFIX = "<spmd:"
+
+#: the three layers a finding can come from, keyed by its path marker.
+#: Layers don't always run together (the jaxpr audit needs a working JAX,
+#: the SPMD audit additionally compiles), so baseline diffs must only
+#: cover the layers that actually ran — otherwise an AST-only run reports
+#: grandfathered jaxpr/spmd entries as stale, and ``--write-baseline``
+#: silently drops them.
+LAYER_KEYS = ("ast", "jaxpr", "spmd")
 
 
-def split_layers(findings: List[Finding]) -> Tuple[List[Finding], List[Finding]]:
-    """-> (ast_findings, jaxpr_findings), by the ``<trace:...>`` path marker.
+def finding_layer(f: Finding) -> str:
+    if f.path.startswith(TRACE_PREFIX):
+        return "jaxpr"
+    if f.path.startswith(SPMD_PREFIX):
+        return "spmd"
+    return "ast"
 
-    The two layers don't always run together (the jaxpr audit needs a
-    working JAX), so baseline diffs must only cover the layers that actually
-    ran — otherwise an AST-only run reports grandfathered jaxpr entries as
-    stale, and ``--write-baseline`` silently drops them."""
-    ast = [f for f in findings if not f.path.startswith(TRACE_PREFIX)]
-    jaxpr = [f for f in findings if f.path.startswith(TRACE_PREFIX)]
-    return ast, jaxpr
+
+def by_layer(findings: List[Finding]) -> Dict[str, List[Finding]]:
+    out: Dict[str, List[Finding]] = {k: [] for k in LAYER_KEYS}
+    for f in findings:
+        out[finding_layer(f)].append(f)
+    return out
+
+
+def split_layers(findings: List[Finding]
+                 ) -> Tuple[List[Finding], List[Finding], List[Finding]]:
+    """-> (ast, jaxpr, spmd) findings, by path marker."""
+    layers = by_layer(findings)
+    return layers["ast"], layers["jaxpr"], layers["spmd"]
 
 
 def default_baseline_path() -> str:

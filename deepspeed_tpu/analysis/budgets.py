@@ -1,0 +1,102 @@
+"""Shrink-only memory & collective budgets (``tools/memory_budgets.json``).
+
+Every Layer-C entry point has a committed byte budget: the
+``memory_analysis()`` fields of its compiled artifact plus the total bytes
+moved by collective instructions in the partitioned program. The contract
+mirrors ``tools/lint_baseline.json``:
+
+- current usage above a committed number -> ``memory-budget-regression``,
+  a HARD finding. Raising a budget is a hand edit that must survive code
+  review — the tool never does it for you.
+- ``dstpu lint --update-budgets`` writes the file ONLY downward: an entry
+  whose usage dropped is re-pinned at the lower number, a new entry point
+  gets its first budget, and nothing is ever raised.
+- a registered entry point with no committed budget is itself a finding —
+  new hot paths land with their budget in the same PR.
+
+Budgets are taken on the canonical audit environment (CPU host platform,
+``--xla_force_host_platform_device_count=8``); the file records
+``mesh_devices`` and comparisons are skipped when the live device count
+differs (a TPU run has different partitioning and different bytes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+#: fields a budget tracks, all bytes, all shrink-only. ``collective_bytes``
+#: is the sum over collective instructions in the partitioned HLO of their
+#: per-device result bytes — the auditor's estimate of bytes moved per step.
+TRACKED_FIELDS: Tuple[str, ...] = (
+    "argument_size_in_bytes", "output_size_in_bytes",
+    "temp_size_in_bytes", "collective_bytes")
+
+
+def default_budgets_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "memory_budgets.json")
+
+
+def load_budgets(path: str) -> Optional[Dict]:
+    """-> {"mesh_devices": int, "budgets": {entry: {field: int}}} or None
+    when the file doesn't exist yet."""
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return {"mesh_devices": int(data.get("mesh_devices", 0)),
+            "budgets": {k: {f: int(v) for f, v in e.items()
+                            if f in TRACKED_FIELDS}
+                        for k, e in data.get("budgets", {}).items()}}
+
+
+def env_matches(budgets: Optional[Dict]) -> bool:
+    """Budgets are only comparable on the mesh size they were taken on."""
+    if not budgets:
+        return False
+    import jax
+    return jax.device_count() == budgets["mesh_devices"]
+
+
+def write_budgets(path: str, budgets: Dict) -> None:
+    data = {
+        "comment": "Per-entry-point compiled memory & collective byte "
+                   "budgets (dstpu lint --spmd). Shrink, never grow: "
+                   "`dstpu lint --update-budgets` only lowers; raising a "
+                   "budget is a hand edit that must survive review.",
+        "mesh_devices": budgets["mesh_devices"],
+        "budgets": {k: dict(sorted(e.items()))
+                    for k, e in sorted(budgets["budgets"].items())},
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2)
+        fh.write("\n")
+
+
+def shrink_budgets(old: Optional[Dict], reports: Dict[str, Dict[str, int]],
+                   mesh_devices: int) -> Tuple[Dict, List[str]]:
+    """Merge current ``reports`` into ``old`` budgets, ONLY downward.
+
+    Returns the new budgets dict and the list of ``entry.field`` keys whose
+    current usage EXCEEDS the committed budget (left untouched — those are
+    regressions the caller must surface, not numbers to absorb)."""
+    old_budgets = dict((old or {}).get("budgets", {}))
+    exceeded: List[str] = []
+    merged: Dict[str, Dict[str, int]] = {k: dict(v)
+                                         for k, v in old_budgets.items()}
+    for name, report in reports.items():
+        entry = merged.setdefault(name, {})
+        for field in TRACKED_FIELDS:
+            if field not in report:
+                continue
+            cur = int(report[field])
+            if field not in entry:
+                entry[field] = cur          # first budget for a new entry
+            elif cur <= entry[field]:
+                entry[field] = cur          # shrink
+            else:
+                exceeded.append(f"{name}.{field}")  # regression: never raise
+    return {"mesh_devices": mesh_devices, "budgets": merged}, exceeded

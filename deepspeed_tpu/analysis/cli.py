@@ -3,20 +3,28 @@
 Exit codes: 0 = clean against the baseline, 1 = new findings (or stale
 baseline entries), 2 = usage error. The fast AST layer runs on every
 invocation; the jaxpr layer (``--jaxpr``) traces the real engine/ZeRO/MoE/
-sequence entry points and needs a working JAX (use ``JAX_PLATFORMS=cpu``
-off-accelerator).
+sequence/serving entry points and needs a working JAX (use
+``JAX_PLATFORMS=cpu`` off-accelerator); the compiled layer (``--spmd``)
+additionally lowers+compiles every entry point with its real
+mesh/shardings and audits the post-SPMD artifact against
+``tools/memory_budgets.json`` (run it with
+``--xla_force_host_platform_device_count=8`` so the budgets' audit mesh
+matches). ``--update-budgets`` re-pins the budgets file — downward only.
+``--json`` emits the findings, the baseline diff, and (when ``--spmd``
+ran) the per-entry memory/collective reports as machine-readable JSON.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import os
 import sys
 from typing import List
 
 from . import ast_rules
-from .baseline import (default_baseline_path, diff_against_baseline,
-                       load_baseline, split_layers, write_baseline)
+from .baseline import (by_layer, default_baseline_path, diff_against_baseline,
+                       load_baseline, write_baseline)
 from .findings import Finding, SEVERITY_ERROR, sort_findings
 from .registry import all_rules, is_known
 
@@ -61,6 +69,32 @@ def run_jaxpr_layer(entry_names=None) -> List[Finding]:
     return audit_entry_points(entry_names)
 
 
+def run_spmd_layer(entry_names=None, budgets_path=None):
+    """-> (findings, reports, budgets_checked: bool). Budget comparison is
+    skipped (with a visible note) when the live device count differs from
+    the committed audit mesh — bytes from a different partitioning are not
+    comparable."""
+    from .budgets import default_budgets_path, env_matches, load_budgets
+    from .spmd_audit import audit_spmd_entry_points
+
+    path = budgets_path or default_budgets_path()
+    budgets = load_budgets(path)
+    checked = env_matches(budgets)
+    if budgets is None:
+        # a silently-skipped budget gate looks like a pass — say so
+        print(f"dstpu lint: no budgets file at {path} — budget checks "
+              "skipped (run --update-budgets to create it)",
+              file=sys.stderr)
+    elif not checked:
+        import jax
+        print(f"dstpu lint: skipping budget checks — {jax.device_count()} "
+              f"live device(s) vs committed audit mesh of "
+              f"{budgets['mesh_devices']}", file=sys.stderr)
+    findings, reports = audit_spmd_entry_points(
+        entry_names, budgets=budgets if checked else None)
+    return findings, reports, checked
+
+
 def render(findings: List[Finding], fix_hints: bool) -> str:
     lines = []
     for f in findings:
@@ -79,11 +113,25 @@ def build_parser() -> argparse.ArgumentParser:
                              "deepspeed_tpu package)")
     parser.add_argument("--jaxpr", action="store_true",
                         help="also run the jaxpr entry-point audits "
-                             "(traces engine/ZeRO/MoE/sequence paths)")
+                             "(traces engine/ZeRO/MoE/sequence/serving "
+                             "paths)")
+    parser.add_argument("--spmd", action="store_true",
+                        help="also run the Layer-C compiled-artifact audits "
+                             "(lowers+compiles every entry point with its "
+                             "real mesh/shardings; checks "
+                             "tools/memory_budgets.json)")
     parser.add_argument("--entry", action="append", default=None,
-                        help="restrict --jaxpr to the named entry points")
+                        help="restrict --jaxpr/--spmd to the named entry "
+                             "points")
     parser.add_argument("--baseline", default=None,
                         help="baseline JSON (default: tools/lint_baseline.json)")
+    parser.add_argument("--budgets", default=None,
+                        help="budgets JSON (default: "
+                             "tools/memory_budgets.json)")
+    parser.add_argument("--update-budgets", action="store_true",
+                        help="run --spmd and re-pin the budgets file — "
+                             "DOWNWARD only; exceeded budgets stay put and "
+                             "keep failing until fixed or hand-raised")
     parser.add_argument("--no-baseline", action="store_true",
                         help="report every finding; ignore the baseline")
     parser.add_argument("--write-baseline", action="store_true",
@@ -100,9 +148,39 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.as_json:
+        # stdout must be pure JSON: the audits boot real engines whose
+        # framework logger writes INFO to stdout — reroute it for the run
+        with _framework_logs_to_stderr():
+            return _main(args)
+    return _main(args)
+
+
+@contextlib.contextmanager
+def _framework_logs_to_stderr():
+    import logging
+
+    from ..utils.logging import logger as fw_logger
+
+    # the handler may hold a stale reference to a replaced sys.stdout
+    # (test capture, IDE shells) — anything not already on stderr moves
+    moved = [(h, h.stream) for h in fw_logger.handlers
+             if isinstance(h, logging.StreamHandler)
+             and getattr(h, "stream", None) is not sys.stderr]
+    for h, _ in moved:
+        h.setStream(sys.stderr)
+    try:
+        yield
+    finally:
+        for h, old in moved:
+            h.setStream(old)
+
+
+def _main(args) -> int:
 
     if args.list_rules:
         from . import trace_harness  # noqa: F401 — registers Layer-B rules
+        from . import spmd_audit  # noqa: F401 — registers Layer-C rules
         for rule in all_rules():
             print(f"{rule.rule_id:26} [{rule.layer}/{rule.severity}] "
                   f"{rule.description}")
@@ -114,42 +192,97 @@ def main(argv=None) -> int:
             print(f"dstpu lint: no such path: {p}", file=sys.stderr)
             return 2
 
-    findings = run_ast_layer(paths)
-    if args.jaxpr:
-        try:
-            findings += run_jaxpr_layer(args.entry)
-        except ValueError as e:
-            print(f"dstpu lint: {e}", file=sys.stderr)
+    run_spmd = args.spmd or args.update_budgets
+    if run_spmd:
+        # fail fast on budget-file problems BEFORE the ~40s compile audit:
+        # a typo'd explicit --budgets path must not silently disable the
+        # gate, and --update-budgets on the wrong mesh must not waste the
+        # whole run only to refuse at the end
+        from .budgets import default_budgets_path, load_budgets
+        budgets_path = args.budgets or default_budgets_path()
+        if (args.budgets and not args.update_budgets
+                and not os.path.exists(args.budgets)):
+            print(f"dstpu lint: no such budgets file: {args.budgets}",
+                  file=sys.stderr)
             return 2
+        if args.update_budgets:
+            import jax
+            old = load_budgets(budgets_path)
+            if old is not None and old["mesh_devices"] != jax.device_count():
+                # numbers from a different partitioning are not comparable
+                # — refusing beats silently replacing the committed audit
+                # mesh
+                print(f"dstpu lint: refusing --update-budgets: "
+                      f"{budgets_path} was taken on {old['mesh_devices']} "
+                      f"devices, this environment has {jax.device_count()}",
+                      file=sys.stderr)
+                return 2
+
+    findings = run_ast_layer(paths)
+    spmd_reports = {}
+    budgets_checked = False
+    try:
+        if args.jaxpr:
+            findings += run_jaxpr_layer(args.entry)
+        if run_spmd:
+            spmd_findings, spmd_reports, budgets_checked = run_spmd_layer(
+                args.entry, args.budgets)
+            findings += spmd_findings
+    except ValueError as e:
+        print(f"dstpu lint: {e}", file=sys.stderr)
+        return 2
     findings = sort_findings(findings)
 
+    if args.update_budgets:
+        from .budgets import shrink_budgets, write_budgets
+        import jax
+        old = load_budgets(budgets_path)
+        reports = {k: r.budget_fields() for k, r in spmd_reports.items()}
+        merged, exceeded = shrink_budgets(old, reports, jax.device_count())
+        write_budgets(budgets_path, merged)
+        print(f"wrote {len(merged['budgets'])} budget entr"
+              f"{'y' if len(merged['budgets']) == 1 else 'ies'} to "
+              f"{budgets_path} (downward only)",
+              # --json keeps stdout pure JSON
+              file=sys.stderr if args.as_json else sys.stdout)
+        for key in exceeded:
+            print(f"  NOT raised (exceeds committed budget): {key}",
+                  file=sys.stderr)
+
+    ran_layers = {"ast"} | ({"jaxpr"} if args.jaxpr else set()) \
+        | ({"spmd"} if run_spmd else set())
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
-        # An AST-only run must not erase grandfathered jaxpr entries: keep
-        # the baseline slice for the layer that did not run.
-        kept = ([] if args.jaxpr
-                else split_layers(load_baseline(baseline_path))[1])
+        # A partial run must not erase grandfathered entries for the
+        # layers that did not run: carry their baseline slices over.
+        kept_layers = by_layer(load_baseline(baseline_path))
+        kept = [f for layer, fs in kept_layers.items()
+                if layer not in ran_layers for f in fs]
         write_baseline(baseline_path, findings + kept)
         print(f"wrote {len(findings) + len(kept)} finding(s) to "
               f"{baseline_path}"
-              + (f" ({len(kept)} jaxpr entr"
-                 f"{'y' if len(kept) == 1 else 'ies'} carried over)"
-                 if kept else ""))
+              + (f" ({len(kept)} entr"
+                 f"{'y' if len(kept) == 1 else 'ies'} from layers that did "
+                 "not run carried over)" if kept else ""))
         return 0
 
     baseline = [] if args.no_baseline else load_baseline(baseline_path)
-    if not args.jaxpr:
-        # Layer B did not run; its baseline entries are neither matchable
-        # nor stale here.
-        baseline = split_layers(baseline)[0]
+    # a layer that did not run has baseline entries that are neither
+    # matchable nor stale here
+    baseline = [f for layer, fs in by_layer(baseline).items()
+                if layer in ran_layers for f in fs]
     new, stale = diff_against_baseline(findings, baseline)
 
     if args.as_json:
         import json
-        print(json.dumps({"findings": [f.to_dict() for f in findings],
-                          "new": [f.to_dict() for f in new],
-                          "stale_baseline": [f.to_dict() for f in stale]},
-                         indent=2))
+        payload = {"findings": [f.to_dict() for f in findings],
+                   "new": [f.to_dict() for f in new],
+                   "stale_baseline": [f.to_dict() for f in stale]}
+        if run_spmd:
+            payload["spmd_reports"] = {k: r.to_dict()
+                                       for k, r in spmd_reports.items()}
+            payload["budgets_checked"] = budgets_checked
+        print(json.dumps(payload, indent=2))
     else:
         report = new if not args.no_baseline else findings
         if report:

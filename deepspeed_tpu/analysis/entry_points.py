@@ -1,24 +1,76 @@
-"""Layer B entry-point audits over the framework's real traced paths.
+"""Registered entry points: the framework's real traced hot paths.
 
-Each audit builds a tiny-but-real instance of a hot path — engine train
-step, ZeRO++ gather/partition micro step, MoE dispatch, ring attention,
-Ulysses attention — traces it with :func:`trace_and_check`, and returns the
-findings. These run on the CPU host platform (``JAX_PLATFORMS=cpu`` with
+Each entry point is declared ONCE as an :class:`EntrySpec` — the callable,
+its representative (sharded) arguments, its donation contract, the mesh it
+runs under, and its compiled-layer expectations — and BOTH analysis layers
+consume the same spec:
+
+- **Layer B** (``dstpu lint --jaxpr``) traces the spec with
+  :func:`trace_and_check` and walks the jaxpr (collective axis binding,
+  donation aliasing, retrace signatures).
+- **Layer C** (``dstpu lint --spmd``, :mod:`.spmd_audit`) lowers and
+  compiles the spec with its real mesh/shardings and audits the
+  post-SPMD artifact (GSPMD-inserted collectives, replicated
+  intermediates, remat residuals, actual aliasing, memory budgets).
+
+These run on the CPU host platform (``JAX_PLATFORMS=cpu`` with
 ``--xla_force_host_platform_device_count=8``, the same virtual mesh the
-unit tests use); nothing executes, only traces.
+unit tests use); nothing executes, only traces and compiles.
 
 ``audit_entry_points()`` is what ``dstpu lint --jaxpr`` and the
 ``test_lint_clean`` CI gate call.
+
+Layer-C expectations on a spec:
+
+- ``expected_spmd`` — HLO collective kinds the entry point's sharding
+  design legitimately lets GSPMD insert (beyond the kinds implied by the
+  source jaxpr's own collective primitives). This is the *declared
+  contract* the ``implicit-reshard`` rule enforces: any other kind
+  appearing in the compiled program is a finding.
+- ``param_shapes`` — full (unpartitioned) parameter shapes, set only on
+  the ZeRO-partitioned schedules where "residuals must never contain full
+  params" is a design invariant (docs/ZERO_OVERLAP.md); the
+  ``remat-residual-full-param`` rule walks scan residuals against it.
+- ``gate_cheap`` — True for the specs the tier-1 CI gate compiles
+  (no engine build, sub-second compiles); the full set runs via
+  ``dstpu lint --spmd`` off-gate. See docs/STATIC_ANALYSIS.md.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List
+import dataclasses
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
 
 from .findings import Finding, SEVERITY_ERROR
 from .trace_harness import check_retrace, trace_and_check
 
 _TINY = dict(max_seq_len=32, vocab_size=256, remat=False)
+
+
+@dataclasses.dataclass
+class EntrySpec:
+    """One registered entry point, shared by Layers B and C."""
+    name: str
+    fn: Callable
+    args: Tuple[Any, ...]
+    donate_argnums: Tuple[int, ...] = ()
+    mesh: Any = None                     # context manager; None = no mesh ctx
+    retrace_args: Optional[Sequence[Tuple]] = None   # arg sets for check_retrace
+    max_signatures: int = 1
+    # --- Layer C contracts ---
+    #: the production jit's extra arguments (in_shardings/out_shardings) —
+    #: Layer C must compile the program production runs, or donation and
+    #: partitioning drift from reality
+    jit_kwargs: Optional[Dict[str, Any]] = None
+    expected_spmd: FrozenSet[str] = frozenset()
+    param_shapes: FrozenSet[Tuple[Tuple[int, ...], str]] = frozenset()
+    gate_cheap: bool = False
+    # bespoke Layer-B checks run by the builder (e.g. telemetry parity)
+    extra_findings: List[Finding] = dataclasses.field(default_factory=list)
+
+    def mesh_ctx(self):
+        import contextlib
+        return self.mesh if self.mesh is not None else contextlib.nullcontext()
 
 
 def _tiny_engine(config_extra=None, **model_kw):
@@ -42,29 +94,71 @@ def _batch(engine, batch=8, seq=16):
     return engine._prepare_batch({"input_ids": ids})
 
 
-def audit_engine_step() -> List[Finding]:
+def _full_param_shapes(model) -> FrozenSet[Tuple[Tuple[int, ...], str]]:
+    """Full (unpartitioned) parameter shapes of ``model`` — what a gathered
+    layer weight looks like. The remat-residual rule flags scan residuals
+    matching any of these."""
+    import jax
+
+    tree = jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    return frozenset((tuple(l.shape), str(l.dtype))
+                     for l in jax.tree.leaves(tree))
+
+
+# ---------------------------------------------------------------------------
+# spec builders — one per registered entry point
+# ---------------------------------------------------------------------------
+
+def build_engine_step() -> EntrySpec:
     """The fused train step: collectives bound, state donated, and the step
-    must not retrace across steps (same shapes -> one signature)."""
+    must not retrace across steps (same shapes -> one signature). The step
+    is GSPMD-sharded (jit + shardings, no shard_map): the data-parallel
+    gradient all-reduce and the ZeRO-1 sharded-optimizer gather/exchange
+    are partitioner-inserted BY DESIGN — the declared expected_spmd set."""
     import jax.numpy as jnp
 
     engine = _tiny_engine()
     batch = _batch(engine)
     lr = jnp.asarray(1e-3, jnp.float32)
-    with engine.mesh:
-        findings = trace_and_check(
-            engine._train_step_fn, engine.state, batch, lr,
-            donate_argnums=(0,), name="engine-train-step")
-    findings += check_retrace(
-        "engine-train-step",
-        [(engine.state, batch, lr), (engine.state, batch, lr)])
-    return findings
+    args = (engine.state, batch, lr)
+    return EntrySpec(
+        name="engine-train-step", fn=engine._train_step_fn, args=args,
+        donate_argnums=(0,), mesh=engine.mesh,
+        jit_kwargs=_fused_step_jit_kwargs(engine),
+        retrace_args=[args, args],
+        expected_spmd=frozenset({"all-reduce", "all-gather", "all-to-all"}))
 
 
-def audit_zero_gather_partition() -> List[Finding]:
+def _fused_step_jit_kwargs(engine) -> Dict[str, Any]:
+    """The fused step's production jit arguments (engine._build_fused_jit):
+    state shardings in and out, replicated scalars."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shardings = engine._state_shardings()
+    rep = NamedSharding(engine.mesh, P())
+    return dict(in_shardings=(shardings, None, None),
+                out_shardings=(shardings, rep, rep, rep))
+
+
+def _zeropp_micro_jit_kwargs(engine) -> Dict[str, Any]:
+    """The explicit ZeRO++ micro's production jit arguments
+    (engine._build_jits, _explicit_micro branch): only grad_acc flows
+    donated; scale replicated; params/batch placed by the caller."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shardings = engine._state_shardings()
+    rep = NamedSharding(engine.mesh, P())
+    return dict(in_shardings=(shardings["grad_acc"], rep, None, None),
+                out_shardings=(shardings["grad_acc"], rep))
+
+
+def build_zero_gather_partition() -> EntrySpec:
     """ZeRO++ micro step — the whole-tree BARRIER schedule, the
     ``overlap_comm: false`` escape hatch (engine._build_zeropp_micro_barrier):
     every collective must ride the canonical dp axes and the donated grad
-    accumulator must alias."""
+    accumulator must alias. Gathers/scatters are EXPLICIT shard_map
+    collectives, so the compiled program may contain no collective kind
+    the source jaxpr doesn't already name (psum lowers to all-reduce)."""
     engine = _tiny_engine(config_extra={"zero_optimization": {
         "stage": 3, "stage3_param_persistence_threshold": 0,
         "zero_quantized_weights": True, "overlap_comm": False}})
@@ -73,14 +167,16 @@ def audit_zero_gather_partition() -> List[Finding]:
     micro = engine._build_zeropp_micro()
     assert not engine._overlap_active, \
         "overlap_comm: false must select the barrier schedule"
-    with engine.mesh:
-        return trace_and_check(
-            micro, engine.state["grad_acc"],
-            engine.state["loss_scale"]["cur_scale"], engine.state["params"],
-            batch, donate_argnums=(0,), name="zero-gather-partition")
+    args = (engine.state["grad_acc"], engine.state["loss_scale"]["cur_scale"],
+            engine.state["params"], batch)
+    return EntrySpec(
+        name="zero-gather-partition", fn=micro, args=args,
+        donate_argnums=(0,), mesh=engine.mesh,
+        jit_kwargs=_zeropp_micro_jit_kwargs(engine),
+        param_shapes=_full_param_shapes(engine.model))
 
 
-def audit_zeropp_micro_overlap() -> List[Finding]:
+def build_zeropp_micro_overlap() -> EntrySpec:
     """The layer-granular pipelined ZeRO++ micro step (ISSUE 3 tentpole,
     engine._build_zeropp_micro_overlap + models/transformer.py
     scan_blocks_pipelined + runtime/zero/overlap.py): double-buffered
@@ -88,7 +184,9 @@ def audit_zeropp_micro_overlap() -> List[Finding]:
     gradient reduce-scatter. The audit enforces axis binding (every
     collective in both scan bodies rides canonical dp axes), donation
     aliasing on the grad accumulator, and a stable retrace signature —
-    the schedule recompiling per step would erase the win it exists for."""
+    the schedule recompiling per step would erase the win it exists for.
+    ``param_shapes`` arms the remat-residual rule: the prefetch CARRY may
+    hold one gathered layer (by design), stacked scan residuals may not."""
     engine = _tiny_engine(config_extra={"zero_optimization": {
         "stage": 3, "stage3_param_persistence_threshold": 0,
         "zero_quantized_weights": True, "zero_quantized_gradients": True}})
@@ -100,21 +198,21 @@ def audit_zeropp_micro_overlap() -> List[Finding]:
         f"schedule; fell back: {engine._overlap_fallback}")
     gacc = engine.state["grad_acc"]
     scale = engine.state["loss_scale"]["cur_scale"]
-    with engine.mesh:
-        findings = trace_and_check(
-            micro, gacc, scale, engine.state["params"], batch,
-            donate_argnums=(0,), name="zeropp-micro-overlap")
-    findings += check_retrace(
-        "zeropp-micro-overlap",
-        [(gacc, scale, engine.state["params"], batch),
-         (gacc, scale, engine.state["params"], batch)])
-    return findings
+    args = (gacc, scale, engine.state["params"], batch)
+    return EntrySpec(
+        name="zeropp-micro-overlap", fn=micro, args=args,
+        donate_argnums=(0,), mesh=engine.mesh,
+        jit_kwargs=_zeropp_micro_jit_kwargs(engine),
+        retrace_args=[args, args],
+        param_shapes=_full_param_shapes(engine.model))
 
 
-def audit_moe_dispatch() -> List[Finding]:
+def build_moe_dispatch() -> EntrySpec:
     """MoE dispatch/combine: the expert exchange is expressed as sharding
     constraints over the expert axis — those specs must name canonical axes
-    of the configured topology."""
+    of the configured topology, and the partitioner materializes the
+    exchange (all-to-all/permute/gather + the combine all-reduce), which is
+    the declared expected_spmd set."""
     import jax
     import jax.numpy as jnp
     from deepspeed_tpu.moe.layer import MoE
@@ -125,14 +223,20 @@ def audit_moe_dispatch() -> List[Finding]:
     moe = MoE(hidden_size=16, intermediate_size=32, num_experts=4, top_k=2)
     params = moe.init(jax.random.PRNGKey(0))
     x = jnp.zeros((4, 8, 16), jnp.float32)
-    with topo.mesh:
-        return trace_and_check(lambda p, t: moe(p, t)[0], params, x,
-                               name="moe-dispatch")
+    args = (params, x)
+    return EntrySpec(
+        name="moe-dispatch", fn=lambda p, t: moe(p, t)[0], args=args,
+        mesh=topo.mesh, retrace_args=[args, args], gate_cheap=True,
+        expected_spmd=frozenset({"all-reduce", "all-gather", "all-to-all",
+                                 "collective-permute"}))
 
 
-def audit_ring_attention() -> List[Finding]:
+def build_ring_attention() -> EntrySpec:
     """Ring attention: the K/V rotation must ppermute over the canonical
-    seq axis inside a shard_map whose mesh matches the global topology."""
+    seq axis inside a shard_map whose mesh matches the global topology.
+    All collectives are explicit (collective-permute from ppermute):
+    expected_spmd is empty — a partitioner-inserted gather here means the
+    sequence sharding broke."""
     import jax.numpy as jnp
     from deepspeed_tpu.runtime import topology as topo_mod
     from deepspeed_tpu.runtime.topology import TopologyConfig
@@ -140,11 +244,14 @@ def audit_ring_attention() -> List[Finding]:
 
     topo_mod.initialize(TopologyConfig(seq=2, data=-1), force=True)
     q = jnp.zeros((4, 8, 4, 8), jnp.float32)
-    return trace_and_check(ring_attention, q, q, q, name="ring-attention")
+    args = (q, q, q)
+    return EntrySpec(name="ring-attention", fn=ring_attention, args=args,
+                     retrace_args=[args, args], gate_cheap=True)
 
 
-def audit_ulysses_attention() -> List[Finding]:
-    """Ulysses: the head-scatter/seq-gather all-to-alls over the seq axis."""
+def build_ulysses_attention() -> EntrySpec:
+    """Ulysses: the head-scatter/seq-gather all-to-alls over the seq axis —
+    explicit in the source jaxpr, so expected_spmd is empty."""
     import jax.numpy as jnp
     from deepspeed_tpu.runtime import topology as topo_mod
     from deepspeed_tpu.runtime.topology import TopologyConfig
@@ -158,12 +265,14 @@ def audit_ulysses_attention() -> List[Finding]:
         return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
 
     q = jnp.zeros((4, 8, 4, 8), jnp.float32)
+    args = (q, q, q)
     # attn is a static callable, not a traced array — close over it.
-    return trace_and_check(lambda q, k, v: ulysses_attention(attn, q, k, v),
-                           q, q, q, name="ulysses-attention")
+    return EntrySpec(name="ulysses-attention",
+                     fn=lambda q, k, v: ulysses_attention(attn, q, k, v),
+                     args=args, retrace_args=[args, args], gate_cheap=True)
 
 
-def audit_flash_kernel() -> List[Finding]:
+def build_flash_kernel() -> EntrySpec:
     """The in-repo Pallas flash training kernel (r6 tentpole,
     ops/transformer/pallas_flash.py): the jaxpr audit covers the wrapper's
     graph — the kernel must bind no collective and alias no donation. The
@@ -186,16 +295,53 @@ def audit_flash_kernel() -> List[Finding]:
                                       window=w, interpret=True)
 
     i32 = lambda x: jnp.asarray(x, jnp.int32)
-    return trace_and_check(fn, q, k, k, i32(0), i32(0),
-                           name="flash-attention-kernel")
+    args = (q, k, k, i32(0), i32(0))
+    return EntrySpec(name="flash-attention-kernel", fn=fn, args=args,
+                     retrace_args=[args, args])
 
 
-def audit_telemetry_off_parity() -> List[Finding]:
+def build_paged_decode() -> EntrySpec:
+    """The paged-decode serving step (inference/v2 paged_attention): one
+    new token per sequence against a blocked KV cache. Batch rides the
+    data axis; the page pool is replicated (every rank serves its own
+    requests against shared pages on the CPU audit mesh). The gather is
+    per-rank local — NO collective belongs in the compiled program, so
+    expected_spmd is empty: any partitioner-inserted gather/reduce means
+    the serving sharding regressed (the 24-request serving wall is a
+    memory/reshard problem, not a FLOPs one)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.inference.v2.kernels.paged_attention import \
+        paged_decode_attention
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
+
+    topo = topo_mod.initialize(TopologyConfig(data=-1), force=True)
+    mesh = topo.mesh
+    B, H, D, kvH, pages, page = 8, 4, 16, 2, 16, 8
+    put = lambda x, *spec: jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    q = put(jnp.zeros((B, H, D), jnp.float32), DATA_AXIS)
+    k_pages = put(jnp.zeros((kvH, pages, page, D), jnp.float32))
+    v_pages = put(jnp.zeros((kvH, pages, page, D), jnp.float32))
+    context_lens = put(jnp.ones((B,), jnp.int32), DATA_AXIS)
+    block_tables = put(jnp.zeros((B, 4), jnp.int32), DATA_AXIS)
+    args = (q, k_pages, v_pages, context_lens, block_tables)
+    return EntrySpec(name="paged-decode", fn=paged_decode_attention,
+                     args=args, mesh=mesh, retrace_args=[args, args],
+                     gate_cheap=True)
+
+
+def build_telemetry_off_parity() -> EntrySpec:
     """The telemetry zero-overhead contract (docs/OBSERVABILITY.md): the
     engine step entry point's jaxpr must be IDENTICAL with telemetry off
     and on — instrumentation is host-side spans around dispatches, never
     graph edits — and neither graph may contain a host-callback primitive
-    (the auditor's ``host-callback-in-graph`` rule covers that part)."""
+    (the auditor's ``host-callback-in-graph`` rule covers that part).
+    The parity diff runs at build time and lands in ``extra_findings``;
+    the spec's fn is the telemetry-ON step, so the Layer-C artifact (and
+    its budget) must match engine-train-step's — drift between those two
+    budget lines is itself a parity smell."""
     import tempfile
 
     import jax
@@ -229,28 +375,76 @@ def audit_telemetry_off_parity() -> List[Finding]:
             engine.state, batch, lr)
     auditor = JaxprAuditor("telemetry-off-parity")
     auditor.walk(jaxpr_on.jaxpr)
-    findings = auditor.findings
+    extra = auditor.findings
     if str(jaxpr_off) != str(jaxpr_on):
-        findings.append(Finding(
+        extra.append(Finding(
             rule_id=TELEMETRY_GRAPH_DRIFT.rule_id,
             path="<trace:telemetry-off-parity>", line=0,
             severity=SEVERITY_ERROR,
             message="engine train-step jaxpr differs between telemetry "
                     "disabled and enabled",
             fix_hint=TELEMETRY_GRAPH_DRIFT.fix_hint))
-    return findings
+    return EntrySpec(
+        name="telemetry-off-parity", fn=engine._train_step_fn,
+        args=(engine.state, batch, lr), donate_argnums=(0,),
+        mesh=engine.mesh, extra_findings=extra,
+        jit_kwargs=_fused_step_jit_kwargs(engine),
+        expected_spmd=frozenset({"all-reduce", "all-gather", "all-to-all"}))
+
+
+SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
+    "engine-train-step": build_engine_step,
+    "zero-gather-partition": build_zero_gather_partition,
+    "zeropp-micro-overlap": build_zeropp_micro_overlap,
+    "moe-dispatch": build_moe_dispatch,
+    "ring-attention": build_ring_attention,
+    "ulysses-attention": build_ulysses_attention,
+    "flash-attention-kernel": build_flash_kernel,
+    "paged-decode": build_paged_decode,
+    "telemetry-off-parity": build_telemetry_off_parity,
+}
+
+
+def build_spec(name: str) -> EntrySpec:
+    """Build one entry point's spec with a clean topology (builders that
+    configure the global MeshTopology get a fresh slate)."""
+    from deepspeed_tpu.runtime import topology as topo_mod
+
+    topo_mod.reset()
+    return SPEC_BUILDERS[name]()
+
+
+def run_entry_audit(spec: EntrySpec) -> List[Finding]:
+    """Layer B over one spec: jaxpr walk + donation + retrace + any bespoke
+    findings the builder produced."""
+    with spec.mesh_ctx():
+        findings = trace_and_check(
+            spec.fn, *spec.args, donate_argnums=spec.donate_argnums,
+            name=spec.name)
+    if spec.retrace_args is not None:
+        findings += check_retrace(spec.name, spec.retrace_args,
+                                  max_signatures=spec.max_signatures)
+    return list(spec.extra_findings) + findings
+
+
+def _make_audit(name: str) -> Callable[[], List[Finding]]:
+    def audit() -> List[Finding]:
+        return run_entry_audit(build_spec(name))
+    audit.__name__ = f"audit_{name.replace('-', '_')}"
+    return audit
 
 
 ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
-    "engine-train-step": audit_engine_step,
-    "zero-gather-partition": audit_zero_gather_partition,
-    "zeropp-micro-overlap": audit_zeropp_micro_overlap,
-    "moe-dispatch": audit_moe_dispatch,
-    "ring-attention": audit_ring_attention,
-    "ulysses-attention": audit_ulysses_attention,
-    "flash-attention-kernel": audit_flash_kernel,
-    "telemetry-off-parity": audit_telemetry_off_parity,
+    name: _make_audit(name) for name in SPEC_BUILDERS
 }
+
+#: the subset the tier-1 CI gate COMPILES (Layer C). Cheap by construction:
+#: no engine build, sub-second compiles on the CPU mesh. The full set runs
+#: via `dstpu lint --spmd` (docs/STATIC_ANALYSIS.md, "Tier-1 cost control").
+#: Pinned rather than computed — building every spec just to read its
+#: gate_cheap flag would boot engines; a test asserts the two agree.
+GATE_SPMD_ENTRY_POINTS: Tuple[str, ...] = (
+    "moe-dispatch", "paged-decode", "ring-attention", "ulysses-attention")
 
 
 def audit_entry_points(names=None) -> List[Finding]:

@@ -1,0 +1,115 @@
+"""THE lower-and-inspect path: jit -> lower -> compile -> reports.
+
+Both consumers of compiled-artifact introspection go through here:
+
+- **telemetry** (:mod:`deepspeed_tpu.telemetry.memory`) asks "how many
+  bytes will this step use" for the memory watermark report;
+- **Layer C** (:mod:`.spmd_audit`) audits the partitioned program — the
+  GSPMD-inserted collectives, the replicated intermediates, the aliasing
+  XLA actually performed, and the same memory analysis checked against the
+  committed budgets in ``tools/memory_budgets.json``.
+
+Keeping one path means the number telemetry prints at runtime and the
+number the auditor gates on are *the same computation* — a budget that
+holds in CI holds in the telemetry flush, byte for byte.
+
+Everything here is host-side: ``lower().compile()`` never executes the
+program, and on the CPU host platform (the audit mesh) compilation of the
+tiny entry points is sub-second to a few seconds each.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+#: memory_analysis fields every report carries (when the backend exposes
+#: them). ``alias_size_in_bytes`` counts donated bytes XLA actually reused.
+MEMORY_FIELDS = ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "alias_size_in_bytes",
+                 "generated_code_size_in_bytes")
+
+
+def memory_report(compiled) -> Optional[Dict[str, float]]:
+    """Byte sizes from an XLA ``Compiled``'s ``memory_analysis()``;
+    None when the backend doesn't expose it."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    out = {}
+    for f in MEMORY_FIELDS:
+        v = getattr(mem, f, None)
+        if v is not None:
+            out[f] = float(v)
+    return out or None
+
+
+@dataclasses.dataclass
+class LoweredArtifact:
+    """One entry point, lowered and compiled with its real shardings."""
+    name: str
+    closed_jaxpr: Any          # jax.core.ClosedJaxpr (source-of-truth graph)
+    compiled: Any              # jax.stages.Compiled
+    arg_leaf_counts: Tuple[int, ...]
+    donate_argnums: Tuple[int, ...]
+    _hlo_text: Optional[str] = None
+
+    @property
+    def hlo_text(self) -> str:
+        """Post-SPMD, post-optimization HLO — per-device shapes, explicit
+        collective instructions, the module-level ``input_output_alias``
+        table. Cached: ``as_text`` re-renders on every call."""
+        if self._hlo_text is None:
+            self._hlo_text = self.compiled.as_text()
+        return self._hlo_text
+
+    def memory(self) -> Optional[Dict[str, float]]:
+        return memory_report(self.compiled)
+
+
+def lower_entry(fn, args: Sequence[Any], *, kwargs: Optional[Dict] = None,
+                donate_argnums: Sequence[int] = (),
+                jit_kwargs: Optional[Dict] = None,
+                name: Optional[str] = None) -> LoweredArtifact:
+    """Trace AND compile ``fn`` exactly as the runtime would jit it.
+
+    ``args`` may be concrete (sharded) arrays or ``ShapeDtypeStruct``
+    trees carrying shardings — either way the compile sees the real
+    input shardings, so the partitioner's decisions match production.
+    ``jit_kwargs`` carries the production jit's extra arguments
+    (``in_shardings``/``out_shardings``) — donation aliasing is decided
+    at lowering against the OUTPUT shardings, so auditing without them
+    would report donations dropped that production keeps. Call under the
+    entry point's mesh context when the function relies on an ambient
+    mesh.
+    """
+    import jax
+
+    kwargs = kwargs or {}
+    name = name or getattr(fn, "__name__", "fn")
+    closed = jax.make_jaxpr(fn)(*args, **kwargs)
+    compiled = (jax.jit(fn, donate_argnums=tuple(donate_argnums),
+                        **(jit_kwargs or {}))
+                .lower(*args, **kwargs).compile())
+    leaf_counts = tuple(len(jax.tree.leaves(a)) for a in args)
+    return LoweredArtifact(name=name, closed_jaxpr=closed, compiled=compiled,
+                           arg_leaf_counts=leaf_counts,
+                           donate_argnums=tuple(donate_argnums))
+
+
+def lower_and_report(jitfn, *abstract_args) -> Optional[Dict[str, float]]:
+    """Lower+compile an already-jitted ``jitfn`` on abstract avals and
+    report its memory analysis. Compilation is cached by signature, so
+    calling this for a shape the step already ran is near-free; a NEW
+    shape pays one compile — call it per entry point, not per step.
+
+    (Telemetry's historical entry; kept here so telemetry and the Layer-C
+    auditor provably share one lowering path.)"""
+    try:
+        compiled = jitfn.lower(*abstract_args).compile()
+    except Exception:
+        return None
+    return memory_report(compiled)

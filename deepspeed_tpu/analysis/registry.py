@@ -1,6 +1,7 @@
 """Pluggable rule registry.
 
-Every rule — AST (Layer A) or jaxpr (Layer B) — registers a :class:`Rule`
+Every rule — AST (Layer A), jaxpr (Layer B) or post-SPMD compiled artifact
+(Layer C) — registers a :class:`Rule`
 descriptor here. The CLI's ``--fix-hints`` and the docs table are generated
 from this registry, and suppression comments (``# dstpu: ignore[rule-id]``)
 are validated against it, so adding a rule is: write the checker, register
@@ -14,18 +15,19 @@ from typing import Callable, Dict, List, Optional
 
 LAYER_AST = "ast"
 LAYER_JAXPR = "jaxpr"
+LAYER_SPMD = "spmd"
 
 
 @dataclasses.dataclass(frozen=True)
 class Rule:
     rule_id: str
-    layer: str           # LAYER_AST | LAYER_JAXPR
+    layer: str           # LAYER_AST | LAYER_JAXPR | LAYER_SPMD
     severity: str        # default severity of findings from this rule
     description: str     # one-liner for docs / --fix-hints
     fix_hint: str        # how to fix, rendered with the finding
 
     def __post_init__(self):
-        assert self.layer in (LAYER_AST, LAYER_JAXPR), self.layer
+        assert self.layer in (LAYER_AST, LAYER_JAXPR, LAYER_SPMD), self.layer
 
 
 _RULES: Dict[str, Rule] = {}

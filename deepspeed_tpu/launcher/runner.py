@@ -427,7 +427,10 @@ def _run_slurm(args, active: Dict[str, List[int]]) -> int:
 def main(args=None) -> int:
     argv = sys.argv[1:] if args is None else list(args)
     if argv and argv[0] == "lint":
-        # `dstpu lint ...` — the static analysis suite, not a launch.
+        # `dstpu lint ...` — the static analysis suite, not a launch
+        # (AST layer; --jaxpr traces entry points; --spmd compiles them
+        # and audits the partitioned artifact against
+        # tools/memory_budgets.json — see docs/STATIC_ANALYSIS.md).
         from ..analysis.cli import main as lint_main
         return lint_main(argv[1:])
     args = parse_args(args)

@@ -26,35 +26,22 @@ import jax
 
 def compiled_memory_report(compiled) -> Optional[Dict[str, float]]:
     """Byte sizes from an XLA ``Compiled``'s ``memory_analysis()``;
-    None when the backend doesn't expose it (CPU host platform often
-    doesn't)."""
-    try:
-        mem = compiled.memory_analysis()
-    except Exception:
-        return None
-    if mem is None:
-        return None
-    fields = ("argument_size_in_bytes", "output_size_in_bytes",
-              "temp_size_in_bytes", "alias_size_in_bytes",
-              "generated_code_size_in_bytes")
-    out = {}
-    for f in fields:
-        v = getattr(mem, f, None)
-        if v is not None:
-            out[f] = float(v)
-    return out or None
+    None when the backend doesn't expose it. Thin delegate to
+    :mod:`deepspeed_tpu.analysis.lowering` — telemetry and the Layer-C
+    SPMD auditor share ONE lower-and-inspect path, so the bytes reported
+    at runtime are the bytes the lint budgets gate on."""
+    from ..analysis.lowering import memory_report
+    return memory_report(compiled)
 
 
 def lower_and_report(jitfn, *abstract_args) -> Optional[Dict[str, float]]:
     """Lower+compile ``jitfn`` on abstract avals and report its memory
     analysis. Compilation is cached by signature, so calling this for a
     shape the step already ran is near-free; a NEW shape pays one compile
-    — call it per entry point, not per step."""
-    try:
-        compiled = jitfn.lower(*abstract_args).compile()
-    except Exception:
-        return None
-    return compiled_memory_report(compiled)
+    — call it per entry point, not per step. (Delegates to
+    ``analysis.lowering.lower_and_report`` — the shared path.)"""
+    from ..analysis.lowering import lower_and_report as _lar
+    return _lar(jitfn, *abstract_args)
 
 
 class MemoryTracker:

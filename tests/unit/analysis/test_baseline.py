@@ -1,0 +1,76 @@
+"""Baseline mechanics: multiset diff semantics, the three-way layer split
+the partial-run flows depend on, and shrink enforcement (stale entries are
+failures, so the file can only move toward empty)."""
+
+from deepspeed_tpu.analysis.baseline import (by_layer, diff_against_baseline,
+                                             finding_layer, load_baseline,
+                                             split_layers, write_baseline)
+from deepspeed_tpu.analysis.findings import Finding, SEVERITY_ERROR
+
+
+def _f(rule="r", path="p.py", line=1, message="m"):
+    return Finding(rule_id=rule, path=path, line=line,
+                   severity=SEVERITY_ERROR, message=message)
+
+
+def test_diff_new_vs_grandfathered():
+    base = [_f(message="old")]
+    new, stale = diff_against_baseline([_f(message="old"),
+                                        _f(message="new")], base)
+    assert [f.message for f in new] == ["new"]
+    assert stale == []
+
+
+def test_diff_multiset_semantics():
+    # two identical findings on different lines share a baseline key (line
+    # numbers are display-only): one baseline entry grandfathers exactly one
+    base = [_f(line=1)]
+    new, stale = diff_against_baseline([_f(line=1), _f(line=99)], base)
+    assert len(new) == 1 and stale == []
+
+
+def test_stale_entries_detected():
+    new, stale = diff_against_baseline([], [_f()])
+    assert new == [] and [f.message for f in stale] == ["m"]
+
+
+def test_finding_layer_markers():
+    assert finding_layer(_f(path="runtime/engine.py")) == "ast"
+    assert finding_layer(_f(path="<trace:engine-train-step>")) == "jaxpr"
+    assert finding_layer(_f(path="<spmd:engine-train-step>")) == "spmd"
+
+
+def test_split_layers_three_way():
+    ast, jaxpr, spmd = split_layers([
+        _f(path="a.py"), _f(path="<trace:e>"), _f(path="<spmd:e>")])
+    assert [f.path for f in ast] == ["a.py"]
+    assert [f.path for f in jaxpr] == ["<trace:e>"]
+    assert [f.path for f in spmd] == ["<spmd:e>"]
+    layers = by_layer([_f(path="<spmd:e>")])
+    assert [f.path for f in layers["spmd"]] == ["<spmd:e>"]
+    assert layers["ast"] == [] and layers["jaxpr"] == []
+
+
+def test_write_load_roundtrip_sorted(tmp_path):
+    path = str(tmp_path / "b.json")
+    fs = [_f(path="z.py"), _f(path="a.py"), _f(path="<spmd:e>")]
+    write_baseline(path, fs)
+    loaded = load_baseline(path)
+    assert [f.path for f in loaded] == ["<spmd:e>", "a.py", "z.py"]
+    # a clean round-trip: nothing new, nothing stale
+    new, stale = diff_against_baseline(fs, loaded)
+    assert new == [] and stale == []
+
+
+def test_shrink_enforcement_via_stale(tmp_path):
+    # the shrink contract: a fixed finding makes its baseline entry stale,
+    # and stale is a FAILURE in the CLI/gate — the file cannot keep entries
+    # for findings that no longer fire, so it only ever shrinks
+    path = str(tmp_path / "b.json")
+    write_baseline(path, [_f(), _f(message="second")])
+    still_firing = [_f()]
+    new, stale = diff_against_baseline(still_firing, load_baseline(path))
+    assert new == []
+    assert [f.message for f in stale] == ["second"]
+    write_baseline(path, still_firing)  # regenerate after the fix
+    assert len(load_baseline(path)) == 1

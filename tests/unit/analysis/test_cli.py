@@ -1,0 +1,256 @@
+"""CLI driver contract: exit codes, JSON output, suppression round-trip,
+baseline layer carryover, and the --update-budgets shrink-only flow.
+
+The expensive layers are exercised elsewhere (test_lint_clean runs the real
+audits); here run_spmd_layer is monkeypatched where the test only cares
+about the driver's plumbing, so the whole module stays sub-second.
+"""
+
+import json
+import textwrap
+
+from deepspeed_tpu.analysis import cli
+from deepspeed_tpu.analysis.baseline import load_baseline, write_baseline
+from deepspeed_tpu.analysis.budgets import load_budgets, write_budgets
+from deepspeed_tpu.analysis.findings import Finding, SEVERITY_ERROR
+from deepspeed_tpu.analysis.spmd_audit import SpmdReport
+
+VIOLATION = textwrap.dedent("""
+    import jax
+
+    def grad_sync(g):
+        return jax.lax.psum(g, "data")
+""")
+
+CLEAN = "x = 1\n"
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(src)
+    return str(p)
+
+
+def _empty_baseline(tmp_path):
+    p = str(tmp_path / "baseline.json")
+    write_baseline(p, [])
+    return p
+
+
+def test_exit_zero_on_clean_file(tmp_path, capsys):
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    assert "0 new" in capsys.readouterr().out
+
+
+def test_exit_one_on_new_finding(tmp_path, capsys):
+    rc = cli.main([_write(tmp_path, "bad.py", VIOLATION),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 1
+    assert "literal-axis-name" in capsys.readouterr().out
+
+
+def test_exit_two_on_missing_path(tmp_path, capsys):
+    rc = cli.main([str(tmp_path / "nope.py")])
+    assert rc == 2
+
+
+def test_exit_two_on_unknown_entry(tmp_path, capsys):
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--jaxpr",
+                   "--entry", "no-such-entry"])
+    assert rc == 2
+    assert "unknown entry point" in capsys.readouterr().err
+
+
+def test_suppression_roundtrip(tmp_path):
+    suppressed = VIOLATION.replace(
+        '"data")', '"data")  # dstpu: ignore[literal-axis-name]')
+    rc = cli.main([_write(tmp_path, "sup.py", suppressed),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+
+
+def test_grandfathered_finding_passes_then_goes_stale(tmp_path, capsys):
+    bad = _write(tmp_path, "bad.py", VIOLATION)
+    baseline = str(tmp_path / "baseline.json")
+    assert cli.main([bad, "--write-baseline", "--baseline", baseline]) == 0
+    # grandfathered: same finding, exit 0
+    assert cli.main([bad, "--baseline", baseline]) == 0
+    # fixed: the baseline entry is now stale, which must ALSO fail (shrink
+    # enforcement — the file cannot rot)
+    (tmp_path / "bad.py").write_text(CLEAN)
+    capsys.readouterr()
+    rc = cli.main([bad, "--baseline", baseline])
+    assert rc == 1
+    assert "stale baseline" in capsys.readouterr().out
+
+
+def test_json_output_machine_readable(tmp_path, capsys):
+    rc = cli.main([_write(tmp_path, "bad.py", VIOLATION), "--json",
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["new"], payload
+    assert payload["new"][0]["rule_id"] == "literal-axis-name"
+    assert "spmd_reports" not in payload  # --spmd did not run
+
+
+def test_json_stdout_stays_pure_under_framework_logging(tmp_path, capsys,
+                                                        monkeypatch):
+    # the audits boot engines whose framework logger writes INFO to
+    # stdout — a --json run must still emit parseable JSON on stdout
+    from deepspeed_tpu.utils.logging import logger as fw_logger
+
+    def noisy(entry_names=None, budgets_path=None):
+        fw_logger.info("engine boot chatter")
+        return [], {}, False
+
+    monkeypatch.setattr(cli, "run_spmd_layer", noisy)
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--spmd", "--json",
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    out, err = capsys.readouterr()
+    json.loads(out)  # must parse — chatter went to stderr
+    assert "engine boot chatter" in err
+
+
+def test_write_baseline_carries_over_layers_that_did_not_run(tmp_path):
+    baseline = str(tmp_path / "baseline.json")
+    spmd_entry = Finding(rule_id="implicit-reshard", path="<spmd:e>", line=0,
+                         severity=SEVERITY_ERROR, message="m")
+    trace_entry = Finding(rule_id="retrace-hazard", path="<trace:e>", line=0,
+                          severity=SEVERITY_ERROR, message="m")
+    write_baseline(baseline, [spmd_entry, trace_entry])
+    # AST-only regenerate must not drop the jaxpr/spmd slices
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN),
+                   "--write-baseline", "--baseline", baseline])
+    assert rc == 0
+    kept = {f.path for f in load_baseline(baseline)}
+    assert kept == {"<spmd:e>", "<trace:e>"}
+
+
+def _fake_spmd(findings, reports):
+    def run(entry_names=None, budgets_path=None):
+        return findings, reports, True
+    return run
+
+
+def test_spmd_findings_and_reports_flow_through_json(tmp_path, monkeypatch,
+                                                     capsys):
+    report = SpmdReport(name="e", memory={"temp_size_in_bytes": 7.0},
+                        collective_counts={"all-gather": 1},
+                        collective_bytes=42)
+    finding = Finding(rule_id="implicit-reshard", path="<spmd:e>", line=0,
+                      severity=SEVERITY_ERROR, message="inserted all-gather")
+    monkeypatch.setattr(cli, "run_spmd_layer",
+                        _fake_spmd([finding], {"e": report}))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--spmd", "--json",
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["spmd_reports"]["e"]["collective_bytes"] == 42
+    assert payload["budgets_checked"] is True
+    assert payload["new"][0]["rule_id"] == "implicit-reshard"
+
+
+def test_update_budgets_writes_only_downward(tmp_path, monkeypatch, capsys):
+    budgets_path = str(tmp_path / "memory_budgets.json")
+    import jax
+    write_budgets(budgets_path, {"mesh_devices": jax.device_count(),
+                                 "budgets": {"e": {
+                                     "temp_size_in_bytes": 100,
+                                     "collective_bytes": 10}}})
+    report = SpmdReport(name="e",
+                        memory={"temp_size_in_bytes": 60.0},  # shrank
+                        collective_counts={},
+                        collective_bytes=25)                  # regressed
+    monkeypatch.setattr(cli, "run_spmd_layer", _fake_spmd([], {"e": report}))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--update-budgets",
+                   "--budgets", budgets_path,
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    out = capsys.readouterr()
+    merged = load_budgets(budgets_path)["budgets"]["e"]
+    assert merged["temp_size_in_bytes"] == 60   # lowered
+    assert merged["collective_bytes"] == 10     # NOT raised
+    assert "NOT raised" in out.err
+
+
+def test_update_budgets_refuses_mismatched_audit_mesh(tmp_path, monkeypatch,
+                                                      capsys):
+    # budgets taken on a different device count must never be overwritten
+    # by numbers from this environment — the partitioning differs; and the
+    # refusal must come BEFORE the expensive compile audit runs
+    budgets_path = str(tmp_path / "memory_budgets.json")
+    write_budgets(budgets_path, {"mesh_devices": 3, "budgets": {
+        "e": {"temp_size_in_bytes": 100}}})
+
+    def must_not_run(entry_names=None, budgets_path=None):
+        raise AssertionError("audit ran before the mesh check")
+
+    monkeypatch.setattr(cli, "run_spmd_layer", must_not_run)
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--update-budgets",
+                   "--budgets", budgets_path,
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 2
+    assert "refusing" in capsys.readouterr().err
+    assert load_budgets(budgets_path)["budgets"]["e"] == {
+        "temp_size_in_bytes": 100}  # untouched
+
+
+def test_spmd_with_missing_explicit_budgets_path_is_usage_error(
+        tmp_path, monkeypatch, capsys):
+    # a typo'd --budgets path must not silently disable the budget gate
+    def must_not_run(entry_names=None, budgets_path=None):
+        raise AssertionError("audit ran despite the bad budgets path")
+
+    monkeypatch.setattr(cli, "run_spmd_layer", must_not_run)
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--spmd",
+                   "--budgets", str(tmp_path / "typo.json"),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 2
+    assert "no such budgets file" in capsys.readouterr().err
+
+
+def test_spmd_missing_budgets_file_prints_skip_note(tmp_path, monkeypatch,
+                                                    capsys):
+    # run_spmd_layer with no budgets file must say the gate was skipped —
+    # a silent skip reads as a pass
+    from deepspeed_tpu.analysis import spmd_audit
+
+    monkeypatch.setattr(spmd_audit, "audit_spmd_entry_points",
+                        lambda names=None, budgets=None: ([], {}))
+    findings, reports, checked = cli.run_spmd_layer(
+        budgets_path=str(tmp_path / "absent.json"))
+    assert findings == [] and reports == {} and checked is False
+    assert "budget checks skipped" in capsys.readouterr().err
+
+
+def test_update_budgets_json_keeps_stdout_pure(tmp_path, monkeypatch,
+                                               capsys):
+    budgets_path = str(tmp_path / "b.json")
+    report = SpmdReport(name="e", memory={"temp_size_in_bytes": 9.0},
+                        collective_counts={}, collective_bytes=3)
+    monkeypatch.setattr(cli, "run_spmd_layer", _fake_spmd([], {"e": report}))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--update-budgets",
+                   "--json", "--budgets", budgets_path,
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    out, err = capsys.readouterr()
+    json.loads(out)              # the 'wrote N entries' line went to stderr
+    assert "budget entr" in err
+
+
+def test_update_budgets_creates_missing_file(tmp_path, monkeypatch):
+    # bootstrap: --update-budgets with a not-yet-existing file writes it
+    budgets_path = str(tmp_path / "new_budgets.json")
+    report = SpmdReport(name="e", memory={"temp_size_in_bytes": 9.0},
+                        collective_counts={}, collective_bytes=3)
+    monkeypatch.setattr(cli, "run_spmd_layer", _fake_spmd([], {"e": report}))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--update-budgets",
+                   "--budgets", budgets_path,
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    assert load_budgets(budgets_path)["budgets"]["e"] == {
+        "temp_size_in_bytes": 9, "collective_bytes": 3}

@@ -3,20 +3,38 @@
 This is `dstpu lint` running inside the tier-1 pytest invocation — the fast
 AST layer over the whole package diffed against the checked-in baseline,
 plus the jaxpr audits over the real traced entry points (the conftest
-already pins JAX_PLATFORMS=cpu with an 8-device host mesh). A failure here
-means a new TPU-graph invariant violation: fix it (preferred) or suppress
-with `# dstpu: ignore[rule-id]`; never grow tools/lint_baseline.json.
+already pins JAX_PLATFORMS=cpu with an 8-device host mesh), plus the
+Layer-C compiled-artifact audit over the CHEAP entry-point subset
+(GATE_SPMD_ENTRY_POINTS: no engine build, sub-second compiles) checked
+against the committed shrink-only tools/memory_budgets.json. The full
+Layer-C set runs off-gate via `dstpu lint --spmd` (docs/STATIC_ANALYSIS.md,
+"Tier-1 cost control"). A failure here means a new TPU-graph invariant
+violation: fix it (preferred), suppress with `# dstpu: ignore[rule-id]`
+(Layer A), or — for a justified budget increase — raise the budget BY HAND
+in tools/memory_budgets.json; never grow tools/lint_baseline.json.
 """
 
 import os
+import time
 
 import pytest
 
 from deepspeed_tpu.analysis.baseline import (default_baseline_path,
                                              diff_against_baseline,
                                              load_baseline, split_layers)
+from deepspeed_tpu.analysis.budgets import (default_budgets_path,
+                                            env_matches, load_budgets)
 from deepspeed_tpu.analysis.cli import run_ast_layer
-from deepspeed_tpu.analysis.entry_points import ENTRY_POINTS, audit_entry_points
+from deepspeed_tpu.analysis.entry_points import (ENTRY_POINTS,
+                                                 GATE_SPMD_ENTRY_POINTS,
+                                                 SPEC_BUILDERS,
+                                                 audit_entry_points)
+
+#: wall-time budget for the Layer-C gate subset (satellite: the gate must
+#: stay cheap — the 4 engineless specs compile in ~3-5 s on the CPU mesh;
+#: 120 s leaves headroom for a cold, loaded CI host without letting an
+#: engine-building spec sneak into the subset unnoticed)
+GATE_SPMD_WALL_BUDGET_S = 120.0
 
 PACKAGE = os.path.join(os.path.dirname(default_baseline_path()), os.pardir,
                        "deepspeed_tpu")
@@ -50,3 +68,77 @@ def test_jaxpr_entry_point_clean(entry):
                 if f.path == f"<trace:{entry}>"]
     new, _ = diff_against_baseline(findings, baseline)
     assert not new, f"jaxpr audit findings:\n{_render(new)}"
+
+
+# ---------------------------------------------------------------------------
+# Layer C gate: compile the cheap subset, audit against committed budgets
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spmd_gate_run():
+    """ONE compile pass over the cheap subset for the whole module — the
+    per-rule assertions below read from it instead of recompiling."""
+    from deepspeed_tpu.analysis.spmd_audit import audit_spmd_entry_points
+
+    budgets = load_budgets(default_budgets_path())
+    start = time.monotonic()
+    findings, reports = audit_spmd_entry_points(
+        list(GATE_SPMD_ENTRY_POINTS),
+        budgets=budgets if env_matches(budgets) else None)
+    elapsed = time.monotonic() - start
+    return findings, reports, elapsed, budgets
+
+
+def test_spmd_gate_subset_clean(spmd_gate_run):
+    findings, reports, _, _ = spmd_gate_run
+    baseline = split_layers(load_baseline(default_baseline_path()))[2]
+    new, _ = diff_against_baseline(findings, baseline)
+    assert not new, f"Layer-C audit findings:\n{_render(new)}"
+    assert set(reports) == set(GATE_SPMD_ENTRY_POINTS)
+
+
+def test_spmd_gate_budgets_were_checked(spmd_gate_run):
+    # the conftest pins the 8-device host mesh, so the committed budgets
+    # MUST be comparable here — a silently skipped budget check would turn
+    # the gate into a no-op
+    _, _, _, budgets = spmd_gate_run
+    assert budgets is not None, "tools/memory_budgets.json missing"
+    assert env_matches(budgets), (
+        "audit mesh mismatch: budgets committed for "
+        f"{budgets['mesh_devices']} devices")
+
+
+def test_spmd_gate_stays_under_wall_budget(spmd_gate_run):
+    _, _, elapsed, _ = spmd_gate_run
+    assert elapsed < GATE_SPMD_WALL_BUDGET_S, (
+        f"Layer-C gate subset took {elapsed:.1f}s (> "
+        f"{GATE_SPMD_WALL_BUDGET_S}s) — an expensive spec crept into "
+        "GATE_SPMD_ENTRY_POINTS; move it to the off-gate `dstpu lint "
+        "--spmd` set")
+
+
+def test_gate_subset_matches_spec_flags():
+    # the pinned gate list and the per-spec gate_cheap flags must agree —
+    # building only the CHEAP specs to check (engine specs are the
+    # expensive ones the pin exists to avoid)
+    from deepspeed_tpu.analysis.entry_points import build_spec
+
+    for name in GATE_SPMD_ENTRY_POINTS:
+        assert build_spec(name).gate_cheap, (
+            f"{name} is pinned in GATE_SPMD_ENTRY_POINTS but its spec does "
+            "not declare gate_cheap")
+
+
+def test_every_entry_point_has_a_committed_budget():
+    # shrink-only file integrity: every registered entry point is budgeted
+    # (a new entry lands with its budget in the same PR) and every budget
+    # names only registered entry points (no rot)
+    budgets = load_budgets(default_budgets_path())
+    assert budgets is not None
+    assert set(budgets["budgets"]) == set(SPEC_BUILDERS), (
+        "tools/memory_budgets.json out of sync with registered entry "
+        "points — run `dstpu lint --update-budgets` (new entries) or "
+        "delete the stale key by hand")
+    for name, entry in budgets["budgets"].items():
+        assert entry, f"empty budget for {name}"
+        assert all(v >= 0 for v in entry.values())

@@ -1,0 +1,360 @@
+"""Layer C rule fixtures: each compiled-artifact rule proven to fire on an
+injected regression and to stay quiet on the healthy version.
+
+The acceptance fixture from ISSUE 5 lives here: a deliberately mis-sharded
+matmul (contraction dim sharded on both operands) must produce BOTH an
+``implicit-reshard`` finding (GSPMD materializes an all-gather to fix the
+operands up) and a ``memory-budget-regression`` finding against a
+committed budget sized for the well-sharded program.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.analysis.budgets import (env_matches, load_budgets,
+                                            shrink_budgets, write_budgets)
+from deepspeed_tpu.analysis.entry_points import EntrySpec
+from deepspeed_tpu.analysis.lowering import lower_and_report, lower_entry
+from deepspeed_tpu.analysis.spmd_audit import (audit_spec_spmd,
+                                               collective_summary,
+                                               parse_alias_params,
+                                               source_collective_kinds)
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="audit mesh needs 8 host devices")
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+
+
+def _rule_ids(findings):
+    return [f.rule_id for f in findings]
+
+
+def _put(mesh, x, *spec):
+    return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+
+# ---------------------------------------------------------------------------
+# implicit-reshard + memory-budget-regression (the ISSUE 5 acceptance pair)
+# ---------------------------------------------------------------------------
+
+def _missharded_matmul_spec(mesh):
+    # contraction dim of w sharded: GSPMD must all-gather w to compute the
+    # dot — the classic silent reshard
+    x = _put(mesh, jnp.zeros((128, 64), jnp.float32), "data")
+    w = _put(mesh, jnp.zeros((64, 32), jnp.float32), "data")
+    return EntrySpec(name="fixture-missharded-matmul",
+                     fn=lambda x, w: x @ w, args=(x, w), mesh=mesh)
+
+
+def test_missharded_matmul_fires_implicit_reshard_and_budget_regression():
+    mesh = _mesh()
+    spec = _missharded_matmul_spec(mesh)
+    budgets = {"mesh_devices": 8, "budgets": {
+        # budget committed for the WELL-sharded program: tiny temps, zero
+        # collective traffic
+        "fixture-missharded-matmul": {"temp_size_in_bytes": 1,
+                                      "collective_bytes": 0}}}
+    findings, report = audit_spec_spmd(spec, budgets=budgets)
+    ids = _rule_ids(findings)
+    assert "implicit-reshard" in ids, findings
+    assert "memory-budget-regression" in ids, findings
+    assert report.collective_counts.get("all-gather"), report
+    [f] = [f for f in findings if f.rule_id == "implicit-reshard"]
+    assert "all-gather" in f.message
+    assert f.path == "<spmd:fixture-missharded-matmul>"
+
+
+def test_well_sharded_matmul_is_clean():
+    mesh = _mesh()
+    x = _put(mesh, jnp.zeros((128, 64), jnp.float32), "data")
+    w = _put(mesh, jnp.zeros((64, 32), jnp.float32))  # replicated weights
+    spec = EntrySpec(name="fixture-clean-matmul", fn=lambda x, w: x @ w,
+                     args=(x, w), mesh=mesh)
+    findings, report = audit_spec_spmd(spec)
+    assert findings == []
+    assert report.collective_bytes == 0
+
+
+def test_declared_expected_spmd_kind_is_not_a_finding():
+    mesh = _mesh()
+    spec = _missharded_matmul_spec(mesh)
+    spec.expected_spmd = frozenset({"all-gather"})
+    findings, _ = audit_spec_spmd(spec)
+    assert "implicit-reshard" not in _rule_ids(findings)
+
+
+def test_source_collective_kind_is_expected():
+    # a psum the SOURCE jaxpr names is not "implicit": all-reduce expected
+    mesh = _mesh()
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    def fn(x):
+        return shard_map(lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+                         in_specs=P("data"), out_specs=P())(x)
+
+    x = _put(mesh, jnp.zeros((8, 16), jnp.float32), "data")
+    spec = EntrySpec(name="fixture-explicit-psum", fn=fn, args=(x,),
+                     mesh=mesh)
+    findings, report = audit_spec_spmd(spec)
+    assert "implicit-reshard" not in _rule_ids(findings)
+    assert report.collective_counts.get("all-reduce")
+
+
+# ---------------------------------------------------------------------------
+# replicated-large-intermediate
+# ---------------------------------------------------------------------------
+
+def test_replicated_large_intermediate_fires():
+    mesh = _mesh()
+    x = _put(mesh, jnp.zeros((256, 256), jnp.float32))  # replicated
+    spec = EntrySpec(name="fixture-replicated", args=(x,), mesh=mesh,
+                     fn=lambda x: (x @ x).sum())
+    # the 256x256 fp32 dot result (256 KiB) materializes at full logical
+    # size on all 8 devices
+    findings, _ = audit_spec_spmd(spec, replicated_bytes=1 << 16)
+    [f] = [f for f in findings
+           if f.rule_id == "replicated-large-intermediate"]
+    assert "f32[256, 256]" in f.message and "8-device" in f.message
+
+
+def test_replicated_rule_quiet_above_default_threshold():
+    mesh = _mesh()
+    x = _put(mesh, jnp.zeros((256, 256), jnp.float32))
+    spec = EntrySpec(name="fixture-replicated", args=(x,), mesh=mesh,
+                     fn=lambda x: (x @ x).sum())
+    findings, _ = audit_spec_spmd(spec)  # default threshold is 64 MiB
+    assert "replicated-large-intermediate" not in _rule_ids(findings)
+
+
+def test_sharded_intermediate_quiet():
+    mesh = _mesh()
+    x = _put(mesh, jnp.zeros((256, 256), jnp.float32), "data")
+    spec = EntrySpec(name="fixture-sharded", args=(x,), mesh=mesh,
+                     fn=lambda x: (x * 2.0).sum())
+    # the intermediate stays row-sharded: per-device shape is 32x256, which
+    # never matches the full logical 256x256
+    findings, _ = audit_spec_spmd(spec, replicated_bytes=1 << 16)
+    assert "replicated-large-intermediate" not in _rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# remat-residual-full-param
+# ---------------------------------------------------------------------------
+
+def test_scan_residual_holding_full_param_fires():
+    p = jnp.zeros((64, 64), jnp.float32)
+
+    def fn(p, xs):
+        def body(c, x):
+            return c + x @ p, p  # stacks the FULL param once per layer
+        return jax.lax.scan(body, jnp.zeros((4, 64)), xs)
+
+    spec = EntrySpec(name="fixture-param-residual", fn=fn,
+                     args=(p, jnp.zeros((3, 4, 64))),
+                     param_shapes=frozenset({((64, 64), "float32")}))
+    findings, _ = audit_spec_spmd(spec, residual_bytes=1 << 10)
+    [f] = [f for f in findings if f.rule_id == "remat-residual-full-param"]
+    assert "float32[3, 64, 64]" in f.message
+
+
+def test_scan_carry_holding_param_is_exempt():
+    # the pipelined schedule's prefetch CARRY legitimately holds one
+    # gathered layer — only stacked residuals violate the invariant
+    p = jnp.zeros((64, 64), jnp.float32)
+
+    def fn(p, xs):
+        def body(carry, x):
+            acts, buf = carry
+            return (acts + x @ buf, buf), acts.sum()
+        return jax.lax.scan(body, (jnp.zeros((4, 64)), p), xs)
+
+    spec = EntrySpec(name="fixture-param-carry", fn=fn,
+                     args=(p, jnp.zeros((3, 4, 64))),
+                     param_shapes=frozenset({((64, 64), "float32")}))
+    findings, _ = audit_spec_spmd(spec, residual_bytes=1 << 10)
+    assert "remat-residual-full-param" not in _rule_ids(findings)
+
+
+def test_activation_residuals_quiet():
+    p = jnp.zeros((64, 64), jnp.float32)
+
+    def fn(p, xs):
+        def body(c, x):
+            h = x @ p
+            return c + h, h  # residual is the activation — the design
+        return jax.lax.scan(body, jnp.zeros((4, 64)), xs)
+
+    spec = EntrySpec(name="fixture-act-residual", fn=fn,
+                     args=(p, jnp.zeros((3, 4, 64))),
+                     param_shapes=frozenset({((64, 64), "float32")}))
+    findings, _ = audit_spec_spmd(spec, residual_bytes=1 << 10)
+    assert "remat-residual-full-param" not in _rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# dead-donation
+# ---------------------------------------------------------------------------
+
+def test_dead_donation_fires_when_xla_drops_the_alias():
+    import warnings
+
+    buf = jnp.zeros((128, 128), jnp.float32)
+    x = jnp.ones((8,), jnp.float32)
+    spec = EntrySpec(name="fixture-dead-donation",
+                     fn=lambda buf, x: x * 2.0,  # buf never aliases anything
+                     args=(buf, x), donate_argnums=(0,))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # jax warns on the unused donation
+        findings, _ = audit_spec_spmd(spec)
+    [f] = [f for f in findings if f.rule_id == "dead-donation"]
+    assert "65536 B" in f.message  # 128*128*4
+
+
+def test_honored_donation_quiet():
+    buf = jnp.zeros((128, 128), jnp.float32)
+    spec = EntrySpec(name="fixture-live-donation",
+                     fn=lambda buf: buf + 1.0, args=(buf,),
+                     donate_argnums=(0,))
+    findings, _ = audit_spec_spmd(spec)
+    assert "dead-donation" not in _rule_ids(findings)
+
+
+# ---------------------------------------------------------------------------
+# memory budgets: shrink-only mechanics
+# ---------------------------------------------------------------------------
+
+def test_budget_missing_entry_is_a_finding():
+    spec = EntrySpec(name="fixture-unbudgeted", fn=lambda x: x + 1.0,
+                     args=(jnp.zeros((4,)),))
+    budgets = {"mesh_devices": 8, "budgets": {}}
+    findings, _ = audit_spec_spmd(spec, budgets=budgets)
+    [f] = [f for f in findings if f.rule_id == "memory-budget-regression"]
+    assert "no committed budget" in f.message
+
+
+def test_budget_within_limits_quiet():
+    spec = EntrySpec(name="fixture-budgeted", fn=lambda x: x + 1.0,
+                     args=(jnp.zeros((4,)),))
+    budgets = {"mesh_devices": 8, "budgets": {
+        "fixture-budgeted": {"temp_size_in_bytes": 1 << 30,
+                             "collective_bytes": 1 << 30}}}
+    findings, _ = audit_spec_spmd(spec, budgets=budgets)
+    assert "memory-budget-regression" not in _rule_ids(findings)
+
+
+def test_shrink_budgets_only_goes_down():
+    old = {"mesh_devices": 8, "budgets": {
+        "a": {"temp_size_in_bytes": 100, "collective_bytes": 50}}}
+    reports = {"a": {"temp_size_in_bytes": 80, "collective_bytes": 70},
+               "b": {"temp_size_in_bytes": 10}}
+    merged, exceeded = shrink_budgets(old, reports, 8)
+    assert merged["budgets"]["a"]["temp_size_in_bytes"] == 80  # shrank
+    assert merged["budgets"]["a"]["collective_bytes"] == 50    # NOT raised
+    assert exceeded == ["a.collective_bytes"]
+    assert merged["budgets"]["b"] == {"temp_size_in_bytes": 10}  # new entry
+
+
+def test_budgets_roundtrip_and_env_match(tmp_path):
+    path = str(tmp_path / "memory_budgets.json")
+    write_budgets(path, {"mesh_devices": 8, "budgets": {
+        "e": {"temp_size_in_bytes": 5}}})
+    loaded = load_budgets(path)
+    assert loaded["budgets"]["e"]["temp_size_in_bytes"] == 5
+    assert env_matches(loaded) == (jax.device_count() == 8)
+    assert not env_matches({"mesh_devices": 3, "budgets": {}})
+    assert not env_matches(None)
+    assert load_budgets(str(tmp_path / "missing.json")) is None
+
+
+def test_budgets_file_drops_untracked_fields(tmp_path):
+    path = str(tmp_path / "b.json")
+    with open(path, "w") as fh:
+        json.dump({"mesh_devices": 8, "budgets": {
+            "e": {"temp_size_in_bytes": 5, "bogus_field": 7}}}, fh)
+    assert load_budgets(path)["budgets"]["e"] == {"temp_size_in_bytes": 5}
+
+
+# ---------------------------------------------------------------------------
+# lower-failed + parser units + shared-lowering parity
+# ---------------------------------------------------------------------------
+
+def test_uncompilable_spec_is_a_hard_finding():
+    def broken(x):
+        raise RuntimeError("boom at trace time")
+
+    spec = EntrySpec(name="fixture-broken", fn=broken,
+                     args=(jnp.zeros((4,)),))
+    findings, report = audit_spec_spmd(spec)
+    assert report is None
+    [f] = findings
+    assert f.rule_id == "spmd-lower-failed" and "boom" in f.message
+
+
+_SYNTHETIC_HLO = """
+HloModule jit_fn, is_scheduled=true, input_output_alias={ {0}: (1, {}, may-alias), {1}: (3, {}, must-alias) }, entry_computation_layout={...}
+
+%fused (p: f32[16,32]) -> f32[16,32] {
+  %p = f32[16,32]{1,0} parameter(0)
+  ROOT %m = f32[16,32]{1,0} multiply(%p, %p)
+}
+
+ENTRY %main {
+  %param = f32[8,64]{1,0} parameter(0)
+  %ag = f32[64,64]{1,0} all-gather(%param), dimensions={0}
+  %ags = (f32[8,64]{1,0}, f32[64,64]{1,0}) all-gather-start(%param)
+  %agd = f32[64,64]{1,0} all-gather-done(%ags)
+  %ar.s = bf16[128]{0} all-reduce-start(%x)
+  %ar.d = bf16[128]{0} all-reduce-done(%ar.s)
+  %cp = (s32[4]{0}, s32[4]{0}) collective-permute(%y, %z)
+  ROOT %dot = f32[16,32]{1,0} fusion(%ag), kind=kOutput, calls=%fused
+}
+"""
+
+
+def test_collective_summary_parses_shapes_async_and_tuples():
+    summary = collective_summary(_SYNTHETIC_HLO)
+    # the async all-gather-start tuple is (operand alias, result): only the
+    # result half is charged, and -done is never double-counted
+    assert summary["all-gather"] == (2, 2 * 64 * 64 * 4)
+    assert summary["all-reduce"] == (1, 128 * 2)   # -start counted, -done not
+    assert summary["collective-permute"] == (1, 2 * 4 * 4)
+
+
+def test_parse_alias_params_reads_the_module_table():
+    assert parse_alias_params(_SYNTHETIC_HLO) == {1, 3}
+    assert parse_alias_params("HloModule bare") is None
+
+
+def test_source_collective_kinds_maps_primitives():
+    mesh = _mesh()
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    def fn(x):
+        return shard_map(lambda v: jax.lax.psum(v, "data"), mesh=mesh,
+                         in_specs=P("data"), out_specs=P())(x)
+
+    closed = jax.make_jaxpr(fn)(jnp.zeros((8, 4)))
+    assert "all-reduce" in source_collective_kinds(closed)
+
+
+def test_telemetry_and_auditor_share_one_lowering_path():
+    # satellite 1: the bytes telemetry reports ARE the bytes the auditor
+    # budgets on — same function, same numbers
+    fn = lambda x: (x @ x).sum()
+    x = jnp.zeros((64, 64), jnp.float32)
+    artifact = lower_entry(fn, (x,), name="parity")
+    via_auditor = artifact.memory()
+    from deepspeed_tpu.telemetry.memory import \
+        lower_and_report as telemetry_lar
+    via_telemetry = telemetry_lar(jax.jit(fn), x)
+    assert via_auditor == via_telemetry
+    assert via_auditor is not None
+    assert lower_and_report(jax.jit(fn), x) == via_telemetry

@@ -204,7 +204,8 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
 
 def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
                   peak_tflops, model_path=None, quantization=None, label="",
-                  stagger_s=0.0, decode_burst=None, kv_dtype=None):
+                  stagger_s=0.0, decode_burst=None, kv_dtype=None,
+                  sched_mode=None, ttft_sla_s=None, gen_sla_tok_s=None):
     import jax.numpy as jnp
     import numpy as np
 
@@ -213,6 +214,8 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
     from deepspeed_tpu.inference.v2.engine_v2 import build_engine, build_hf_engine
     from deepspeed_tpu.inference.v2.scheduler import ContinuousBatchingScheduler
     from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.telemetry import (TelemetryConfig, build_telemetry,
+                                         reset_telemetry)
 
     topo_mod.reset()
     # size the KV pool to this workload (the default reserves for 512
@@ -257,10 +260,21 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
         model = engine.model
     else:
         engine = build_engine(model, config=cfg)
+    sched_kw = {}
+    if sched_mode is not None:
+        sched_kw["mode"] = sched_mode
+    if ttft_sla_s is not None:
+        sched_kw["ttft_sla_s"] = ttft_sla_s
+    if gen_sla_tok_s is not None:
+        sched_kw["gen_sla_tok_s"] = gen_sla_tok_s
     sched = ContinuousBatchingScheduler(
         engine, token_budget=token_budget,
-        # arrival-mode: canonical wave shapes (see scheduler ctor)
-        max_prefills_per_wave=1 if stagger_s else None)
+        # arrival-mode prefill cap: with the ragged wave program this is
+        # purely an admission knob (the three-canonical-shapes compile
+        # guard it used to be is gone, ISSUE 6); SLA-aware runs pass
+        # sched_mode/SLA targets instead and leave packing free
+        max_prefills_per_wave=(1 if stagger_s and not sched_kw else None),
+        **sched_kw)
     rng = np.random.default_rng(0)
     vocab = model.config.vocab_size
 
@@ -287,6 +301,12 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
         else:
             time.sleep(0.002)
     assert all(w.done for w in warm)
+
+    # serving reservoirs (PR 4 telemetry): enabled AFTER warmup so the
+    # timed window's waves/requests alone feed the TTFT + queue-wait
+    # percentiles this line reports (the ISSUE 6 acceptance metric)
+    tele = build_telemetry(TelemetryConfig(
+        enabled=True, watchdog={"enabled": False}))
 
     # Arrival process: ``stagger_s`` spaces submissions (the FastGen
     # benchmark protocol is a request ARRIVAL process, not a simultaneous
@@ -337,6 +357,13 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
     # SLA fractions count ALL submitted requests: one that never produced a
     # token (or never finished) is the worst violator, not an exclusion
     incomplete = sum(not r.done for r in reqs)
+    # TTFT percentiles from the telemetry serving reservoirs (queue wait
+    # split from execute, so deep queues attribute latency honestly)
+    ttft_pct = tele.metrics.ttft_latency.percentiles((50, 99)) \
+        if len(tele.metrics.ttft_latency) else {}
+    wait_pct = tele.metrics.queue_wait.percentiles((99,)) \
+        if len(tele.metrics.queue_wait) else {}
+    reset_telemetry()
     del engine, sched
     gc.collect()
     return {
@@ -359,8 +386,13 @@ def bench_serving(model, n_requests, prompt_len, max_new, token_budget,
             sum(g >= 2.0 for g in per_req_gen) / n_requests, 3),
         "incomplete_requests": incomplete,
         "out_tokens": out_tokens,
+        **({"ttft_p50_s": round(ttft_pct["p50"], 3),
+            "ttft_p99_s": round(ttft_pct["p99"], 3)} if ttft_pct else {}),
+        **({"queue_wait_p99_s": round(wait_pct["p99"], 3)}
+           if wait_pct else {}),
         **({"arrival_stagger_s": stagger_s} if stagger_s else {}),
         **({"kv_cache_dtype": kv_dtype} if kv_dtype else {}),
+        **({"sched_mode": sched_mode} if sched_mode else {}),
     }
 
 
@@ -443,9 +475,10 @@ def bench_attn_32k(peak_tflops):
     return line
 
 
-N_TPU_RUNS = 15     # build_runs(on_tpu=True) length — asserted in child mode
-N_SERVING_RUNS = 3  # ... of which the LAST THREE are serving lines
-#                     (7B 512-prompt, 7B long-context, MoE) — one sample
+N_TPU_RUNS = 18     # build_runs(on_tpu=True) length — asserted in child mode
+N_SERVING_RUNS = 6  # ... of which the LAST SIX are serving lines
+#                     (7B 512-prompt, 7B long-context, MoE-6req, and the
+#                     32/64/128 concurrency ladder) — one sample
 
 
 def _probe_backend() -> str:
@@ -952,6 +985,34 @@ def _run_configs():
                 label="mixtral-arch 8e top2 scaled MoE, ",
                 stagger_s=0.6, decode_burst=8)
         runs.append(serving_moe_run)
+
+        def serving_scale_run(n_requests):
+            # SERVING SCALE LADDER (ISSUE 6 acceptance: the 64-request
+            # line must sustain >= 3x the 6-request baseline out-tok/s
+            # with bounded p99 TTFT): same mixtral-arch model as the
+            # 6-request line above, served through the ragged-wave
+            # engine with the disaggregated SLA-aware scheduler. Shorter
+            # prompts than the baseline keep 128 concurrent KV-resident
+            # sequences inside one chip's pool (fp8 KV); the arrival gap
+            # shrinks with scale so the steady state actually reaches
+            # n_requests concurrent streams instead of serially draining.
+            # TTFT p50/p99 come from the telemetry serving reservoirs
+            # (queue wait split from execute — bench_serving fields).
+            return bench_serving(
+                mixtral_model("mixtral-8x7b", dtype=jnp.bfloat16,
+                              remat=False, num_layers=8, hidden_size=1024,
+                              intermediate_size=3584, num_heads=16,
+                              num_kv_heads=4, max_seq_len=1024,
+                              vocab_size=32000),
+                n_requests=n_requests, prompt_len=256, max_new=64,
+                token_budget=2048, peak_tflops=peak,
+                label=f"mixtral-arch MoE x{n_requests} concurrent, ",
+                stagger_s=4.0 / n_requests, decode_burst=8,
+                kv_dtype="fp8", sched_mode="disaggregated",
+                ttft_sla_s=4.0, gen_sla_tok_s=2.0)
+        runs.append(lambda: serving_scale_run(32))
+        runs.append(lambda: serving_scale_run(64))
+        runs.append(lambda: serving_scale_run(128))
     else:  # smoke path for hosts without a chip
         runs.append(lambda: bench_train(
             "gpt2-tiny ZeRO-1 cpu-smoke",

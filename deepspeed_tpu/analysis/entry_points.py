@@ -332,6 +332,62 @@ def build_paged_decode() -> EntrySpec:
                      gate_cheap=True)
 
 
+def build_ragged_paged_attention() -> EntrySpec:
+    """The ragged serving wave (ISSUE 6 tentpole): ragged paged attention
+    dispatched through ``shard_map`` over the data axis against a
+    DATA-SHARDED page pool — the production composition
+    ``engine_v2._wave_sharded_fn`` runs (each rank's sub-wave against its
+    local pool slice). The zero-collective decode contract carries over
+    from ``paged-decode``: everything is rank-local by construction, so
+    ``expected_spmd`` is empty and ANY partitioner-inserted collective
+    means the pool sharding or the local-id discipline regressed.
+
+    The ragged wave descriptors (``cu_q_lens`` / ``kv_lens`` /
+    ``page_indices``) are traced as ABSTRACT i32 arrays: a regression
+    that bakes wave composition into static kernel configuration cannot
+    concretize a tracer and surfaces as a hard trace-failed finding
+    (numerics pinned by tests/unit/inference/test_ragged_paged_attention
+    .py). The kernel path itself is traced in interpret mode, the same
+    program the CPU parity suite validates."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.inference.v2.kernels.ragged_paged_attention import \
+        ragged_paged_attention
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    topo = topo_mod.initialize(TopologyConfig(data=-1), force=True)
+    mesh = topo.mesh
+    dp = mesh.shape[DATA_AXIS]
+    # per-rank sub-wave: 16 flat tokens, 8 atoms, 4-page tables against a
+    # 4-pages-per-rank pool slice (global pool dp*4 pages)
+    H, D, kvH, ps = 4, 16, 2, 8
+    Nr, Ar, MP = 16, 8, 4
+
+    def wave_attn(q, k_pages, v_pages, cu_q_lens, kv_lens, page_indices):
+        return ragged_paged_attention(
+            q, k_pages, v_pages, kv_lens, page_indices, cu_q_lens,
+            block_q=8, use_pallas=True, interpret=True)
+
+    d = DATA_AXIS
+    fn = shard_map(wave_attn, mesh=mesh,
+                   in_specs=(P(d), P(None, d), P(None, d),
+                             P(d), P(d), P(d, None)),
+                   out_specs=P(d), check_vma=False)
+    put = lambda x, *spec: jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    q = put(jnp.zeros((dp * Nr, H, D), jnp.float32), d)
+    k_pages = put(jnp.zeros((kvH, dp * 4, ps, D), jnp.float32), None, d)
+    v_pages = put(jnp.zeros((kvH, dp * 4, ps, D), jnp.float32), None, d)
+    cu = put(jnp.zeros((dp * (Ar + 1),), jnp.int32), d)
+    kv_lens = put(jnp.ones((dp * Ar,), jnp.int32), d)
+    tables = put(jnp.zeros((dp * Ar, MP), jnp.int32), d)
+    args = (q, k_pages, v_pages, cu, kv_lens, tables)
+    return EntrySpec(name="ragged-paged-attention", fn=fn, args=args,
+                     mesh=mesh, retrace_args=[args, args], gate_cheap=True)
+
+
 def build_telemetry_off_parity() -> EntrySpec:
     """The telemetry zero-overhead contract (docs/OBSERVABILITY.md): the
     engine step entry point's jaxpr must be IDENTICAL with telemetry off
@@ -401,6 +457,7 @@ SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
     "ulysses-attention": build_ulysses_attention,
     "flash-attention-kernel": build_flash_kernel,
     "paged-decode": build_paged_decode,
+    "ragged-paged-attention": build_ragged_paged_attention,
     "telemetry-off-parity": build_telemetry_off_parity,
 }
 
@@ -444,7 +501,8 @@ ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
 #: Pinned rather than computed — building every spec just to read its
 #: gate_cheap flag would boot engines; a test asserts the two agree.
 GATE_SPMD_ENTRY_POINTS: Tuple[str, ...] = (
-    "moe-dispatch", "paged-decode", "ring-attention", "ulysses-attention")
+    "moe-dispatch", "paged-decode", "ragged-paged-attention",
+    "ring-attention", "ulysses-attention")
 
 
 def audit_entry_points(names=None) -> List[Finding]:

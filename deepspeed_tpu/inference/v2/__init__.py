@@ -1,10 +1,11 @@
 """FastGen-style ragged-batching inference (reference ``inference/v2``).
 
 TPU-first redesign of the reference's continuous-batching engine
-(``inference/v2/engine_v2.py``): blocked (paged) KV cache, UID-addressed
-sequence state, Dynamic SplitFuse token budgeting — with the dynamic-shape
-parts expressed as a small set of bucketed static-shape XLA programs
-(chunked prefill + batched paged decode) instead of CUDA ragged kernels.
+(``inference/v2/engine_v2.py``): blocked (paged) KV cache — shardable
+across the mesh's data axis — UID-addressed sequence state, Dynamic
+SplitFuse token budgeting, and ONE ragged-wave program per bucket (the
+Pallas ragged paged attention kernel, ``kernels/ragged_paged_attention``)
+serving any prefill/decode composition instead of CUDA ragged kernels.
 """
 
 from .config_v2 import RaggedInferenceEngineConfig, DeepSpeedTPStateManagerConfig  # noqa: F401

@@ -29,7 +29,8 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...models.transformer import TransformerLM
-from ...runtime.topology import MODEL_AXIS, MeshTopology, TopologyConfig
+from ...runtime.topology import (DATA_AXIS, MODEL_AXIS, MeshTopology,
+                                 TopologyConfig)
 from ...utils.logging import log_dist
 from .config_v2 import RaggedInferenceEngineConfig
 from .model import RaggedInferenceModel
@@ -115,14 +116,53 @@ class InferenceEngineV2:
         max_ctx = min(sm.max_context, c.max_seq_len)
         self.max_blocks_per_seq = -(-max_ctx // block_size)
         num_blocks = self.config.num_kv_blocks
+        derived_blocks = num_blocks is None
         if num_blocks is None:
             # enough for max_ragged_sequence_count sequences at half context
             num_blocks = 1 + sm.max_ragged_sequence_count * max(
                 1, self.max_blocks_per_seq // 2)
+        # -- page-pool shard decision (ISSUE 6: sharded, not replicated) --
+        # Data-axis sharding splits the PAGE dim: each rank owns
+        # num_blocks/dp pages + its own null block, sequences pin to one
+        # shard, and waves dispatch through shard_map with no collectives.
+        # Requires tp == 1 (with tp > 1 the pool is head-sharded over the
+        # model axis below — already "sharded across the mesh", and the
+        # per-head KV write must stay GSPMD-placed).
+        dp = int(self.mesh.shape.get(DATA_AXIS, 1))
+        tp = self.topology.model_parallel_size
+        pool_mode = self.config.kv_pool_sharding
+        wave_on = (self.config.wave_dispatch != "legacy"
+                   and os.environ.get("DSTPU_WAVE") != "legacy")
+        self.kv_shards = 1
+        if pool_mode not in ("auto", "data", "replicated"):
+            raise ValueError(f"kv_pool_sharding must be auto|data|replicated,"
+                             f" got {pool_mode!r}")
+        if pool_mode != "replicated" and tp == 1 and dp > 1 and wave_on:
+            if derived_blocks and pool_mode == "auto":
+                # a sequence's blocks all come from ONE shard, so a shard
+                # must be able to hold a max-context sequence (plus its
+                # null block) or long requests become permanently
+                # unschedulable; then round up so the pool shards cleanly
+                num_blocks = max(num_blocks,
+                                 dp * (self.max_blocks_per_seq + 1))
+                num_blocks = -(-num_blocks // dp) * dp
+                self.kv_shards = dp
+            elif pool_mode == "data":
+                if num_blocks % dp or num_blocks // dp < 2:
+                    raise ValueError(
+                        f"kv_pool_sharding='data' needs num_kv_blocks "
+                        f"divisible by the data axis ({dp}) with >= 2 "
+                        f"blocks per shard, got {num_blocks}")
+                self.kv_shards = dp
+        elif pool_mode == "data":
+            raise ValueError(
+                "kv_pool_sharding='data' requires tensor_parallel_degree 1, "
+                "a multi-device data axis, and the wave dispatch")
         self.kv_cache = BlockedKVCache(
             c.num_layers, c.kv_heads, c.head_dim, num_blocks, block_size,
             dtype=self.config.kv_cache_dtype)
-        self.state_manager = DSStateManager(sm, self.kv_cache)
+        self.state_manager = DSStateManager(sm, self.kv_cache,
+                                            num_shards=self.kv_shards)
         # module selection (reference modules/heuristics.py instantiate_*):
         # resolved once here; the chosen names are logged below so kernel
         # fallbacks are visible, never silent
@@ -131,7 +171,8 @@ class InferenceEngineV2:
         self._impls["linear"] = instantiate_linear(self.config, c)
         self._model = RaggedInferenceModel(
             model, block_size, self.max_blocks_per_seq,
-            use_pallas=self._impls["decode"].name == "pallas_paged")
+            use_pallas=self._impls["decode"].name == "pallas_paged",
+            ragged_block_q=self.config.ragged_block_q)
         self.model = model
 
         specs = model.specs()
@@ -182,7 +223,12 @@ class InferenceEngineV2:
             # path) fall back to page-dim sharding: even memory split, XLA
             # inserts the gathers.
             tp = self.topology.model_parallel_size
-            if c.kv_heads % tp == 0:
+            if self.kv_shards > 1:
+                # data-sharded pool (decided above): each data rank owns a
+                # contiguous page range; wave dispatch goes through
+                # shard_map so every gather/write is rank-local
+                spec = P(None, None, DATA_AXIS)
+            elif c.kv_heads % tp == 0:
                 spec = P(None, MODEL_AXIS)
             elif self.kv_cache.num_blocks % tp == 0:
                 spec = P(None, None, MODEL_AXIS)
@@ -190,18 +236,20 @@ class InferenceEngineV2:
                 spec = P()
             kv_spec = NamedSharding(self.mesh, spec)
             # the pools are already DEVICE arrays (jnp.zeros at cache
-            # construction) — device_put here is a device-side reshard,
-            # never a host transfer, so no slab cap applies
-            self.kv_cache.update(
-                jax.device_put(self.kv_cache.k_pages, kv_spec),
-                jax.device_put(self.kv_cache.v_pages, kv_spec))
+            # construction) — place() is a device-side reshard, never a
+            # host transfer, so no slab cap applies
+            self.kv_cache.place(kv_spec, num_shards=self.kv_shards)
 
         self._burst_fns: Dict[Tuple[int, int, int], Any] = {}
         log_dist(
             f"InferenceEngineV2: {num_blocks} KV blocks × {block_size} tokens "
-            f"({self.kv_cache.mem_bytes() / 2**20:.0f} MiB), "
+            f"({self.kv_cache.mem_bytes() / 2**20:.0f} MiB"
+            + (f", {self.kv_shards}-way data-sharded pool"
+               if self.kv_shards > 1 else "") + "), "
             f"tp={self.topology.model_parallel_size}, "
-            f"attn={self._impls['decode'].name}/{self._impls['prefill'].name}, "
+            f"attn={self._impls['decode'].name}/{self._impls['prefill'].name}"
+            f"/{self._impls['wave'].name}, "
+            f"dispatch={'wave' if self._wave_dispatch_on else 'legacy'}, "
             f"linear={self._impls['linear'].name}", ranks=[0])
 
     def _place_quantized_streaming(self, specs: Any, params: Any,
@@ -431,6 +479,34 @@ class InferenceEngineV2:
     def _ragged_fn(self):
         return jax.jit(self._model.ragged_forward, donate_argnums=(1, 2))
 
+    @functools.cached_property
+    def _wave_fn(self):
+        """The unified ragged-wave program (replicated / model-sharded
+        pool): one jit, retraced per (N, A, MP, R) bucket."""
+        return jax.jit(self._model.wave_forward, donate_argnums=(1, 2))
+
+    @functools.cached_property
+    def _wave_sharded_fn(self):
+        """The data-sharded wave dispatch: shard_map over the data axis —
+        each rank runs the FULL model (tp == 1, params replicated) on its
+        own sub-wave against its LOCAL page-pool slice. Zero collectives
+        by construction: gathers, writes and logits are all rank-local
+        (the ``ragged-paged-attention`` lint entry point compiles exactly
+        this composition and budgets it)."""
+        from ...utils.jax_compat import shard_map
+
+        d = DATA_AXIS
+        fn = shard_map(
+            self._model.wave_forward, mesh=self.mesh,
+            in_specs=(P(),                       # params (replicated; tp==1)
+                      P(None, None, d), P(None, None, d),   # k/v pages
+                      P(d), P(d), P(d),          # tokens, positions, write
+                      P(d), P(d), P(d, None),    # cu_q_lens, kv_lens, tables
+                      P(d)),                     # last_rows
+            out_specs=(P(d), P(None, None, d), P(None, None, d)),
+            check_vma=False)
+        return jax.jit(fn, donate_argnums=(1, 2))
+
     # ------------------------------------------------------------------
     # scheduling queries (reference engine_v2.py:153,179)
     # ------------------------------------------------------------------
@@ -450,12 +526,25 @@ class InferenceEngineV2:
     def can_schedule(self, uids: Sequence[int], lengths: Sequence[int]) -> bool:
         """Dry-run KV block budgeting (reference ``can_schedule``/
         ``get_length_needed``)."""
+        return self._plan_shards(uids, lengths) is not None
+
+    def _plan_shards(self, uids: Sequence[int],
+                     lengths: Sequence[int]) -> Optional[Dict[int, int]]:
+        """The ONE placement rule both ``can_schedule`` (dry run) and
+        ``put`` (commit) evaluate, so they always agree: existing
+        sequences grow in their pinned shard; new sequences land on the
+        least-loaded shard AT THAT POINT of the plan (ties -> lowest id).
+        Returns {uid: shard} or None if the batch does not fit. With one
+        shard this degenerates to the original aggregate free-block
+        check."""
         sm = self.config.state_manager
         if len(uids) > sm.max_ragged_sequence_count:
-            return False
+            return None
         if sum(lengths) > sm.max_ragged_batch_size:
-            return False
-        need = 0
+            return None
+        alloc = self.state_manager.allocator
+        free = [alloc.shard_free_blocks(r) for r in range(alloc.num_shards)]
+        plan: Dict[int, int] = {}
         for uid, n in zip(uids, lengths):
             seq = self.state_manager.get_sequence(uid)
             seen = 0 if seq is None else seq.seen_tokens
@@ -463,10 +552,20 @@ class InferenceEngineV2:
             if seen + n > self.max_context:
                 # growing past the block-table capacity would silently
                 # overwrite the sequence's own live KV
-                return False
+                return None
             total_blocks = -(-(seen + n) // self.state_manager.block_size)
-            need += max(0, total_blocks - have)
-        return need <= self.state_manager.free_blocks
+            need = max(0, total_blocks - have)
+            if seq is not None:
+                r = seq.shard
+            elif uid in plan:
+                r = plan[uid]
+            else:
+                r = max(range(len(free)), key=lambda i: (free[i], -i))
+            if need > free[r]:
+                return None
+            free[r] -= need
+            plan[uid] = r
+        return plan
 
     def flush(self, uid: int) -> None:
         self.state_manager.flush_sequence(uid)
@@ -502,17 +601,25 @@ class InferenceEngineV2:
         contract; reference atom_builder + flash_attn_by_atoms). Prompts
         longer than ``max_prefill_chunk`` take one extra dispatch per extra
         chunk wave.
+
+        Default dispatch is the unified ragged-WAVE program (one atom
+        class, ragged_paged_attention); ``wave_dispatch="legacy"`` or
+        ``DSTPU_WAVE=legacy`` restores the previous two-class program
+        (the A/B denominator, tools/serving_ab.py).
         """
-        if not self.can_schedule(batch_uids, [len(t) for t in batch_tokens]):
+        plan = self._plan_shards(batch_uids, [len(t) for t in batch_tokens])
+        if plan is None:
             raise RuntimeError("batch does not fit KV/budget; call can_schedule first")
 
         work: List[Tuple[int, np.ndarray]] = []
         for uid, tokens in zip(batch_uids, batch_tokens):
             tokens = np.asarray(tokens, np.int32)
-            seq = self.state_manager.get_or_create_sequence(uid)
+            seq = self.state_manager.get_or_create_sequence(uid,
+                                                            shard=plan[uid])
             self.state_manager.allocate_blocks(seq, len(tokens))
             work.append((uid, tokens))
 
+        run = self._run_wave if self._wave_dispatch_on else self._run_ragged
         cap = self.config.max_prefill_chunk
         out_logits: Dict[int, np.ndarray] = {}
         offset = {uid: 0 for uid, _ in work}
@@ -521,17 +628,71 @@ class InferenceEngineV2:
                     for uid, toks in work if offset[uid] < len(toks)]
             if not wave:
                 break
-            logits = self._run_ragged(wave)
+            logits = run(wave)
             for i, (uid, chunk) in enumerate(wave):
                 offset[uid] += len(chunk)
                 out_logits[uid] = logits[i]
         return np.stack([out_logits[u] for u in batch_uids])
+
+    @property
+    def _wave_dispatch_on(self) -> bool:
+        """Live env read so an A/B harness can flip mid-process; a
+        data-sharded pool REQUIRES the wave program (the legacy two-class
+        program indexes the pool globally)."""
+        if self.kv_shards > 1:
+            return True
+        return (self.config.wave_dispatch != "legacy"
+                and os.environ.get("DSTPU_WAVE") != "legacy")
+
+    def _run_wave(self, wave: List[Tuple[int, np.ndarray]]) -> np.ndarray:
+        """One dispatch of a mixed wave through the unified ragged-wave
+        program. wave: [(uid, chunk)] — any composition of decode tokens
+        and prefill chunks; the host atom builder (ragged/wave.py)
+        flattens it into ONE token stream + per-atom descriptors, sharded
+        pools get one equally-bucketed sub-wave per data rank."""
+        from .ragged.wave import WaveEntry, build_sharded_wave
+
+        sm = self.state_manager
+        shards = max(self.kv_shards, 1)
+        per_shard: List[List[WaveEntry]] = [[] for _ in range(shards)]
+        for uid, chunk in wave:
+            seq = sm.get_sequence(uid)
+            r = seq.shard if shards > 1 else 0
+            local = [sm.allocator.local_id(b) for b in seq.blocks] \
+                if shards > 1 else list(seq.blocks)
+            per_shard[r].append(WaveEntry(uid, chunk, seq.seen_tokens, local))
+        desc = build_sharded_wave(per_shard,
+                                  block_q=self.config.ragged_block_q,
+                                  block_size=sm.block_size)
+        fn = self._wave_sharded_fn if shards > 1 else self._wave_fn
+        from ...telemetry import get_telemetry
+        with get_telemetry().phase("wave_dispatch", phase="serving",
+                                   sequences=len(wave),
+                                   tokens=int(desc.n_tokens),
+                                   shards=shards):
+            with self.mesh:
+                logits, k_pages, v_pages = fn(
+                    self.params, self.kv_cache.k_pages, self.kv_cache.v_pages,
+                    jnp.asarray(desc.tokens), jnp.asarray(desc.positions),
+                    jnp.asarray(desc.write_idx), jnp.asarray(desc.cu_q_lens),
+                    jnp.asarray(desc.kv_lens), jnp.asarray(desc.page_indices),
+                    jnp.asarray(desc.last_rows))
+        self.kv_cache.update(k_pages, v_pages)
+        for uid, chunk in wave:
+            sm.get_sequence(uid).post_forward(len(chunk))
+        logits = np.asarray(logits)
+        return np.stack([logits[desc.row_of_uid[uid]] for uid, _ in wave])
 
     def can_burst(self, batch_uids: Sequence[int], num_steps: int) -> bool:
         """Burst feasibility: the fused program runs len(uids) tokens PER
         STEP (the ragged token budget applies per step, not to the k-fold
         product), but allocates ``num_steps`` KV slots per sequence up
         front."""
+        if self.kv_shards > 1:
+            # fused bursts index the pool globally (and scan-carry it
+            # whole); under a data-sharded pool decode throughput comes
+            # from disaggregated decode waves instead (docs/SERVING.md)
+            return False
         sm = self.config.state_manager
         n = len(batch_uids)
         if n > sm.max_ragged_sequence_count or n > sm.max_ragged_batch_size:

@@ -1,1 +1,2 @@
 from .paged_attention import chunk_prefill_attention, paged_decode_attention  # noqa: F401
+from .ragged_paged_attention import ragged_paged_attention  # noqa: F401

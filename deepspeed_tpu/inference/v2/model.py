@@ -41,12 +41,14 @@ Params = Dict[str, Any]
 class RaggedInferenceModel:
 
     def __init__(self, model: TransformerLM, block_size: int, max_blocks_per_seq: int,
-                 use_pallas: bool = None):
+                 use_pallas: bool = None, ragged_block_q: int = 8):
         self.model = model
         self.config = model.config
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.use_pallas = use_pallas
+        # atom tile of the unified wave program (wave_forward)
+        self.ragged_block_q = ragged_block_q
         c = self.config
         if not c.causal:
             raise ValueError(
@@ -185,6 +187,45 @@ class RaggedInferenceModel:
         return x, k_pages, v_pages
 
     # -- programs -----------------------------------------------------------
+    def wave_forward(self, params: Params, k_pages, v_pages,
+                     tokens, positions, write_idx,
+                     cu_q_lens, kv_lens, page_tables, last_rows):
+        """THE unified ragged-wave program (ISSUE 6 tentpole): ONE atom
+        class instead of ``ragged_forward``'s two. The host atom builder
+        (``ragged/wave.py``) flattens any wave composition — decode
+        tokens, prefill chunks, any mix — into a flat token stream
+        ``tokens [N]`` plus per-atom descriptors, and every layer's
+        attention is a single :func:`ragged_paged_attention` launch.
+        Projections / MLP / norms run fused over the compact [N] stream
+        (padded rows are dead weight, not per-class padding products).
+
+        ``write_idx [N]`` are host-computed flat slots into the (LOCAL)
+        pool — under a data-sharded pool this program runs per-rank
+        inside ``shard_map`` and every gather/write stays rank-local.
+        Returns (logits [R, V] — one row per scheduled sequence-chunk,
+        selected by ``last_rows`` — k_pages, v_pages).
+        """
+        from .kernels.ragged_paged_attention import ragged_paged_attention
+
+        x = self._embed(params, tokens, positions)          # [N, hid]
+        max_flat = k_pages.shape[2] * self.block_size
+        write_idx = jnp.clip(write_idx, 0, max_flat - 1)
+
+        def attn(q, k_l, v_l, window):
+            # use_pallas=None: the ragged kernel's own dispatch policy
+            # (DSTPU_RAGGED_ATTN env; ALiBi/window/fp8 force XLA inside)
+            return ragged_paged_attention(
+                q, k_l, v_l, kv_lens, page_tables, cu_q_lens,
+                scale=self._scale, block_q=self.ragged_block_q,
+                use_pallas=None, alibi_slopes=self._alibi,
+                window=window)
+
+        x, k_pages, v_pages = self._layer_loop(
+            params, k_pages, v_pages, x, attn, write_idx, positions)
+        sel = x[jnp.clip(last_rows, 0, x.shape[0] - 1)]
+        logits = self._unembed(params, sel)
+        return logits, k_pages, v_pages
+
     def ragged_forward(self, params: Params, k_pages, v_pages,
                        d_tokens, d_positions, d_context_lens, d_block_tables,
                        p_tokens, p_positions, p_valid, p_history, p_block_tables):

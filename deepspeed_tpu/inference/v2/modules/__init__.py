@@ -1,4 +1,5 @@
 from .registry import (DSModuleRegistry, ModuleImplementation,  # noqa: F401
                        ATTENTION_DECODE_REGISTRY, ATTENTION_PREFILL_REGISTRY,
+                       ATTENTION_WAVE_REGISTRY,
                        LINEAR_REGISTRY)
 from .heuristics import instantiate_attention, instantiate_linear  # noqa: F401

@@ -12,6 +12,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional
 
 from .registry import (ATTENTION_DECODE_REGISTRY, ATTENTION_PREFILL_REGISTRY,
+                       ATTENTION_WAVE_REGISTRY,
                        LINEAR_REGISTRY, ModuleImplementation)
 
 
@@ -34,6 +35,7 @@ def instantiate_attention(engine_config, model_config,
     return {
         "decode": ATTENTION_DECODE_REGISTRY.choose(ctx),
         "prefill": ATTENTION_PREFILL_REGISTRY.choose(ctx),
+        "wave": ATTENTION_WAVE_REGISTRY.choose(ctx),
     }
 
 

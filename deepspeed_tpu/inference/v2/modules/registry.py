@@ -92,6 +92,35 @@ ATTENTION_PREFILL_REGISTRY = DSModuleRegistry("attention_prefill")
 ATTENTION_PREFILL_REGISTRY.register(ModuleImplementation(
     name="ragged_chunk", priority=10, supports=lambda ctx: True))
 
+
+def _ragged_wave_pallas_supported(ctx: Dict[str, Any]) -> bool:
+    """The in-repo ragged paged attention kernel (ISSUE 6,
+    kernels/ragged_paged_attention.py): default on TPU, env-gated like the
+    kernel's own dispatch (DSTPU_RAGGED_ATTN: ''=auto, 'pallas' force,
+    'xla' escape). ALiBi models route the bias through the XLA atom path."""
+    import jax
+
+    from ..kernels.ragged_paged_attention import _ragged_backend
+    mode = _ragged_backend()
+    if mode == "xla":
+        return False
+    if ctx.get("position") == "alibi":
+        return False
+    if mode == "pallas":
+        return True
+    return ctx.get("backend", jax.default_backend()) == "tpu"
+
+
+#: the unified wave program's attention slot (ISSUE 6): ONE atom class for
+#: any prefill/decode composition, vs the decode/prefill split above that
+#: the legacy two-class dispatch still uses
+ATTENTION_WAVE_REGISTRY = DSModuleRegistry("attention_wave")
+ATTENTION_WAVE_REGISTRY.register(ModuleImplementation(
+    name="ragged_pallas", priority=10,
+    supports=_ragged_wave_pallas_supported))
+ATTENTION_WAVE_REGISTRY.register(ModuleImplementation(
+    name="ragged_xla", priority=0, supports=lambda ctx: True))
+
 LINEAR_REGISTRY = DSModuleRegistry("linear")
 LINEAR_REGISTRY.register(ModuleImplementation(
     name="dense", priority=0, supports=lambda ctx: True))

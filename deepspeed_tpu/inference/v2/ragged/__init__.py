@@ -3,3 +3,4 @@ from .kv_cache import BlockedKVCache  # noqa: F401
 from .ragged_manager import DSStateManager  # noqa: F401
 from .ragged_wrapper import RaggedBatchWrapper  # noqa: F401
 from .sequence_descriptor import DSSequenceDescriptor  # noqa: F401
+from .wave import WaveDescriptors, WaveEntry, build_sharded_wave, build_wave  # noqa: F401

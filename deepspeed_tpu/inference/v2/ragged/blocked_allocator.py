@@ -5,46 +5,101 @@ Counterpart of the reference ``inference/v2/ragged/blocked_allocator.py:11``
 pure Python — block *ids* are host metadata; block *contents* live on device
 in :class:`~deepspeed_tpu.inference.v2.ragged.kv_cache.BlockedKVCache`.
 
-Block id 0 is reserved as the null/scratch block: padded block-table entries
-and padded token writes are directed at it so static-shape programs never
-corrupt live cache state.
+The pool may be SHARDED across the mesh's data axis (ISSUE 6: the page
+pool stops being replicated): ``num_shards > 1`` partitions the id space
+into equal contiguous ranges — shard ``r`` owns global ids
+``[r*pps, (r+1)*pps)`` where ``pps = num_blocks // num_shards`` — and a
+sequence allocates ALL its blocks from one shard, so its pages are local
+to one data rank and the attention gather never crosses the mesh. The
+FIRST block of every shard (local id 0) is reserved as that shard's
+null/scratch block: padded block-table entries and padded token writes are
+directed at the rank-local null so static-shape programs never corrupt
+live cache state. ``num_shards=1`` reproduces the original single-pool
+behavior exactly (global block 0 reserved).
 """
 
 from __future__ import annotations
+
+from typing import List, Optional
 
 
 class BlockedAllocator:
 
     NULL_BLOCK = 0
 
-    def __init__(self, num_blocks: int):
-        if num_blocks < 2:
-            raise ValueError(f"need >= 2 blocks (1 reserved), got {num_blocks}")
+    def __init__(self, num_blocks: int, num_shards: int = 1):
+        if num_shards < 1:
+            raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+        if num_blocks % num_shards:
+            raise ValueError(
+                f"{num_blocks} blocks not divisible into {num_shards} shards")
+        pps = num_blocks // num_shards
+        if pps < 2:
+            raise ValueError(
+                f"need >= 2 blocks per shard (1 reserved), got {pps}")
         self._num_blocks = num_blocks
-        self._free_list = list(range(num_blocks - 1, 0, -1))  # id 0 reserved
+        self._num_shards = num_shards
+        self._per_shard = pps
+        # per-shard free lists of GLOBAL ids; local id 0 of each shard
+        # (global r*pps) is the shard's reserved null block
+        self._free: List[List[int]] = [
+            list(range((r + 1) * pps - 1, r * pps, -1))
+            for r in range(num_shards)]
+
+    @property
+    def num_shards(self) -> int:
+        return self._num_shards
+
+    @property
+    def blocks_per_shard(self) -> int:
+        return self._per_shard
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free_list)
+        return sum(len(f) for f in self._free)
+
+    def shard_free_blocks(self, shard: int) -> int:
+        return len(self._free[shard])
 
     @property
     def total_blocks(self) -> int:
-        return self._num_blocks - 1
+        return self._num_blocks - self._num_shards  # one null per shard
 
-    def allocate(self, num_blocks: int) -> list:
-        """Pop ``num_blocks`` ids; raises if insufficient (caller should have
-        consulted ``free_blocks`` — reference ``can_schedule`` pattern)."""
-        if num_blocks > len(self._free_list):
+    def shard_of(self, block: int) -> int:
+        return block // self._per_shard
+
+    def local_id(self, block: int) -> int:
+        """Pool-local id of a global block id (what a data rank's slice of
+        the sharded page array indexes by)."""
+        return block % self._per_shard
+
+    def least_loaded_shard(self) -> int:
+        """Shard with the most free blocks (ties -> lowest id) — the
+        deterministic placement rule ``can_schedule`` dry-runs and ``put``
+        commits, so the two always agree."""
+        return max(range(self._num_shards),
+                   key=lambda r: (len(self._free[r]), -r))
+
+    def allocate(self, num_blocks: int, shard: int = 0) -> list:
+        """Pop ``num_blocks`` GLOBAL ids from ``shard``; raises if
+        insufficient (caller should have consulted ``shard_free_blocks`` —
+        reference ``can_schedule`` pattern)."""
+        free = self._free[shard]
+        if num_blocks > len(free):
             raise ValueError(
-                f"cannot allocate {num_blocks} blocks, {len(self._free_list)} free")
-        out = self._free_list[-num_blocks:] if num_blocks else []
-        del self._free_list[len(self._free_list) - num_blocks:]
+                f"cannot allocate {num_blocks} blocks from shard {shard}, "
+                f"{len(free)} free")
+        out = free[-num_blocks:] if num_blocks else []
+        del free[len(free) - num_blocks:]
         return out
 
-    def free(self, blocks) -> None:
+    def free(self, blocks, shard: Optional[int] = None) -> None:
+        """Return blocks to their owning shards (``shard`` is accepted for
+        symmetry but derived per id — blocks carry their shard in the id)."""
         for blk in blocks:
-            if blk == self.NULL_BLOCK:
-                raise ValueError("cannot free the null block")
-            if not (0 < blk < self._num_blocks):
+            if not (0 <= blk < self._num_blocks):
                 raise ValueError(f"block id {blk} out of range")
-        self._free_list.extend(blocks)
+            r = blk // self._per_shard
+            if blk % self._per_shard == self.NULL_BLOCK:
+                raise ValueError(f"cannot free shard {r}'s null block {blk}")
+            self._free[r].append(blk)

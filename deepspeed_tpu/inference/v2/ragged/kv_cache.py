@@ -50,6 +50,23 @@ class BlockedKVCache:
         self.k_pages = jnp.zeros(shape, dtype)
         self.v_pages = jnp.zeros(shape, dtype)
         self._off_jits = {}  # offload/restore program cache, keyed (kind, n)
+        # set by place(): the pool's NamedSharding — restore programs pin
+        # their output to it so an offload round-trip cannot silently
+        # decay a sharded pool to replicated
+        self._sharding = None
+        # >1 when the page dim is sharded over the mesh's data axis (each
+        # data rank owns num_blocks/num_shards pages + its own null block)
+        self.num_shards = 1
+
+    def place(self, sharding, num_shards: int = 1) -> None:
+        """Reshard the pool in place (device-side — the pools are already
+        device arrays, so this is never a host transfer) and remember the
+        sharding for restore-path programs."""
+        self.k_pages = jax.device_put(self.k_pages, sharding)
+        self.v_pages = jax.device_put(self.v_pages, sharding)
+        self._sharding = sharding
+        self.num_shards = num_shards
+        self._off_jits.clear()
 
     @property
     def per_token_bytes(self) -> int:
@@ -84,11 +101,16 @@ class BlockedKVCache:
 
     def _restore_jit(self, n: int):
         if ("res", n) not in self._off_jits:
-            # donate the pages: the scatter aliases the pool in place
+            # donate the pages: the scatter aliases the pool in place. A
+            # sharded pool pins the output sharding so the round-trip
+            # preserves the page-dim partitioning.
+            kw = {}
+            if self._sharding is not None:
+                kw["out_shardings"] = (self._sharding, self._sharding)
             self._off_jits[("res", n)] = jax.jit(
                 lambda kp, vp, ids, hk, hv: (kp.at[:, :, ids].set(hk),
                                              vp.at[:, :, ids].set(hv)),
-                donate_argnums=(0, 1))
+                donate_argnums=(0, 1), **kw)
         return self._off_jits[("res", n)]
 
     def offload(self, block_ids: List[int]) -> Tuple[np.ndarray, np.ndarray]:

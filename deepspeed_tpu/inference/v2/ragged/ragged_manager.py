@@ -19,11 +19,13 @@ class DSStateManager:
 
     def __init__(self,
                  config: DeepSpeedTPStateManagerConfig,
-                 kv_cache: BlockedKVCache):
+                 kv_cache: BlockedKVCache,
+                 num_shards: int = 1):
         self._config = config
         self.kv_cache = kv_cache
         self.block_size = kv_cache.block_size
-        self._allocator = BlockedAllocator(kv_cache.num_blocks)
+        self._allocator = BlockedAllocator(kv_cache.num_blocks,
+                                           num_shards=num_shards)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
         # uid -> (descriptor, host_k, host_v): sequences whose KV is
         # stashed in host RAM (preemption under KV pressure)
@@ -35,33 +37,55 @@ class DSStateManager:
         return self._allocator.free_blocks
 
     @property
+    def num_shards(self) -> int:
+        return self._allocator.num_shards
+
+    @property
+    def allocator(self) -> BlockedAllocator:
+        return self._allocator
+
+    @property
     def tracked_sequences(self) -> int:
         return len(self._seqs)
 
     def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
         return self._seqs.get(uid)
 
-    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
-        """Reference ``ragged_manager.py:132`` (get_or_create_sequence)."""
+    def get_or_create_sequence(self, uid: int,
+                               shard: Optional[int] = None) -> DSSequenceDescriptor:
+        """Reference ``ragged_manager.py:132`` (get_or_create_sequence).
+        ``shard`` pins a NEW sequence to a pool shard (sharded page pool);
+        default: the least-loaded shard at creation time."""
         seq = self._seqs.get(uid)
         if seq is None:
             if len(self._seqs) >= self._config.max_tracked_sequences:
                 raise RuntimeError(
                     f"tracking {len(self._seqs)} sequences, limit "
                     f"{self._config.max_tracked_sequences}")
-            seq = DSSequenceDescriptor(uid, self.block_size)
+            if shard is None:
+                shard = self._allocator.least_loaded_shard()
+            seq = DSSequenceDescriptor(uid, self.block_size, shard=shard)
             self._seqs[uid] = seq
         return seq
 
     # -- block lifecycle ----------------------------------------------------
+    def shard_free_blocks(self, shard: int) -> int:
+        return self._allocator.shard_free_blocks(shard)
+
     def can_allocate(self, uid: int, new_tokens: int) -> bool:
-        seq = self._seqs.get(uid) or DSSequenceDescriptor(uid, self.block_size)
-        return seq.blocks_needed(new_tokens) <= self.free_blocks
+        seq = self._seqs.get(uid)
+        if seq is None:
+            need = DSSequenceDescriptor(uid, self.block_size) \
+                .blocks_needed(new_tokens)
+            return need <= self._allocator.shard_free_blocks(
+                self._allocator.least_loaded_shard())
+        return seq.blocks_needed(new_tokens) \
+            <= self._allocator.shard_free_blocks(seq.shard)
 
     def allocate_blocks(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
         need = seq.blocks_needed(new_tokens)
         if need:
-            seq.extend_blocks(self._allocator.allocate(need))
+            seq.extend_blocks(self._allocator.allocate(need, shard=seq.shard))
 
     def flush_sequence(self, uid: int) -> None:
         """Free a sequence's blocks and forget it (reference
@@ -88,15 +112,18 @@ class DSStateManager:
     def can_restore(self, uid: int, headroom: int = 0) -> bool:
         """``headroom`` extra free blocks demanded beyond the restore
         itself — the scheduler's anti-thrash guard (restoring into a pool
-        with zero slack would re-preempt on the next block boundary)."""
+        with zero slack would re-preempt on the next block boundary).
+        Sharded pool: the stash restores into its ORIGINAL shard (the
+        sequence's wave descriptors stay rank-local)."""
         seq, _, _ = self._offloaded[uid]
-        return len(seq.blocks) + headroom <= self.free_blocks
+        return len(seq.blocks) + headroom \
+            <= self._allocator.shard_free_blocks(seq.shard)
 
     def restore_sequence(self, uid: int) -> None:
         """Re-place an offloaded sequence's KV into freshly-allocated
         blocks (ids generally differ from offload time)."""
         seq, host_k, host_v = self._offloaded.pop(uid)
-        fresh = self._allocator.allocate(len(seq.blocks))
+        fresh = self._allocator.allocate(len(seq.blocks), shard=seq.shard)
         self.kv_cache.restore(host_k, host_v, fresh)
         seq.blocks = fresh
         self._seqs[uid] = seq

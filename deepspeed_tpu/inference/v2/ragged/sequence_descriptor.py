@@ -14,11 +14,15 @@ from typing import List
 
 class DSSequenceDescriptor:
 
-    def __init__(self, uid: int, block_size: int):
+    def __init__(self, uid: int, block_size: int, shard: int = 0):
         self.uid = uid
         self._block_size = block_size
         self.seen_tokens = 0           # tokens whose KV is in cache
-        self.blocks: List[int] = []    # ordered KV block ids
+        self.blocks: List[int] = []    # ordered KV block ids (global)
+        # pool shard this sequence's blocks come from (sharded page pool:
+        # all of a sequence's pages live on one data rank, so its
+        # attention gathers never cross the mesh)
+        self.shard = shard
 
     @property
     def cur_allocated_blocks(self) -> int:

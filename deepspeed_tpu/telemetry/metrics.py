@@ -115,6 +115,13 @@ class MetricsEngine:
         # serving
         self.token_latency = LatencyHistogram()
         self.wave_latency = LatencyHistogram()
+        # per-REQUEST serving reservoirs (ISSUE 6): TTFT decomposed into
+        # queue wait (submit -> first scheduled) and execute (first
+        # scheduled -> first token), so deep queues attribute latency to
+        # admission rather than to the forward pass
+        self.ttft_latency = LatencyHistogram()
+        self.queue_wait = LatencyHistogram()
+        self.ttft_execute = LatencyHistogram()
 
     # -- feeding ---------------------------------------------------------
     def record_step(self, duration_s: float, tokens: int = 0,
@@ -189,4 +196,9 @@ class MetricsEngine:
         if len(self.token_latency):
             out.update({f"token_latency_{k}_s": v for k, v in
                         self.token_latency.percentiles().items()})
+        if len(self.ttft_latency):
+            out.update({f"ttft_{k}_s": v for k, v in
+                        self.ttft_latency.percentiles().items()})
+            out.update({f"queue_wait_{k}_s": v for k, v in
+                        self.queue_wait.percentiles().items()})
         return out

@@ -133,14 +133,30 @@ class Telemetry:
     # -- serving ---------------------------------------------------------
     def record_wave(self, kind: str, tokens: int, duration_s: float,
                     queue_depth: int = 0, running: int = 0,
-                    occupancy: float = 0.0) -> None:
+                    occupancy: float = 0.0, admitted: int = 0,
+                    queue_wait_s: float = 0.0) -> None:
+        """``duration_s`` is EXECUTE time only (compose + dispatch + fetch
+        of this wave); ``queue_wait_s`` is the longest submit->schedule
+        wait among the ``admitted`` requests this wave first scheduled —
+        kept separate so deep queues cannot masquerade as slow forwards."""
         self.trace.instant(f"wave:{kind}", phase=PHASE_SERVING,
                            tokens=tokens, queue_depth=queue_depth,
                            running=running, occupancy=round(occupancy, 4),
-                           dur_ms=round(duration_s * 1e3, 3))
+                           dur_ms=round(duration_s * 1e3, 3),
+                           admitted=admitted,
+                           queue_wait_ms=round(queue_wait_s * 1e3, 3))
         self.metrics.wave_latency.record(duration_s)
         if tokens > 0:
             self.metrics.token_latency.record(duration_s / tokens)
+
+    def record_request(self, queue_wait_s: float, ttft_s: float) -> None:
+        """Per-request TTFT attribution at first token: total TTFT, the
+        queue-wait component, and the execute remainder each land in
+        their own reservoir (the serving SLA scoreboard the scheduler's
+        admission policy and the bench lines read)."""
+        self.metrics.ttft_latency.record(ttft_s)
+        self.metrics.queue_wait.record(queue_wait_s)
+        self.metrics.ttft_execute.record(max(0.0, ttft_s - queue_wait_s))
 
     # -- MFU plumbing ----------------------------------------------------
     def set_flops_fn(self, fn: Callable[[], float]) -> None:
@@ -257,6 +273,9 @@ class NullTelemetry:
         pass
 
     def record_wave(self, *a, **k):
+        pass
+
+    def record_request(self, *a, **k):
         pass
 
     def set_flops_fn(self, fn):

@@ -108,8 +108,8 @@ class TestSplitFuseBatching:
         warm = rng.integers(0, V, size=8)
         eng.put([41], [warm[:-1]])                 # running sequence
         calls = []
-        orig = eng._run_ragged
-        monkeypatch.setattr(eng, "_run_ragged",
+        orig = eng._run_wave  # the unified ragged-wave dispatch (ISSUE 6)
+        monkeypatch.setattr(eng, "_run_wave",
                             lambda wave: (calls.append(len(wave)), orig(wave))[1])
         fresh = rng.integers(0, V, size=9)
         out = eng.put([41, 42], [warm[-1:], fresh])  # decode + prefill together

@@ -1,0 +1,336 @@
+"""ISSUE 6 serving-stack tests: the host wave builder, the data-sharded
+page pool, scheduler preemption equivalence under the unified ragged
+waves, disaggregated composition, SLA-aware admission, and the
+queue-wait/execute TTFT split."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2, generate
+from deepspeed_tpu.inference.v2.config_v2 import (
+    DeepSpeedTPStateManagerConfig, RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.ragged.wave import (WaveEntry, build_wave,
+                                                    build_sharded_wave)
+from deepspeed_tpu.inference.v2.scheduler import ContinuousBatchingScheduler
+from deepspeed_tpu.models import llama_model
+
+
+def tiny_config(**kw):
+    base = dict(
+        kv_block_size=4,
+        num_kv_blocks=257,
+        max_prefill_chunk=16,
+        kv_cache_dtype=jnp.float32,
+        state_manager=DeepSpeedTPStateManagerConfig(
+            max_ragged_batch_size=64, max_ragged_sequence_count=16,
+            max_context=64),
+    )
+    base.update(kw)
+    return RaggedInferenceEngineConfig(**base)
+
+
+def tiny_model():
+    return llama_model("llama2-tiny", dtype=jnp.float32, remat=False,
+                       max_seq_len=64)
+
+
+# ---------------------------------------------------------------------------
+# wave builder (host atom builder)
+# ---------------------------------------------------------------------------
+
+
+class TestWaveBuilder:
+
+    def test_atoms_and_write_indices(self):
+        """A mixed wave: decode + straddling chunk. Atom splits at
+        block_q, kv_lens count history + consumed chunk, write slots land
+        block-accurately across page boundaries."""
+        entries = [
+            WaveEntry(uid=7, tokens=np.asarray([5], np.int32), seen=6,
+                      blocks=[3, 9]),
+            WaveEntry(uid=8, tokens=np.arange(10, dtype=np.int32), seen=3,
+                      blocks=[2, 5, 11, 4]),
+        ]
+        d = build_wave(entries, block_q=8, block_size=4)
+        # atom 0: the decode (q_len 1, kv 7); atoms 1-2: the chunk split 8+2
+        np.testing.assert_array_equal(d.cu_q_lens[:4], [0, 1, 9, 11])
+        np.testing.assert_array_equal(d.kv_lens[:3], [7, 11, 13])
+        # decode writes at position 6 -> block 9 (slot 6//4=1), offset 2
+        assert d.write_idx[0] == 9 * 4 + 2
+        # chunk token 0 at position 3 -> block 2 offset 3; token 1 at
+        # position 4 -> block 5 offset 0 (boundary straddle)
+        assert d.write_idx[1] == 2 * 4 + 3
+        assert d.write_idx[2] == 5 * 4 + 0
+        # last valid rows: decode row 0, chunk row 10
+        assert d.last_rows[0] == 0 and d.last_rows[1] == 10
+        assert d.row_of_uid == {7: 0, 8: 1}
+        # padding atoms: flat cu, zero kv (kernel skips every page)
+        assert (d.kv_lens[3:] == 0).all()
+        assert (np.diff(d.cu_q_lens[3:]) == 0).all()
+
+    def test_sharded_wave_equal_buckets(self):
+        """Per-shard sub-waves pad to the SAME bucket and concatenate in
+        shard order; row_of_uid maps into the concatenated logits."""
+        a = [WaveEntry(1, np.arange(3, dtype=np.int32), 0, [1])]
+        b = [WaveEntry(2, np.arange(9, dtype=np.int32), 4, [2, 3, 7, 8]),
+             WaveEntry(3, np.asarray([1], np.int32), 2, [5])]
+        d = build_sharded_wave([a, b], block_q=8, block_size=4)
+        n_shards = 2
+        assert d.tokens.shape[0] % n_shards == 0
+        N = d.tokens.shape[0] // n_shards
+        R = d.last_rows.shape[0] // n_shards
+        assert d.cu_q_lens.shape[0] % n_shards == 0
+        assert d.row_of_uid[1] == 0 and d.row_of_uid[2] == R
+        assert d.row_of_uid[3] == R + 1
+        # shard 1's last_rows index into ITS sub-stream (local rows:
+        # entry 2 occupies 0..8, entry 3 row 9)
+        assert d.last_rows[R] == 8 and d.last_rows[R + 1] == 9
+
+
+# ---------------------------------------------------------------------------
+# data-sharded page pool
+# ---------------------------------------------------------------------------
+
+
+class TestShardedPool:
+
+    def _gen(self, cfg_kw, prompts, max_new=8, params=None, **sched_kw):
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        model = tiny_model()
+        eng = InferenceEngineV2(model, config=tiny_config(**cfg_kw), seed=3)
+        if params is not None:
+            eng.params = params
+        sched = ContinuousBatchingScheduler(eng, token_budget=48, **sched_kw)
+        reqs = [sched.submit(p, max_new_tokens=max_new) for p in prompts]
+        while sched.has_work:
+            if sched.step() == 0:
+                break
+        return eng, [list(r.generated) for r in reqs]
+
+    def test_sharded_pool_parity_and_preemption(self, eight_devices):
+        """kv_pool_sharding='data': pages split over the data axis (8
+        ranks), sequences pinned per shard, shard_map dispatch. One
+        replicated reference run anchors BOTH checks (tier-1 wall cost):
+        a roomy sharded pool generates identically, and a contended one
+        (two 3-block sequences on a 4-block shard) preempts through the
+        offload stash/restore round-trip and still matches token for
+        token (satellite: preemption under the new waves)."""
+        rng = np.random.default_rng(12)
+        # each request needs ceil((4 prompt + 6 new)/4) = 3 blocks
+        prompts = [rng.integers(0, 128, size=(4,)) for _ in range(9)]
+        eng_r, ref = self._gen({}, prompts, max_new=6)
+        # roomy sharded pool: 264/8 -> 32 usable per shard, no preemption
+        eng_s, out = self._gen(
+            dict(num_kv_blocks=264, kv_pool_sharding="data"), prompts,
+            max_new=6, params=eng_r.params)
+        assert eng_s.kv_shards == 8
+        spec = eng_s.kv_cache.k_pages.sharding.spec
+        assert len(spec) > 2 and spec[2] == "data", spec
+        assert out == ref
+        # fused bursts are superseded under a sharded pool
+        assert not eng_s.can_burst([1], 2)
+        # tight pool: 40/8 -> 4 usable per shard, so two requests on one
+        # shard contend (3 + 3 > 4) and preempt mid-generation
+        _, out_t = self._gen(
+            dict(num_kv_blocks=40, kv_pool_sharding="data"), prompts,
+            max_new=6, params=eng_r.params)
+        for got, want in zip(out_t, ref):
+            np.testing.assert_array_equal(got, want[:len(got)])
+        assert any(len(o) == 6 for o in out_t)  # someone finished
+
+    def test_derived_pool_shards_fit_max_context(self, eight_devices):
+        """Auto-sharded DERIVED pools must size every shard to hold a
+        max-context sequence (+ its null block): sequences pin to one
+        shard, so a smaller shard would make long prompts permanently
+        unschedulable with a silent 0-token result."""
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        eng = InferenceEngineV2(tiny_model(), config=tiny_config(
+            num_kv_blocks=None,
+            state_manager=DeepSpeedTPStateManagerConfig(
+                max_ragged_batch_size=64, max_ragged_sequence_count=4,
+                max_context=64)))
+        assert eng.kv_shards == 8
+        assert eng.state_manager.allocator.blocks_per_shard - 1 \
+            >= eng.max_blocks_per_seq
+        # a full-max-context request is schedulable on an empty pool
+        assert eng.can_schedule([1], [eng.max_context])
+
+    def test_explicit_data_sharding_validates(self, eight_devices):
+        """An indivisible explicit pool must raise, not silently
+        replicate."""
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        with pytest.raises(ValueError, match="divisible"):
+            InferenceEngineV2(tiny_model(), config=tiny_config(
+                num_kv_blocks=257, kv_pool_sharding="data"))
+
+
+class TestLegacyEscapeHatch:
+
+    def test_legacy_dispatch_matches_wave(self, monkeypatch):
+        """DSTPU_WAVE=legacy routes through the previous two-class
+        program (the A/B denominator) and generates the same tokens."""
+        from deepspeed_tpu.runtime import topology as topo_mod
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, 128, size=(7,))]
+
+        def run():
+            topo_mod.reset()
+            eng = InferenceEngineV2(tiny_model(), config=tiny_config(),
+                                    seed=4)
+            return eng, generate(eng, prompts, max_new_tokens=4)
+
+        eng, ref = run()
+        assert eng._wave_dispatch_on
+        monkeypatch.setenv("DSTPU_WAVE", "legacy")
+        eng2, out = run()
+        assert not eng2._wave_dispatch_on
+        assert out == ref
+
+
+# ---------------------------------------------------------------------------
+# preemption equivalence under the unified waves (single pool)
+# ---------------------------------------------------------------------------
+
+
+class TestPreemptionEquivalence:
+
+    def _run(self, kv_host_offload, num_kv_blocks, params=None):
+        from deepspeed_tpu.runtime import topology as topo_mod
+        topo_mod.reset()
+        model = tiny_model()
+        eng = InferenceEngineV2(
+            model, config=tiny_config(num_kv_blocks=num_kv_blocks), seed=3)
+        if params is not None:
+            eng.params = params
+        sched = ContinuousBatchingScheduler(eng, token_budget=32,
+                                            kv_host_offload=kv_host_offload)
+        rng = np.random.default_rng(7)
+        prompts = [rng.integers(0, 128, size=(8,)) for _ in range(2)]
+        reqs = [sched.submit(p, max_new_tokens=8) for p in prompts]
+        while sched.has_work:
+            if sched.step() == 0:
+                break
+        return eng, [list(r.generated) for r in reqs]
+
+    def test_offload_and_fold_match_unpreempted(self):
+        """Both preemption strategies — host-RAM stash/restore and the
+        fold-into-prompt re-prefill fallback — reproduce the unpreempted
+        generations token for token under the ragged wave dispatch."""
+        # 7 blocks -> 6 usable: each request needs 4 ((8 prompt + 8
+        # new)/4), so the pair contends and one preempts mid-generation
+        eng, ref = self._run(True, num_kv_blocks=257)  # roomy: no preempt
+        _, stash = self._run(True, num_kv_blocks=7, params=eng.params)
+        _, fold = self._run(False, num_kv_blocks=7, params=eng.params)
+        assert any(len(o) == 8 for o in stash)
+        for got, want in zip(stash, ref):
+            np.testing.assert_array_equal(got, want[:len(got)])
+        for got, want in zip(fold, ref):
+            np.testing.assert_array_equal(got, want[:len(got)])
+
+
+# ---------------------------------------------------------------------------
+# scheduler policy (stub engine: no device work)
+# ---------------------------------------------------------------------------
+
+
+class _SM:
+    max_ragged_batch_size = 32
+
+
+class _Cfg:
+    state_manager = _SM()
+    decode_burst = 1
+
+
+class StubEngine:
+    config = _Cfg()
+
+    def can_schedule(self, uids, lengths):
+        return True
+
+    def put(self, uids, tokens):
+        return np.zeros((len(uids), 16), np.float32)
+
+    def flush(self, uid):
+        pass
+
+
+class TestSlaPolicy:
+
+    def test_disaggregated_separates_classes(self):
+        """mode='disaggregated' with both classes pending alternates
+        decode-only and prefill-only waves (no SLA pressure: share 0.5)."""
+        sched = ContinuousBatchingScheduler(
+            StubEngine(), token_budget=32, mode="disaggregated")
+        sched.submit(list(range(20)), max_new_tokens=4)
+        assert sched.step() == 20          # prefill completes, now running
+        sched.submit(list(range(20)), max_new_tokens=4)
+        kinds = []
+        for _ in range(4):
+            n0 = len(sched._running)
+            q0 = sum(r.prefill_remaining for r in sched._queue)
+            sched.step()
+            q1 = sum(r.prefill_remaining for r in sched._queue)
+            kinds.append("prefill" if q1 < q0 else "decode")
+            if not sched._queue:
+                break
+        # the two classes never mixed in one wave, and both ran
+        assert "prefill" in kinds and "decode" in kinds
+
+    def test_gen_pressure_freezes_admission_ttft_overrides(self):
+        """Admission policy: rolling p50 execute above 1/gen_sla freezes
+        NEW admissions; TTFT pressure (oldest wait > ttft_sla/2)
+        overrides the freeze."""
+        from deepspeed_tpu.telemetry import clock
+        sched = ContinuousBatchingScheduler(
+            StubEngine(), token_budget=32, mode="disaggregated",
+            gen_sla_tok_s=100.0, ttft_sla_s=1000.0)
+        sched._running.append(sched.submit([1, 2]))  # fake a running seq
+        sched._queue.clear()
+        for _ in range(8):
+            sched._exec_hist.record(0.5)   # 0.5 s/wave >> 0.01 s SLA
+        assert sched._gen_pressure()
+        now = clock.now()
+        req = sched.submit(list(range(4)))
+        req.submit_s = now  # just arrived: no TTFT pressure yet
+        assert not sched._admit_new(now)
+        req.submit_s = now - 600.0         # waited > ttft_sla/2
+        assert sched._ttft_pressure(now)
+        assert sched._admit_new(now)
+
+    def test_queue_wait_execute_split_recorded(self, tmp_path):
+        """TTFT attribution: per-request queue-wait and TTFT land in the
+        telemetry reservoirs, and wave records carry execute time plus
+        the admitted requests' wait — the 'honest under deep queues'
+        satellite."""
+        from deepspeed_tpu.telemetry import (TelemetryConfig,
+                                             build_telemetry,
+                                             reset_telemetry)
+        tele = build_telemetry(TelemetryConfig(
+            enabled=True, watchdog={"enabled": False},
+            trace={"output_path": str(tmp_path)}))
+        try:
+            sched = ContinuousBatchingScheduler(StubEngine(),
+                                                token_budget=32)
+            sched.submit(list(range(6)), max_new_tokens=2)
+            sched.step()                  # prefill -> first token
+            assert len(tele.metrics.ttft_latency) == 1
+            assert len(tele.metrics.queue_wait) == 1
+            assert len(tele.metrics.ttft_execute) == 1
+            ttft = tele.metrics.ttft_latency.percentiles((50,))["p50"]
+            wait = tele.metrics.queue_wait.percentiles((50,))["p50"]
+            assert 0.0 <= wait <= ttft
+            summary = tele.metrics.summary()
+            assert "ttft_p99_s" in summary and "queue_wait_p99_s" in summary
+            waves = [e for e in tele.trace.events()
+                     if e["kind"] == "instant"
+                     and e["name"].startswith("wave:")]
+            assert waves[-1]["args"]["admitted"] == 1
+            assert "queue_wait_ms" in waves[-1]["args"]
+        finally:
+            reset_telemetry()

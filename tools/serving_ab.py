@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Ragged-wave dispatch vs legacy two-class dispatch: process-interleaved
+serving A/B on the SAME request trace (ISSUE 6 satellite).
+
+Variants (each in its own subprocess, interleaved per the repo noise
+protocol, tools/ab_common.py):
+
+- ``wave``   — the unified ragged-wave program (ONE atom class per
+  launch, kernels/ragged_paged_attention.py);
+- ``legacy`` — the previous decode-rows + prefill-grid program pair
+  (``DSTPU_WAVE=legacy``), the denominator every earlier serving line
+  was measured on.
+
+Both serve an identical trace: N requests of fixed prompt length under
+the arrival protocol, greedy decode. The child prints out-tok/s plus the
+telemetry-reservoir TTFT percentiles so the comparison covers latency
+attribution too, not just throughput.
+
+Env knobs: DSTPU_AB_REQS (16), DSTPU_AB_PROMPT (256), DSTPU_AB_NEW (32),
+DSTPU_AB_ARCH ('scaled-moe' = the bench's mixtral-arch model; 'tiny' =
+llama2-tiny for smoke runs off-chip).
+
+Run: python tools/serving_ab.py            (dispatcher)
+     python tools/serving_ab.py --child X  (one variant, one window)
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VARIANTS = ("wave", "legacy")
+
+
+def child(variant: str):
+    if variant == "legacy":
+        os.environ["DSTPU_WAVE"] = "legacy"
+    else:
+        os.environ.pop("DSTPU_WAVE", None)
+    import time
+
+    import jax.numpy as jnp
+
+    from bench import bench_serving
+    from deepspeed_tpu.models import llama_model, mixtral_model
+
+    arch = os.environ.get("DSTPU_AB_ARCH", "scaled-moe")
+    reqs = int(os.environ.get("DSTPU_AB_REQS", "16"))
+    prompt = int(os.environ.get("DSTPU_AB_PROMPT", "256"))
+    max_new = int(os.environ.get("DSTPU_AB_NEW", "32"))
+    if arch == "tiny":
+        model = llama_model("llama2-tiny", dtype=jnp.float32, remat=False)
+        prompt, max_new = min(prompt, 16), min(max_new, 8)
+    else:
+        model = mixtral_model("mixtral-8x7b", dtype=jnp.bfloat16,
+                              remat=False, num_layers=8, hidden_size=1024,
+                              intermediate_size=3584, num_heads=16,
+                              num_kv_heads=4, max_seq_len=1024,
+                              vocab_size=32000)
+    t0 = time.perf_counter()
+    line = bench_serving(model, n_requests=reqs, prompt_len=prompt,
+                         max_new=max_new, token_budget=max(1024, prompt),
+                         peak_tflops=None, stagger_s=2.0 / max(reqs, 1),
+                         decode_burst=8, label=f"{variant} A/B, ")
+    wall = time.perf_counter() - t0
+    print(json.dumps({
+        "variant": variant,
+        # ab_common keeps the MIN best_window_s across a variant's
+        # windows: report seconds-per-kilotoken so the best window IS the
+        # highest-throughput one (wall covers warmup+compile and only
+        # rides along as context)
+        "best_window_s": round(1000.0 / max(line["value"], 1e-9), 4),
+        "wall_s": round(wall, 3),
+        "out_tok_s": line["value"],
+        "mean_ttft_s": line.get("mean_ttft_s"),
+        "ttft_p50_s": line.get("ttft_p50_s"),
+        "ttft_p99_s": line.get("ttft_p99_s"),
+        "queue_wait_p99_s": line.get("queue_wait_p99_s"),
+    }), flush=True)
+
+
+def main():
+    if "--child" in sys.argv:
+        child(sys.argv[sys.argv.index("--child") + 1])
+        return
+    from tools.ab_common import run_interleaved
+
+    best = run_interleaved(
+        VARIANTS,
+        lambda name: [sys.executable, os.path.abspath(__file__),
+                      "--child", name],
+        rounds=int(os.environ.get("DSTPU_AB_ROUNDS", "2")),
+        timeout=int(os.environ.get("DSTPU_AB_TIMEOUT", "1800")))
+    if all(n in best for n in VARIANTS):
+        print(json.dumps({
+            "metric": "serving A/B wave vs legacy (same trace)",
+            "wave_out_tok_s": best["wave"]["out_tok_s"],
+            "legacy_out_tok_s": best["legacy"]["out_tok_s"],
+            "wave_speedup": round(best["wave"]["out_tok_s"]
+                                  / max(best["legacy"]["out_tok_s"], 1e-9),
+                                  3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,6 +1,6 @@
 """dstpu-lint: static analysis enforcing TPU-graph invariants.
 
-Three layers (see docs/STATIC_ANALYSIS.md):
+Four layers (see docs/STATIC_ANALYSIS.md):
 
 - **Layer A** (:mod:`.ast_rules`) — pure-AST rules, no jax import, runs on
   every file: hidden host syncs, trace-time nondeterminism, Python
@@ -15,6 +15,13 @@ Three layers (see docs/STATIC_ANALYSIS.md):
   (``implicit-reshard``), replicated large intermediates, full-param scan
   residuals, donations XLA actually dropped, and compiled memory bytes
   against the shrink-only ``tools/memory_budgets.json``.
+- **Layer D** (:mod:`.schedule_audit`) — walks the same compiled
+  artifact's instruction SCHEDULE: classifies every collective
+  overlapped/exposed/serialized (dot/conv FLOP slack vs a per-platform
+  bytes/flop ratio, ``while`` bodies trip-count-scaled), gates exposed
+  bytes against the shrink-only ``tools/exposure_budgets.json``, and
+  emits per-entry collective placement maps
+  (``tools/collective_maps/``).
 
 Findings are structured (:mod:`.findings`), rules pluggable
 (:mod:`.registry`), and the gate diffs against ``tools/lint_baseline.json``

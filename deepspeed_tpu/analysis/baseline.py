@@ -16,21 +16,28 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Dict, List, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from .findings import Finding, sort_findings
 
 
 TRACE_PREFIX = "<trace:"
 SPMD_PREFIX = "<spmd:"
+SCHED_PREFIX = "<sched:"
 
-#: the three layers a finding can come from, keyed by its path marker.
+#: the four layers a finding can come from, keyed by its path marker.
 #: Layers don't always run together (the jaxpr audit needs a working JAX,
-#: the SPMD audit additionally compiles), so baseline diffs must only
-#: cover the layers that actually ran — otherwise an AST-only run reports
-#: grandfathered jaxpr/spmd entries as stale, and ``--write-baseline``
-#: silently drops them.
-LAYER_KEYS = ("ast", "jaxpr", "spmd")
+#: the SPMD/schedule audits additionally compile), so baseline diffs must
+#: only cover the layers that actually ran — otherwise an AST-only run
+#: reports grandfathered jaxpr/spmd/schedule entries as stale, and
+#: ``--write-baseline`` silently drops them.
+LAYER_KEYS = ("ast", "jaxpr", "spmd", "schedule")
+
+#: path markers of the entry-point layers (everything except "ast") — the
+#: layers whose baseline entries are keyed by a registered entry-point
+#: name rather than a source file.
+ENTRY_PREFIXES = {"jaxpr": TRACE_PREFIX, "spmd": SPMD_PREFIX,
+                  "schedule": SCHED_PREFIX}
 
 
 def finding_layer(f: Finding) -> str:
@@ -38,7 +45,33 @@ def finding_layer(f: Finding) -> str:
         return "jaxpr"
     if f.path.startswith(SPMD_PREFIX):
         return "spmd"
+    if f.path.startswith(SCHED_PREFIX):
+        return "schedule"
     return "ast"
+
+
+def entry_name(path: str) -> Optional[str]:
+    """The registered entry-point name a ``<trace:...>``/``<spmd:...>``/
+    ``<sched:...>`` finding path refers to; None for AST (file) paths."""
+    for prefix in ENTRY_PREFIXES.values():
+        if path.startswith(prefix) and path.endswith(">"):
+            return path[len(prefix):-1]
+    return None
+
+
+def prune_unknown_entries(findings: List[Finding], known: Iterable[str]
+                          ) -> Tuple[List[Finding], List[Finding]]:
+    """Drop baseline entries whose path names an entry point that no
+    longer exists in the registry -> (kept, pruned). Without this,
+    ``--write-baseline`` on a partial layer run carries grandfathered
+    findings for deleted specs forever (they can never fire again, so
+    they can never go stale either)."""
+    known = set(known)
+    kept, pruned = [], []
+    for f in findings:
+        name = entry_name(f.path)
+        (pruned if name is not None and name not in known else kept).append(f)
+    return kept, pruned
 
 
 def by_layer(findings: List[Finding]) -> Dict[str, List[Finding]]:
@@ -48,11 +81,10 @@ def by_layer(findings: List[Finding]) -> Dict[str, List[Finding]]:
     return out
 
 
-def split_layers(findings: List[Finding]
-                 ) -> Tuple[List[Finding], List[Finding], List[Finding]]:
-    """-> (ast, jaxpr, spmd) findings, by path marker."""
+def split_layers(findings: List[Finding]) -> Tuple[List[Finding], ...]:
+    """-> (ast, jaxpr, spmd, schedule) findings, by path marker."""
     layers = by_layer(findings)
-    return layers["ast"], layers["jaxpr"], layers["spmd"]
+    return tuple(layers[k] for k in LAYER_KEYS)
 
 
 def default_baseline_path() -> str:

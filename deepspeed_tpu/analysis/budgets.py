@@ -40,16 +40,19 @@ def default_budgets_path() -> str:
     return os.path.join(root, "tools", "memory_budgets.json")
 
 
-def load_budgets(path: str) -> Optional[Dict]:
+def load_budgets(path: str,
+                 fields: Tuple[str, ...] = TRACKED_FIELDS) -> Optional[Dict]:
     """-> {"mesh_devices": int, "budgets": {entry: {field: int}}} or None
-    when the file doesn't exist yet."""
+    when the file doesn't exist yet. ``fields`` selects the tracked keys —
+    Layer C's memory budgets by default; Layer D passes its exposure
+    fields so both shrink-only files share one loader."""
     if not os.path.exists(path):
         return None
     with open(path, "r", encoding="utf-8") as fh:
         data = json.load(fh)
     return {"mesh_devices": int(data.get("mesh_devices", 0)),
             "budgets": {k: {f: int(v) for f, v in e.items()
-                            if f in TRACKED_FIELDS}
+                            if f in fields}
                         for k, e in data.get("budgets", {}).items()}}
 
 
@@ -61,12 +64,16 @@ def env_matches(budgets: Optional[Dict]) -> bool:
     return jax.device_count() == budgets["mesh_devices"]
 
 
-def write_budgets(path: str, budgets: Dict) -> None:
-    data = {
-        "comment": "Per-entry-point compiled memory & collective byte "
+DEFAULT_COMMENT = ("Per-entry-point compiled memory & collective byte "
                    "budgets (dstpu lint --spmd). Shrink, never grow: "
                    "`dstpu lint --update-budgets` only lowers; raising a "
-                   "budget is a hand edit that must survive review.",
+                   "budget is a hand edit that must survive review.")
+
+
+def write_budgets(path: str, budgets: Dict,
+                  comment: Optional[str] = None) -> None:
+    data = {
+        "comment": comment or DEFAULT_COMMENT,
         "mesh_devices": budgets["mesh_devices"],
         "budgets": {k: dict(sorted(e.items()))
                     for k, e in sorted(budgets["budgets"].items())},
@@ -77,7 +84,9 @@ def write_budgets(path: str, budgets: Dict) -> None:
 
 
 def shrink_budgets(old: Optional[Dict], reports: Dict[str, Dict[str, int]],
-                   mesh_devices: int) -> Tuple[Dict, List[str]]:
+                   mesh_devices: int,
+                   fields: Tuple[str, ...] = TRACKED_FIELDS
+                   ) -> Tuple[Dict, List[str]]:
     """Merge current ``reports`` into ``old`` budgets, ONLY downward.
 
     Returns the new budgets dict and the list of ``entry.field`` keys whose
@@ -89,7 +98,7 @@ def shrink_budgets(old: Optional[Dict], reports: Dict[str, Dict[str, int]],
                                          for k, v in old_budgets.items()}
     for name, report in reports.items():
         entry = merged.setdefault(name, {})
-        for field in TRACKED_FIELDS:
+        for field in fields:
             if field not in report:
                 continue
             cur = int(report[field])

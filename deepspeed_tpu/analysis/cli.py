@@ -10,8 +10,13 @@ mesh/shardings and audits the post-SPMD artifact against
 ``tools/memory_budgets.json`` (run it with
 ``--xla_force_host_platform_device_count=8`` so the budgets' audit mesh
 matches). ``--update-budgets`` re-pins the budgets file — downward only.
-``--json`` emits the findings, the baseline diff, and (when ``--spmd``
-ran) the per-entry memory/collective reports as machine-readable JSON.
+The schedule layer (``--schedule``) walks each compiled entry point's
+instruction schedule, classifies every collective overlapped/exposed/
+serialized against ``tools/exposure_budgets.json`` and refreshes the
+per-entry placement maps in ``tools/collective_maps/``.
+``--json`` emits the findings, the baseline diff, and (when ``--spmd`` /
+``--schedule`` ran) the per-entry memory/collective/schedule reports as
+machine-readable JSON.
 """
 
 from __future__ import annotations
@@ -24,7 +29,7 @@ from typing import List
 
 from . import ast_rules
 from .baseline import (by_layer, default_baseline_path, diff_against_baseline,
-                       load_baseline, write_baseline)
+                       load_baseline, prune_unknown_entries, write_baseline)
 from .findings import Finding, SEVERITY_ERROR, sort_findings
 from .registry import all_rules, is_known
 
@@ -69,29 +74,55 @@ def run_jaxpr_layer(entry_names=None) -> List[Finding]:
     return audit_entry_points(entry_names)
 
 
-def run_spmd_layer(entry_names=None, budgets_path=None):
+def _budget_gate_note(budgets, path, what, update_flag):
+    """-> env_matches(budgets), with a visible note when the gate is
+    skipped — a silently-skipped budget check looks like a pass."""
+    from .budgets import env_matches
+
+    checked = env_matches(budgets)
+    if budgets is None:
+        print(f"dstpu lint: no {what} file at {path} — {what} checks "
+              f"skipped (run {update_flag} to create it)", file=sys.stderr)
+    elif not checked:
+        import jax
+        print(f"dstpu lint: skipping {what} checks — {jax.device_count()} "
+              f"live device(s) vs committed audit mesh of "
+              f"{budgets['mesh_devices']}", file=sys.stderr)
+    return checked
+
+
+def run_spmd_layer(entry_names=None, budgets_path=None, entries=None):
     """-> (findings, reports, budgets_checked: bool). Budget comparison is
     skipped (with a visible note) when the live device count differs from
     the committed audit mesh — bytes from a different partitioning are not
-    comparable."""
-    from .budgets import default_budgets_path, env_matches, load_budgets
+    comparable. ``entries`` is an optional shared compile pass (a combined
+    ``--spmd --schedule`` run lowers each entry once for both layers)."""
+    from .budgets import default_budgets_path, load_budgets
     from .spmd_audit import audit_spmd_entry_points
 
     path = budgets_path or default_budgets_path()
     budgets = load_budgets(path)
-    checked = env_matches(budgets)
-    if budgets is None:
-        # a silently-skipped budget gate looks like a pass — say so
-        print(f"dstpu lint: no budgets file at {path} — budget checks "
-              "skipped (run --update-budgets to create it)",
-              file=sys.stderr)
-    elif not checked:
-        import jax
-        print(f"dstpu lint: skipping budget checks — {jax.device_count()} "
-              f"live device(s) vs committed audit mesh of "
-              f"{budgets['mesh_devices']}", file=sys.stderr)
+    checked = _budget_gate_note(budgets, path, "budget", "--update-budgets")
     findings, reports = audit_spmd_entry_points(
-        entry_names, budgets=budgets if checked else None)
+        entry_names, budgets=budgets if checked else None, entries=entries)
+    return findings, reports, checked
+
+
+def run_schedule_layer(entry_names=None, exposure_path=None, entries=None):
+    """Layer D (``--schedule``): compile each entry point and walk its
+    schedule. -> (findings, reports, exposure_checked: bool). Same
+    mesh-match semantics (and shared-``entries`` contract) as the
+    Layer-C budgets."""
+    from .schedule_audit import (audit_schedule_entry_points,
+                                 default_exposure_path,
+                                 load_exposure_budgets)
+
+    path = exposure_path or default_exposure_path()
+    exposure = load_exposure_budgets(path)
+    checked = _budget_gate_note(exposure, path, "exposure budget",
+                                "--schedule --update-budgets")
+    findings, reports = audit_schedule_entry_points(
+        entry_names, exposure=exposure if checked else None, entries=entries)
     return findings, reports, checked
 
 
@@ -120,6 +151,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "(lowers+compiles every entry point with its "
                              "real mesh/shardings; checks "
                              "tools/memory_budgets.json)")
+    parser.add_argument("--schedule", action="store_true",
+                        help="also run the Layer-D HLO-schedule overlap "
+                             "audits (classifies every compiled collective "
+                             "overlapped/exposed/serialized, checks "
+                             "tools/exposure_budgets.json, and refreshes "
+                             "tools/collective_maps/<entry>.json)")
+    parser.add_argument("--maps-dir", default=None,
+                        help="directory for the per-entry collective maps "
+                             "a --schedule run emits (default: "
+                             "tools/collective_maps)")
     parser.add_argument("--entry", action="append", default=None,
                         help="restrict --jaxpr/--spmd to the named entry "
                              "points")
@@ -128,10 +169,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--budgets", default=None,
                         help="budgets JSON (default: "
                              "tools/memory_budgets.json)")
+    parser.add_argument("--exposure-budgets", default=None,
+                        dest="exposure_budgets",
+                        help="exposure budgets JSON for --schedule "
+                             "(default: tools/exposure_budgets.json)")
     parser.add_argument("--update-budgets", action="store_true",
                         help="run --spmd and re-pin the budgets file — "
                              "DOWNWARD only; exceeded budgets stay put and "
-                             "keep failing until fixed or hand-raised")
+                             "keep failing until fixed or hand-raised. "
+                             "With --schedule, additionally re-pins "
+                             "tools/exposure_budgets.json (same contract)")
     parser.add_argument("--no-baseline", action="store_true",
                         help="report every finding; ignore the baseline")
     parser.add_argument("--write-baseline", action="store_true",
@@ -181,6 +228,7 @@ def _main(args) -> int:
     if args.list_rules:
         from . import trace_harness  # noqa: F401 — registers Layer-B rules
         from . import spmd_audit  # noqa: F401 — registers Layer-C rules
+        from . import schedule_audit  # noqa: F401 — registers Layer-D rules
         for rule in all_rules():
             print(f"{rule.rule_id:26} [{rule.layer}/{rule.severity}] "
                   f"{rule.description}")
@@ -193,45 +241,95 @@ def _main(args) -> int:
             return 2
 
     run_spmd = args.spmd or args.update_budgets
-    if run_spmd:
+    run_sched = args.schedule
+    if run_spmd or run_sched:
         # fail fast on budget-file problems BEFORE the ~40s compile audit:
         # a typo'd explicit --budgets path must not silently disable the
         # gate, and --update-budgets on the wrong mesh must not waste the
         # whole run only to refuse at the end
         from .budgets import default_budgets_path, load_budgets
+        from .schedule_audit import (default_exposure_path,
+                                     load_exposure_budgets)
         budgets_path = args.budgets or default_budgets_path()
-        if (args.budgets and not args.update_budgets
-                and not os.path.exists(args.budgets)):
-            print(f"dstpu lint: no such budgets file: {args.budgets}",
-                  file=sys.stderr)
-            return 2
-        if args.update_budgets:
-            import jax
-            old = load_budgets(budgets_path)
-            if old is not None and old["mesh_devices"] != jax.device_count():
-                # numbers from a different partitioning are not comparable
-                # — refusing beats silently replacing the committed audit
-                # mesh
-                print(f"dstpu lint: refusing --update-budgets: "
-                      f"{budgets_path} was taken on {old['mesh_devices']} "
-                      f"devices, this environment has {jax.device_count()}",
+        exposure_path = args.exposure_budgets or default_exposure_path()
+        for given, what in ((args.budgets if run_spmd else None, "budgets"),
+                            (args.exposure_budgets if run_sched else None,
+                             "exposure budgets")):
+            if given and not args.update_budgets and not os.path.exists(given):
+                print(f"dstpu lint: no such {what} file: {given}",
                       file=sys.stderr)
                 return 2
+        if args.update_budgets:
+            import jax
+            pinned = [(budgets_path, load_budgets(budgets_path))]
+            if run_sched:
+                pinned.append((exposure_path,
+                               load_exposure_budgets(exposure_path)))
+            for path, old in pinned:
+                if old is not None \
+                        and old["mesh_devices"] != jax.device_count():
+                    # numbers from a different partitioning are not
+                    # comparable — refusing beats silently replacing the
+                    # committed audit mesh
+                    print(f"dstpu lint: refusing --update-budgets: "
+                          f"{path} was taken on {old['mesh_devices']} "
+                          f"devices, this environment has "
+                          f"{jax.device_count()}", file=sys.stderr)
+                    return 2
 
     findings = run_ast_layer(paths)
     spmd_reports = {}
+    sched_reports = {}
     budgets_checked = False
+    exposure_checked = False
     try:
         if args.jaxpr:
             findings += run_jaxpr_layer(args.entry)
+        shared_entries = None
+        if run_spmd and run_sched:
+            # one lower+compile pass feeds both compiled layers
+            from .spmd_audit import iter_compiled_entries
+            shared_entries = list(iter_compiled_entries(args.entry))
         if run_spmd:
             spmd_findings, spmd_reports, budgets_checked = run_spmd_layer(
-                args.entry, args.budgets)
+                args.entry, args.budgets, entries=shared_entries)
             findings += spmd_findings
+        if run_sched:
+            sched_findings, sched_reports, exposure_checked = \
+                run_schedule_layer(args.entry, args.exposure_budgets,
+                                   entries=shared_entries)
+            findings += sched_findings
     except ValueError as e:
         print(f"dstpu lint: {e}", file=sys.stderr)
         return 2
     findings = sort_findings(findings)
+
+    collective_maps = {}
+    if run_sched:
+        # every --schedule run refreshes the committed placement maps —
+        # the declarative artifact the auto-overlap planner consumes.
+        # Same mesh discipline as the budgets: placement from a different
+        # partitioning must not overwrite the committed audit-mesh maps
+        # (a missing exposure file means bootstrap — write freely).
+        from .budgets import env_matches
+        from .schedule_audit import (default_maps_dir, load_exposure_budgets,
+                                     write_collective_map)
+        import jax
+        exposure_on_disk = load_exposure_budgets(exposure_path)
+        maps_ok = exposure_on_disk is None or env_matches(exposure_on_disk)
+        maps_dir = args.maps_dir or default_maps_dir()
+        for name, report in sched_reports.items():
+            if maps_ok:
+                write_collective_map(maps_dir, report, jax.device_count())
+            collective_maps[name] = report.to_map(jax.device_count())
+        if sched_reports and maps_ok:
+            print(f"refreshed {len(sched_reports)} collective map(s) in "
+                  f"{maps_dir}", file=sys.stderr)
+        elif sched_reports:
+            print(f"dstpu lint: NOT refreshing collective maps — "
+                  f"{jax.device_count()} live device(s) vs committed audit "
+                  f"mesh of {exposure_on_disk['mesh_devices']}",
+                  file=sys.stderr)
 
     if args.update_budgets:
         from .budgets import shrink_budgets, write_budgets
@@ -248,16 +346,54 @@ def _main(args) -> int:
         for key in exceeded:
             print(f"  NOT raised (exceeds committed budget): {key}",
                   file=sys.stderr)
+        if run_sched:
+            from .schedule_audit import (shrink_exposure_budgets,
+                                         write_exposure_budgets)
+            old_exp = load_exposure_budgets(exposure_path)
+            exp_reports = {k: r.budget_fields()
+                           for k, r in sched_reports.items()}
+            merged_exp, exceeded_exp = shrink_exposure_budgets(
+                old_exp, exp_reports, jax.device_count())
+            write_exposure_budgets(exposure_path, merged_exp)
+            print(f"wrote {len(merged_exp['budgets'])} exposure budget "
+                  f"entr{'y' if len(merged_exp['budgets']) == 1 else 'ies'} "
+                  f"to {exposure_path} (downward only)",
+                  file=sys.stderr if args.as_json else sys.stdout)
+            for key in exceeded_exp:
+                print(f"  NOT raised (exceeds committed exposure budget): "
+                      f"{key}", file=sys.stderr)
 
     ran_layers = {"ast"} | ({"jaxpr"} if args.jaxpr else set()) \
-        | ({"spmd"} if run_spmd else set())
+        | ({"spmd"} if run_spmd else set()) \
+        | ({"schedule"} if run_sched else set())
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
         # A partial run must not erase grandfathered entries for the
-        # layers that did not run: carry their baseline slices over.
+        # layers that did not run: carry their baseline slices over —
+        # except entries naming specs that no longer exist in the
+        # registry, which are pruned with a warning (they could otherwise
+        # never fire and never go stale: grandfathered forever).
+        from .baseline import entry_name
         kept_layers = by_layer(load_baseline(baseline_path))
         kept = [f for layer, fs in kept_layers.items()
                 if layer not in ran_layers for f in fs]
+        if args.entry:
+            # an --entry-restricted run only re-audited THOSE entries:
+            # the ran layers' baseline slices for every other entry point
+            # carry over too, or a partial regenerate would erase them
+            audited = set(args.entry)
+            kept += [f for layer, fs in kept_layers.items()
+                     if layer in ran_layers and layer != "ast"
+                     for f in fs if entry_name(f.path) not in audited]
+        pruned = []
+        if any(entry_name(f.path) is not None for f in kept):
+            # lazy: only an entry-marker carryover needs the registry —
+            # a pure AST regenerate must stay jax-import-free
+            from .entry_points import SPEC_BUILDERS
+            kept, pruned = prune_unknown_entries(kept, SPEC_BUILDERS)
+        for f in pruned:
+            print(f"dstpu lint: pruning stale baseline entry for unknown "
+                  f"entry point: {f.path} [{f.rule_id}]", file=sys.stderr)
         write_baseline(baseline_path, findings + kept)
         print(f"wrote {len(findings) + len(kept)} finding(s) to "
               f"{baseline_path}"
@@ -282,6 +418,11 @@ def _main(args) -> int:
             payload["spmd_reports"] = {k: r.to_dict()
                                        for k, r in spmd_reports.items()}
             payload["budgets_checked"] = budgets_checked
+        if run_sched:
+            payload["schedule_reports"] = {k: r.summary()
+                                           for k, r in sched_reports.items()}
+            payload["collective_maps"] = collective_maps
+            payload["exposure_checked"] = exposure_checked
         print(json.dumps(payload, indent=2))
     else:
         report = new if not args.no_baseline else findings

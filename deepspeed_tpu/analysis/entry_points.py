@@ -65,6 +65,12 @@ class EntrySpec:
     expected_spmd: FrozenSet[str] = frozenset()
     param_shapes: FrozenSet[Tuple[Tuple[int, ...], str]] = frozenset()
     gate_cheap: bool = False
+    #: Layer D contract (docs/STATIC_ANALYSIS.md): the entry's schedule is
+    #: DESIGNED to overlap its collectives — exposed bytes beyond the
+    #: committed exposure budget escalate from a budget regression to the
+    #: hard ``exposed-collective`` finding. Declared on the pipelined
+    #: ZeRO micro and the ragged serving wave.
+    overlap_contract: bool = False
     # bespoke Layer-B checks run by the builder (e.g. telemetry parity)
     extra_findings: List[Finding] = dataclasses.field(default_factory=list)
 
@@ -204,7 +210,8 @@ def build_zeropp_micro_overlap() -> EntrySpec:
         donate_argnums=(0,), mesh=engine.mesh,
         jit_kwargs=_zeropp_micro_jit_kwargs(engine),
         retrace_args=[args, args],
-        param_shapes=_full_param_shapes(engine.model))
+        param_shapes=_full_param_shapes(engine.model),
+        overlap_contract=True)
 
 
 def build_moe_dispatch() -> EntrySpec:
@@ -385,7 +392,8 @@ def build_ragged_paged_attention() -> EntrySpec:
     tables = put(jnp.zeros((dp * Ar, MP), jnp.int32), d)
     args = (q, k_pages, v_pages, cu, kv_lens, tables)
     return EntrySpec(name="ragged-paged-attention", fn=fn, args=args,
-                     mesh=mesh, retrace_args=[args, args], gate_cheap=True)
+                     mesh=mesh, retrace_args=[args, args], gate_cheap=True,
+                     overlap_contract=True)
 
 
 def build_telemetry_off_parity() -> EntrySpec:

@@ -1,0 +1,898 @@
+"""Layer D: HLO-schedule overlap auditor + collective placement maps.
+
+Layer C (:mod:`.spmd_audit`) answers *which* collectives the partitioned
+program contains; this layer answers *where they land in the schedule* and
+whether the surrounding compute can hide them. T3 (arXiv:2401.16677)
+argues that is the question that decides comm/compute overlap, and *The
+Big Send-off* (arXiv:2504.18658) needs the same placement data to pick a
+per-bucket algorithm — ROADMAP item 2's auto-overlap planner consumes the
+maps this layer emits.
+
+For every registered :class:`~.entry_points.EntrySpec` the auditor walks
+the compiled module's instruction sequence (the optimized HLO is emitted
+``is_scheduled=true``, so text order IS the schedule), pairs async
+``-start``/``-done`` collectives, costs the dot/conv FLOPs of the
+surrounding compute — recursing into ``while`` bodies scaled by the
+compiler's ``known_trip_count``, the static analogue of
+``TreeComm.trace_executions`` — and classifies each collective:
+
+- **overlapped** — enough *independent* compute sits in the collective's
+  slack window to hide its bytes under the per-platform bytes/flop ratio
+  (:func:`bytes_per_flop`). For an async pair the window is the
+  instructions between ``-start`` and ``-done`` (the schedule's declared
+  overlap); for a sync collective (the CPU audit mesh emits only these)
+  it is the compute scheduled *after* the launch that does not depend on
+  its result — what an async-capable backend could run concurrently.
+- **exposed** — the window's independent compute cannot hide the bytes:
+  the program stalls on the wire.
+- **serialized** — the collective's first reader is itself another
+  collective with zero costed compute between them: a dependent
+  back-to-back chain that no scheduler can overlap.
+
+Rules:
+
+- ``exposed-collective`` — entries declaring ``overlap_contract`` in
+  their spec (the pipelined ZeRO micro, the ragged serving wave) must
+  have zero exposed bytes beyond their committed exposure budget.
+- ``serialized-collective-chain`` — a dependent back-to-back collective
+  chain (above a noise floor) anywhere in the schedule.
+- ``exposure-budget-regression`` — per-entry exposed bytes checked
+  against the committed shrink-only ``tools/exposure_budgets.json``
+  (same contract as the memory budgets: ``--update-budgets`` only ever
+  writes downward).
+- ``schedule-audit-failed`` — the entry could not be compiled/walked.
+
+Each audit also produces the entry's **collective map**
+(``tools/collective_maps/<entry>.json``): kind, bytes, start/done
+schedule positions, hideable FLOPs, classification and loop context per
+collective — the declarative artifact the item-2 planner (and
+``tools/overlap_report.py``) consume.
+
+Findings carry the ``<sched:NAME>`` path marker so the baseline machinery
+treats the layer independently, exactly like Layer C's ``<spmd:NAME>``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+import numpy as np
+
+from .budgets import (load_budgets, shrink_budgets as _shrink,
+                      write_budgets as _write)
+from .findings import Finding, SEVERITY_ERROR, SEVERITY_WARNING, sort_findings
+from .registry import LAYER_SCHEDULE, Rule, register
+from .spmd_audit import _HLO_COLLECTIVE_KINDS, _dtype_itemsize
+
+SCHED_PREFIX = "<sched:"
+
+EXPOSED_COLLECTIVE = register(Rule(
+    rule_id="exposed-collective", layer=LAYER_SCHEDULE,
+    severity=SEVERITY_ERROR,
+    description="Entry point declares an overlap contract but its "
+                "schedule carries exposed collective bytes beyond the "
+                "committed exposure budget — the pipelining the entry "
+                "exists for has regressed",
+    fix_hint="restructure the schedule so the collective overlaps "
+             "independent compute (prefetch it a step earlier, move the "
+             "consumer later); if the exposure is a deliberate pipeline "
+             "edge, raise tools/exposure_budgets.json BY HAND and defend "
+             "it in review"))
+
+SERIALIZED_CHAIN = register(Rule(
+    rule_id="serialized-collective-chain", layer=LAYER_SCHEDULE,
+    severity=SEVERITY_WARNING,
+    description="Dependent back-to-back collectives with no compute "
+                "between them — the chain's latency is the sum of its "
+                "links and no scheduler can hide it",
+    fix_hint="break the dependence (fuse the collectives, reassociate "
+             "the reduction, or interleave independent compute between "
+             "the links); hierarchical/multi-algorithm selection "
+             "(ROADMAP item 1) is the systematic fix"))
+
+EXPOSURE_BUDGET_REGRESSION = register(Rule(
+    rule_id="exposure-budget-regression", layer=LAYER_SCHEDULE,
+    severity=SEVERITY_ERROR,
+    description="Exposed collective bytes exceed the committed "
+                "shrink-only budget (tools/exposure_budgets.json), or "
+                "the entry point has no committed exposure budget",
+    fix_hint="overlap the newly exposed collective back under compute; "
+             "if the exposure is justified, raise the budget BY HAND in "
+             "tools/exposure_budgets.json and defend it in review"))
+
+SCHEDULE_AUDIT_FAILED = register(Rule(
+    rule_id="schedule-audit-failed", layer=LAYER_SCHEDULE,
+    severity=SEVERITY_ERROR,
+    description="Entry point failed to compile or its schedule could not "
+                "be walked — a broken hot path must not pass silently",
+    fix_hint="run under JAX_PLATFORMS=cpu with "
+             "xla_force_host_platform_device_count>=8 and fix the "
+             "compile error"))
+
+#: serialized chains whose TOTAL moved bytes (summed over all links,
+#: execution-scaled) stay below this floor are noise — a scalar loss
+#: psum feeding a grad-norm psum is not worth a finding.
+SERIALIZED_MIN_BYTES = 4096
+
+#: classification: a collective is *overlapped* when
+#: ``hideable_flops * bytes_per_flop >= operand_bytes``. The ratio is the
+#: interconnect bytes a device can move per FLOP it computes — peak ICI
+#: bandwidth over peak dense FLOPs, same marketing-peak convention as
+#: telemetry's ``PEAK_FLOPS_BY_KIND`` (the number just has to be stated;
+#: classification is a roofline ratio, not a wall-clock claim). Keyed by
+#: substrings of ``jax.devices()[0].device_kind`` lowercased.
+BYTES_PER_FLOP_BY_KIND = (
+    ("v6e", 3.9e-4),         # ~360 GB/s ICI / 918 Tflops
+    ("v5p", 1.0e-3),         # ~459 GB/s ICI / 459 Tflops
+    ("v5e", 8.1e-4),         # ~160 GB/s ICI / 197 Tflops
+    ("v5 lite", 8.1e-4),
+    ("v4", 8.7e-4),          # ~240 GB/s ICI / 275 Tflops
+    ("v3", 5.3e-4),
+    ("v2", 1.1e-3),
+    ("cpu", 5e-2),           # host audit mesh: generous, so schedule
+                             # STRUCTURE (not host memcpy speed) decides
+)
+
+
+def bytes_per_flop(device_kind: Optional[str] = None) -> float:
+    """Per-platform hideable-bytes-per-flop ratio;
+    ``DSTPU_BYTES_PER_FLOP`` overrides."""
+    env = os.environ.get("DSTPU_BYTES_PER_FLOP")
+    if env:
+        return float(env)
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # pragma: no cover - no backend
+            return 5e-2
+    kind = (device_kind or "").lower()
+    for key, ratio in BYTES_PER_FLOP_BY_KIND:
+        if key in kind:
+            return ratio
+    return 5e-2
+
+
+# ---------------------------------------------------------------------------
+# structured HLO parsing (instruction order, operands, called computations)
+# ---------------------------------------------------------------------------
+
+_ARRAY_SHAPE_RE = re.compile(r"([a-z][\w]*)\[([0-9,]*)\]")
+_INSTR_HEAD_RE = re.compile(r"^\s+(?:ROOT\s+)?%([\w.\-]+)\s*=\s*")
+_COMP_HEAD_RE = re.compile(r"^(ENTRY\s+)?%([\w.\-]+)\s+\(.*\{\s*$")
+_OPCODE_RE = re.compile(r"\s*([a-z][a-z0-9\-]*)")
+_TRIP_COUNT_RE = re.compile(r'known_trip_count[^0-9]*"n"[^0-9]*(\d+)')
+_CALLED_RE = re.compile(r"(?:calls|body|to_apply)=%([\w.\-]+)")
+# conditional instructions name their branches with these attrs, not
+# `calls=` — missing them would silently drop branch collectives
+_BRANCH_KEYS_RE = re.compile(
+    r"(?:true_computation|false_computation|branch_computations)="
+    r"(%[\w.\-]+|\{[^}]*\})")
+_CONDITION_RE = re.compile(r"condition=%([\w.\-]+)")
+_METADATA_RE = re.compile(r'op_name="([^"]*)"')
+_SOURCE_RE = re.compile(r'source_file="([^"]*)"[^"]*source_line=(\d+)')
+_DIMS_SET_RE = {side: re.compile(side + r"_contracting_dims=\{([0-9,]*)\}")
+                for side in ("lhs", "rhs")}
+
+
+def _array_bytes(text: str) -> int:
+    """Total bytes of every typed array shape in ``text``."""
+    total = 0
+    for m in _ARRAY_SHAPE_RE.finditer(text):
+        dims = m.group(2)
+        n = int(np.prod([int(d) for d in dims.split(",")],
+                        dtype=np.int64)) if dims else 1
+        total += n * _dtype_itemsize(m.group(1))
+    return total
+
+
+def _balanced(text: str) -> Tuple[str, str]:
+    """Split ``(....)rest`` at the matching close paren -> (inner, rest)."""
+    depth = 0
+    for i, ch in enumerate(text):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return text[1:i], text[i + 1:]
+    return text[1:], ""
+
+
+def _top_level_split(text: str) -> List[str]:
+    out, depth, start = [], 0, 0
+    for i, ch in enumerate(text):
+        if ch in "({[":
+            depth += 1
+        elif ch in ")}]":
+            depth -= 1
+        elif ch == "," and depth == 0:
+            out.append(text[start:i])
+            start = i + 1
+    if text[start:].strip():
+        out.append(text[start:])
+    return out
+
+
+@dataclasses.dataclass
+class HloInstruction:
+    """One scheduled instruction of one computation."""
+    name: str
+    opcode: str
+    shape_text: str                    # result shape (array or tuple)
+    operands: List[Tuple[str, str]]    # (operand name, operand text)
+    attrs: str                         # everything after the operand list
+    index: int                         # schedule position in its computation
+
+    @property
+    def result_bytes(self) -> int:
+        return _array_bytes(self.shape_text)
+
+    @property
+    def operand_bytes(self) -> int:
+        return sum(_array_bytes(text) for _, text in self.operands)
+
+    @property
+    def operand_names(self) -> List[str]:
+        return [n for n, _ in self.operands]
+
+    @property
+    def called(self) -> List[str]:
+        return _CALLED_RE.findall(self.attrs)
+
+    @property
+    def branches(self) -> List[str]:
+        """Branch computations of a ``conditional`` (true/false or the
+        indexed ``branch_computations={...}`` form)."""
+        out: List[str] = []
+        for group in _BRANCH_KEYS_RE.findall(self.attrs):
+            out.extend(re.findall(r"%([\w.\-]+)", group))
+        return out
+
+    @property
+    def trip_count(self) -> Optional[int]:
+        m = _TRIP_COUNT_RE.search(self.attrs)
+        return int(m.group(1)) if m else None
+
+    @property
+    def op_name(self) -> str:
+        m = _METADATA_RE.search(self.attrs)
+        return m.group(1) if m else ""
+
+    @property
+    def source(self) -> str:
+        m = _SOURCE_RE.search(self.attrs)
+        if m is None:
+            return ""
+        # repo-relative: the committed maps must not bake in a machine
+        path = m.group(1)
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))) + os.sep
+        if path.startswith(root):
+            path = path[len(root):]
+        return f"{path}:{m.group(2)}"
+
+    @property
+    def collective_kind(self) -> Optional[str]:
+        """'all-gather' for both sync ops and ``-start`` halves; None for
+        non-collectives and for ``-done`` halves (paired, never counted
+        twice)."""
+        op = self.opcode
+        if op.endswith("-done"):
+            return None
+        kind = op[:-6] if op.endswith("-start") else op
+        return kind if kind in _HLO_COLLECTIVE_KINDS else None
+
+    @property
+    def is_async_start(self) -> bool:
+        return self.opcode.endswith("-start")
+
+
+def _parse_instruction(line: str, index: int) -> Optional[HloInstruction]:
+    head = _INSTR_HEAD_RE.match(line)
+    if head is None:
+        return None
+    name, rest = head.group(1), line[head.end():]
+    if rest.startswith("("):
+        shape_text, rest = _balanced(rest)
+        shape_text = f"({shape_text})"
+    else:
+        m = re.match(r"[\w]+\[[0-9,]*\](?:\{[^}]*\})?", rest)
+        if m is None:
+            return None
+        shape_text, rest = m.group(0), rest[m.end():]
+    op = _OPCODE_RE.match(rest)
+    if op is None:
+        return None
+    rest = rest[op.end():]
+    if not rest.startswith("("):
+        return None
+    operand_text, attrs = _balanced(rest)
+    operands = []
+    for seg in _top_level_split(operand_text):
+        names = re.findall(r"%([\w.\-]+)", seg)
+        if names:
+            operands.append((names[-1], seg))
+    return HloInstruction(name=name, opcode=op.group(1),
+                          shape_text=shape_text, operands=operands,
+                          attrs=attrs, index=index)
+
+
+@dataclasses.dataclass
+class HloComputation:
+    name: str
+    is_entry: bool
+    instructions: List[HloInstruction]
+
+    def __post_init__(self):
+        self.by_name = {i.name: i for i in self.instructions}
+
+
+def parse_hlo_computations(hlo_text: str) -> Dict[str, HloComputation]:
+    """The optimized module as ordered computations. The dump is emitted
+    with ``is_scheduled=true``, so each computation's instruction order is
+    the actual schedule."""
+    comps: Dict[str, HloComputation] = {}
+    current: Optional[HloComputation] = None
+    for line in hlo_text.splitlines():
+        head = _COMP_HEAD_RE.match(line)
+        if head is not None:
+            current = HloComputation(name=head.group(2),
+                                     is_entry=bool(head.group(1)),
+                                     instructions=[])
+            comps[current.name] = current
+            continue
+        if line.startswith("}"):
+            current = None
+            continue
+        if current is not None:
+            instr = _parse_instruction(line, len(current.instructions))
+            if instr is not None:
+                current.instructions.append(instr)
+                current.by_name[instr.name] = instr
+    return comps
+
+
+def entry_computation(comps: Dict[str, HloComputation]
+                      ) -> Optional[HloComputation]:
+    for comp in comps.values():
+        if comp.is_entry:
+            return comp
+    return None
+
+
+# ---------------------------------------------------------------------------
+# FLOP costing (dot/conv — the same cost model XLA's cost_analysis keys
+# MFU on; everything element-wise is treated as free)
+# ---------------------------------------------------------------------------
+
+def _shape_elems(shape_text: str) -> int:
+    total = 0
+    for m in _ARRAY_SHAPE_RE.finditer(shape_text):
+        dims = m.group(2)
+        total += int(np.prod([int(d) for d in dims.split(",")],
+                             dtype=np.int64)) if dims else 1
+    return total
+
+
+def _dot_flops(instr: HloInstruction) -> int:
+    """2 * result_elems * contracted_extent, dims from the dot's own
+    attrs and the lhs operand's printed shape."""
+    out_elems = _shape_elems(instr.shape_text)
+    if not instr.operands:
+        return 2 * out_elems
+    lhs_text = instr.operands[0][1]
+    m = _ARRAY_SHAPE_RE.search(lhs_text)
+    if m is None:
+        return 2 * out_elems
+    lhs_dims = [int(d) for d in m.group(2).split(",")] if m.group(2) else []
+    cm = _DIMS_SET_RE["lhs"].search(instr.attrs)
+    contracted = 1
+    if cm and cm.group(1):
+        for i in cm.group(1).split(","):
+            if int(i) < len(lhs_dims):
+                contracted *= lhs_dims[int(i)]
+    elif lhs_dims:
+        contracted = lhs_dims[-1]   # default dot: last lhs dim contracts
+    return 2 * out_elems * contracted
+
+
+def _conv_flops(instr: HloInstruction) -> int:
+    """2 * output_elems * (kernel elems / output features) — the rhs is
+    the kernel; its output-feature dim ('o' in dim_labels) produces, the
+    rest contract."""
+    out_elems = _shape_elems(instr.shape_text)
+    if len(instr.operands) < 2:
+        return 2 * out_elems
+    m = _ARRAY_SHAPE_RE.search(instr.operands[1][1])
+    if m is None:
+        return 2 * out_elems
+    rhs_dims = [int(d) for d in m.group(2).split(",")] if m.group(2) else []
+    kernel = int(np.prod(rhs_dims, dtype=np.int64)) if rhs_dims else 1
+    lm = re.search(r"dim_labels=[^_,]*_([\w?]+)->", instr.attrs)
+    if lm and "o" in lm.group(1) and lm.group(1).index("o") < len(rhs_dims):
+        kernel //= max(1, rhs_dims[lm.group(1).index("o")])
+    return 2 * out_elems * kernel
+
+
+class FlopModel:
+    """Per-instruction and per-computation dot/conv FLOPs, with
+    ``fusion``/``call``/``while`` instructions charged their callee's cost
+    (``while`` scaled by the compiler's known trip count)."""
+
+    def __init__(self, comps: Dict[str, HloComputation]):
+        self.comps = comps
+        self._comp_cache: Dict[str, int] = {}
+
+    def instruction_flops(self, instr: HloInstruction) -> int:
+        op = instr.opcode
+        if op == "dot":
+            return _dot_flops(instr)
+        if op == "convolution":
+            return _conv_flops(instr)
+        if instr.collective_kind is not None or op.endswith("-done"):
+            return 0    # a collective's reduction lambda is not compute
+        if op == "conditional":
+            # one branch runs: charge the cheapest (conservative for the
+            # hideable-compute estimate)
+            branch_costs = [self.computation_flops(b)
+                            for b in instr.branches]
+            return min(branch_costs) if branch_costs else 0
+        called = instr.called
+        if not called:
+            return 0
+        total = sum(self.computation_flops(c) for c in called)
+        if op == "while":
+            total *= max(1, instr.trip_count or 1)
+        return total
+
+    def computation_flops(self, name: str) -> int:
+        if name in self._comp_cache:
+            return self._comp_cache[name]
+        self._comp_cache[name] = 0   # cycle guard
+        comp = self.comps.get(name)
+        if comp is not None:
+            self._comp_cache[name] = sum(self.instruction_flops(i)
+                                         for i in comp.instructions)
+        return self._comp_cache[name]
+
+
+# ---------------------------------------------------------------------------
+# the schedule walk
+# ---------------------------------------------------------------------------
+
+CLASS_OVERLAPPED = "overlapped"
+CLASS_EXPOSED = "exposed"
+CLASS_SERIALIZED = "serialized"
+
+
+@dataclasses.dataclass
+class CollectiveRecord:
+    """One collective's placement in the compiled schedule — a row of the
+    entry's collective map."""
+    kind: str
+    name: str
+    computation: str
+    start_index: int
+    done_index: Optional[int]          # async pairs only
+    operand_bytes: int                 # input-side bytes, per launch
+    result_bytes: int
+    hideable_flops: int
+    classification: str
+    executions: int                    # loop-context trip-count product
+    loop: Optional[Dict[str, Any]]     # {"while": ..., "trip_count": ...}
+    op_name: str
+    source: str
+
+    @property
+    def moved_bytes(self) -> int:
+        """Execution-scaled input-side bytes — the convention matches the
+        runtime's ``record_collective`` (which charges each launch's
+        input bytes), so the static and runtime splits compare."""
+        return self.operand_bytes * self.executions
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+def _async_done_index(comp: HloComputation, start: HloInstruction
+                      ) -> Optional[int]:
+    for instr in comp.instructions[start.index + 1:]:
+        if instr.opcode == start.opcode[:-6] + "-done" \
+                and start.name in instr.operand_names:
+            return instr.index
+    return None
+
+
+def _dependents(comp: HloComputation, roots: Set[str]) -> Set[str]:
+    """Names of instructions transitively reading any of ``roots`` within
+    the computation (schedule order makes one forward pass sufficient)."""
+    out = set(roots)
+    for instr in comp.instructions:
+        if instr.name in out:
+            continue
+        if any(n in out for n in instr.operand_names):
+            out.add(instr.name)
+    return out - roots
+
+
+def _start_result_bytes(instr: HloInstruction) -> int:
+    """An async ``-start`` returns ``(operand aliases..., results...,
+    context scratch...)`` — charge only the result slice: skip as many
+    leading shapes as the instruction has operands, and drop trailing
+    integer-scalar scratch (the u32[] context pair a
+    collective-permute-start carries)."""
+    if not instr.is_async_start:
+        return instr.result_bytes
+    shapes = [(m.group(1), m.group(2), _array_bytes(m.group(0)))
+              for m in _ARRAY_SHAPE_RE.finditer(instr.shape_text)]
+    if len(shapes) <= 1:
+        return sum(b for _, _, b in shapes)
+    n_ops = len(instr.operands)
+    cand = shapes[n_ops:] if 0 < n_ops < len(shapes) else (
+        shapes[len(shapes) // 2:] if len(shapes) % 2 == 0 else shapes[-1:])
+    while len(cand) > 1 and cand[-1][0] in ("u32", "s32", "u64", "s64") \
+            and cand[-1][1] == "":
+        cand = cand[:-1]
+    return sum(b for _, _, b in cand)
+
+
+def walk_schedule(comps: Dict[str, HloComputation],
+                  ratio: float) -> Tuple[List[CollectiveRecord], List[str]]:
+    """Classify every collective reachable from the entry computation ->
+    (records, serialized chain descriptions)."""
+    flops = FlopModel(comps)
+    records: List[CollectiveRecord] = []
+    chains: List[str] = []
+    entry = entry_computation(comps)
+    if entry is None:
+        return records, chains
+
+    def visit(comp: HloComputation, mult: int,
+              loop: Optional[Dict[str, Any]], seen: Set[str]) -> None:
+        if comp.name in seen:
+            return
+        seen = seen | {comp.name}
+        comp_records: List[CollectiveRecord] = []
+        for instr in comp.instructions:
+            if instr.opcode == "while":
+                # body AND condition: a psum inside cond_fun (a global
+                # convergence check) is a per-iteration collective too
+                trip = max(1, instr.trip_count or 1)
+                for b in instr.called + _CONDITION_RE.findall(instr.attrs):
+                    visit_comp = comps.get(b)
+                    if visit_comp is not None:
+                        visit(visit_comp, mult * trip,
+                              {"while": instr.name, "trip_count": trip},
+                              seen)
+                continue
+            if instr.opcode in ("call", "conditional"):
+                for c in instr.called + instr.branches:
+                    sub = comps.get(c)
+                    if sub is not None:
+                        visit(sub, mult, loop, seen)
+            kind = instr.collective_kind
+            if kind is None:
+                continue
+            done_idx = (_async_done_index(comp, instr)
+                        if instr.is_async_start else None)
+            result_name = instr.name
+            if done_idx is not None:
+                result_name = comp.instructions[done_idx].name
+            deps = _dependents(comp, {instr.name, result_name})
+            if done_idx is not None:
+                # async pair: the schedule DECLARED its overlap window
+                window = comp.instructions[instr.index + 1:done_idx]
+            elif loop is not None:
+                # sync collective in a loop body: the schedule is circular
+                # across iterations (a launch at the body's tail overlaps
+                # the next iteration's head — the software-pipelining the
+                # prefetch carry exists for), so every non-dependent
+                # instruction of the body is window
+                window = comp.instructions
+            else:
+                # sync straight-line: what a launch-early/consume-late
+                # backend could run concurrently is the compute scheduled
+                # after the launch
+                window = comp.instructions[instr.index + 1:]
+            hideable = sum(flops.instruction_flops(w) for w in window
+                           if w.name not in deps
+                           and w.name != instr.name
+                           and w.collective_kind is None)
+            rec = CollectiveRecord(
+                kind=kind, name=instr.name, computation=comp.name,
+                start_index=instr.index, done_index=done_idx,
+                operand_bytes=instr.operand_bytes,
+                result_bytes=_start_result_bytes(instr),
+                hideable_flops=int(hideable),
+                classification=(CLASS_OVERLAPPED
+                                if hideable * ratio >= instr.operand_bytes
+                                else CLASS_EXPOSED),
+                executions=mult, loop=loop, op_name=instr.op_name,
+                source=instr.source)
+            comp_records.append(rec)
+            records.append(rec)
+
+        # serialized chains: a collective whose FIRST reader is itself a
+        # collective, with zero costed compute between the two launches
+        by_name = {r.name: r for r in comp_records}
+        link_to: Dict[str, str] = {}
+        for rec in comp_records:
+            anchor = rec.done_index if rec.done_index is not None \
+                else rec.start_index
+            result = comp.instructions[anchor].name
+            for instr in comp.instructions[anchor + 1:]:
+                if result in instr.operand_names:
+                    gap = comp.instructions[anchor + 1:instr.index]
+                    gap_flops = sum(flops.instruction_flops(g) for g in gap)
+                    if instr.collective_kind is not None and gap_flops == 0 \
+                            and instr.name in by_name:
+                        link_to[rec.name] = instr.name
+                    break
+        heads = set(link_to) - set(link_to.values())
+        for head in sorted(heads):
+            chain = [head]
+            while chain[-1] in link_to:
+                chain.append(link_to[chain[-1]])
+            chain_bytes = sum(by_name[n].moved_bytes for n in chain)
+            if chain_bytes < SERIALIZED_MIN_BYTES:
+                continue
+            for n in chain:
+                by_name[n].classification = CLASS_SERIALIZED
+            kinds = " -> ".join(by_name[n].kind for n in chain)
+            chains.append(
+                f"{len(chain)} dependent back-to-back collective(s) in "
+                f"{comp.name}: {kinds} ({chain_bytes} B, no compute "
+                f"between launches)")
+
+    visit(entry, 1, None, set())
+    return records, chains
+
+
+# ---------------------------------------------------------------------------
+# reports, exposure budgets, collective maps
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class ScheduleReport:
+    """Per-entry schedule numbers: the collective map rows plus the
+    overlapped/exposed byte split the exposure budgets and the telemetry
+    parity test consume."""
+    name: str
+    records: List[CollectiveRecord]
+    bytes_per_flop: float
+
+    def split(self) -> Dict[str, int]:
+        out = {CLASS_OVERLAPPED: 0, CLASS_EXPOSED: 0, CLASS_SERIALIZED: 0}
+        for r in self.records:
+            out[r.classification] += r.moved_bytes
+        return out
+
+    @property
+    def overlapped_bytes(self) -> int:
+        return self.split()[CLASS_OVERLAPPED]
+
+    @property
+    def exposed_bytes(self) -> int:
+        """Exposed + serialized — serialized links are exposed bytes the
+        schedule additionally chains."""
+        s = self.split()
+        return s[CLASS_EXPOSED] + s[CLASS_SERIALIZED]
+
+    def budget_fields(self) -> Dict[str, int]:
+        return {"exposed_bytes": int(self.exposed_bytes)}
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "bytes_per_flop": self.bytes_per_flop,
+                "summary": self.summary(),
+                "collectives": [r.to_dict() for r in self.records]}
+
+    def summary(self) -> Dict[str, Any]:
+        counts: Dict[str, int] = {}
+        for r in self.records:
+            counts[r.classification] = counts.get(r.classification, 0) + 1
+        split = self.split()
+        # "exposed_bytes" here is THE budgeted quantity (exposed +
+        # serialized, == self.exposed_bytes) so the map summary, the
+        # --json payload and tools/exposure_budgets.json all agree;
+        # "serialized_bytes" calls out the chained subset
+        return {"collectives": len(self.records), "counts": counts,
+                "overlapped_bytes": split[CLASS_OVERLAPPED],
+                "exposed_bytes": (split[CLASS_EXPOSED]
+                                  + split[CLASS_SERIALIZED]),
+                "serialized_bytes": split[CLASS_SERIALIZED],
+                "total_bytes": sum(split.values())}
+
+    def to_map(self, mesh_devices: int) -> Dict[str, Any]:
+        """The committed ``tools/collective_maps/<entry>.json`` artifact
+        (deterministic: no timestamps, stable ordering)."""
+        return {"entry": self.name, "mesh_devices": mesh_devices,
+                "bytes_per_flop": self.bytes_per_flop,
+                "summary": self.summary(),
+                "collectives": [r.to_dict() for r in self.records]}
+
+
+EXPOSURE_FIELDS: Tuple[str, ...] = ("exposed_bytes",)
+
+EXPOSURE_COMMENT = ("Per-entry-point exposed collective byte budgets "
+                    "(dstpu lint --schedule). Shrink, never grow: "
+                    "`dstpu lint --schedule --update-budgets` only "
+                    "lowers; raising a budget is a hand edit that must "
+                    "survive review.")
+
+
+def default_exposure_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "exposure_budgets.json")
+
+
+def load_exposure_budgets(path: str) -> Optional[Dict]:
+    return load_budgets(path, fields=EXPOSURE_FIELDS)
+
+
+def write_exposure_budgets(path: str, budgets: Dict) -> None:
+    _write(path, budgets, comment=EXPOSURE_COMMENT)
+
+
+def shrink_exposure_budgets(old, reports: Dict[str, Dict[str, int]],
+                            mesh_devices: int):
+    return _shrink(old, reports, mesh_devices, fields=EXPOSURE_FIELDS)
+
+
+def default_maps_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "collective_maps")
+
+
+def write_collective_map(maps_dir: str, report: ScheduleReport,
+                         mesh_devices: int) -> str:
+    os.makedirs(maps_dir, exist_ok=True)
+    path = os.path.join(maps_dir, f"{report.name}.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(report.to_map(mesh_devices), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_collective_map(maps_dir: str, name: str) -> Optional[Dict]:
+    path = os.path.join(maps_dir, f"{name}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# ---------------------------------------------------------------------------
+# the audit
+# ---------------------------------------------------------------------------
+
+def _finding(rule: Rule, name: str, message: str) -> Finding:
+    return Finding(rule_id=rule.rule_id, path=f"{SCHED_PREFIX}{name}>",
+                   line=0, severity=rule.severity, message=message,
+                   fix_hint=rule.fix_hint)
+
+
+def audit_artifact_schedule(spec, artifact, *,
+                            ratio: Optional[float] = None,
+                            ) -> Tuple[List[Finding], ScheduleReport]:
+    """Walk one compiled artifact's schedule: classification + the
+    serialized-chain rule. Budget/contract checks need the committed file
+    (:func:`check_exposure`)."""
+    ratio = bytes_per_flop() if ratio is None else ratio
+    comps = parse_hlo_computations(artifact.hlo_text)
+    records, chains = walk_schedule(comps, ratio)
+    findings = [_finding(SERIALIZED_CHAIN, spec.name, chain)
+                for chain in chains]
+    report = ScheduleReport(name=spec.name, records=records,
+                            bytes_per_flop=ratio)
+    return findings, report
+
+
+def check_exposure(name: str, report: ScheduleReport,
+                   exposure: Optional[Dict],
+                   overlap_contract: bool = False) -> List[Finding]:
+    """Diff one entry's exposed bytes against the committed shrink-only
+    exposure budgets (already loaded + env-matched; None skips). Contract
+    entries escalate a breach to ``exposed-collective``: their whole
+    design is that nothing unbudgeted is ever exposed."""
+    if exposure is None:
+        return []
+    entry = exposure.get("budgets", {}).get(name)
+    if entry is None or "exposed_bytes" not in entry:
+        return [_finding(
+            EXPOSURE_BUDGET_REGRESSION, name,
+            "no committed exposure budget in tools/exposure_budgets.json "
+            "— run `dstpu lint --schedule --update-budgets` and commit "
+            "the file")]
+    exposed = int(report.exposed_bytes)
+    budget = int(entry["exposed_bytes"])
+    if exposed <= budget:
+        return []
+    offenders = sorted(
+        {f"{r.kind}@{r.source or r.computation}" for r in report.records
+         if r.classification in (CLASS_EXPOSED, CLASS_SERIALIZED)})
+    detail = (f"exposed collective bytes {exposed} B exceed the committed "
+              f"budget {budget} B (+{exposed - budget} B); exposed: "
+              f"{', '.join(offenders) or 'none'}")
+    if overlap_contract:
+        return [_finding(
+            EXPOSED_COLLECTIVE, name,
+            f"entry declares an overlap contract but carries unbudgeted "
+            f"exposed collectives — {detail}")]
+    return [_finding(EXPOSURE_BUDGET_REGRESSION, name, detail)]
+
+
+def audit_spec_schedule(spec, exposure: Optional[Dict] = None,
+                        artifact=None, **kw
+                        ) -> Tuple[List[Finding], Optional[ScheduleReport]]:
+    """Compile (unless ``artifact`` is supplied — the gate compiles once
+    and feeds Layers C and D) and run every Layer-D rule on one spec."""
+    from .lowering import lower_entry
+
+    if artifact is None:
+        try:
+            with spec.mesh_ctx():
+                artifact = lower_entry(spec.fn, spec.args,
+                                       donate_argnums=spec.donate_argnums,
+                                       jit_kwargs=spec.jit_kwargs,
+                                       name=spec.name)
+        except Exception as e:  # noqa: BLE001 — any failure is a finding
+            return [_finding(SCHEDULE_AUDIT_FAILED, spec.name,
+                             f"failed to lower/compile: "
+                             f"{type(e).__name__}: {e}")], None
+    findings, report = audit_artifact_schedule(spec, artifact, **kw)
+    findings += check_exposure(spec.name, report, exposure,
+                               getattr(spec, "overlap_contract", False))
+    return findings, report
+
+
+def trace_runtime_split(spec) -> Dict[str, int]:
+    """The RUNTIME side of the overlap parity: trace ``spec.fn`` under a
+    recording ledger (``dist.record_collective`` fires at trace time —
+    nothing executes) -> ``{"overlapped_bytes", "exposed_bytes"}``.
+    The parity test and ``tools/overlap_report.py`` hold this against the
+    static :class:`ScheduleReport` split: same taxonomy, two estimators
+    (design-intent tags vs compiled placement)."""
+    import jax
+
+    from deepspeed_tpu import comm as dist
+
+    ledger = dist.CollectiveLedger()
+    with dist.record_into(ledger):
+        with spec.mesh_ctx():
+            jax.eval_shape(spec.fn, *spec.args)
+    return ledger.split()
+
+
+def audit_schedule_entry_points(names=None, exposure: Optional[Dict] = None,
+                                entries=None,
+                                ) -> Tuple[List[Finding],
+                                           Dict[str, ScheduleReport]]:
+    """Run Layer D over the registered entry points (default: all).
+
+    ``exposure`` is the loaded+env-matched exposure budgets dict (None
+    skips budget checks); ``entries`` an optional pre-materialized
+    :func:`~.spmd_audit.iter_compiled_entries` result so a combined run
+    compiles once. Returns findings plus per-entry reports for
+    ``--update-budgets`` / ``--json`` / the collective maps."""
+    from .spmd_audit import iter_compiled_entries
+
+    findings: List[Finding] = []
+    reports: Dict[str, ScheduleReport] = {}
+    for name, spec, artifact, error in (
+            entries if entries is not None else iter_compiled_entries(names)):
+        if error is not None:
+            findings.append(_finding(SCHEDULE_AUDIT_FAILED, name, error))
+            continue
+        f, report = audit_spec_schedule(spec, exposure=exposure,
+                                        artifact=artifact)
+        findings.extend(f)
+        if report is not None:
+            reports[name] = report
+    return sort_findings(findings), reports

@@ -498,17 +498,16 @@ def audit_spec_spmd(spec, budgets: Optional[Dict] = None, **thresholds
     return findings, report
 
 
-def audit_spmd_entry_points(names=None, budgets: Optional[Dict] = None,
-                            ) -> Tuple[List[Finding], Dict[str, SpmdReport]]:
-    """Run Layer C over the registered entry points (default: all).
-
-    ``budgets`` is the loaded+env-matched budgets dict (None skips budget
-    checks — the CLI and gate pass it when the environment matches the
-    committed mesh). Returns findings plus per-entry reports for
-    ``--update-budgets`` / ``--json``."""
+def iter_compiled_entries(names=None):
+    """Build + lower/compile each registered entry point ONCE, yielding
+    ``(name, spec, artifact, error)`` — ``error`` is a message string when
+    the spec could not even build or compile (spec/artifact None as
+    appropriate). Layers C and D both consume this, so a combined run
+    pays one compile per entry, not one per layer."""
     from deepspeed_tpu.runtime import topology as topo_mod
 
     from .entry_points import SPEC_BUILDERS, build_spec
+    from .lowering import lower_entry
 
     if names:
         unknown = sorted(set(names) - set(SPEC_BUILDERS))
@@ -516,21 +515,53 @@ def audit_spmd_entry_points(names=None, budgets: Optional[Dict] = None,
             raise ValueError(
                 f"unknown entry point(s): {', '.join(unknown)} "
                 f"(known: {', '.join(sorted(SPEC_BUILDERS))})")
+    try:
+        for name in SPEC_BUILDERS:
+            if names and name not in names:
+                continue
+            try:
+                spec = build_spec(name)  # resets the global topology first
+            except Exception as e:  # noqa: BLE001
+                yield (name, None, None,
+                       f"entry point failed to build: "
+                       f"{type(e).__name__}: {e}")
+                continue
+            try:
+                with spec.mesh_ctx():
+                    artifact = lower_entry(
+                        spec.fn, spec.args,
+                        donate_argnums=spec.donate_argnums,
+                        jit_kwargs=spec.jit_kwargs, name=spec.name)
+            except Exception as e:  # noqa: BLE001
+                yield (name, spec, None,
+                       f"failed to lower/compile: {type(e).__name__}: {e}")
+                continue
+            yield name, spec, artifact, None
+    finally:
+        topo_mod.reset()
+
+
+def audit_spmd_entry_points(names=None, budgets: Optional[Dict] = None,
+                            entries=None,
+                            ) -> Tuple[List[Finding], Dict[str, SpmdReport]]:
+    """Run Layer C over the registered entry points (default: all).
+
+    ``budgets`` is the loaded+env-matched budgets dict (None skips budget
+    checks — the CLI and gate pass it when the environment matches the
+    committed mesh). ``entries`` is an optional pre-materialized
+    :func:`iter_compiled_entries` result — a combined ``--spmd
+    --schedule`` run compiles once and feeds both layers. Returns
+    findings plus per-entry reports for ``--update-budgets`` /
+    ``--json``."""
     findings: List[Finding] = []
     reports: Dict[str, SpmdReport] = {}
-    for name in SPEC_BUILDERS:
-        if names and name not in names:
+    for name, spec, artifact, error in (
+            entries if entries is not None else iter_compiled_entries(names)):
+        if error is not None:
+            findings.append(_finding(SPMD_LOWER_FAILED, name, error))
             continue
-        try:
-            spec = build_spec(name)  # resets the global topology first
-        except Exception as e:  # noqa: BLE001
-            findings.append(_finding(
-                SPMD_LOWER_FAILED, name,
-                f"entry point failed to build: {type(e).__name__}: {e}"))
-            continue
-        f, report = audit_spec_spmd(spec, budgets=budgets)
+        f, report = audit_artifact(spec, artifact)
+        f += check_budgets(name, report, budgets)
         findings.extend(f)
-        if report is not None:
-            reports[name] = report
-    topo_mod.reset()
+        reports[name] = report
     return sort_findings(findings), reports

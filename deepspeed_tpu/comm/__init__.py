@@ -1,8 +1,8 @@
-from .comm import (ReduceOp, all_gather, all_reduce, all_to_all,  # noqa: F401
-                   all_to_all_single, axis_index, axis_size, barrier,
-                   broadcast, comms_log_tail, configure, gather,
-                   get_local_rank, get_rank, get_world_size,
+from .comm import (CollectiveLedger, ReduceOp, all_gather,  # noqa: F401
+                   all_reduce, all_to_all, all_to_all_single, axis_index,
+                   axis_size, barrier, broadcast, comms_log_tail, configure,
+                   gather, get_local_rank, get_rank, get_world_size,
                    inference_all_reduce, init_distributed, is_initialized,
                    log_summary, monitored_barrier, ppermute,
-                   record_collective, recv, reduce, reduce_scatter, scatter,
-                   send)
+                   record_collective, record_into, recv, reduce,
+                   reduce_scatter, scatter, send)

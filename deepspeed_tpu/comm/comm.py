@@ -23,6 +23,7 @@ the axis size like ZeRO's ``average_tensor`` (stage_1_and_2.py:1004).
 
 from __future__ import annotations
 
+import contextlib
 import enum
 import os
 from typing import Optional, Sequence, Union
@@ -161,6 +162,63 @@ def record_collective(op_name: str, nbytes: int, axis: AxisNames,
     if tele.enabled:
         tele.record_collective(op_name, int(nbytes), axis,
                                overlapped=overlapped, count=count)
+
+
+class CollectiveLedger:
+    """Minimal CommsLogger-shaped sink: collects ``record_collective``
+    calls as dicts. Used (via :func:`record_into`) by the Layer-D parity
+    test and ``tools/overlap_report.py`` to capture the runtime
+    overlapped/exposed split of one traced step without configuring the
+    full telemetry stack."""
+
+    def __init__(self):
+        self.records = []
+
+    def append(self, op_name: str, nbytes: int, axis,
+               overlapped: Optional[bool] = None, count: int = 1) -> None:
+        self.records.append({"op": op_name, "bytes": int(nbytes),
+                             "axes": tuple(axis) if isinstance(
+                                 axis, (tuple, list)) else (axis,),
+                             "overlapped": overlapped, "count": int(count)})
+
+    def split(self) -> dict:
+        """-> {"overlapped_bytes", "exposed_bytes"} (count-scaled;
+        untagged records excluded, same as the telemetry metric)."""
+        out = {"overlapped_bytes": 0, "exposed_bytes": 0}
+        for r in self.records:
+            if r["overlapped"] is True:
+                out["overlapped_bytes"] += r["bytes"] * r["count"]
+            elif r["overlapped"] is False:
+                out["exposed_bytes"] += r["bytes"] * r["count"]
+        return out
+
+    # the rest of the CommsLogger surface the module-level helpers may
+    # call while this ledger is installed (comms_log_tail from the stall
+    # watchdog, log_summary) — a diagnostic path must not crash
+    def tail(self, n: int = 12) -> str:
+        return "\n".join(
+            f"{r['op']} {r['bytes']} B axes={r['axes']} "
+            f"overlapped={r['overlapped']} x{r['count']}"
+            for r in self.records[-n:])
+
+    def log_all(self, show_straggler: bool = False) -> None:
+        logger.info(self.tail(len(self.records) or 1))
+
+
+@contextlib.contextmanager
+def record_into(ledger):
+    """Temporarily route ``record_collective`` into ``ledger`` (anything
+    with a CommsLogger-shaped ``append``), restoring the configured
+    logger on exit. Collective records fire at TRACE time, so tracing a
+    step under this context captures its full comm schedule without
+    executing anything."""
+    global _COMMS_LOGGER
+    old = _COMMS_LOGGER
+    _COMMS_LOGGER = ledger
+    try:
+        yield ledger
+    finally:
+        _COMMS_LOGGER = old
 
 
 def comms_log_tail(n: int = 12) -> str:

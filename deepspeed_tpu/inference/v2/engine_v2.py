@@ -666,6 +666,17 @@ class InferenceEngineV2:
                                   block_size=sm.block_size)
         fn = self._wave_sharded_fn if shards > 1 else self._wave_fn
         from ...telemetry import get_telemetry
+        from ... import comm as dist
+        # The wave program moves ZERO collective bytes by contract (the
+        # sharded pool keeps every gather/write rank-local; lint entry
+        # `ragged-paged-attention` compiles and budgets exactly this).
+        # Record the dispatch anyway — overlapped, zero bytes — so the
+        # overlap ledger COVERS serving instead of silently omitting it,
+        # and Layer D's parity test can hold the serving split at 0/0
+        # against the static collective map (a future collective creeping
+        # into the wave shows up in both ledgers, not neither).
+        dist.record_collective("wave_dispatch", 0, (DATA_AXIS,),
+                               overlapped=True)
         with get_telemetry().phase("wave_dispatch", phase="serving",
                                    sequences=len(wave),
                                    tokens=int(desc.n_tokens),

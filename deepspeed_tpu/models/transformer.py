@@ -499,7 +499,7 @@ class TransformerLM:
                               keep: Optional[jax.Array] = None,
                               attn_mask: Optional[jax.Array] = None,
                               layers_per_step: int = 1,
-                              comm_scope=None):
+                              comm_scope=None, comm_edge=None):
         """Layer-granular ZeRO overlap schedule over SHARDED stacked block
         params (the engine's pipelined ZeRO++/stage-3 micro step; see
         runtime/zero/overlap.py for the comm half).
@@ -529,11 +529,17 @@ class TransformerLM:
         comm layer can account its in-body collectives as executing ``k``
         times per step (a scan body traces once but launches per
         iteration) — the engine passes the TreeComm's ``trace_executions``.
+        ``comm_edge(overlapped)`` (optional) is entered around the
+        pipeline-EDGE launches — the forward prologue gather and the
+        epilogue grad flush, which have no compute to hide under — so
+        they are recorded exposed rather than inheriting the tree's
+        blanket class; the engine passes ``TreeComm.schedule_class``.
 
         Returns ``(x_out, moe_aux_sum, pullback)``.
         """
         import contextlib
         scope = comm_scope or (lambda k: contextlib.nullcontext())
+        edge = comm_edge or (lambda overlapped: contextlib.nullcontext())
         c = self.config
         L = c.num_layers
         lps = int(layers_per_step)
@@ -568,7 +574,8 @@ class TransformerLM:
         xs = {"shard": nxt, "keep": keepb}
         if winb is not None:
             xs["win"] = winb
-        pf0 = gather(take(blocksb, 0))
+        with edge(False):  # prologue: nothing runs yet to hide it
+            pf0 = gather(take(blocksb, 0))
 
         def fwd_body(carry, xs_s):
             xx, pf, aux_acc = carry
@@ -593,7 +600,8 @@ class TransformerLM:
             unbundle = lambda t: jax.tree.map(
                 lambda a: a.reshape((L,) + a.shape[2:]), t)
             if n_steps == 1:
-                ds0 = scatter(dp)
+                with edge(False):  # epilogue flush: step's last launch
+                    ds0 = scatter(dp)
                 return unbundle(jax.tree.map(lambda a: a[None], ds0)), dx
             pb0 = gather(take(blocksb, n_steps - 2))
             # reverse prefetch: slot s carries step s-1's shard (slot 0 a
@@ -621,7 +629,8 @@ class TransformerLM:
             with scope(n_steps - 1):
                 (dx0, _, pending0), ds_stack = jax.lax.scan(
                     bwd_body, (dx, pb0, dp), xs_b, reverse=True)
-            ds0 = scatter(pending0)  # flush step 0's grads
+            with edge(False):  # epilogue: flush step 0's grads, exposed
+                ds0 = scatter(pending0)
             # ds_stack[s] holds step s+1's sharded grads; step 0 is ds0
             dblocksb = jax.tree.map(
                 lambda h, t: jnp.concatenate([h[None], t], axis=0),

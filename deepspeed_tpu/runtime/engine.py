@@ -1367,7 +1367,8 @@ class DeepSpeedEngine:
                 gather=blk_comm.gather, scatter=blk_comm.scatter,
                 keep=layer_mask, attn_mask=batch.get("attention_mask"),
                 layers_per_step=lps,
-                comm_scope=blk_comm.trace_executions)
+                comm_scope=blk_comm.trace_executions,
+                comm_edge=blk_comm.schedule_class)
 
             def head_f(rf, xx):
                 return model.head_loss(rf, xx, labels,

@@ -191,6 +191,22 @@ class _TreeCommImpl:
         finally:
             self._exec_mult = old
 
+    @contextlib.contextmanager
+    def schedule_class(self, overlapped: bool):
+        """Override the schedule class recorded for launches traced inside
+        this context. The pipelined schedule's EDGE launches — the forward
+        prologue gather and the epilogue grad flush — have no surrounding
+        compute to hide under and are exposed BY DESIGN; recording them
+        with the tree's blanket ``overlapped=True`` would overstate the
+        overlap ledger (and break parity with Layer D's per-launch static
+        classification, tests/unit/analysis/test_schedule_audit.py)."""
+        old = self.overlapped
+        self.overlapped = bool(overlapped)
+        try:
+            yield
+        finally:
+            self.overlapped = old
+
     def _rec(self, op: str, nbytes: int, axes) -> None:
         from ... import comm as dist
         dist.record_collective(op, nbytes, axes, overlapped=self.overlapped,

@@ -40,15 +40,31 @@ def test_finding_layer_markers():
     assert finding_layer(_f(path="<spmd:engine-train-step>")) == "spmd"
 
 
-def test_split_layers_three_way():
-    ast, jaxpr, spmd = split_layers([
-        _f(path="a.py"), _f(path="<trace:e>"), _f(path="<spmd:e>")])
+def test_split_layers_four_way():
+    ast, jaxpr, spmd, sched = split_layers([
+        _f(path="a.py"), _f(path="<trace:e>"), _f(path="<spmd:e>"),
+        _f(path="<sched:e>")])
     assert [f.path for f in ast] == ["a.py"]
     assert [f.path for f in jaxpr] == ["<trace:e>"]
     assert [f.path for f in spmd] == ["<spmd:e>"]
+    assert [f.path for f in sched] == ["<sched:e>"]
     layers = by_layer([_f(path="<spmd:e>")])
     assert [f.path for f in layers["spmd"]] == ["<spmd:e>"]
     assert layers["ast"] == [] and layers["jaxpr"] == []
+    assert layers["schedule"] == []
+
+
+def test_entry_name_and_prune_unknown():
+    from deepspeed_tpu.analysis.baseline import (entry_name,
+                                                 prune_unknown_entries)
+
+    assert entry_name("<spmd:engine-train-step>") == "engine-train-step"
+    assert entry_name("<sched:x>") == "x" and entry_name("a.py") is None
+    kept, pruned = prune_unknown_entries(
+        [_f(path="a.py"), _f(path="<sched:known>"), _f(path="<spmd:gone>")],
+        known={"known"})
+    assert [f.path for f in kept] == ["a.py", "<sched:known>"]
+    assert [f.path for f in pruned] == ["<spmd:gone>"]
 
 
 def test_write_load_roundtrip_sorted(tmp_path):

@@ -102,7 +102,7 @@ def test_json_stdout_stays_pure_under_framework_logging(tmp_path, capsys,
     # stdout — a --json run must still emit parseable JSON on stdout
     from deepspeed_tpu.utils.logging import logger as fw_logger
 
-    def noisy(entry_names=None, budgets_path=None):
+    def noisy(entry_names=None, budgets_path=None, entries=None):
         fw_logger.info("engine boot chatter")
         return [], {}, False
 
@@ -116,10 +116,14 @@ def test_json_stdout_stays_pure_under_framework_logging(tmp_path, capsys,
 
 
 def test_write_baseline_carries_over_layers_that_did_not_run(tmp_path):
+    # entries must name REGISTERED specs — unknown names are pruned
+    # (test_write_baseline_prunes_entries_for_deleted_specs below)
     baseline = str(tmp_path / "baseline.json")
-    spmd_entry = Finding(rule_id="implicit-reshard", path="<spmd:e>", line=0,
+    spmd_entry = Finding(rule_id="implicit-reshard",
+                         path="<spmd:engine-train-step>", line=0,
                          severity=SEVERITY_ERROR, message="m")
-    trace_entry = Finding(rule_id="retrace-hazard", path="<trace:e>", line=0,
+    trace_entry = Finding(rule_id="retrace-hazard",
+                          path="<trace:engine-train-step>", line=0,
                           severity=SEVERITY_ERROR, message="m")
     write_baseline(baseline, [spmd_entry, trace_entry])
     # AST-only regenerate must not drop the jaxpr/spmd slices
@@ -127,11 +131,11 @@ def test_write_baseline_carries_over_layers_that_did_not_run(tmp_path):
                    "--write-baseline", "--baseline", baseline])
     assert rc == 0
     kept = {f.path for f in load_baseline(baseline)}
-    assert kept == {"<spmd:e>", "<trace:e>"}
+    assert kept == {"<spmd:engine-train-step>", "<trace:engine-train-step>"}
 
 
 def _fake_spmd(findings, reports):
-    def run(entry_names=None, budgets_path=None):
+    def run(entry_names=None, budgets_path=None, entries=None):
         return findings, reports, True
     return run
 
@@ -186,7 +190,7 @@ def test_update_budgets_refuses_mismatched_audit_mesh(tmp_path, monkeypatch,
     write_budgets(budgets_path, {"mesh_devices": 3, "budgets": {
         "e": {"temp_size_in_bytes": 100}}})
 
-    def must_not_run(entry_names=None, budgets_path=None):
+    def must_not_run(entry_names=None, budgets_path=None, entries=None):
         raise AssertionError("audit ran before the mesh check")
 
     monkeypatch.setattr(cli, "run_spmd_layer", must_not_run)
@@ -202,7 +206,7 @@ def test_update_budgets_refuses_mismatched_audit_mesh(tmp_path, monkeypatch,
 def test_spmd_with_missing_explicit_budgets_path_is_usage_error(
         tmp_path, monkeypatch, capsys):
     # a typo'd --budgets path must not silently disable the budget gate
-    def must_not_run(entry_names=None, budgets_path=None):
+    def must_not_run(entry_names=None, budgets_path=None, entries=None):
         raise AssertionError("audit ran despite the bad budgets path")
 
     monkeypatch.setattr(cli, "run_spmd_layer", must_not_run)
@@ -220,7 +224,8 @@ def test_spmd_missing_budgets_file_prints_skip_note(tmp_path, monkeypatch,
     from deepspeed_tpu.analysis import spmd_audit
 
     monkeypatch.setattr(spmd_audit, "audit_spmd_entry_points",
-                        lambda names=None, budgets=None: ([], {}))
+                        lambda names=None, budgets=None, entries=None:
+                        ([], {}))
     findings, reports, checked = cli.run_spmd_layer(
         budgets_path=str(tmp_path / "absent.json"))
     assert findings == [] and reports == {} and checked is False
@@ -254,3 +259,208 @@ def test_update_budgets_creates_missing_file(tmp_path, monkeypatch):
     assert rc == 0
     assert load_budgets(budgets_path)["budgets"]["e"] == {
         "temp_size_in_bytes": 9, "collective_bytes": 3}
+
+
+# ---------------------------------------------------------------------------
+# Layer D (--schedule) driver plumbing
+# ---------------------------------------------------------------------------
+
+def _sched_report(name="e", exposed=True):
+    from deepspeed_tpu.analysis.schedule_audit import (CollectiveRecord,
+                                                       ScheduleReport)
+    rec = CollectiveRecord(
+        kind="all-gather", name="ag.1", computation="main", start_index=3,
+        done_index=None, operand_bytes=512, result_bytes=4096,
+        hideable_flops=0,
+        classification="exposed" if exposed else "overlapped",
+        executions=2, loop=None, op_name="jit(f)/all_gather",
+        source="f.py:1")
+    return ScheduleReport(name=name, records=[rec], bytes_per_flop=5e-2)
+
+
+def _fake_sched(findings, reports):
+    def run(entry_names=None, exposure_path=None, entries=None):
+        return findings, reports, True
+    return run
+
+
+def test_schedule_reports_and_maps_flow_through_json(tmp_path, monkeypatch,
+                                                     capsys):
+    finding = Finding(rule_id="exposure-budget-regression", path="<sched:e>",
+                      line=0, severity=SEVERITY_ERROR, message="over budget")
+    monkeypatch.setattr(cli, "run_schedule_layer",
+                        _fake_sched([finding], {"e": _sched_report()}))
+    maps_dir = str(tmp_path / "maps")
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--schedule", "--json",
+                   "--maps-dir", maps_dir,
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["schedule_reports"]["e"]["exposed_bytes"] == 1024  # x2
+    assert payload["collective_maps"]["e"]["collectives"][0]["kind"] \
+        == "all-gather"
+    assert payload["exposure_checked"] is True
+    assert payload["new"][0]["rule_id"] == "exposure-budget-regression"
+    # the CLI run refreshed the on-disk map artifact too
+    from deepspeed_tpu.analysis.schedule_audit import load_collective_map
+    assert load_collective_map(maps_dir, "e")["entry"] == "e"
+
+
+def test_schedule_clean_run_exits_zero(tmp_path, monkeypatch, capsys):
+    monkeypatch.setattr(cli, "run_schedule_layer",
+                        _fake_sched([], {"e": _sched_report(exposed=False)}))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--schedule",
+                   "--maps-dir", str(tmp_path / "maps"),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    assert "refreshed 1 collective map" in capsys.readouterr().err
+
+
+def test_schedule_with_missing_explicit_exposure_path_is_usage_error(
+        tmp_path, monkeypatch, capsys):
+    def must_not_run(entry_names=None, exposure_path=None, entries=None):
+        raise AssertionError("audit ran despite the bad exposure path")
+
+    monkeypatch.setattr(cli, "run_schedule_layer", must_not_run)
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--schedule",
+                   "--exposure-budgets", str(tmp_path / "typo.json"),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 2
+    assert "no such exposure budgets file" in capsys.readouterr().err
+
+
+def test_schedule_missing_exposure_file_prints_skip_note(tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    from deepspeed_tpu.analysis import schedule_audit
+
+    monkeypatch.setattr(schedule_audit, "audit_schedule_entry_points",
+                        lambda names=None, exposure=None, entries=None:
+                        ([], {}))
+    findings, reports, checked = cli.run_schedule_layer(
+        exposure_path=str(tmp_path / "absent.json"))
+    assert findings == [] and reports == {} and checked is False
+    assert "exposure budget checks skipped" in capsys.readouterr().err
+
+
+def test_update_budgets_with_schedule_writes_exposure_downward(
+        tmp_path, monkeypatch, capsys):
+    from deepspeed_tpu.analysis.schedule_audit import (
+        load_exposure_budgets, write_exposure_budgets)
+    import jax
+
+    exposure_path = str(tmp_path / "exposure_budgets.json")
+    write_exposure_budgets(exposure_path, {
+        "mesh_devices": jax.device_count(),
+        "budgets": {"e": {"exposed_bytes": 100},
+                    "low": {"exposed_bytes": 2000}}})
+    reports = {"e": _sched_report("e"),            # 1024 B: regressed? no —
+               "low": _sched_report("low")}        # both report 1024 B
+    monkeypatch.setattr(cli, "run_spmd_layer", _fake_spmd([], {}))
+    monkeypatch.setattr(cli, "run_schedule_layer", _fake_sched([], reports))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--update-budgets",
+                   "--schedule", "--budgets", str(tmp_path / "mem.json"),
+                   "--exposure-budgets", exposure_path,
+                   "--maps-dir", str(tmp_path / "maps"),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    merged = load_exposure_budgets(exposure_path)["budgets"]
+    assert merged["e"]["exposed_bytes"] == 100     # NOT raised (1024 > 100)
+    assert merged["low"]["exposed_bytes"] == 1024  # lowered from 2000
+    err = capsys.readouterr().err
+    assert "NOT raised (exceeds committed exposure budget): e" in err
+
+
+def test_update_budgets_refuses_mismatched_exposure_mesh(tmp_path,
+                                                         monkeypatch,
+                                                         capsys):
+    from deepspeed_tpu.analysis.schedule_audit import write_exposure_budgets
+
+    exposure_path = str(tmp_path / "exposure_budgets.json")
+    write_exposure_budgets(exposure_path, {"mesh_devices": 3, "budgets": {
+        "e": {"exposed_bytes": 5}}})
+
+    def must_not_run(entry_names=None, **kw):
+        raise AssertionError("audit ran before the mesh check")
+
+    monkeypatch.setattr(cli, "run_spmd_layer", must_not_run)
+    monkeypatch.setattr(cli, "run_schedule_layer", must_not_run)
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--update-budgets",
+                   "--schedule", "--budgets", str(tmp_path / "mem.json"),
+                   "--exposure-budgets", exposure_path,
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 2
+    assert "refusing" in capsys.readouterr().err
+
+
+# ---------------------------------------------------------------------------
+# --write-baseline stale-entry pruning (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+def test_write_baseline_prunes_entries_for_deleted_specs(tmp_path, capsys):
+    baseline = str(tmp_path / "baseline.json")
+    known = Finding(rule_id="implicit-reshard",
+                    path="<spmd:engine-train-step>", line=0,
+                    severity=SEVERITY_ERROR, message="m")
+    gone_spmd = Finding(rule_id="implicit-reshard", path="<spmd:deleted-e>",
+                        line=0, severity=SEVERITY_ERROR, message="m")
+    gone_sched = Finding(rule_id="exposed-collective", path="<sched:gone-e>",
+                         line=0, severity=SEVERITY_ERROR, message="m")
+    write_baseline(baseline, [known, gone_spmd, gone_sched])
+    # AST-only regenerate: the known spmd entry carries over, the entries
+    # naming specs that no longer exist are pruned with a warning
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN),
+                   "--write-baseline", "--baseline", baseline])
+    assert rc == 0
+    kept = {f.path for f in load_baseline(baseline)}
+    assert kept == {"<spmd:engine-train-step>"}
+    err = capsys.readouterr().err
+    assert "pruning stale baseline entry" in err
+    assert "<spmd:deleted-e>" in err and "<sched:gone-e>" in err
+
+
+def test_schedule_does_not_overwrite_maps_on_mismatched_mesh(tmp_path,
+                                                             monkeypatch,
+                                                             capsys):
+    # maps carry the committed audit mesh's placement; a run on a
+    # different device count must not rewrite them (same discipline as
+    # the shrink-only budgets)
+    from deepspeed_tpu.analysis.schedule_audit import write_exposure_budgets
+
+    exposure_path = str(tmp_path / "exposure_budgets.json")
+    write_exposure_budgets(exposure_path, {"mesh_devices": 3, "budgets": {
+        "e": {"exposed_bytes": 5}}})
+    monkeypatch.setattr(cli, "run_schedule_layer",
+                        _fake_sched([], {"e": _sched_report()}))
+    maps_dir = str(tmp_path / "maps")
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--schedule",
+                   "--exposure-budgets", exposure_path,
+                   "--maps-dir", maps_dir,
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    from deepspeed_tpu.analysis.schedule_audit import load_collective_map
+    assert load_collective_map(maps_dir, "e") is None   # NOT written
+    assert "NOT refreshing collective maps" in capsys.readouterr().err
+
+
+def test_entry_restricted_write_baseline_keeps_other_entries(tmp_path,
+                                                             monkeypatch):
+    # --schedule --entry X --write-baseline re-audits only X: the other
+    # entries' grandfathered <sched:...> rows must carry over untouched
+    baseline = str(tmp_path / "baseline.json")
+    other = Finding(rule_id="exposure-budget-regression",
+                    path="<sched:engine-train-step>", line=0,
+                    severity=SEVERITY_ERROR, message="m")
+    audited = Finding(rule_id="exposure-budget-regression",
+                      path="<sched:moe-dispatch>", line=0,
+                      severity=SEVERITY_ERROR, message="fixed-now")
+    write_baseline(baseline, [other, audited])
+    monkeypatch.setattr(cli, "run_schedule_layer", _fake_sched([], {}))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--schedule",
+                   "--entry", "moe-dispatch", "--write-baseline",
+                   "--maps-dir", str(tmp_path / "maps"),
+                   "--baseline", baseline])
+    assert rc == 0
+    kept = {f.path for f in load_baseline(baseline)}
+    # the audited entry's (now-clean) row is dropped; the other survives
+    assert kept == {"<sched:engine-train-step>"}

@@ -4,14 +4,17 @@ This is `dstpu lint` running inside the tier-1 pytest invocation — the fast
 AST layer over the whole package diffed against the checked-in baseline,
 plus the jaxpr audits over the real traced entry points (the conftest
 already pins JAX_PLATFORMS=cpu with an 8-device host mesh), plus the
-Layer-C compiled-artifact audit over the CHEAP entry-point subset
-(GATE_SPMD_ENTRY_POINTS: no engine build, sub-second compiles) checked
-against the committed shrink-only tools/memory_budgets.json. The full
-Layer-C set runs off-gate via `dstpu lint --spmd` (docs/STATIC_ANALYSIS.md,
-"Tier-1 cost control"). A failure here means a new TPU-graph invariant
-violation: fix it (preferred), suppress with `# dstpu: ignore[rule-id]`
-(Layer A), or — for a justified budget increase — raise the budget BY HAND
-in tools/memory_budgets.json; never grow tools/lint_baseline.json.
+Layer-C compiled-artifact audit AND the Layer-D schedule audit over the
+CHEAP entry-point subset (GATE_SPMD_ENTRY_POINTS: no engine build,
+sub-second compiles) — ONE compile pass feeds both layers — checked
+against the committed shrink-only tools/memory_budgets.json and
+tools/exposure_budgets.json. The full sets run off-gate via `dstpu lint
+--spmd --schedule` (docs/STATIC_ANALYSIS.md, "Tier-1 cost control"). A
+failure here means a new TPU-graph invariant violation: fix it
+(preferred), suppress with `# dstpu: ignore[rule-id]` (Layer A), or —
+for a justified budget increase — raise the budget BY HAND in
+tools/memory_budgets.json / tools/exposure_budgets.json; never grow
+tools/lint_baseline.json.
 """
 
 import os
@@ -29,11 +32,16 @@ from deepspeed_tpu.analysis.entry_points import (ENTRY_POINTS,
                                                  GATE_SPMD_ENTRY_POINTS,
                                                  SPEC_BUILDERS,
                                                  audit_entry_points)
+from deepspeed_tpu.analysis.schedule_audit import (default_exposure_path,
+                                                   default_maps_dir,
+                                                   load_collective_map,
+                                                   load_exposure_budgets)
 
-#: wall-time budget for the Layer-C gate subset (satellite: the gate must
-#: stay cheap — the 4 engineless specs compile in ~3-5 s on the CPU mesh;
+#: wall-time budget for the compiled gate subset — Layers C AND D over
+#: the engineless specs off ONE compile pass (the specs compile in
+#: ~3-5 s on the CPU mesh; the Layer-D walk is text parsing on top).
 #: 120 s leaves headroom for a cold, loaded CI host without letting an
-#: engine-building spec sneak into the subset unnoticed)
+#: engine-building spec sneak into the subset unnoticed.
 GATE_SPMD_WALL_BUDGET_S = 120.0
 
 PACKAGE = os.path.join(os.path.dirname(default_baseline_path()), os.pardir,
@@ -76,21 +84,41 @@ def test_jaxpr_entry_point_clean(entry):
 
 @pytest.fixture(scope="module")
 def spmd_gate_run():
-    """ONE compile pass over the cheap subset for the whole module — the
-    per-rule assertions below read from it instead of recompiling."""
-    from deepspeed_tpu.analysis.spmd_audit import audit_spmd_entry_points
+    """ONE compile pass over the cheap subset for the whole module — each
+    artifact feeds BOTH the Layer-C audit and the Layer-D schedule walk
+    (the shared-lowering contract), and the per-rule assertions below
+    read from it instead of recompiling."""
+    from deepspeed_tpu.analysis.spmd_audit import (audit_artifact,
+                                                   check_budgets,
+                                                   iter_compiled_entries)
+    from deepspeed_tpu.analysis.schedule_audit import audit_spec_schedule
 
     budgets = load_budgets(default_budgets_path())
+    exposure = load_exposure_budgets(default_exposure_path())
+    budgets_ok = env_matches(budgets)
+    exposure_ok = env_matches(exposure)
+    findings, reports = [], {}
+    sched_findings, sched_reports = [], {}
     start = time.monotonic()
-    findings, reports = audit_spmd_entry_points(
-        list(GATE_SPMD_ENTRY_POINTS),
-        budgets=budgets if env_matches(budgets) else None)
+    for name, spec, artifact, error in iter_compiled_entries(
+            list(GATE_SPMD_ENTRY_POINTS)):
+        assert error is None, f"{name}: {error}"
+        f, report = audit_artifact(spec, artifact)
+        f += check_budgets(name, report, budgets if budgets_ok else None)
+        findings += f
+        reports[name] = report
+        sf, sreport = audit_spec_schedule(
+            spec, exposure=exposure if exposure_ok else None,
+            artifact=artifact)
+        sched_findings += sf
+        sched_reports[name] = sreport
     elapsed = time.monotonic() - start
-    return findings, reports, elapsed, budgets
+    return (findings, reports, elapsed, budgets,
+            sched_findings, sched_reports, exposure)
 
 
 def test_spmd_gate_subset_clean(spmd_gate_run):
-    findings, reports, _, _ = spmd_gate_run
+    findings, reports = spmd_gate_run[0], spmd_gate_run[1]
     baseline = split_layers(load_baseline(default_baseline_path()))[2]
     new, _ = diff_against_baseline(findings, baseline)
     assert not new, f"Layer-C audit findings:\n{_render(new)}"
@@ -101,7 +129,7 @@ def test_spmd_gate_budgets_were_checked(spmd_gate_run):
     # the conftest pins the 8-device host mesh, so the committed budgets
     # MUST be comparable here — a silently skipped budget check would turn
     # the gate into a no-op
-    _, _, _, budgets = spmd_gate_run
+    budgets = spmd_gate_run[3]
     assert budgets is not None, "tools/memory_budgets.json missing"
     assert env_matches(budgets), (
         "audit mesh mismatch: budgets committed for "
@@ -109,12 +137,72 @@ def test_spmd_gate_budgets_were_checked(spmd_gate_run):
 
 
 def test_spmd_gate_stays_under_wall_budget(spmd_gate_run):
-    _, _, elapsed, _ = spmd_gate_run
+    elapsed = spmd_gate_run[2]
     assert elapsed < GATE_SPMD_WALL_BUDGET_S, (
-        f"Layer-C gate subset took {elapsed:.1f}s (> "
+        f"compiled gate subset (Layers C+D) took {elapsed:.1f}s (> "
         f"{GATE_SPMD_WALL_BUDGET_S}s) — an expensive spec crept into "
         "GATE_SPMD_ENTRY_POINTS; move it to the off-gate `dstpu lint "
-        "--spmd` set")
+        "--spmd --schedule` set")
+
+
+# ---------------------------------------------------------------------------
+# Layer D gate: the same artifacts, walked for schedule findings
+# ---------------------------------------------------------------------------
+
+def test_schedule_gate_subset_clean(spmd_gate_run):
+    sched_findings, sched_reports = spmd_gate_run[4], spmd_gate_run[5]
+    baseline = split_layers(load_baseline(default_baseline_path()))[3]
+    new, _ = diff_against_baseline(sched_findings, baseline)
+    assert not new, f"Layer-D audit findings:\n{_render(new)}"
+    assert set(sched_reports) == set(GATE_SPMD_ENTRY_POINTS)
+
+
+def test_schedule_gate_exposure_was_checked(spmd_gate_run):
+    exposure = spmd_gate_run[6]
+    assert exposure is not None, "tools/exposure_budgets.json missing"
+    assert env_matches(exposure), (
+        "audit mesh mismatch: exposure budgets committed for "
+        f"{exposure['mesh_devices']} devices")
+
+
+def test_serving_contract_entries_have_zero_collectives(spmd_gate_run):
+    # the data-sharded serving wave's whole design is rank-local
+    # everything: its schedule must stay collective-free, not merely
+    # budgeted (docs/SERVING.md)
+    sched_reports = spmd_gate_run[5]
+    for name in ("ragged-paged-attention", "paged-decode"):
+        assert sched_reports[name].records == [], (
+            f"{name} grew collectives: "
+            f"{sched_reports[name].summary()}")
+
+
+def test_every_entry_point_has_an_exposure_budget():
+    exposure = load_exposure_budgets(default_exposure_path())
+    assert exposure is not None
+    assert set(exposure["budgets"]) == set(SPEC_BUILDERS), (
+        "tools/exposure_budgets.json out of sync with registered entry "
+        "points — run `dstpu lint --schedule --update-budgets` (new "
+        "entries) or delete the stale key by hand")
+    for name, entry in exposure["budgets"].items():
+        assert entry.get("exposed_bytes", -1) >= 0, name
+
+
+def test_every_entry_point_has_a_committed_collective_map(spmd_gate_run):
+    # the maps are the artifact ROADMAP item 2's planner consumes: one
+    # per registered entry, refreshed by `dstpu lint --schedule`; for the
+    # gate subset the committed summary must match this run's walk
+    sched_reports = spmd_gate_run[5]
+    for name in SPEC_BUILDERS:
+        data = load_collective_map(default_maps_dir(), name)
+        assert data is not None, (
+            f"tools/collective_maps/{name}.json missing — run "
+            "`dstpu lint --schedule` and commit the maps")
+        assert data["entry"] == name
+    for name in GATE_SPMD_ENTRY_POINTS:
+        committed = load_collective_map(default_maps_dir(), name)
+        assert committed["summary"] == sched_reports[name].summary(), (
+            f"committed collective map for {name} is stale — rerun "
+            "`dstpu lint --schedule`")
 
 
 def test_gate_subset_matches_spec_flags():

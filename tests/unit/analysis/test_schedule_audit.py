@@ -1,0 +1,485 @@
+"""Layer D fixtures: the HLO-schedule walker proven on the shapes it must
+not miscount (async pairs inside ``while`` bodies, tuple-shaped
+``all-gather-start`` operands), each new rule proven to fire on an
+injected regression and stay quiet on the healthy version, and the
+ISSUE 7 acceptance parity: the static overlapped/exposed split must agree
+with the runtime ``record_collective`` split on the pipelined ZeRO entry
+(and the serving wave must hold the 0/0 zero-collective split in BOTH
+ledgers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.analysis.entry_points import EntrySpec
+from deepspeed_tpu.analysis.schedule_audit import (
+    CLASS_EXPOSED, CLASS_OVERLAPPED, CLASS_SERIALIZED, FlopModel,
+    ScheduleReport, audit_artifact_schedule, audit_spec_schedule,
+    check_exposure, entry_computation, parse_hlo_computations,
+    trace_runtime_split, walk_schedule, write_collective_map,
+    load_collective_map)
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="audit mesh needs 8 host devices")
+
+RATIO = 5e-2   # the CPU audit-mesh bytes/flop ratio, pinned for fixtures
+
+
+class _FakeArtifact:
+    def __init__(self, hlo_text):
+        self.hlo_text = hlo_text
+
+
+def _spec(name, **kw):
+    return EntrySpec(name=name, fn=lambda x: x, args=(jnp.zeros((4,)),),
+                     **kw)
+
+
+def _rule_ids(findings):
+    return [f.rule_id for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# parser + walker fixtures: the two shapes the walker must not miscount
+# ---------------------------------------------------------------------------
+
+# an async all-gather pair NESTED IN A WHILE BODY: the gather's window is
+# start..done (one independent 2*64*256*256 = 8.4 MFLOP dot inside it),
+# and its bytes/flops scale by the compiler's known trip count of 4.
+_WHILE_ASYNC_HLO = """\
+HloModule jit_fx, is_scheduled=true
+
+%body (p: (s32[], f32[256,256], f32[64,256])) -> (s32[], f32[256,256], f32[64,256]) {
+  %p = (s32[], f32[256,256], f32[64,256]) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[256,256], f32[64,256]) %p), index=0
+  %w = f32[256,256]{1,0} get-tuple-element((s32[], f32[256,256], f32[64,256]) %p), index=1
+  %x = f32[64,256]{1,0} get-tuple-element((s32[], f32[256,256], f32[64,256]) %p), index=2
+  %ags = (f32[64,256]{1,0}, f32[64,256]{1,0}) all-gather-start(f32[64,256]{1,0} %x), dimensions={0}
+  %mm = f32[64,256]{1,0} dot(f32[64,256]{1,0} %x, f32[256,256]{1,0} %w), lhs_contracting_dims={1}, rhs_contracting_dims={0}
+  %agd = f32[64,256]{1,0} all-gather-done((f32[64,256]{1,0}, f32[64,256]{1,0}) %ags)
+  %c1 = s32[] constant(1)
+  %ip = s32[] add(s32[] %i, s32[] %c1)
+  ROOT %t = (s32[], f32[256,256], f32[64,256]) tuple(s32[] %ip, f32[256,256]{1,0} %w, f32[64,256]{1,0} %agd)
+}
+
+%cond (q: (s32[], f32[256,256], f32[64,256])) -> pred[] {
+  %q = (s32[], f32[256,256], f32[64,256]) parameter(0)
+  %j = s32[] get-tuple-element((s32[], f32[256,256], f32[64,256]) %q), index=0
+  %n = s32[] constant(4)
+  ROOT %lt = pred[] compare(s32[] %j, s32[] %n), direction=LT
+}
+
+ENTRY %main (a: f32[256,256], b: f32[64,256]) -> f32[64,256] {
+  %a = f32[256,256]{1,0} parameter(0)
+  %b = f32[64,256]{1,0} parameter(1)
+  %z = s32[] constant(0)
+  %t0 = (s32[], f32[256,256], f32[64,256]) tuple(s32[] %z, f32[256,256]{1,0} %a, f32[64,256]{1,0} %b)
+  %wh = (s32[], f32[256,256], f32[64,256]) while((s32[], f32[256,256], f32[64,256]) %t0), condition=%cond, body=%body, backend_config={"known_trip_count":{"n":"4"}}
+  ROOT %out = f32[64,256]{1,0} get-tuple-element((s32[], f32[256,256], f32[64,256]) %wh), index=2
+}
+"""
+
+
+def test_async_pair_in_while_body_paired_costed_and_trip_scaled():
+    comps = parse_hlo_computations(_WHILE_ASYNC_HLO)
+    assert entry_computation(comps).name == "main"
+    records, chains = walk_schedule(comps, RATIO)
+    assert chains == []
+    [rec] = records
+    assert rec.kind == "all-gather"
+    assert rec.computation == "body"
+    assert rec.done_index is not None and rec.done_index > rec.start_index
+    assert rec.operand_bytes == 64 * 256 * 4
+    assert rec.result_bytes == 64 * 256 * 4      # result half, not doubled
+    assert rec.hideable_flops == 2 * 64 * 256 * 256  # the one dot inside
+    assert rec.executions == 4                   # known_trip_count
+    assert rec.loop == {"while": "wh", "trip_count": 4}
+    # 8.4 MFLOP * 0.05 B/flop comfortably hides 64 KiB
+    assert rec.classification == CLASS_OVERLAPPED
+    assert rec.moved_bytes == 64 * 256 * 4 * 4   # execution-scaled
+
+
+# a TUPLE-SHAPED all-gather-start: two operands, result tuple carries the
+# operand aliases first — operand bytes sum both inputs, result bytes
+# charge only the gathered half (never both, or bytes double).
+_TUPLE_START_HLO = """\
+HloModule jit_fy, is_scheduled=true
+
+ENTRY %main (p0: f32[8,64], p1: f32[8,8]) -> f32[64,64] {
+  %p0 = f32[8,64]{1,0} parameter(0)
+  %p1 = f32[8,8]{1,0} parameter(1)
+  %ags = (f32[8,64]{1,0}, f32[8,8]{1,0}, f32[64,64]{1,0}, f32[64,8]{1,0}) all-gather-start(f32[8,64]{1,0} %p0, f32[8,8]{1,0} %p1), dimensions={0}
+  %agd = (f32[64,64]{1,0}, f32[64,8]{1,0}) all-gather-done((f32[8,64]{1,0}, f32[8,8]{1,0}, f32[64,64]{1,0}, f32[64,8]{1,0}) %ags)
+  ROOT %out = f32[64,64]{1,0} get-tuple-element((f32[64,64]{1,0}, f32[64,8]{1,0}) %agd), index=0
+}
+"""
+
+
+def test_tuple_shaped_all_gather_start_operands_not_double_counted():
+    comps = parse_hlo_computations(_TUPLE_START_HLO)
+    records, _ = walk_schedule(comps, RATIO)
+    [rec] = records
+    assert rec.operand_bytes == (8 * 64 + 8 * 8) * 4
+    assert rec.result_bytes == (64 * 64 + 64 * 8) * 4   # gathered half only
+    assert rec.done_index is not None
+    assert rec.executions == 1 and rec.loop is None
+    # nothing between start and done: zero window -> exposed
+    assert rec.hideable_flops == 0
+    assert rec.classification == CLASS_EXPOSED
+
+
+# ---------------------------------------------------------------------------
+# serialized-collective-chain: fire + quiet
+# ---------------------------------------------------------------------------
+
+_SERIALIZED_HLO = """\
+HloModule jit_fz, is_scheduled=true
+
+%add (x: f32[], y: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  %y = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %x, f32[] %y)
+}
+
+ENTRY %main (p: f32[2048], w: f32[2048,16]) -> f32[2048] {
+  %p = f32[2048]{0} parameter(0)
+  %w = f32[2048,16]{1,0} parameter(1)
+  %ar1 = f32[2048]{0} all-reduce(f32[2048]{0} %p), to_apply=%add
+  %ar2 = f32[2048]{0} all-reduce(f32[2048]{0} %ar1), to_apply=%add
+  ROOT %o = f32[2048]{0} add(f32[2048]{0} %ar2, f32[2048]{0} %ar2)
+}
+"""
+
+# same two all-reduces, but a dot CONSUMES ar1 before ar2 reads anything:
+# the first reader is compute, so no chain (ar1 classifies exposed — its
+# only downstream compute depends on it).
+_UNCHAINED_HLO = _SERIALIZED_HLO.replace(
+    "  %ar2 = f32[2048]{0} all-reduce(f32[2048]{0} %ar1), to_apply=%add\n",
+    "  %mm = f32[16]{0} dot(f32[2048]{0} %ar1, f32[2048,16]{1,0} %w), "
+    "lhs_contracting_dims={0}, rhs_contracting_dims={0}\n"
+    "  %ar2 = f32[2048]{0} all-reduce(f32[2048]{0} %ar1), to_apply=%add\n")
+
+
+def test_serialized_chain_fires_on_dependent_back_to_back_collectives():
+    spec = _spec("fixture-serialized")
+    findings, report = audit_artifact_schedule(
+        spec, _FakeArtifact(_SERIALIZED_HLO), ratio=RATIO)
+    [f] = [f for f in findings if f.rule_id == "serialized-collective-chain"]
+    assert "all-reduce -> all-reduce" in f.message
+    assert f.path == "<sched:fixture-serialized>"
+    assert all(r.classification == CLASS_SERIALIZED for r in report.records)
+    # serialized bytes count as exposed for the budget flow
+    assert report.exposed_bytes == 2 * 2048 * 4
+
+
+def test_no_chain_when_compute_reads_the_first_collective():
+    findings, report = audit_artifact_schedule(
+        _spec("fixture-unchained"), _FakeArtifact(_UNCHAINED_HLO),
+        ratio=RATIO)
+    assert "serialized-collective-chain" not in _rule_ids(findings)
+    assert {r.classification for r in report.records} <= {
+        CLASS_EXPOSED, CLASS_OVERLAPPED}
+
+
+def test_tiny_serialized_chain_below_noise_floor_is_quiet():
+    tiny = _SERIALIZED_HLO.replace("2048]", "8]").replace("2048,16]", "8,16]")
+    findings, _ = audit_artifact_schedule(
+        _spec("fixture-tiny-chain"), _FakeArtifact(tiny), ratio=RATIO)
+    assert findings == []   # 2 * 32 B chain: not worth a finding
+
+
+# the hand-pipelined quiet half of the pair: the while-body async fixture
+# IS the healthy schedule — overlapped classification, no findings even
+# with a zero exposure budget.
+def test_pipelined_schedule_is_clean_under_zero_exposure_budget():
+    spec = _spec("fixture-pipelined", overlap_contract=True)
+    findings, report = audit_artifact_schedule(
+        spec, _FakeArtifact(_WHILE_ASYNC_HLO), ratio=RATIO)
+    exposure = {"mesh_devices": jax.device_count(), "budgets": {
+        "fixture-pipelined": {"exposed_bytes": 0}}}
+    findings += check_exposure(spec.name, report, exposure,
+                               overlap_contract=True)
+    assert findings == []
+    assert report.exposed_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# exposed-collective + exposure-budget-regression: fire + quiet (live
+# compiles: the GSPMD gather feeding a dependent dot is exposed by
+# construction, whatever the scheduler does)
+# ---------------------------------------------------------------------------
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), ("data",))
+
+
+def _exposed_gather_spec(name, **kw):
+    # contraction dim of w sharded: GSPMD all-gathers w right before the
+    # dot that CONSUMES it — dependent, unhideable, exposed
+    mesh = _mesh()
+    put = lambda x, *s: jax.device_put(x, NamedSharding(mesh, P(*s)))
+    x = put(jnp.zeros((128, 64), jnp.float32), "data")
+    w = put(jnp.zeros((64, 32), jnp.float32), "data")
+    return EntrySpec(name=name, fn=lambda x, w: x @ w, args=(x, w),
+                     mesh=mesh, **kw)
+
+
+def test_exposed_collective_fires_on_contract_entry_over_budget():
+    spec = _exposed_gather_spec("fixture-exposed-contract",
+                                overlap_contract=True)
+    exposure = {"mesh_devices": jax.device_count(), "budgets": {
+        "fixture-exposed-contract": {"exposed_bytes": 0}}}
+    findings, report = audit_spec_schedule(spec, exposure=exposure)
+    assert report.exposed_bytes > 0
+    [f] = [f for f in findings if f.rule_id == "exposed-collective"]
+    assert "overlap contract" in f.message and "all-gather" in f.message
+    assert "exposure-budget-regression" not in _rule_ids(findings)
+
+
+def test_budgeted_exposure_is_quiet_on_contract_entry():
+    spec = _exposed_gather_spec("fixture-exposed-contract",
+                                overlap_contract=True)
+    findings, report = audit_spec_schedule(spec)
+    exposure = {"mesh_devices": jax.device_count(), "budgets": {
+        "fixture-exposed-contract": {
+            "exposed_bytes": int(report.exposed_bytes)}}}
+    findings += check_exposure(spec.name, report, exposure,
+                               overlap_contract=True)
+    assert "exposed-collective" not in _rule_ids(findings)
+
+
+def test_exposure_budget_regression_fires_without_contract():
+    spec = _exposed_gather_spec("fixture-exposed-plain")
+    exposure = {"mesh_devices": jax.device_count(), "budgets": {
+        "fixture-exposed-plain": {"exposed_bytes": 0}}}
+    findings, _ = audit_spec_schedule(spec, exposure=exposure)
+    [f] = [f for f in findings
+           if f.rule_id == "exposure-budget-regression"]
+    assert "exceed" in f.message
+    assert "exposed-collective" not in _rule_ids(findings)
+
+
+def test_missing_exposure_budget_is_a_finding():
+    spec = _exposed_gather_spec("fixture-unbudgeted-exposure")
+    exposure = {"mesh_devices": jax.device_count(), "budgets": {}}
+    findings, _ = audit_spec_schedule(spec, exposure=exposure)
+    [f] = [f for f in findings
+           if f.rule_id == "exposure-budget-regression"]
+    assert "no committed exposure budget" in f.message
+
+
+def test_uncompilable_spec_is_a_hard_finding():
+    def broken(x):
+        raise RuntimeError("boom at trace time")
+
+    spec = EntrySpec(name="fixture-broken-sched", fn=broken,
+                     args=(jnp.zeros((4,)),))
+    findings, report = audit_spec_schedule(spec)
+    assert report is None
+    [f] = findings
+    assert f.rule_id == "schedule-audit-failed" and "boom" in f.message
+
+
+# ---------------------------------------------------------------------------
+# collective map artifact
+# ---------------------------------------------------------------------------
+
+def test_collective_map_roundtrip(tmp_path):
+    comps = parse_hlo_computations(_WHILE_ASYNC_HLO)
+    records, _ = walk_schedule(comps, RATIO)
+    report = ScheduleReport(name="fixture-map", records=records,
+                            bytes_per_flop=RATIO)
+    write_collective_map(str(tmp_path), report, mesh_devices=8)
+    data = load_collective_map(str(tmp_path), "fixture-map")
+    assert data["entry"] == "fixture-map" and data["mesh_devices"] == 8
+    assert data["summary"]["overlapped_bytes"] == report.overlapped_bytes
+    [row] = data["collectives"]
+    assert row["kind"] == "all-gather" and row["executions"] == 4
+    assert row["loop"] == {"while": "wh", "trip_count": 4}
+    assert load_collective_map(str(tmp_path), "absent") is None
+
+
+def test_flop_model_charges_fusion_call_and_while():
+    comps = parse_hlo_computations(_WHILE_ASYNC_HLO)
+    fm = FlopModel(comps)
+    body_flops = fm.computation_flops("body")
+    assert body_flops == 2 * 64 * 256 * 256
+    [wh] = [i for i in entry_computation(comps).instructions
+            if i.opcode == "while"]
+    assert fm.instruction_flops(wh) == 4 * body_flops  # trip-scaled
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 7 acceptance: static split vs runtime record_collective split
+# ---------------------------------------------------------------------------
+
+def _overlap_fraction(overlapped, exposed):
+    total = overlapped + exposed
+    return overlapped / total if total else None
+
+
+def test_zero_pipelined_static_runtime_parity():
+    """The pipelined ZeRO entry: Layer D's compiled-placement split and
+    the comm layer's design-intent tags must agree within 10% on the
+    overlapped fraction — two independent estimators of one schedule."""
+    from deepspeed_tpu.analysis.entry_points import build_spec
+
+    spec = build_spec("zeropp-micro-overlap")
+    runtime = trace_runtime_split(spec)
+    assert runtime["overlapped_bytes"] > 0, \
+        "pipelined schedule stopped recording overlapped collectives"
+    assert runtime["exposed_bytes"] > 0, \
+        "pipeline edge launches must be recorded exposed"
+    findings, report = audit_spec_schedule(spec)
+    assert report is not None, findings
+    static_frac = _overlap_fraction(report.overlapped_bytes,
+                                    report.exposed_bytes)
+    runtime_frac = _overlap_fraction(runtime["overlapped_bytes"],
+                                     runtime["exposed_bytes"])
+    assert abs(static_frac - runtime_frac) <= 0.10, (
+        f"static {static_frac:.3f} vs runtime {runtime_frac:.3f}: the "
+        "compiled schedule and the comm layer's schedule-class tags "
+        "disagree — see tools/overlap_report.py zeropp-micro-overlap")
+
+
+def test_serving_wave_parity_zero_collectives_in_both_ledgers():
+    """The serving entry of the parity test (ISSUE 7 satellite): the
+    ragged wave's static map must contain zero collectives AND the
+    runtime wave dispatch must now RECORD its zero-collective contract
+    (previously it recorded nothing, silently omitting serving from the
+    overlap ledger)."""
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.analysis.entry_points import build_spec
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models.gpt2 import gpt2_model
+    from tests.unit.inference.v2.test_engine_v2 import tiny_config
+
+    # static side: the lint entry compiles the production composition
+    spec = build_spec("ragged-paged-attention")
+    findings, report = audit_spec_schedule(spec)
+    assert report is not None, findings
+    assert report.records == []          # zero collectives by contract
+    assert report.exposed_bytes == 0 and report.overlapped_bytes == 0
+
+    # runtime side: one real wave through the v2 engine, ledger attached
+    model = gpt2_model("gpt2-tiny", max_seq_len=64, vocab_size=128,
+                       remat=False)
+    eng = InferenceEngineV2(model, config=tiny_config())
+    ledger = dist.CollectiveLedger()
+    with dist.record_into(ledger):
+        eng.put([7], [np.arange(5, dtype=np.int32)])
+    waves = [r for r in ledger.records if r["op"] == "wave_dispatch"]
+    assert waves, "serving wave dispatch no longer feeds the comm ledger"
+    assert all(r["bytes"] == 0 for r in waves)   # the contract, recorded
+    split = ledger.split()
+    assert split["overlapped_bytes"] == 0 and split["exposed_bytes"] == 0
+
+
+# a collective hidden inside a conditional BRANCH: the walker must find
+# it (branches are named true_computation=/false_computation=/
+# branch_computations=, not calls=)
+_CONDITIONAL_HLO = """\
+HloModule jit_fc, is_scheduled=true
+
+%add (x: f32[], y: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  %y = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %x, f32[] %y)
+}
+
+%taken (p: f32[2048]) -> f32[2048] {
+  %p = f32[2048]{0} parameter(0)
+  ROOT %ar = f32[2048]{0} all-reduce(f32[2048]{0} %p), to_apply=%add
+}
+
+%skipped (q: f32[2048]) -> f32[2048] {
+  ROOT %q = f32[2048]{0} parameter(0)
+}
+
+ENTRY %main (c: pred[], v: f32[2048]) -> f32[2048] {
+  %c = pred[] parameter(0)
+  %v = f32[2048]{0} parameter(1)
+  ROOT %sel = f32[2048]{0} conditional(pred[] %c, f32[2048]{0} %v, f32[2048]{0} %v), true_computation=%taken, false_computation=%skipped
+}
+"""
+
+
+def test_collective_inside_conditional_branch_is_walked():
+    comps = parse_hlo_computations(_CONDITIONAL_HLO)
+    records, _ = walk_schedule(comps, RATIO)
+    [rec] = records
+    assert rec.kind == "all-reduce" and rec.computation == "taken"
+    assert rec.operand_bytes == 2048 * 4
+
+
+# a psum inside the while CONDITION (a global convergence check): the
+# walker must see it — condition computations are per-iteration too
+_COND_COLLECTIVE_HLO = """\
+HloModule jit_fw, is_scheduled=true
+
+%add (x: f32[], y: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  %y = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %x, f32[] %y)
+}
+
+%body2 (p: (s32[], f32[2048])) -> (s32[], f32[2048]) {
+  %p = (s32[], f32[2048]) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[2048]) %p), index=0
+  %v = f32[2048]{0} get-tuple-element((s32[], f32[2048]) %p), index=1
+  %c1 = s32[] constant(1)
+  %ip = s32[] add(s32[] %i, s32[] %c1)
+  ROOT %t = (s32[], f32[2048]) tuple(s32[] %ip, f32[2048]{0} %v)
+}
+
+%cond2 (q: (s32[], f32[2048])) -> pred[] {
+  %q = (s32[], f32[2048]) parameter(0)
+  %e = f32[2048]{0} get-tuple-element((s32[], f32[2048]) %q), index=1
+  %ar = f32[2048]{0} all-reduce(f32[2048]{0} %e), to_apply=%add
+  %z = f32[] constant(0)
+  %r = f32[] reduce(f32[2048]{0} %ar, f32[] %z), dimensions={0}, to_apply=%add
+  %tol = f32[] constant(1)
+  ROOT %gt = pred[] compare(f32[] %r, f32[] %tol), direction=GT
+}
+
+ENTRY %main (v: f32[2048]) -> f32[2048] {
+  %v = f32[2048]{0} parameter(0)
+  %z = s32[] constant(0)
+  %t0 = (s32[], f32[2048]) tuple(s32[] %z, f32[2048]{0} %v)
+  %wh = (s32[], f32[2048]) while((s32[], f32[2048]) %t0), condition=%cond2, body=%body2, backend_config={"known_trip_count":{"n":"3"}}
+  ROOT %out = f32[2048]{0} get-tuple-element((s32[], f32[2048]) %wh), index=1
+}
+"""
+
+
+def test_collective_inside_while_condition_is_walked():
+    comps = parse_hlo_computations(_COND_COLLECTIVE_HLO)
+    records, _ = walk_schedule(comps, RATIO)
+    [rec] = records
+    assert rec.kind == "all-reduce" and rec.computation == "cond2"
+    assert rec.executions == 3   # per-iteration, trip-scaled
+    assert rec.loop == {"while": "wh", "trip_count": 3}
+
+
+# an async collective-permute-start carries (operand, result, u32 scratch,
+# u32 scratch): result_bytes must be the result buffer, not the scratch
+_PERMUTE_START_HLO = """\
+HloModule jit_fp, is_scheduled=true
+
+ENTRY %main (p: f32[1024]) -> f32[1024] {
+  %p = f32[1024]{0} parameter(0)
+  %cps = (f32[1024]{0}, f32[1024]{0}, u32[], u32[]) collective-permute-start(f32[1024]{0} %p), source_target_pairs={{0,1},{1,0}}
+  ROOT %cpd = f32[1024]{0} collective-permute-done((f32[1024]{0}, f32[1024]{0}, u32[], u32[]) %cps)
+}
+"""
+
+
+def test_permute_start_result_bytes_skip_context_scratch():
+    comps = parse_hlo_computations(_PERMUTE_START_HLO)
+    records, _ = walk_schedule(comps, RATIO)
+    [rec] = records
+    assert rec.kind == "collective-permute"
+    assert rec.operand_bytes == 1024 * 4
+    assert rec.result_bytes == 1024 * 4   # NOT the 8 B of u32 scratch

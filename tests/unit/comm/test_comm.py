@@ -79,3 +79,25 @@ def test_reduce_avg_and_allreduce_ops(eight_devices):
     np.testing.assert_allclose(np.asarray(avg).ravel(), [3.5] * 8)
     mx = _run(lambda v: comm.all_reduce(v, op=ReduceOp.MAX, axis="data"), x)
     np.testing.assert_array_equal(np.asarray(mx).ravel(), [7] * 8)
+
+
+def test_collective_ledger_record_into_and_logger_surface():
+    """record_into() temporarily installs the ledger as THE comms logger:
+    records flow in (count-scaled split), the module-level diagnostic
+    helpers (comms_log_tail — the stall watchdog's dump) keep working
+    while it is installed, and the previous logger is restored."""
+    import deepspeed_tpu.comm as dist
+
+    ledger = dist.CollectiveLedger()
+    with dist.record_into(ledger):
+        dist.record_collective("all_gather", 256, ("data",),
+                               overlapped=True, count=3)
+        dist.record_collective("reduce_scatter", 128, ("data",),
+                               overlapped=False)
+        tail = dist.comms_log_tail(2)
+        assert "all_gather" in tail and "reduce_scatter" in tail
+    assert ledger.split() == {"overlapped_bytes": 768, "exposed_bytes": 128}
+    assert len(ledger.records) == 2
+    # restored: records outside the context do not land in the ledger
+    dist.record_collective("all_reduce", 64, ("data",), overlapped=False)
+    assert len(ledger.records) == 2

@@ -4,15 +4,18 @@
     python tools/dstpu_lint.py deepspeed_tpu/            # fast AST layer
     python tools/dstpu_lint.py --jaxpr                   # + jaxpr audits
     python tools/dstpu_lint.py --spmd                    # + compiled audits
+    python tools/dstpu_lint.py --schedule                # + HLO-schedule audits
     python tools/dstpu_lint.py --update-budgets          # re-pin budgets
+    python tools/dstpu_lint.py --schedule --update-budgets  # + exposure budgets
     python tools/dstpu_lint.py --write-baseline          # regenerate baseline
     python tools/dstpu_lint.py --fix-hints --no-baseline # full report + hints
 
 Same engine as `dstpu lint`; exit 0 means clean against
-tools/lint_baseline.json (and, with --spmd, tools/memory_budgets.json).
-Run --spmd/--update-budgets under JAX_PLATFORMS=cpu with
---xla_force_host_platform_device_count=8 so the audit mesh matches the
-committed budgets."""
+tools/lint_baseline.json (and, with --spmd/--schedule,
+tools/memory_budgets.json / tools/exposure_budgets.json; --schedule also
+refreshes tools/collective_maps/). Run the compiled layers under
+JAX_PLATFORMS=cpu with --xla_force_host_platform_device_count=8 so the
+audit mesh matches the committed budgets."""
 
 import os
 import sys

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Side-by-side overlap scoreboard: static collective map vs runtime split.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/overlap_report.py [entry] [--all]
+
+For one registered lint entry point (default: ``zeropp-micro-overlap``,
+the pipelined ZeRO schedule) this prints the two independent estimates of
+the same quantity — how many collective bytes the schedule hides under
+compute:
+
+- **static** — Layer D's walk of the compiled HLO schedule
+  (``dstpu lint --schedule``): per-collective placement, hideable FLOPs,
+  overlapped/exposed/serialized classification. Bytes are the actual
+  wire payloads (quantized collectives count their quantized bytes).
+- **runtime** — the ``dist.record_collective`` ledger captured at trace
+  time: the schedule classes the comm layer *declares* (TreeComm's
+  overlapped/exposed tags, pipeline edges marked exposed). Bytes follow
+  the logger's full-precision convention.
+
+The two use different byte conventions, so the comparable number is the
+overlapped FRACTION of each split — the tier-1 parity test
+(tests/unit/analysis/test_schedule_audit.py) holds them within 10% on
+the pipelined ZeRO entry. A growing gap means either the compiler
+stopped scheduling the overlap the comm layer promises (static drops),
+or the comm layer's tags rot (runtime drifts) — this scoreboard is the
+human-readable view for ROADMAP items 1-2.
+"""
+
+import argparse
+import os
+import sys
+
+if __package__ in (None, ""):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.realpath(__file__))))
+
+
+def frac(split):
+    total = sum(split.values())
+    return (split.get("overlapped", split.get("overlapped_bytes", 0)) / total
+            if total else None)
+
+
+def report_entry(name: str) -> int:
+    from deepspeed_tpu.analysis.entry_points import build_spec
+    from deepspeed_tpu.analysis.schedule_audit import (
+        CLASS_EXPOSED, CLASS_OVERLAPPED, CLASS_SERIALIZED,
+        audit_spec_schedule, trace_runtime_split)
+
+    spec = build_spec(name)
+    runtime = trace_runtime_split(spec)
+    findings, rep = audit_spec_schedule(spec)
+    if rep is None:
+        print(f"{name}: schedule audit failed:", file=sys.stderr)
+        for f in findings:
+            print(f"  {f.message}", file=sys.stderr)
+        return 1
+    static = rep.split()
+
+    print(f"\n== {name} ==")
+    print(f"{'':28}{'static (compiled HLO)':>24}{'runtime (ledger)':>20}")
+    rows = [
+        ("overlapped bytes", static[CLASS_OVERLAPPED],
+         runtime["overlapped_bytes"]),
+        ("exposed bytes",
+         static[CLASS_EXPOSED] + static[CLASS_SERIALIZED],
+         runtime["exposed_bytes"]),
+        ("  of which serialized", static[CLASS_SERIALIZED], ""),
+    ]
+    for label, a, b in rows:
+        print(f"{label:28}{a:>24}{str(b):>20}")
+    sf, rf = frac(static), frac({"overlapped": runtime["overlapped_bytes"],
+                                 "exposed": runtime["exposed_bytes"]})
+    fmt = lambda v: "n/a (no collectives)" if v is None else f"{v:.3f}"
+    print(f"{'overlapped fraction':28}{fmt(sf):>24}{fmt(rf):>20}")
+    if sf is not None and rf is not None:
+        delta = abs(sf - rf)
+        verdict = "OK (<= 0.10)" if delta <= 0.10 else "DRIFT (> 0.10)"
+        print(f"{'parity delta':28}{delta:>24.3f}{verdict:>20}")
+    print(f"\nper-collective placement ({len(rep.records)} in schedule "
+          f"order; x = executions from loop trip counts):")
+    for r in rep.records:
+        loop = f" in {r.loop['while']}(x{r.loop['trip_count']})" \
+            if r.loop else ""
+        print(f"  {r.classification:10} {r.kind:20} x{r.executions} "
+              f"{r.operand_bytes:>9} B  hideable {r.hideable_flops:>12} "
+              f"flops  {r.source}{loop}")
+    for f in findings:
+        print(f"finding: [{f.rule_id}] {f.message}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="static vs runtime collective overlap scoreboard")
+    parser.add_argument("entry", nargs="?", default="zeropp-micro-overlap",
+                        help="registered lint entry point (default: the "
+                             "pipelined ZeRO micro)")
+    parser.add_argument("--all", action="store_true",
+                        help="report every registered entry point")
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.analysis.entry_points import SPEC_BUILDERS
+    names = list(SPEC_BUILDERS) if args.all else [args.entry]
+    unknown = [n for n in names if n not in SPEC_BUILDERS]
+    if unknown:
+        print(f"unknown entry point(s): {', '.join(unknown)} "
+              f"(known: {', '.join(sorted(SPEC_BUILDERS))})",
+              file=sys.stderr)
+        return 2
+    rc = 0
+    for name in names:
+        rc = max(rc, report_entry(name))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

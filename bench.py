@@ -28,6 +28,10 @@ line):
       per-layer gather/reduce-scatter inside the scan) vs the barrier
       schedule (overlap_comm false, fresh subprocess denominator)
                                                -> tokens/sec + ratio
+  [11b] GPT-2 125M ZeRO-3 overlap, QUANTIZED TRANSPORT (ISSUE 8: the
+      planner's int8 grad wire + hierarchical decomposition, default-on)
+      vs full-width flat (DSTPU_COMM_QUANT=0, fresh subprocess
+      denominator)                             -> tokens/sec + vs_quant_off
   [12] FULL-DEPTH llama2-7b (32 layers, real dims) int4 WOQ + fp8 KV,
       16 requests, served from a real-format HF checkpoint dir via
       build_hf_engine + continuous batching    -> output tok/s + TTFT
@@ -475,7 +479,7 @@ def bench_attn_32k(peak_tflops):
     return line
 
 
-N_TPU_RUNS = 18     # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 19     # build_runs(on_tpu=True) length — asserted in child mode
 N_SERVING_RUNS = 6  # ... of which the LAST SIX are serving lines
 #                     (7B 512-prompt, 7B long-context, MoE-6req, and the
 #                     32/64/128 concurrency ladder) — one sample
@@ -575,6 +579,29 @@ def _zero_overlap_cfg(overlap: bool = True):
     }
 
 
+def _comm_quant_denominator():
+    """Child mode: the SAME gpt2-125m stage-3 pipelined schedule with the
+    transport planner's escape hatch (DSTPU_COMM_QUANT=0 — every plan
+    full-width/flat, byte-identical to the pre-ISSUE-8 program), in a
+    fresh process (HBM isolation). The pipelined schedule stays ON: the
+    only variable is the wire."""
+    os.environ["DSTPU_COMM_QUANT"] = "0"
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import gpt2_model
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
+    steps = 30 if on_tpu else 3
+    _emit(bench_train(
+        "gpt2-125m ZeRO-3 overlap full-width (denominator)",
+        gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+        _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3, peak))
+
+
 def _zero_overlap_denominator():
     """Child mode: the SAME gpt2-125m stage-3 model through the SAME
     explicit shard_map micro but with the whole-tree BARRIER schedule, in
@@ -605,6 +632,8 @@ def main():
         return _offload_denominator()
     if "--zero-overlap-denominator" in sys.argv:
         return _zero_overlap_denominator()
+    if "--comm-quant-denominator" in sys.argv:
+        return _comm_quant_denominator()
     if "--one" not in sys.argv and _probe_backend() not in ("cpu",):
         return _dispatch_tpu()  # client-free parent
     return _run_configs()
@@ -927,6 +956,35 @@ def _run_configs():
                 line["overlap_off_tokens_per_sec"] = bar_line["value"]
             return line
         runs.append(zero_overlap_run)
+
+        def comm_quant_run():
+            # Quantized + hierarchical transport (ISSUE 8 tentpole): the
+            # SAME gpt2-125m stage-3 pipelined schedule, planner defaults
+            # (int8 grad wire) vs the full-width escape hatch in its OWN
+            # subprocess (DSTPU_COMM_QUANT=0, _comm_quant_denominator) —
+            # the wire is the only variable. Acceptance: grad reduce wire
+            # bytes -40%+ (pinned statically by the per-kind budgets),
+            # step time no worse (vs_quant_off >= ~1.0).
+            line = bench_train(
+                "gpt2-125m ZeRO-3 overlap QUANT-TRANSPORT bf16",
+                gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+                _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3,
+                peak, note=", int8 grad wire (transport planner default)")
+            import subprocess
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--comm-quant-denominator"],
+                    capture_output=True, text=True, timeout=2400)
+                off_line = _last_metric_line(r.stdout)
+            except subprocess.TimeoutExpired:
+                off_line = None
+            if off_line and off_line.get("value"):
+                line["vs_quant_off"] = round(
+                    line["value"] / off_line["value"], 3)
+                line["quant_off_tokens_per_sec"] = off_line["value"]
+            return line
+        runs.append(comm_quant_run)
 
         def serving_7b_run():
             # FULL-DEPTH llama2-7b (32 layers, real dims) at int8 WOQ

@@ -33,6 +33,19 @@ TRACKED_FIELDS: Tuple[str, ...] = (
     "argument_size_in_bytes", "output_size_in_bytes",
     "temp_size_in_bytes", "collective_bytes")
 
+#: PER-KIND collective budgets (ISSUE 8): alongside the total, every
+#: ``collective_bytes.<hlo-kind>`` key (e.g. ``collective_bytes.all-to-all``)
+#: is tracked shrink-only whenever ``collective_bytes`` is among the
+#: tracked fields. This is what statically pins the quantized-transport
+#: byte win per kind — an entry whose reduce-scatter bytes grow back to
+#: full width regresses that kind's budget even if another kind shrank.
+KIND_PREFIX = "collective_bytes."
+
+
+def tracks_field(field: str, fields: Tuple[str, ...]) -> bool:
+    return field in fields or ("collective_bytes" in fields
+                               and field.startswith(KIND_PREFIX))
+
 
 def default_budgets_path() -> str:
     root = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -52,7 +65,7 @@ def load_budgets(path: str,
         data = json.load(fh)
     return {"mesh_devices": int(data.get("mesh_devices", 0)),
             "budgets": {k: {f: int(v) for f, v in e.items()
-                            if f in fields}
+                            if tracks_field(f, fields)}
                         for k, e in data.get("budgets", {}).items()}}
 
 
@@ -67,7 +80,10 @@ def env_matches(budgets: Optional[Dict]) -> bool:
 DEFAULT_COMMENT = ("Per-entry-point compiled memory & collective byte "
                    "budgets (dstpu lint --spmd). Shrink, never grow: "
                    "`dstpu lint --update-budgets` only lowers; raising a "
-                   "budget is a hand edit that must survive review.")
+                   "budget is a hand edit that must survive review. "
+                   "collective_bytes[.kind] are OPERAND-side (input payload) "
+                   "bytes per launch — the wire convention shared with "
+                   "Layer D and record_collective (docs/COLLECTIVES.md).")
 
 
 def write_budgets(path: str, budgets: Dict,
@@ -98,8 +114,8 @@ def shrink_budgets(old: Optional[Dict], reports: Dict[str, Dict[str, int]],
                                          for k, v in old_budgets.items()}
     for name, report in reports.items():
         entry = merged.setdefault(name, {})
-        for field in fields:
-            if field not in report:
+        for field in report:
+            if not tracks_field(field, fields):
                 continue
             cur = int(report[field])
             if field not in entry:

@@ -396,6 +396,45 @@ def build_ragged_paged_attention() -> EntrySpec:
                      overlap_contract=True)
 
 
+def build_quantized_transport() -> EntrySpec:
+    """The transport planner's quantized + hierarchical collective paths
+    (ISSUE 8, comm/comm.py + ops/quantizer): an explicit shard_map region
+    over the two-tier audit mesh (mics=2 intra-tier x data=4 cross-tier)
+    running the planner-resolved grad reduce-scatter (int8 wire,
+    hierarchical decomposition) and the EQuARX-style quantized
+    all-reduce. Layer B enforces collective axis binding on the quantized
+    wire legs; every collective is explicit in the source jaxpr, so
+    ``expected_spmd`` is empty; Layers C/D pin the wire bytes per kind
+    and the exposure budget (docs/COLLECTIVES.md)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.runtime.topology import (DATA_AXIS, MICS_AXIS,
+                                                TopologyConfig)
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    topo = topo_mod.initialize(TopologyConfig(mics=2, data=-1), force=True)
+    axes = (DATA_AXIS, MICS_AXIS)
+
+    def local(g, a):
+        rs = dist.reduce_scatter(g, axis=axes, kind="grad")
+        ar = dist.all_reduce(a, axis=axes, kind="grad")
+        return rs, ar
+
+    fn = shard_map(local, mesh=topo.mesh,
+                   in_specs=(P(axes), P(axes)),
+                   out_specs=(P(axes), P(None)),  # rs shards; ar replicates
+                   check_vma=False)
+    g = jnp.zeros((2048, 16), jnp.float32)
+    a = jnp.zeros((4096,), jnp.float32)
+    args = (g, a)
+    return EntrySpec(name="quantized-transport", fn=fn, args=args,
+                     mesh=topo.mesh, retrace_args=[args, args],
+                     gate_cheap=True)
+
+
 def build_telemetry_off_parity() -> EntrySpec:
     """The telemetry zero-overhead contract (docs/OBSERVABILITY.md): the
     engine step entry point's jaxpr must be IDENTICAL with telemetry off
@@ -465,6 +504,7 @@ SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
     "ulysses-attention": build_ulysses_attention,
     "flash-attention-kernel": build_flash_kernel,
     "paged-decode": build_paged_decode,
+    "quantized-transport": build_quantized_transport,
     "ragged-paged-attention": build_ragged_paged_attention,
     "telemetry-off-parity": build_telemetry_off_parity,
 }
@@ -509,8 +549,8 @@ ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
 #: Pinned rather than computed — building every spec just to read its
 #: gate_cheap flag would boot engines; a test asserts the two agree.
 GATE_SPMD_ENTRY_POINTS: Tuple[str, ...] = (
-    "moe-dispatch", "paged-decode", "ragged-paged-attention",
-    "ring-attention", "ulysses-attention")
+    "moe-dispatch", "paged-decode", "quantized-transport",
+    "ragged-paged-attention", "ring-attention", "ulysses-attention")
 
 
 def audit_entry_points(names=None) -> List[Finding]:

@@ -852,13 +852,13 @@ def audit_spec_schedule(spec, exposure: Optional[Dict] = None,
     return findings, report
 
 
-def trace_runtime_split(spec) -> Dict[str, int]:
-    """The RUNTIME side of the overlap parity: trace ``spec.fn`` under a
-    recording ledger (``dist.record_collective`` fires at trace time —
-    nothing executes) -> ``{"overlapped_bytes", "exposed_bytes"}``.
-    The parity test and ``tools/overlap_report.py`` hold this against the
-    static :class:`ScheduleReport` split: same taxonomy, two estimators
-    (design-intent tags vs compiled placement)."""
+def trace_runtime_ledger(spec):
+    """Trace ``spec.fn`` ONCE under a recording ledger
+    (``dist.record_collective`` fires at trace time — nothing executes)
+    and return the :class:`~deepspeed_tpu.comm.CollectiveLedger`. One
+    trace only: jax caches traces per (fn, avals), so a second
+    ``eval_shape`` of the same spec records NOTHING — callers wanting
+    both the split and the raw records must share this ledger."""
     import jax
 
     from deepspeed_tpu import comm as dist
@@ -867,7 +867,17 @@ def trace_runtime_split(spec) -> Dict[str, int]:
     with dist.record_into(ledger):
         with spec.mesh_ctx():
             jax.eval_shape(spec.fn, *spec.args)
-    return ledger.split()
+    return ledger
+
+
+def trace_runtime_split(spec) -> Dict[str, int]:
+    """The RUNTIME side of the overlap parity ->
+    ``{"overlapped_bytes", "exposed_bytes"}`` (WIRE bytes — the
+    convention that matches the static side's HLO operand bytes).
+    The parity test and ``tools/overlap_report.py`` hold this against the
+    static :class:`ScheduleReport` split: same taxonomy, two estimators
+    (design-intent tags vs compiled placement)."""
+    return trace_runtime_ledger(spec).split()
 
 
 def audit_schedule_entry_points(names=None, exposure: Optional[Dict] = None,

@@ -50,7 +50,7 @@ from typing import Any, Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
 
 import numpy as np
 
-from .budgets import TRACKED_FIELDS
+from .budgets import KIND_PREFIX, TRACKED_FIELDS, tracks_field
 from .findings import Finding, SEVERITY_ERROR, SEVERITY_WARNING, sort_findings
 from .registry import LAYER_SPMD, Rule, register
 
@@ -189,19 +189,48 @@ def iter_hlo_instructions(hlo_text: str) -> Iterable[
         yield m.group(2), _parse_shapes(m.group(1))
 
 
+# a collective instruction with its operand list: opcode + everything up
+# to (at least) the operand parenthesis; the blob is cut at the matching
+# close paren by _operand_blob so trailing attributes never leak shapes in
+_COLL_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+    r"(?:\([^)]*\)|[a-z][\w]*\[[0-9,]*\](?:\{[^}]*\})?)\s+"
+    r"([a-z][a-z0-9\-]*)\((.*)$", re.MULTILINE)
+
+
+def _operand_blob(rest: str) -> str:
+    """``rest`` starts just past the opcode's '('; return the operand text
+    up to the MATCHING ')' (tuple-shaped operands nest parens)."""
+    depth = 1
+    for i, ch in enumerate(rest):
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+            if depth == 0:
+                return rest[:i]
+    return rest
+
+
 def collective_summary(hlo_text: str) -> Dict[str, Tuple[int, int]]:
-    """-> {kind: (count, total_result_bytes)} over the partitioned program.
-    Async pairs count once (``-start`` carries the shape, ``-done`` is
-    skipped). An async ``-start`` returns ``(operand aliases..., results
-    ...)`` — only the result half is charged, or the bytes would double."""
+    """-> {kind: (count, total_operand_bytes)} over the partitioned program.
+
+    Bytes are OPERAND-side (each launch's input payload) — the same
+    convention as Layer D's per-launch ``moved_bytes`` and the runtime
+    ledger's ``record_collective``, and the honest wire estimate under
+    quantized transport: a reduce-scatter's input is what travels the
+    links (its result is the 1/n shard), and an int8 all-to-all's input
+    is the 1-byte payload + scale sideband. (Before ISSUE 8 this charged
+    RESULT bytes, which inverted the reduce-scatter vs all-to-all
+    comparison and hid the quantization win.) Async pairs count once
+    (``-start`` carries the operands, ``-done`` is skipped)."""
     out: Dict[str, Tuple[int, int]] = {}
-    for opcode, shapes in iter_hlo_instructions(hlo_text):
+    for m in _COLL_RE.finditer(hlo_text):
+        opcode = m.group(1)
         kind = opcode[:-6] if opcode.endswith("-start") else opcode
         if opcode.endswith("-done") or kind not in _HLO_COLLECTIVE_KINDS:
             continue
-        if opcode.endswith("-start") and len(shapes) > 1:
-            shapes = (shapes[len(shapes) // 2:] if len(shapes) % 2 == 0
-                      else shapes[-1:])
+        shapes = _parse_shapes(_operand_blob(m.group(2)))
         count, total = out.get(kind, (0, 0))
         out[kind] = (count + 1, total + sum(b for _, _, b in shapes))
     return out
@@ -328,17 +357,25 @@ class SpmdReport:
     memory: Dict[str, float]
     collective_counts: Dict[str, int]
     collective_bytes: int
+    collective_bytes_by_kind: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
 
     def budget_fields(self) -> Dict[str, int]:
         out = {f: int(self.memory[f]) for f in TRACKED_FIELDS
                if f in self.memory}
         out["collective_bytes"] = int(self.collective_bytes)
+        # per-kind shrink-only budgets (ISSUE 8): the static pin of the
+        # quantized-transport byte reduction, one key per HLO kind
+        for kind, nbytes in sorted(self.collective_bytes_by_kind.items()):
+            out[KIND_PREFIX + kind] = int(nbytes)
         return out
 
     def to_dict(self) -> Dict[str, Any]:
         return {"name": self.name, "memory": self.memory,
                 "collective_counts": self.collective_counts,
-                "collective_bytes": self.collective_bytes}
+                "collective_bytes": self.collective_bytes,
+                "collective_bytes_by_kind": dict(
+                    sorted(self.collective_bytes_by_kind.items()))}
 
 
 def _finding(rule: Rule, name: str, message: str) -> Finding:
@@ -428,7 +465,8 @@ def audit_artifact(spec, artifact, *,
     report = SpmdReport(
         name=name, memory=artifact.memory() or {},
         collective_counts={k: c for k, (c, _) in summary.items()},
-        collective_bytes=sum(b for _, b in summary.values()))
+        collective_bytes=sum(b for _, b in summary.values()),
+        collective_bytes_by_kind={k: b for k, (_, b) in summary.items()})
     return findings, report
 
 
@@ -466,8 +504,19 @@ def check_budgets(name: str, report: SpmdReport,
             "`dstpu lint --update-budgets` and commit the file")]
     findings = []
     current = report.budget_fields()
-    for field in TRACKED_FIELDS:
-        if field not in entry or field not in current:
+    for field in sorted(current):
+        if not tracks_field(field, TRACKED_FIELDS):
+            continue
+        if field not in entry:
+            if field.startswith(KIND_PREFIX) and current[field] > 0:
+                # a collective KIND with no committed budget appeared —
+                # the per-kind analogue of a new-entry missing budget
+                findings.append(_finding(
+                    MEMORY_BUDGET_REGRESSION, name,
+                    f"{field} {current[field]} B has no committed per-kind "
+                    f"budget — a new collective kind entered the compiled "
+                    f"program (hand-add it with review, or fix the "
+                    f"sharding)"))
             continue
         if current[field] > entry[field]:
             findings.append(_finding(

@@ -1,8 +1,10 @@
-from .comm import (CollectiveLedger, ReduceOp, all_gather,  # noqa: F401
-                   all_reduce, all_to_all, all_to_all_single, axis_index,
-                   axis_size, barrier, broadcast, comms_log_tail, configure,
-                   gather, get_local_rank, get_rank, get_world_size,
-                   inference_all_reduce, init_distributed, is_initialized,
-                   log_summary, monitored_barrier, ppermute,
-                   record_collective, record_into, recv, reduce,
-                   reduce_scatter, scatter, send)
+from .comm import (CollectiveLedger, ReduceOp, TransportPlan,  # noqa: F401
+                   all_gather, all_reduce, all_to_all, all_to_all_single,
+                   axis_index, axis_size, barrier, broadcast, comms_log_tail,
+                   configure, configure_transport, gather, get_local_rank,
+                   get_rank, get_world_size, inference_all_reduce,
+                   init_distributed, is_initialized, log_summary,
+                   monitored_barrier, ppermute, record_collective,
+                   record_into, recv, reduce, reduce_scatter,
+                   reset_transport, resolve_transport, scatter, send,
+                   transport_config)

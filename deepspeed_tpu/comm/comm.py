@@ -24,9 +24,10 @@ the axis size like ZeRO's ``average_tensor`` (stage_1_and_2.py:1004).
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import enum
 import os
-from typing import Optional, Sequence, Union
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +46,209 @@ class ReduceOp(enum.Enum):
     MAX = "max"
     MIN = "min"
     PRODUCT = "product"
+
+
+# -- transport planner (ISSUE 8 tentpole) ------------------------------------
+#
+# Every collective launch resolves through a per-bucket TransportPlan:
+# wire WIDTH (full | bf16 | int8 | fp8) chosen from the tensor KIND
+# (param / grad / activation) and bucket bytes, and ALGORITHM (flat |
+# hierarchical) chosen from the mesh topology — two-tier decomposition
+# when the axis tuple spans the DCN-eligible 'data' axis plus intra-slice
+# ICI axes (*The Big Send-off*, arXiv:2504.18658: intra-ICI reduce-scatter
+# + inter-tier reduction on the 1/n shard), EQuARX-style
+# quantize->reduce->dequantize with per-group scales for the low-precision
+# widths (arXiv:2506.17615). Full rules: docs/COLLECTIVES.md.
+
+WIDTH_FULL = "full"
+WIDTH_BF16 = "bf16"
+WIDTH_INT8 = "int8"
+WIDTH_FP8 = "fp8"
+ALGO_FLAT = "flat"
+ALGO_HIERARCHICAL = "hierarchical"
+
+KIND_PARAM = "param"
+KIND_GRAD = "grad"
+KIND_ACTIVATION = "activation"
+
+_WIDTHS = (WIDTH_FULL, WIDTH_BF16, WIDTH_INT8, WIDTH_FP8)
+_KINDS = (KIND_PARAM, KIND_GRAD, KIND_ACTIVATION)
+
+#: process-global transport policy (engine config block ``comm_transport``
+#: lands here via :func:`configure_transport`; tests/tools flip the env
+#: gates). ``DSTPU_COMM_QUANT=0`` is the kill switch: planner DEFAULTS
+#: escape to full width (explicitly-requested widths — the ZeRO++
+#: qwZ/qgZ config knobs — are a user contract and keep riding).
+#: ``DSTPU_COMM_HIER=0`` pins the flat algorithm.
+_TRANSPORT_DEFAULTS = dict(
+    enabled=True,
+    grad_width=WIDTH_INT8,          # gradient reductions (EF-compensable)
+    activation_width=WIDTH_BF16,    # MoE dispatch / seq all-to-all resharding
+    permute_width=WIDTH_INT8,       # ring KV hops (explicit sideband scales)
+    hierarchical=True,
+    group_size=256,
+    min_bytes=1024,                 # buckets below this stay full width
+    error_feedback=False,           # costs one fp32 copy of each grad bucket
+)
+_TRANSPORT = dict(_TRANSPORT_DEFAULTS)
+
+#: widths each collective op can move. Reductions need sideband scales
+#: (int8/fp8 quantize->sum); pure data movement can also plain-cast
+#: (bf16). Unsupported requests degrade to the nearest supported width
+#: rather than erroring — the plan is a performance policy, not an API.
+_OP_WIDTHS = {
+    "all_reduce": (WIDTH_FULL, WIDTH_INT8, WIDTH_FP8),
+    "reduce_scatter": (WIDTH_FULL, WIDTH_INT8, WIDTH_FP8),
+    "all_gather": (WIDTH_FULL, WIDTH_BF16, WIDTH_INT8, WIDTH_FP8),
+    "all_to_all": (WIDTH_FULL, WIDTH_BF16),
+    "ppermute": (WIDTH_FULL, WIDTH_BF16, WIDTH_INT8),
+}
+_WIDTH_FALLBACK = {
+    ("all_reduce", WIDTH_BF16): WIDTH_FULL,
+    ("reduce_scatter", WIDTH_BF16): WIDTH_FULL,
+    ("all_to_all", WIDTH_INT8): WIDTH_BF16,
+    ("all_to_all", WIDTH_FP8): WIDTH_BF16,
+    ("ppermute", WIDTH_FP8): WIDTH_INT8,
+}
+
+
+def configure_transport(**kwargs) -> None:
+    """Set process-global transport policy (engine ``comm_transport``
+    config block). Unknown keys or widths raise — a typo'd policy must
+    not silently revert to defaults."""
+    for key, val in kwargs.items():
+        if key not in _TRANSPORT_DEFAULTS:
+            raise ValueError(
+                f"unknown comm_transport key {key!r} "
+                f"(known: {', '.join(sorted(_TRANSPORT_DEFAULTS))})")
+        if key.endswith("_width") and val not in _WIDTHS:
+            raise ValueError(f"comm_transport.{key}={val!r} not in {_WIDTHS}")
+        _TRANSPORT[key] = val
+
+
+def transport_config() -> dict:
+    return dict(_TRANSPORT)
+
+
+def reset_transport() -> None:
+    _TRANSPORT.clear()
+    _TRANSPORT.update(_TRANSPORT_DEFAULTS)
+
+
+def _quant_defaults_on() -> bool:
+    return _TRANSPORT["enabled"] and os.environ.get(
+        "DSTPU_COMM_QUANT", "1") != "0"
+
+
+def _hier_on() -> bool:
+    return _TRANSPORT["hierarchical"] and os.environ.get(
+        "DSTPU_COMM_HIER", "1") != "0"
+
+
+@dataclasses.dataclass(frozen=True)
+class TransportPlan:
+    """How one collective launch moves its bytes. ``inner``/``outer``
+    are the hierarchical tiers (intra-slice ICI axes / the DCN-eligible
+    'data' axis); empty under the flat algorithm."""
+    width: str = WIDTH_FULL
+    algo: str = ALGO_FLAT
+    inner: Tuple[str, ...] = ()
+    outer: Tuple[str, ...] = ()
+    group_size: int = 256
+    error_feedback: bool = False
+
+    @property
+    def quantized(self) -> bool:
+        return self.width in (WIDTH_INT8, WIDTH_FP8)
+
+    def wire_bytes(self, n_elems: int, itemsize: int) -> int:
+        """Estimated bytes on the wire for an ``n_elems`` payload whose
+        logical element width is ``itemsize`` — what
+        :func:`record_collective`'s ``wire_bytes`` column carries so the
+        overlap ledger stays honest under quantized transport. Sideband
+        scale/zero arrays are charged; the hierarchical outer leg adds
+        its full-width 1/n_inner shard."""
+        groups = -(-n_elems // max(self.group_size, 1))
+        if self.width == WIDTH_INT8:
+            base = n_elems + groups * 8       # int8 payload + f32 scale/zero
+        elif self.width == WIDTH_FP8:
+            base = n_elems + groups * 4       # fp8 payload + f32 scale
+        elif self.width == WIDTH_BF16:
+            base = n_elems * min(2, itemsize)
+        else:
+            base = n_elems * itemsize
+        if self.algo == ALGO_HIERARCHICAL and self.inner:
+            ni = 1
+            for a in self.inner:
+                ni *= _transport_axis_size(a)
+            base += (n_elems // max(ni, 1)) * 4   # full-width outer leg
+        return int(base)
+
+
+FULL_FLAT_PLAN = TransportPlan()
+
+
+def _transport_axis_size(axis) -> int:
+    """Axis size for planning: the global topology when initialized (host
+    side), the bound mesh axis inside shard_map otherwise. Unknown -> 1
+    (treated as a dead axis; the plan degrades to flat/full, never
+    crashes a trace)."""
+    from ..runtime import topology as topo_mod
+    if topo_mod.is_initialized():
+        try:
+            return topo_mod.get_topology().axis_size(axis)
+        except (KeyError, TypeError):
+            pass
+    try:
+        return int(_compat_axis_size(axis))
+    except (NameError, KeyError, ValueError, TypeError):
+        return 1
+
+
+def resolve_transport(kind: Optional[str], op: str, nbytes: int,
+                      axes: AxisNames, axis_sizes: Optional[dict] = None,
+                      requested: Optional[str] = None) -> TransportPlan:
+    """Resolve one launch's :class:`TransportPlan`.
+
+    ``kind`` is the tensor kind (``param``/``grad``/``activation``;
+    ``None`` = unclassified traffic, always full/flat — generic frontend
+    callers keep their exact pre-planner behavior). ``requested`` is an
+    explicit width contract (the ZeRO++ qwZ/qgZ config knobs) that
+    survives the ``DSTPU_COMM_QUANT=0`` kill switch; planner *defaults*
+    do not. ``axis_sizes`` supplies host-known mesh sizes (the bucket
+    planner's dict); otherwise sizes come from the topology/bound mesh.
+    """
+    if kind is None and requested is None:
+        return FULL_FLAT_PLAN     # unclassified traffic: exact pre-planner path
+    axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+    size_of = (axis_sizes.get if axis_sizes is not None
+               else lambda a, _=None: _transport_axis_size(a))
+    live = tuple(a for a in axes_t if (size_of(a, 1) or 1) > 1)
+
+    width = requested if requested in _WIDTHS else WIDTH_FULL
+    if (requested is None and kind in _KINDS and _quant_defaults_on()
+            and nbytes >= _TRANSPORT["min_bytes"]):
+        if kind == KIND_GRAD:
+            width = _TRANSPORT["grad_width"]
+        elif kind == KIND_ACTIVATION:
+            width = (_TRANSPORT["permute_width"] if op == "ppermute"
+                     else _TRANSPORT["activation_width"])
+        # KIND_PARAM default stays full: the param all-gather width is the
+        # user's qwZ contract (zero_quantized_weights -> requested="int8")
+    while width not in _OP_WIDTHS.get(op, (WIDTH_FULL,)):
+        width = _WIDTH_FALLBACK.get((op, width), WIDTH_FULL)
+
+    algo, inner, outer = ALGO_FLAT, (), ()
+    if (op in ("all_reduce", "reduce_scatter", "all_gather")
+            and _quant_defaults_on() and _hier_on()):
+        out_axes = tuple(a for a in live if a == DATA_AXIS)
+        in_axes = tuple(a for a in live if a != DATA_AXIS)
+        if out_axes and in_axes:
+            algo, inner, outer = ALGO_HIERARCHICAL, in_axes, out_axes
+    return TransportPlan(width=width, algo=algo, inner=inner, outer=outer,
+                         group_size=_TRANSPORT["group_size"],
+                         error_feedback=(bool(_TRANSPORT["error_feedback"])
+                                         and kind == KIND_GRAD))
 
 
 _INITIALIZED = False
@@ -130,20 +334,25 @@ def configure(config=None, comms_logger=None) -> None:
         _COMMS_LOGGER = CommsLogger(config.comms_config)
 
 
-def _record(op_name: str, x, axis: AxisNames) -> None:
+def _record(op_name: str, x, axis: AxisNames,
+            plan: Optional[TransportPlan] = None) -> None:
     tele = _telemetry()
     if _COMMS_LOGGER is None and not tele.enabled:
         return
-    size = int(np.prod(jnp.shape(x))) * jnp.result_type(x).itemsize
+    n = int(np.prod(jnp.shape(x)))
+    itemsize = jnp.result_type(x).itemsize
+    size = n * itemsize
+    wire = plan.wire_bytes(n, itemsize) if plan is not None else size
     if _COMMS_LOGGER is not None:
-        _COMMS_LOGGER.append(op_name, size, axis)
+        _COMMS_LOGGER.append(op_name, size, axis, wire_bytes=wire)
     if tele.enabled:
-        tele.record_collective(op_name, size, axis)
+        tele.record_collective(op_name, size, axis, wire_bytes=wire)
 
 
 def record_collective(op_name: str, nbytes: int, axis: AxisNames,
                       overlapped: Optional[bool] = None,
-                      count: int = 1) -> None:
+                      count: int = 1,
+                      wire_bytes: Optional[int] = None) -> None:
     """Record a collective issued through raw ``jax.lax`` primitives (the
     ZeRO micro schedules build their own gathers/scatters) with its
     schedule class: ``overlapped=True`` means the launch is issued
@@ -151,17 +360,23 @@ def record_collective(op_name: str, nbytes: int, axis: AxisNames,
     in-scan prefetch/reduce-scatter), ``False`` means it sits on the
     critical path (barrier schedule, edge-of-step gathers). ``count`` is
     the executions-per-step of one trace site (a scan body traces once but
-    launches per iteration). Feeds the overlapped/exposed split column of
+    launches per iteration). ``wire_bytes`` is what actually travels the
+    links when the transport plan narrows the width (int8 payload +
+    sideband scales); defaults to ``nbytes`` — full-width launches and
+    logical accounting agree. Feeds the overlapped/exposed split column of
     :func:`log_summary` and the telemetry trace/overlap-efficiency metric
     (docs/OBSERVABILITY.md). No-op unless a CommsLogger or telemetry is
     configured."""
+    wire = int(nbytes) if wire_bytes is None else int(wire_bytes)
     if _COMMS_LOGGER is not None:
         _COMMS_LOGGER.append(op_name, int(nbytes), axis,
-                             overlapped=overlapped, count=count)
+                             overlapped=overlapped, count=count,
+                             wire_bytes=wire)
     tele = _telemetry()
     if tele.enabled:
         tele.record_collective(op_name, int(nbytes), axis,
-                               overlapped=overlapped, count=count)
+                               overlapped=overlapped, count=count,
+                               wire_bytes=wire)
 
 
 class CollectiveLedger:
@@ -175,21 +390,29 @@ class CollectiveLedger:
         self.records = []
 
     def append(self, op_name: str, nbytes: int, axis,
-               overlapped: Optional[bool] = None, count: int = 1) -> None:
+               overlapped: Optional[bool] = None, count: int = 1,
+               wire_bytes: Optional[int] = None) -> None:
         self.records.append({"op": op_name, "bytes": int(nbytes),
+                             "wire_bytes": int(nbytes if wire_bytes is None
+                                               else wire_bytes),
                              "axes": tuple(axis) if isinstance(
                                  axis, (tuple, list)) else (axis,),
                              "overlapped": overlapped, "count": int(count)})
 
-    def split(self) -> dict:
+    def split(self, wire: bool = True) -> dict:
         """-> {"overlapped_bytes", "exposed_bytes"} (count-scaled;
-        untagged records excluded, same as the telemetry metric)."""
+        untagged records excluded, same as the telemetry metric).
+        ``wire=True`` (default) charges WIRE bytes — the convention that
+        matches Layer D's static split, which reads actual HLO operand
+        bytes and therefore sees quantized payloads at their quantized
+        size. ``wire=False`` restores logical full-width accounting."""
+        key = "wire_bytes" if wire else "bytes"
         out = {"overlapped_bytes": 0, "exposed_bytes": 0}
         for r in self.records:
             if r["overlapped"] is True:
-                out["overlapped_bytes"] += r["bytes"] * r["count"]
+                out["overlapped_bytes"] += r[key] * r["count"]
             elif r["overlapped"] is False:
-                out["exposed_bytes"] += r["bytes"] * r["count"]
+                out["exposed_bytes"] += r[key] * r["count"]
         return out
 
     # the rest of the CommsLogger surface the module-level helpers may
@@ -253,13 +476,109 @@ def barrier(name: str = "deepspeed_tpu_barrier") -> None:
         multihost_utils.sync_global_devices(name)
 
 
+# -- hierarchical decompositions (transport planner, algo=hierarchical) ------
+
+def _hier_psum(x, inner: Tuple[str, ...], outer: Tuple[str, ...]):
+    """Two-tier all-reduce: reduce-scatter over the intra-tier (ICI) axes,
+    all-reduce the 1/n_inner shard over the cross-tier (DCN) axes,
+    all-gather back over the intra-tier axes. Cross-tier bytes shrink by
+    the inner axis size. Falls back to the flat psum when the element
+    count does not tile over the inner axes."""
+    ni = axis_size(inner)
+    if x.size % ni:
+        return jax.lax.psum(x, inner + outer)
+    flat = x.reshape(-1)
+    part = jax.lax.psum_scatter(flat, inner, scatter_dimension=0, tiled=True)
+    part = jax.lax.psum(part, outer)
+    full = jax.lax.all_gather(part, inner, axis=0, tiled=True)
+    return full.reshape(x.shape)
+
+
+def _hier_regroup(xm, axes: Tuple[str, ...], inner: Tuple[str, ...],
+                  outer: Tuple[str, ...]):
+    """Rearrange a destination-major reduce-scatter input ([N*s, ...] in
+    the flat compound-axis block order of ``axes``) into inner-major
+    block order, so a two-stage scatter (inner then outer) delivers each
+    member exactly the block the flat launch would. Size-1 axes in the
+    caller's tuple (excluded from the plan's tiers) contribute factor 1
+    to the block layout and are dropped from the math — exact."""
+    axes = tuple(a for a in axes if a in inner or a in outer)
+    sizes = [axis_size(a) for a in axes]
+    n = int(np.prod(sizes))
+    s = xm.shape[0] // n
+    t = xm.reshape(tuple(sizes) + (s,) + xm.shape[1:])
+    order = ([axes.index(a) for a in inner] + [axes.index(a) for a in outer]
+             + list(range(len(sizes), t.ndim)))
+    t = jnp.transpose(t, order)
+    return t.reshape((n * s,) + xm.shape[1:])
+
+
+def _hier_psum_scatter(xm, axes: Tuple[str, ...], inner: Tuple[str, ...],
+                       outer: Tuple[str, ...], quantized_inner=None):
+    """Two-tier reduce-scatter with the flat launch's output layout:
+    stage 1 reduce-scatters over the intra-tier axes (optionally with a
+    quantized wire via ``quantized_inner(x, axis)``), stage 2
+    reduce-scatters the 1/n_inner partial over the cross-tier axes at
+    full width — the Big Send-off split: the DCN tier moves 1/n_inner of
+    the bytes. ``xm``: [N*s, ...] destination-major."""
+    t = _hier_regroup(xm, axes, inner, outer)
+    if quantized_inner is not None:
+        part = quantized_inner(t, inner)
+    else:
+        part = jax.lax.psum_scatter(t, inner, scatter_dimension=0, tiled=True)
+    return jax.lax.psum_scatter(part, outer, scatter_dimension=0, tiled=True)
+
+
+def _hier_all_gather(x, axes: Tuple[str, ...], inner: Tuple[str, ...],
+                     outer: Tuple[str, ...]):
+    """Two-tier tiled all-gather reproducing the flat compound-axis block
+    order of ``axes``: gather over the intra-tier axes, then the
+    cross-tier axes, then reorder the (outer, inner) block grid back to
+    the flat order. Size-1 axes drop out of the layout math (exact)."""
+    axes = tuple(a for a in axes if a in inner or a in outer)
+    gi = jax.lax.all_gather(x, inner, axis=0, tiled=False)     # [ni, s, ...]
+    go = jax.lax.all_gather(gi, outer, axis=0, tiled=False)    # [no, ni, s,...]
+    i_sizes = [axis_size(a) for a in inner]
+    o_sizes = [axis_size(a) for a in outer]
+    t = go.reshape(tuple(o_sizes) + tuple(i_sizes) + go.shape[2:])
+    current = tuple(outer) + tuple(inner)
+    order = ([current.index(a) for a in axes]
+             + list(range(len(current), t.ndim)))
+    t = jnp.transpose(t, order)
+    n = int(np.prod(i_sizes)) * int(np.prod(o_sizes))
+    return t.reshape((n * x.shape[0],) + x.shape[1:])
+
+
 # -- in-mesh collectives (call inside shard_map / pjit) ----------------------
 
-def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = DATA_AXIS, group=None):
-    """psum/pmax/pmin over named axes (reference comm.py:466 all_reduce)."""
-    _record("all_reduce", tensor, axis)
+def _nbytes(tensor) -> int:
+    return int(np.prod(jnp.shape(tensor))) * jnp.result_type(tensor).itemsize
+
+
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = DATA_AXIS,
+               group=None, kind: Optional[str] = None):
+    """psum/pmax/pmin over named axes (reference comm.py:466 all_reduce).
+
+    ``kind`` routes SUM/AVG through the transport planner: ``grad``
+    buckets default to the EQuARX-style quantized all-reduce, compound
+    axes spanning 'data' decompose hierarchically. ``kind=None`` (and
+    MAX/MIN/PRODUCT always) is the exact full-width psum."""
+    plan = (resolve_transport(kind, "all_reduce", _nbytes(tensor), axis)
+            if kind is not None and op in (ReduceOp.SUM, ReduceOp.AVG)
+            else FULL_FLAT_PLAN)
+    _record("all_reduce", tensor, axis, plan=plan)
     if op in (ReduceOp.SUM, ReduceOp.AVG):
-        out = jax.lax.psum(tensor, axis)
+        if plan.quantized:
+            from ..ops.quantizer.quantizer import quantized_all_reduce
+            inner = plan.inner if plan.algo == ALGO_HIERARCHICAL else axis
+            outer = plan.outer if plan.algo == ALGO_HIERARCHICAL else ()
+            out = quantized_all_reduce(tensor, axis=inner, outer=outer,
+                                       group_size=plan.group_size,
+                                       fp8=plan.width == WIDTH_FP8)
+        elif plan.algo == ALGO_HIERARCHICAL:
+            out = _hier_psum(tensor, plan.inner, plan.outer)
+        else:
+            out = jax.lax.psum(tensor, axis)
         if op == ReduceOp.AVG:
             out = out / axis_size(axis)
         return out
@@ -270,27 +589,91 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = DATA_AXIS,
     raise ValueError(f"Unsupported reduce op {op}")
 
 
-def all_gather(tensor, axis: AxisNames = DATA_AXIS, tensor_axis: int = 0, tiled: bool = True):
+def all_gather(tensor, axis: AxisNames = DATA_AXIS, tensor_axis: int = 0,
+               tiled: bool = True, kind: Optional[str] = None):
     """Concatenate shards along ``tensor_axis`` (reference all_gather_into_tensor,
-    comm.py:308)."""
-    _record("all_gather", tensor, axis)
-    return jax.lax.all_gather(tensor, axis, axis=tensor_axis, tiled=tiled)
+    comm.py:308). ``kind='param'`` resolves the width through the
+    transport planner (explicit qwZ requests ride ``ops/quantizer``)."""
+    plan = (resolve_transport(kind, "all_gather", _nbytes(tensor), axis)
+            if kind is not None and tiled else FULL_FLAT_PLAN)
+    _record("all_gather", tensor, axis, plan=plan)
+    if plan is FULL_FLAT_PLAN or plan == FULL_FLAT_PLAN:
+        return jax.lax.all_gather(tensor, axis, axis=tensor_axis, tiled=tiled)
+    xm = jnp.moveaxis(tensor, tensor_axis, 0)
+    if plan.quantized:
+        from ..ops.quantizer.quantizer import (fp8_all_gather,
+                                               quantized_all_gather)
+        g = (fp8_all_gather(xm, axis, plan.group_size)
+             if plan.width == WIDTH_FP8
+             else quantized_all_gather(xm, axis, group_size=plan.group_size))
+    else:
+        wire = xm.astype(jnp.bfloat16) if plan.width == WIDTH_BF16 else xm
+        if plan.algo == ALGO_HIERARCHICAL:
+            axes_t = (axis,) if isinstance(axis, str) else tuple(axis)
+            g = _hier_all_gather(wire, axes_t, plan.inner, plan.outer)
+        else:
+            g = jax.lax.all_gather(wire, axis, axis=0, tiled=True)
+        g = g.astype(tensor.dtype)
+    return jnp.moveaxis(g, 0, tensor_axis)
 
 
-def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM, axis: AxisNames = DATA_AXIS, scatter_axis: int = 0):
-    """Sum then scatter shards (reference reduce_scatter_tensor, comm.py:257)."""
-    _record("reduce_scatter", tensor, axis)
-    out = jax.lax.psum_scatter(tensor, axis, scatter_dimension=scatter_axis, tiled=True)
+def reduce_scatter(tensor, op: ReduceOp = ReduceOp.SUM,
+                   axis: AxisNames = DATA_AXIS, scatter_axis: int = 0,
+                   kind: Optional[str] = None):
+    """Sum then scatter shards (reference reduce_scatter_tensor, comm.py:257).
+
+    ``kind='grad'`` resolves through the transport planner: int8/fp8
+    widths take the ZeRO++ qgZ wire (quantize -> all-to-all -> local
+    sum), compound axes spanning 'data' decompose into intra-tier
+    reduce-scatter + cross-tier reduce-scatter on the 1/n shard."""
+    plan = (resolve_transport(kind, "reduce_scatter", _nbytes(tensor), axis)
+            if kind is not None and op in (ReduceOp.SUM, ReduceOp.AVG)
+            else FULL_FLAT_PLAN)
+    _record("reduce_scatter", tensor, axis, plan=plan)
+    if plan is FULL_FLAT_PLAN or plan == FULL_FLAT_PLAN:
+        out = jax.lax.psum_scatter(tensor, axis,
+                                   scatter_dimension=scatter_axis, tiled=True)
+    else:
+        from ..ops.quantizer.quantizer import (fp8_reduce_scatter,
+                                               quantized_reduce_scatter)
+        q_inner = None
+        if plan.width == WIDTH_FP8:
+            q_inner = lambda x, ax: fp8_reduce_scatter(
+                x, ax, group_size=plan.group_size)
+        elif plan.width == WIDTH_INT8:
+            q_inner = lambda x, ax: quantized_reduce_scatter(
+                x, ax, group_size=plan.group_size)
+        xm = jnp.moveaxis(tensor, scatter_axis, 0)
+        if plan.algo == ALGO_HIERARCHICAL:
+            axes_t = (axis,) if isinstance(axis, str) else tuple(axis)
+            r = _hier_psum_scatter(xm, axes_t, plan.inner, plan.outer,
+                                   quantized_inner=q_inner)
+        elif q_inner is not None:
+            r = q_inner(xm, axis)
+        else:
+            r = jax.lax.psum_scatter(xm, axis, scatter_dimension=0,
+                                     tiled=True)
+        out = jnp.moveaxis(r, 0, scatter_axis)
     if op == ReduceOp.AVG:
         out = out / axis_size(axis)
     return out
 
 
-def all_to_all(tensor, axis: AxisNames = SEQ_AXIS, split_axis: int = 0, concat_axis: int = 0):
+def all_to_all(tensor, axis: AxisNames = SEQ_AXIS, split_axis: int = 0,
+               concat_axis: int = 0, kind: Optional[str] = None):
     """All-to-all resharding (reference all_to_all_single, comm.py:388) — the
-    primitive behind Ulysses sequence parallelism and MoE dispatch."""
-    _record("all_to_all", tensor, axis)
-    return jax.lax.all_to_all(tensor, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+    primitive behind Ulysses sequence parallelism and MoE dispatch.
+    ``kind='activation'`` narrows the wire to bf16 (a pure-movement cast;
+    the receive side restores the logical dtype)."""
+    plan = (resolve_transport(kind, "all_to_all", _nbytes(tensor), axis)
+            if kind is not None else FULL_FLAT_PLAN)
+    _record("all_to_all", tensor, axis, plan=plan)
+    wire = tensor
+    if plan.width == WIDTH_BF16 and tensor.dtype.itemsize > 2:
+        wire = tensor.astype(jnp.bfloat16)
+    out = jax.lax.all_to_all(wire, axis, split_axis=split_axis,
+                             concat_axis=concat_axis, tiled=True)
+    return out.astype(tensor.dtype) if wire is not tensor else out
 
 
 def broadcast(tensor, src: int = 0, axis: AxisNames = DATA_AXIS):
@@ -304,10 +687,28 @@ def broadcast(tensor, src: int = 0, axis: AxisNames = DATA_AXIS):
     return jax.lax.all_gather(tensor, axis)[src]
 
 
-def ppermute(tensor, perm, axis: AxisNames = PIPE_AXIS):
+def ppermute(tensor, perm, axis: AxisNames = PIPE_AXIS,
+             kind: Optional[str] = None):
     """Point-to-point ring/permutation transfer — the TPU equivalent of the
-    reference's pipeline ``p2p.send/recv`` (runtime/pipe/p2p.py:50,71)."""
-    _record("ppermute", tensor, axis)
+    reference's pipeline ``p2p.send/recv`` (runtime/pipe/p2p.py:50,71).
+
+    ``kind='activation'`` narrows the hop's wire per the transport plan:
+    int8 quantizes before the permute and dequantizes after — one
+    (re-)quantization PER HOP, so a value rotated around a ring of sp
+    members is re-rounded sp times (ring attention accepts this: the
+    per-hop straight-through VJP is what keeps K/V trainable, see
+    ops/quantizer.quantized_ppermute and sequence/ring_attention.py);
+    bf16 is a plain cast."""
+    plan = (resolve_transport(kind, "ppermute", _nbytes(tensor), axis)
+            if kind is not None else FULL_FLAT_PLAN)
+    _record("ppermute", tensor, axis, plan=plan)
+    if plan.width == WIDTH_INT8:
+        from ..ops.quantizer.quantizer import quantized_ppermute
+        return quantized_ppermute(tensor, perm, axis,
+                                  group_size=plan.group_size)
+    if plan.width == WIDTH_BF16 and tensor.dtype.itemsize > 2:
+        return jax.lax.ppermute(tensor.astype(jnp.bfloat16), axis,
+                                perm).astype(tensor.dtype)
     return jax.lax.ppermute(tensor, axis, perm)
 
 

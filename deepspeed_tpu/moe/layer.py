@@ -129,8 +129,31 @@ class MoE:
         if pipelined:
             gathered = _c(gathered, P(None, None))
         expert_in = gathered.reshape(e, cap, h)
+        # Dispatch/combine transport plan (ISSUE 8, docs/COLLECTIVES.md):
+        # the expert exchange is GSPMD-mediated (the constraint below makes
+        # the partitioner emit the all-to-all), so the wire narrows by
+        # CASTING the dispatched activations — bf16 by default, exact
+        # no-op when the model already computes in a <=2-byte dtype. Only
+        # a live expert axis pays an exchange; without one the cast would
+        # cost accuracy for zero wire bytes.
+        from .. import comm as dist
+        live_ep = (topo_mod.is_initialized()
+                   and topo_mod.get_topology().expert_parallel_size > 1)
+        wire_dtype = None
+        if live_ep and x.dtype.itemsize > 2:
+            tp = dist.resolve_transport(
+                "activation", "all_to_all", expert_in.size * x.dtype.itemsize,
+                (EXPERT_AXIS,))
+            if tp.width == "bf16":
+                wire_dtype = jnp.bfloat16
+
+        def _exchange(t, spec):
+            if wire_dtype is None:
+                return _c(t, spec)
+            return _c(t.astype(wire_dtype), spec).astype(x.dtype)
+
         # all-to-all over ICI: expert dim sharded across the expert axis
-        expert_in = _c(expert_in, P(EXPERT_AXIS, BATCH_AXES, None))
+        expert_in = _exchange(expert_in, P(EXPERT_AXIS, BATCH_AXES, None))
 
         # expert FFN as batched einsum over the (sharded) expert dim
         if self.activation == "silu_gated":
@@ -144,10 +167,17 @@ class MoE:
         expert_out = jnp.einsum("ecf,efh->ech", mid, params["wo"].astype(x.dtype))
 
         # inverse all-to-all + combine back to tokens: per-token gather of
-        # its k slots, weighted sum — O(tokens*k*hidden)
+        # its k slots, weighted sum — O(tokens*k*hidden). The return
+        # exchange materializes at the row gather below (the partitioner
+        # reshards the expert-sharded rows to the token layout there), so
+        # the wire cast must PERSIST through the gather — cast back only
+        # on the picked rows.
+        if wire_dtype is not None:
+            expert_out = expert_out.astype(wire_dtype)
         expert_out = _c(expert_out, P(EXPERT_AXIS, BATCH_AXES, None))
         flat_out = expert_out.reshape(e * cap, h)
         picked = flat_out[jnp.where(keep, eidx * cap + pos, 0)]  # [t, k, h]
+        picked = picked.astype(x.dtype)
         w = (weight * keep).astype(x.dtype)
         out = jnp.sum(picked * w[:, :, None], axis=1)
         return out.reshape(b, s, h), aux

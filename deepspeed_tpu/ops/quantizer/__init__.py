@@ -1,2 +1,6 @@
 from .quantizer import (dequantize_blockwise, quantize_blockwise,  # noqa: F401
-                        quantized_all_gather, quantized_reduce_scatter)
+                        quantized_all_gather, quantized_reduce_scatter,
+                        dequantize_blockwise_fp8, quantize_blockwise_fp8,
+                        ef_quantized_reduce_scatter, fp8_all_gather,
+                        fp8_reduce_scatter, quantize_with_feedback,
+                        quantized_all_reduce)

@@ -129,6 +129,57 @@ def dequantize_blockwise(q: jax.Array, scale: jax.Array, zero: jax.Array,
     return out.astype(dtype)
 
 
+def quantize_blockwise_fp8(x: jax.Array, group_size: int = 256
+                           ) -> Tuple[jax.Array, jax.Array]:
+    """Scaled-fp8 wire format (EQuARX's low-precision transport alternative
+    to int8): each group is scaled so its absmax lands at fp8-e4m3's max
+    normal (448) and cast to ``float8_e4m3fn``. One fp32 scale per group,
+    no zero point (the format is signed and symmetric). Returns
+    (q [groups, group_size] f8, scale [groups] f32)."""
+    orig_size = x.size
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-orig_size) % group_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    groups = flat.reshape(-1, group_size)
+    fp8_max = 448.0  # e4m3fn max normal
+    absmax = jnp.max(jnp.abs(groups), axis=1, keepdims=True)
+    scale = absmax / fp8_max
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = (groups / scale).astype(jnp.float8_e4m3fn)
+    return q, scale[:, 0]
+
+
+def dequantize_blockwise_fp8(q: jax.Array, scale: jax.Array,
+                             out_size: int = None, out_shape=None,
+                             dtype=jnp.float32) -> jax.Array:
+    out = q.astype(jnp.float32) * scale[:, None]
+    out = out.reshape(-1)
+    if out_size is not None:
+        out = out[:out_size]
+    if out_shape is not None:
+        out = out.reshape(out_shape)
+    return out.astype(dtype)
+
+
+def quantize_with_feedback(x: jax.Array, err: jax.Array, num_bits: int = 8,
+                           group_size: int = 256):
+    """Error-feedback quantization (the compensation step of EF-SGD /
+    1-bit Adam, reference ``compressed_allreduce`` server_error):
+    quantize the COMPENSATED signal ``x + err`` and carry the new
+    residual forward. Over accumulated steps the residuals telescope:
+    sum(dequant_t) = sum(x_t) + err_0 - err_T, so the accumulated
+    reduction error is bounded by ONE step's quantization error instead
+    of growing with the step count. Returns (q, scale, zero, new_err);
+    ``new_err`` has ``x``'s shape/f32."""
+    comp = x.astype(jnp.float32) + err.astype(jnp.float32)
+    q, scale, zero = quantize_blockwise(comp, num_bits, group_size)
+    roundtrip = dequantize_blockwise(
+        q, scale, zero, num_bits, group_size,
+        out_size=comp.size, out_shape=comp.shape)
+    return q, scale, zero, comp - roundtrip
+
+
 def quantized_all_gather(x: jax.Array, axis: str = DATA_AXIS, num_bits: int = 8,
                          group_size: int = 256, n_chunks: int = 1) -> jax.Array:
     """ZeRO++ qwZ-style all-gather: quantize the local shard, gather int8
@@ -208,3 +259,169 @@ def quantized_reduce_scatter(x: jax.Array, axis: str = DATA_AXIS, num_bits: int 
     shard = shard.reshape(n, chunk + pad)[:, :chunk]
     out = jnp.sum(shard, axis=0)
     return out.reshape((x.shape[0] // n,) + x.shape[1:]).astype(x.dtype)
+
+
+def _dest_chunk_group_size(chunk: int, group_size: int, num_bits: int) -> int:
+    """Effective per-destination-chunk group size (see the inline comments
+    in :func:`quantized_reduce_scatter` — tiny chunks must not pad up to a
+    full group, int4 groups must stay even)."""
+    group_size = max(1, min(group_size, chunk))
+    if num_bits == 4:
+        group_size = max(2, group_size - group_size % 2)
+    return group_size
+
+
+def ef_quantized_reduce_scatter(x: jax.Array, err: jax.Array,
+                                axis=DATA_AXIS, num_bits: int = 8,
+                                group_size: int = 256
+                                ) -> Tuple[jax.Array, jax.Array]:
+    """:func:`quantized_reduce_scatter` with error feedback: the residual
+    of THIS member's quantization is returned and must be fed back on the
+    next reduction of the same bucket (``err`` starts as zeros of
+    ``x``'s shape). The wire format and output layout are identical to
+    the plain call — only the quantized VALUES differ (they carry the
+    compensated signal x + err). ``err`` has ``x``'s shape (zeros on the
+    first step) and so does the returned residual — the pair is a valid
+    scan/jit carry; group padding stays internal (a padded position's
+    signal and residual are both zero, so its residual is exactly zero
+    and dropping it loses nothing)."""
+    n = axis_size(axis)
+    assert x.shape[0] % n == 0
+    chunk = x.size // n
+    group_size = _dest_chunk_group_size(chunk, group_size, num_bits)
+    xr = x.astype(jnp.float32).reshape(n, chunk)
+    er = err.astype(jnp.float32).reshape(n, chunk)
+    pad = (-chunk) % group_size
+    if pad:
+        xr = jnp.pad(xr, ((0, 0), (0, pad)))
+        er = jnp.pad(er, ((0, 0), (0, pad)))
+    q, scale, zero, new_err = quantize_with_feedback(
+        xr, er, num_bits, group_size)
+    new_err = new_err[:, :chunk].reshape(x.shape)
+    q_t = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    s_t = jax.lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)
+    z_t = jax.lax.all_to_all(zero, axis, split_axis=0, concat_axis=0, tiled=True)
+    shard = dequantize_blockwise(q_t, s_t, z_t, num_bits, group_size)
+    shard = shard.reshape(n, chunk + pad)[:, :chunk]
+    out = jnp.sum(shard, axis=0)
+    return (out.reshape((x.shape[0] // n,) + x.shape[1:]).astype(x.dtype),
+            new_err)
+
+
+def fp8_reduce_scatter(x: jax.Array, axis=DATA_AXIS,
+                       group_size: int = 256, n_chunks: int = 1) -> jax.Array:
+    """:func:`quantized_reduce_scatter` with the scaled-fp8 wire format:
+    same all-to-all + local-sum structure, same layout, but values travel
+    as ``float8_e4m3fn`` (1 byte) with one fp32 scale per group and no
+    zero-point sideband."""
+    n = axis_size(axis)
+    assert x.shape[0] % n == 0
+    if n_chunks > 1:
+        return scatter_in_row_chunks(
+            lambda c: fp8_reduce_scatter(c, axis, group_size), x, n, n_chunks)
+    chunk = x.size // n
+    group_size = _dest_chunk_group_size(chunk, group_size, num_bits=8)
+    xr = x.astype(jnp.float32).reshape(n, chunk)
+    pad = (-chunk) % group_size
+    if pad:
+        xr = jnp.pad(xr, ((0, 0), (0, pad)))
+    q, scale = quantize_blockwise_fp8(xr, group_size)
+    q_t = jax.lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    s_t = jax.lax.all_to_all(scale, axis, split_axis=0, concat_axis=0, tiled=True)
+    shard = dequantize_blockwise_fp8(q_t, s_t)
+    shard = shard.reshape(n, chunk + pad)[:, :chunk]
+    out = jnp.sum(shard, axis=0)
+    return out.reshape((x.shape[0] // n,) + x.shape[1:]).astype(x.dtype)
+
+
+def fp8_all_gather(x: jax.Array, axis=DATA_AXIS, group_size: int = 256,
+                   n_chunks: int = 1) -> jax.Array:
+    """:func:`quantized_all_gather` with the scaled-fp8 wire format."""
+    if n_chunks > 1:
+        if x.shape[0] % n_chunks:
+            raise ValueError(f"n_chunks={n_chunks} must divide the shard's "
+                             f"leading dim {x.shape[0]}")
+        return gather_in_row_chunks(
+            lambda c: fp8_all_gather(c, axis, group_size),
+            x, axis_size(axis), n_chunks)
+    group_size = max(1, min(group_size, x.size))
+    q, scale = quantize_blockwise_fp8(x, group_size)
+    q_g = jax.lax.all_gather(q, axis, axis=0, tiled=True)
+    s_g = jax.lax.all_gather(scale, axis, axis=0, tiled=True)
+    n = axis_size(axis)
+    out = dequantize_blockwise_fp8(q_g, s_g)
+    padded = -(-x.size // group_size) * group_size
+    out = out.reshape(n, padded)[:, :x.size]
+    return out.reshape((x.shape[0] * n,) + x.shape[1:]).astype(x.dtype)
+
+
+def quantized_ppermute(t: jax.Array, perm, axis, num_bits: int = 8,
+                       group_size: int = 256) -> jax.Array:
+    """Quantized point-to-point permutation (ring hops): quantize, permute
+    the int8 payload + fp32 scale sideband, dequantize on arrival.
+
+    Gradient contract (straight-through): the backward pass permutes the
+    cotangent along the INVERSE ring at full width — quantization is
+    treated as identity by AD. Without this, ``round`` would zero every
+    gradient flowing through a rotating K/V block and ring attention
+    would stop training its keys/values."""
+    group_size = max(1, min(group_size, t.size))
+    if num_bits == 4:
+        group_size = max(2, group_size - group_size % 2)
+
+    @jax.custom_vjp
+    def hop(x):
+        return _hop_fwd_only(x)
+
+    def _hop_fwd_only(x):
+        q, scale, zero = quantize_blockwise(x, num_bits, group_size)
+        q = jax.lax.ppermute(q, axis, perm)
+        scale = jax.lax.ppermute(scale, axis, perm)
+        zero = jax.lax.ppermute(zero, axis, perm)
+        return dequantize_blockwise(q, scale, zero, num_bits, group_size,
+                                    out_size=x.size, out_shape=x.shape,
+                                    dtype=x.dtype)
+
+    def fwd(x):
+        return _hop_fwd_only(x), None
+
+    def bwd(_, g):
+        inv = [(dst, src) for src, dst in perm]
+        return (jax.lax.ppermute(g, axis, inv),)
+
+    hop.defvjp(fwd, bwd)
+    return hop(t)
+
+
+def quantized_all_reduce(x: jax.Array, axis=DATA_AXIS, num_bits: int = 8,
+                         group_size: int = 256, outer=(),
+                         fp8: bool = False) -> jax.Array:
+    """EQuARX-style quantized all-reduce (arXiv:2506.17615): decompose the
+    all-reduce into quantize -> reduce-scatter (all-to-all wire + local
+    sum) -> [full-width all-reduce over ``outer`` tiers] -> quantized
+    all-gather. Both wire legs move 8-bit payloads; the optional ``outer``
+    leg (the DCN tier of a hierarchical plan) reduces the already-1/n
+    shard at full width — cross-tier bytes shrink by the inner axis size
+    AND the wire width together (*The Big Send-off*, arXiv:2504.18658).
+
+    Input may be any shape; it is flattened and padded to an axis-size
+    multiple for the scatter leg (zero padding is exact under symmetric
+    quantization)."""
+    n = axis_size(axis)
+    flat = x.astype(jnp.float32).reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    if fp8:
+        part = fp8_reduce_scatter(flat, axis, group_size)
+    else:
+        part = quantized_reduce_scatter(flat, axis, num_bits, group_size)
+    if outer:
+        part = jax.lax.psum(part, outer)
+    if fp8:
+        full = fp8_all_gather(part, axis, group_size)
+    else:
+        full = quantized_all_gather(part, axis, num_bits, group_size)
+    if pad:
+        full = full[:x.size]
+    return full.reshape(x.shape).astype(x.dtype)

@@ -192,6 +192,12 @@ class DeepSpeedConfig:
             csv_monitor=CSVConfig(**pd.get("csv_monitor", {})),
         )
         self.comms_config = CommsLoggerConfig(**pd.get("comms_logger", {}))
+        # collective transport planner policy (comm/comm.py, docs/
+        # COLLECTIVES.md): per-bucket width/algorithm defaults. Raw dict,
+        # validated when the engine installs it via
+        # ``comm.configure_transport`` — an invalid key/width raises at
+        # engine build, not at first traced launch.
+        self.comm_transport: dict = dict(pd.get("comm_transport", {}))
         # telemetry subsystem (telemetry/): off by default; the
         # DSTPU_TELEMETRY env var overrides either way at build time
         from ..telemetry.config import TelemetryConfig

@@ -456,6 +456,22 @@ class DeepSpeedEngine:
         from .. import comm as dist
         if config.comms_logger_enabled:
             dist.configure(config=config)
+        if config.comm_transport:
+            # install the transport-planner policy BEFORE any micro step
+            # traces (plans are resolved at trace time); invalid keys or
+            # widths raise here, at engine build
+            dist.configure_transport(**config.comm_transport)
+            if config.comm_transport.get("error_feedback"):
+                # the residual carry is functional state the scan-based
+                # micro schedules do not thread yet (ROADMAP item 2's
+                # compiler-map planner owns that restructuring) — EF
+                # applies today to TreeComm.scatter(err=...) callers;
+                # see docs/COLLECTIVES.md "Error feedback"
+                logger.warning(
+                    "comm_transport.error_feedback: the engine micro "
+                    "schedules do not carry the residual state yet; "
+                    "error feedback is active only for explicit "
+                    "TreeComm.scatter(err=...) callers")
 
         self._jit_micro_step = None
         self._jit_apply_step = None
@@ -1173,7 +1189,12 @@ class DeepSpeedEngine:
     def _build_zeropp_micro_barrier(self):
         from ..utils.jax_compat import shard_map
         from .. import comm as dist
-        from ..ops.quantizer.quantizer import (quantized_all_gather,
+        from ..comm.comm import (ALGO_HIERARCHICAL, KIND_GRAD, KIND_PARAM,
+                                 WIDTH_FP8, WIDTH_INT8, _hier_psum_scatter,
+                                 resolve_transport)
+        from ..ops.quantizer.quantizer import (fp8_all_gather,
+                                               fp8_reduce_scatter,
+                                               quantized_all_gather,
                                                quantized_reduce_scatter)
 
         mesh = self.mesh
@@ -1182,6 +1203,7 @@ class DeepSpeedEngine:
         grad_dtype = self.grad_dtype
         (zc, all_dp, n_dp, param_specs, grad_specs,
          gather_src_specs) = self._zeropp_micro_env()
+        axis_sizes = dict(self.topology.mesh.shape)
 
         def gather_full(x, spec):
             dim, axes = self._dp_axes_in(spec)
@@ -1190,13 +1212,25 @@ class DeepSpeedEngine:
             axes = tuple(a for a in axes if self.topology.axis_size(a) > 1)
             if not axes:
                 return x
+            tp = resolve_transport(
+                KIND_PARAM, "all_gather", x.size * x.dtype.itemsize, axes,
+                axis_sizes=axis_sizes,
+                requested=WIDTH_INT8 if zc.zero_quantized_weights else None)
+            if tp.algo == ALGO_HIERARCHICAL:
+                # the barrier gather executes flat — record it flat
+                import dataclasses as _dc
+                tp = _dc.replace(tp, algo="flat", inner=(), outer=())
             xm = jnp.moveaxis(x, dim, 0)
             # whole-tree gather before the loss: fully EXPOSED collective
             # time (what the overlap schedule exists to hide)
             dist.record_collective("all_gather", x.size * x.dtype.itemsize,
-                                   axes, overlapped=False)
-            if zc.zero_quantized_weights:
+                                   axes, overlapped=False,
+                                   wire_bytes=tp.wire_bytes(
+                                       x.size, x.dtype.itemsize))
+            if tp.width == WIDTH_INT8:
                 g = quantized_all_gather(xm, axis=axes)
+            elif tp.width == WIDTH_FP8:
+                g = fp8_all_gather(xm, axes)
             else:
                 g = jax.lax.all_gather(xm, axes, axis=0, tiled=True)
             return jnp.moveaxis(g, 0, dim)
@@ -1209,12 +1243,34 @@ class DeepSpeedEngine:
                                        g.size * g.dtype.itemsize, all_dp,
                                        overlapped=False)
                 return jax.lax.psum(g, all_dp) / n_dp
+            # per-leaf transport plan (docs/COLLECTIVES.md): grads default
+            # to the int8 wire; qgZ stays an explicit width request;
+            # multi-axis dp decomposes hierarchically
+            tp = resolve_transport(
+                KIND_GRAD, "reduce_scatter", g.size * 4, axes,
+                axis_sizes=axis_sizes,
+                requested=(WIDTH_INT8 if zc.zero_quantized_gradients
+                           else None))
             gm = jnp.moveaxis(g.astype(jnp.float32), dim, 0)
             dist.record_collective(
-                "all_to_all" if zc.zero_quantized_gradients
-                else "reduce_scatter", g.size * 4, axes, overlapped=False)
-            if zc.zero_quantized_gradients:
-                r = quantized_reduce_scatter(gm, axis=axes)
+                "all_to_all" if tp.quantized else "reduce_scatter",
+                g.size * 4, axes, overlapped=False,
+                wire_bytes=tp.wire_bytes(g.size, 4))
+            if tp.algo == ALGO_HIERARCHICAL:
+                q_inner = None
+                if tp.width == WIDTH_INT8:
+                    q_inner = lambda x, ax: quantized_reduce_scatter(
+                        x, axis=ax, group_size=tp.group_size)
+                elif tp.width == WIDTH_FP8:
+                    q_inner = lambda x, ax: fp8_reduce_scatter(
+                        x, ax, group_size=tp.group_size)
+                r = _hier_psum_scatter(gm, axes, tp.inner, tp.outer,
+                                       quantized_inner=q_inner)
+            elif tp.width == WIDTH_INT8:
+                r = quantized_reduce_scatter(gm, axis=axes,
+                                             group_size=tp.group_size)
+            elif tp.width == WIDTH_FP8:
+                r = fp8_reduce_scatter(gm, axes, group_size=tp.group_size)
             else:
                 r = jax.lax.psum_scatter(gm, axes, scatter_dimension=0, tiled=True)
             # Batch is sharded over ALL dp axes but under MiCS the grad spec

@@ -42,7 +42,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ...ops.quantizer.quantizer import (gather_in_row_chunks,
+from ...comm.comm import (ALGO_HIERARCHICAL, KIND_GRAD, KIND_PARAM,
+                          WIDTH_FP8, WIDTH_INT8, TransportPlan,
+                          _hier_psum_scatter, resolve_transport)
+from ...ops.quantizer.quantizer import (ef_quantized_reduce_scatter,
+                                        fp8_all_gather, fp8_reduce_scatter,
+                                        gather_in_row_chunks,
                                         quantized_all_gather,
                                         quantized_reduce_scatter,
                                         scatter_in_row_chunks)
@@ -149,19 +154,52 @@ def build_tree_comm(gather_spec_tree, grad_spec_tree, struct_tree,
     gather_plan, g_over = plan(gcomms, allgather_bucket)
     scatter_plan, s_over = plan(scomms, reduce_bucket)
 
+    # per-bucket transport plans (ISSUE 8 tentpole): width/algo resolved
+    # from tensor kind, bucket bytes and the mesh's axis sizes — the qwZ/
+    # qgZ config knobs become explicit width REQUESTS (they survive the
+    # DSTPU_COMM_QUANT kill switch), everything else takes the planner's
+    # defaults (grads -> int8, multi-axis dp -> hierarchical scatter).
+    def transports(entries, comms, kind, requested, op, elem_bytes):
+        plans = []
+        for e in entries:
+            lc = comms[e.leaves[0]]
+            if lc.dim is None:
+                plans.append(TransportPlan())   # replicated leaf: full psum
+                continue
+            nbytes = sum(int(np.prod(comms[i].shape))
+                         for i in e.leaves) * elem_bytes
+            tp = resolve_transport(kind, op, nbytes, lc.axes,
+                                   axis_sizes=axis_sizes,
+                                   requested=requested)
+            if op == "all_gather" and tp.algo == ALGO_HIERARCHICAL:
+                # gathers execute FLAT here (every member needs every
+                # byte; see the gather comment below) — the stored plan
+                # must match, or wire_bytes would charge a hierarchical
+                # outer leg the launch never runs
+                tp = dataclasses.replace(tp, algo="flat", inner=(),
+                                         outer=())
+            plans.append(tp)
+        return plans
+
+    gather_tp = transports(gather_plan, gcomms, KIND_PARAM,
+                           WIDTH_INT8 if quant_weights else None,
+                           "all_gather", 4)
+    scatter_tp = transports(scatter_plan, scomms, KIND_GRAD,
+                            WIDTH_INT8 if quant_grads else None,
+                            "reduce_scatter", 4)
+
     return _TreeCommImpl(treedef, names, gcomms, scomms, gather_plan,
-                         scatter_plan,
+                         scatter_plan, gather_tp, scatter_tp,
                          oversize=sorted({names[i] for i in g_over}
                                          | {names[i] for i in s_over}),
-                         quant_weights=quant_weights,
-                         quant_grads=quant_grads, n_dp=n_dp, all_dp=all_dp,
+                         n_dp=n_dp, all_dp=all_dp,
                          overlapped=overlapped, name=name)
 
 
 class _TreeCommImpl:
 
     def __init__(self, treedef, names, gcomms, scomms, gather_plan,
-                 scatter_plan, *, oversize, quant_weights, quant_grads,
+                 scatter_plan, gather_tp, scatter_tp, *, oversize,
                  n_dp, all_dp, overlapped, name):
         self.treedef = treedef
         self.names = names
@@ -169,9 +207,9 @@ class _TreeCommImpl:
         self.scomms = scomms
         self.gather_plan = gather_plan
         self.scatter_plan = scatter_plan
+        self.gather_tp = gather_tp      # TransportPlan per gather entry
+        self.scatter_tp = scatter_tp    # TransportPlan per scatter entry
         self.oversize = oversize
-        self.quant_weights = quant_weights
-        self.quant_grads = quant_grads
         self.n_dp = n_dp
         self.all_dp = all_dp
         self.overlapped = overlapped
@@ -207,35 +245,52 @@ class _TreeCommImpl:
         finally:
             self.overlapped = old
 
-    def _rec(self, op: str, nbytes: int, axes) -> None:
+    def _rec(self, op: str, nbytes: int, axes,
+             tp: Optional[TransportPlan] = None,
+             n_elems: Optional[int] = None) -> None:
         from ... import comm as dist
+        wire = (tp.wire_bytes(n_elems, 4) if tp is not None
+                and n_elems is not None else nbytes)
         dist.record_collective(op, nbytes, axes, overlapped=self.overlapped,
-                               count=self._exec_mult)
+                               count=self._exec_mult, wire_bytes=wire)
 
     def plan_summary(self) -> str:
         fused = sum(1 for e in self.gather_plan if len(e.leaves) > 1)
         chunked = sum(1 for e in self.gather_plan if e.chunks > 1)
+        widths = sorted({tp.width for tp in self.scatter_tp})
+        hier = sum(1 for tp in self.scatter_tp
+                   if tp.algo == ALGO_HIERARCHICAL)
         return (f"{self.name}: {len(self.gcomms)} leaves -> "
                 f"{len(self.gather_plan)} gather launches ({fused} fused, "
                 f"{chunked} chunked) / {len(self.scatter_plan)} "
-                f"reduce launches")
+                f"reduce launches (widths {'/'.join(widths)}, "
+                f"{hier} hierarchical)")
 
     # -- gather --------------------------------------------------------
-    def _gather_one(self, x, lc: LeafComm, chunks: int):
+    # width rides the per-bucket plan (qwZ -> int8 request); gathers stay
+    # flat — every member needs every byte, so hierarchy buys latency
+    # structure, not bytes, and the bucket pipeliner already owns latency
+    def _gather_one(self, x, lc: LeafComm, chunks: int, tp: TransportPlan):
         if lc.dim is None:
             return x
         xm = jnp.moveaxis(x, lc.dim, 0)
-        self._rec("all_gather", x.size * x.dtype.itemsize, lc.axes)
-        if self.quant_weights:
-            g = quantized_all_gather(xm, axis=lc.axes, n_chunks=chunks)
+        self._rec("all_gather", x.size * x.dtype.itemsize, lc.axes,
+                  tp, x.size)
+        if tp.width == WIDTH_INT8:
+            g = quantized_all_gather(xm, axis=lc.axes,
+                                     group_size=tp.group_size,
+                                     n_chunks=chunks)
+        elif tp.width == WIDTH_FP8:
+            g = fp8_all_gather(xm, lc.axes, group_size=tp.group_size,
+                               n_chunks=chunks)
         else:
             g = _chunked_all_gather(xm, lc.axes, chunks)
         return jnp.moveaxis(g, 0, lc.dim)
 
-    def _gather_fused(self, xs, lcs):
+    def _gather_fused(self, xs, lcs, tp: TransportPlan):
         axes = lcs[0].axes
         n = axis_size(axes)
-        q = self.quant_weights
+        q = tp.quantized
         flats, meta = [], []
         for x, lc in zip(xs, lcs):
             xm = jnp.moveaxis(x, lc.dim, 0)
@@ -247,9 +302,13 @@ class _TreeCommImpl:
             flats.append(f)
             meta.append((xm.shape, k, kp))
         buf = jnp.concatenate(flats)
-        self._rec("all_gather", buf.size * buf.dtype.itemsize, axes)
-        if q:
-            g = quantized_all_gather(buf, axis=axes)
+        self._rec("all_gather", buf.size * buf.dtype.itemsize, axes,
+                  tp, buf.size)
+        if tp.width == WIDTH_INT8:
+            g = quantized_all_gather(buf, axis=axes,
+                                     group_size=tp.group_size)
+        elif tp.width == WIDTH_FP8:
+            g = fp8_all_gather(buf, axes, group_size=tp.group_size)
         else:
             g = jax.lax.all_gather(buf, axes, axis=0, tiled=True)
         R = g.reshape(n, buf.shape[0])
@@ -264,41 +323,81 @@ class _TreeCommImpl:
     def gather(self, tree):
         xs = self.treedef.flatten_up_to(tree)
         outs = [None] * len(xs)
-        for entry in self.gather_plan:
+        for entry, tp in zip(self.gather_plan, self.gather_tp):
             if len(entry.leaves) == 1:
                 i = entry.leaves[0]
                 outs[i] = self._gather_one(xs[i], self.gcomms[i],
-                                           entry.chunks)
+                                           entry.chunks, tp)
             else:
                 lcs = [self.gcomms[i] for i in entry.leaves]
                 for i, o in zip(entry.leaves,
                                 self._gather_fused(
-                                    [xs[i] for i in entry.leaves], lcs)):
+                                    [xs[i] for i in entry.leaves], lcs, tp)):
                     outs[i] = o
         return jax.tree_util.tree_unflatten(self.treedef, outs)
 
     # -- scatter -------------------------------------------------------
-    def _scatter_one(self, g, lc: LeafComm, chunks: int):
+    def _quant_inner(self, tp: TransportPlan):
+        """Stage-1 wire of a hierarchical scatter plan (None = full)."""
+        if tp.width == WIDTH_INT8:
+            return lambda x, ax: quantized_reduce_scatter(
+                x, axis=ax, group_size=tp.group_size)
+        if tp.width == WIDTH_FP8:
+            return lambda x, ax: fp8_reduce_scatter(
+                x, ax, group_size=tp.group_size)
+        return None
+
+    def _ef_applies(self, tp: TransportPlan) -> bool:
+        """Error feedback compensates the flat int8 wire (the common
+        single-tier dp reduction); hierarchical plans keep the plain
+        quantizer — the residual of the regrouped inner stage has no
+        stable per-leaf identity across plan changes."""
+        return tp.error_feedback and tp.width == WIDTH_INT8 \
+            and tp.algo != ALGO_HIERARCHICAL
+
+    def _scatter_one(self, g, lc: LeafComm, chunks: int, tp: TransportPlan,
+                     err=None):
         if lc.dim is None:
             self._rec("all_reduce", g.size * g.dtype.itemsize,
                       self.all_dp)
-            return jax.lax.psum(g, self.all_dp) / self.n_dp
+            return jax.lax.psum(g, self.all_dp) / self.n_dp, None
         gm = jnp.moveaxis(g.astype(jnp.float32), lc.dim, 0)
-        op = "all_to_all" if self.quant_grads else "reduce_scatter"
-        self._rec(op, g.size * 4, lc.axes)
-        if self.quant_grads:
-            r = quantized_reduce_scatter(gm, axis=lc.axes, n_chunks=chunks)
+        op = "all_to_all" if tp.quantized else "reduce_scatter"
+        self._rec(op, g.size * 4, lc.axes, tp, g.size)
+        new_err = None
+        if tp.algo == ALGO_HIERARCHICAL:
+            one = lambda c: _hier_psum_scatter(
+                c, lc.axes, tp.inner, tp.outer,
+                quantized_inner=self._quant_inner(tp))
+            if chunks > 1:
+                # oversize buckets keep their peak-HBM-bounding splits on
+                # the hierarchical path too (same destination-row chunk
+                # layout as the flat launches)
+                r = scatter_in_row_chunks(one, gm, axis_size(lc.axes),
+                                          chunks)
+            else:
+                r = one(gm)
+        elif self._ef_applies(tp) and err is not None and chunks <= 1:
+            r, new_err = ef_quantized_reduce_scatter(
+                gm, err, axis=lc.axes, group_size=tp.group_size)
+        elif tp.width == WIDTH_INT8:
+            r = quantized_reduce_scatter(gm, axis=lc.axes,
+                                         group_size=tp.group_size,
+                                         n_chunks=chunks)
+        elif tp.width == WIDTH_FP8:
+            r = fp8_reduce_scatter(gm, lc.axes, group_size=tp.group_size,
+                                   n_chunks=chunks)
         else:
             r = _chunked_psum_scatter(gm, lc.axes, chunks)
         if lc.rest:
             self._rec("all_reduce", r.size * 4, lc.rest)
             r = jax.lax.psum(r, lc.rest)
-        return jnp.moveaxis(r, 0, lc.dim) / self.n_dp
+        return jnp.moveaxis(r, 0, lc.dim) / self.n_dp, new_err
 
-    def _scatter_fused(self, gs, lcs):
+    def _scatter_fused(self, gs, lcs, tp: TransportPlan, err=None):
         axes = lcs[0].axes
         n = axis_size(axes)
-        q = self.quant_grads
+        q = tp.quantized
         cols, meta = [], []
         for g, lc in zip(gs, lcs):
             gm = jnp.moveaxis(g.astype(jnp.float32), lc.dim, 0)
@@ -313,9 +412,19 @@ class _TreeCommImpl:
             meta.append((rest_shape, k, kp))
         buf = jnp.concatenate(cols, axis=1).reshape(-1)
         op = "all_to_all" if q else "reduce_scatter"
-        self._rec(op, buf.size * 4, axes)
-        if q:
-            r = quantized_reduce_scatter(buf, axis=axes)
+        self._rec(op, buf.size * 4, axes, tp, buf.size)
+        new_err = None
+        if tp.algo == ALGO_HIERARCHICAL:
+            r = _hier_psum_scatter(buf, axes, tp.inner, tp.outer,
+                                   quantized_inner=self._quant_inner(tp))
+        elif self._ef_applies(tp) and err is not None:
+            r, new_err = ef_quantized_reduce_scatter(
+                buf, err, axis=axes, group_size=tp.group_size)
+        elif tp.width == WIDTH_INT8:
+            r = quantized_reduce_scatter(buf, axis=axes,
+                                         group_size=tp.group_size)
+        elif tp.width == WIDTH_FP8:
+            r = fp8_reduce_scatter(buf, axes, group_size=tp.group_size)
         else:
             r = jax.lax.psum_scatter(buf, axes, scatter_dimension=0,
                                      tiled=True)
@@ -328,20 +437,64 @@ class _TreeCommImpl:
             seg = r[off:off + k].reshape(rest_shape)
             off += kp
             outs.append(jnp.moveaxis(seg, 0, lc.dim) / self.n_dp)
-        return outs
+        return outs, new_err
 
-    def scatter(self, tree):
+    def err_struct(self):
+        """Error-feedback carry shapes, one slot per scatter launch
+        (None where EF does not apply — full-width, fp8, hierarchical
+        or replicated buckets). The caller owns the state: pass the
+        zeros-initialized list to :meth:`scatter` as ``err`` and carry
+        the returned residuals to the next micro step."""
+        out = []
+        for entry, tp in zip(self.scatter_plan, self.scatter_tp):
+            lcs = [self.scomms[i] for i in entry.leaves]
+            if lcs[0].dim is None or not self._ef_applies(tp) \
+                    or entry.chunks > 1:
+                # chunked (oversize) buckets keep the plain chunked
+                # quantizer — a per-chunk residual has no stable identity
+                # if the chunk plan changes
+                out.append(None)
+                continue
+            if len(lcs) == 1:
+                lc = lcs[0]
+                mshape = ((lc.shape[lc.dim],)
+                          + tuple(s for d, s in enumerate(lc.shape)
+                                  if d != lc.dim))
+                out.append(jax.ShapeDtypeStruct(mshape, jnp.float32))
+            else:
+                n = axis_size(lcs[0].axes)
+                total = 0
+                for lc in lcs:
+                    k = int(np.prod(lc.shape)) // n
+                    total += _pad_rows(k, tp.quantized)
+                out.append(jax.ShapeDtypeStruct((n * total,), jnp.float32))
+        return out
+
+    def scatter(self, tree, err=None):
+        """Reduce-scatter the gradient tree through the per-bucket
+        transport plans. ``err=None``: plain call returning the scattered
+        tree. ``err`` = list from :meth:`err_struct` (zeros first step):
+        returns ``(tree, new_err)`` with error-feedback compensation
+        applied to eligible buckets."""
         gs = self.treedef.flatten_up_to(tree)
         outs = [None] * len(gs)
-        for entry in self.scatter_plan:
+        new_errs = [None] * len(self.scatter_plan)
+        for j, (entry, tp) in enumerate(zip(self.scatter_plan,
+                                            self.scatter_tp)):
+            e_in = err[j] if err is not None else None
             if len(entry.leaves) == 1:
                 i = entry.leaves[0]
-                outs[i] = self._scatter_one(gs[i], self.scomms[i],
-                                            entry.chunks)
+                outs[i], new_errs[j] = self._scatter_one(
+                    gs[i], self.scomms[i], entry.chunks, tp, err=e_in)
             else:
                 lcs = [self.scomms[i] for i in entry.leaves]
-                for i, o in zip(entry.leaves,
-                                self._scatter_fused(
-                                    [gs[i] for i in entry.leaves], lcs)):
+                fused, new_errs[j] = self._scatter_fused(
+                    [gs[i] for i in entry.leaves], lcs, tp, err=e_in)
+                for i, o in zip(entry.leaves, fused):
                     outs[i] = o
-        return jax.tree_util.tree_unflatten(self.treedef, outs)
+        out_tree = jax.tree_util.tree_unflatten(self.treedef, outs)
+        if err is not None:
+            return out_tree, [jnp.zeros(s.shape, s.dtype)
+                              if ne is None and s is not None else ne
+                              for ne, s in zip(new_errs, self.err_struct())]
+        return out_tree

@@ -41,6 +41,61 @@ from .layer import SEQ_SHARDED
 NEG_INF = -1e30
 
 
+class _HopWire:
+    """Per-hop K/V wire format (ISSUE 8): the transport plan decides how
+    the rotating blocks travel the ring. ``int8`` sends each hop as a
+    quantized payload + per-group fp32 scales via
+    ``ops.quantizer.quantized_ppermute`` — whose straight-through VJP
+    permutes cotangents along the inverse ring at full width, so K/V
+    keep training — and the exact LSE merge across hops is untouched.
+    ``bf16`` is a plain cast; ``full`` is the identity (pre-planner
+    behavior, bitwise)."""
+
+    def __init__(self, width: str, shape, dtype, group_size: int = 256):
+        self.width = width
+        self.dtype = dtype
+        size = 1
+        for d in shape:
+            size *= d
+        self.size = size
+        self.group_size = max(1, min(group_size, size))
+
+    def hop(self, t, perm):
+        if self.width == "int8":
+            from ..ops.quantizer.quantizer import quantized_ppermute
+            return quantized_ppermute(t, perm, SEQ_AXIS,
+                                      group_size=self.group_size)
+        if self.width == "bf16" and t.dtype.itemsize > 2:
+            return jax.lax.ppermute(t.astype(jnp.bfloat16), SEQ_AXIS,
+                                    perm).astype(self.dtype)
+        return jax.lax.ppermute(t, SEQ_AXIS, perm)
+
+    def wire_bytes(self) -> int:
+        if self.width == "int8":
+            groups = -(-self.size // self.group_size)
+            return self.size + groups * 8
+        if self.width == "bf16":
+            return self.size * min(2, jnp.dtype(self.dtype).itemsize)
+        return self.size * jnp.dtype(self.dtype).itemsize
+
+
+def _hop_wires(k, v):
+    """Resolve the ring transport plan and record the rotation's bytes
+    (sp hops of K and V each; schedule class untagged — the static
+    Layer-D map owns the ring's overlap classification)."""
+    from .. import comm as dist
+    nbytes = k.size * k.dtype.itemsize
+    plan = dist.resolve_transport("activation", "ppermute", nbytes, SEQ_AXIS)
+    kw = _HopWire(plan.width, k.shape, k.dtype, plan.group_size)
+    vw = _HopWire(plan.width, v.shape, v.dtype, plan.group_size)
+    sp = dist.axis_size(SEQ_AXIS)
+    dist.record_collective("ppermute", nbytes, SEQ_AXIS, count=sp,
+                           wire_bytes=kw.wire_bytes())
+    dist.record_collective("ppermute", v.size * v.dtype.itemsize, SEQ_AXIS,
+                           count=sp, wire_bytes=vw.wire_bytes())
+    return kw, vw
+
+
 def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
                 causal: bool, scale: float) -> jax.Array:
     """Per-device body. q/k/v local shards [B, s, H|kvH, D]."""
@@ -55,6 +110,7 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
     l0 = jnp.zeros((B, kvH, G, s, 1), jnp.float32)
     a0 = jnp.zeros((B, kvH, G, s, D), jnp.float32)
     perm = [(j, (j + 1) % sp) for j in range(sp)]
+    kw, vw = _hop_wires(k, v)
 
     def step(i, carry):
         m, l, acc, k_cur, v_cur = carry
@@ -76,8 +132,8 @@ def _ring_local(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc = acc * corr + jnp.einsum(
             "bhgqk,bkhd->bhgqd", p.astype(v_cur.dtype), v_cur)
-        k_cur = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
-        v_cur = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+        k_cur = kw.hop(k_cur, perm)
+        v_cur = vw.hop(v_cur, perm)
         return m_new, l, acc, k_cur, v_cur
 
     m, l, acc, _, _ = jax.lax.fori_loop(0, sp, step, (m0, l0, a0, k, v))
@@ -104,6 +160,7 @@ def _ring_local_flash(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
     r = jax.lax.axis_index(SEQ_AXIS)
     B, s, H, D = q.shape
     perm = [(j, (j + 1) % sp) for j in range(sp)]
+    kw, vw = _hop_wires(k, v)
     # fp32 cross-hop carry: merging in the input dtype would re-round the
     # running output once per hop (the XLA body's accumulator is fp32 too)
     o0 = jnp.zeros((B, s, H, D), jnp.float32)
@@ -119,8 +176,8 @@ def _ring_local_flash(q: jax.Array, k: jax.Array, v: jax.Array, *, sp: int,
             q, k_cur, v_cur, causal=causal, scale=scale,
             q_offset=(r - owner) * s if causal else 0, interpret=interpret)
         o, lse = merge_partials(o, lse, o_h.astype(jnp.float32), lse_h)
-        k_cur = jax.lax.ppermute(k_cur, SEQ_AXIS, perm)
-        v_cur = jax.lax.ppermute(v_cur, SEQ_AXIS, perm)
+        k_cur = kw.hop(k_cur, perm)
+        v_cur = vw.hop(v_cur, perm)
         return o, lse, k_cur, v_cur
 
     o, _, _, _ = jax.lax.fori_loop(0, sp, step, (o0, lse0, k, v))

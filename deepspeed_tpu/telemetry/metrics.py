@@ -108,6 +108,10 @@ class MetricsEngine:
         # comm schedule-class byte totals (trace-time records)
         self.comm_overlapped_bytes = 0
         self.comm_exposed_bytes = 0
+        # transport accounting: logical vs wire bytes across ALL records
+        # (untagged included) — the quantized-transport scoreboard
+        self.comm_logical_bytes = 0
+        self.comm_wire_bytes = 0
         # model arithmetic for MFU — set once by the engine from the flops
         # profiler's cost-analysis machinery
         self.model_flops_per_step: float = 0.0
@@ -139,11 +143,22 @@ class MetricsEngine:
         self.checkpoint_lost_s += max(0.0, float(seconds))
 
     def record_comm(self, nbytes: int, overlapped: Optional[bool],
-                    count: int = 1) -> None:
+                    count: int = 1,
+                    wire_bytes: Optional[int] = None) -> None:
         if overlapped is True:
             self.comm_overlapped_bytes += int(nbytes) * int(count)
         elif overlapped is False:
             self.comm_exposed_bytes += int(nbytes) * int(count)
+        self.comm_logical_bytes += int(nbytes) * int(count)
+        self.comm_wire_bytes += int(nbytes if wire_bytes is None
+                                    else wire_bytes) * int(count)
+
+    def wire_ratio(self) -> Optional[float]:
+        """wire / logical collective bytes (1.0 = full width everywhere;
+        the transport planner's byte win, docs/COLLECTIVES.md)."""
+        if self.comm_logical_bytes == 0:
+            return None
+        return self.comm_wire_bytes / self.comm_logical_bytes
 
     # -- derived ---------------------------------------------------------
     def step_percentiles(self, ps=(50, 90, 99)) -> Dict[str, float]:
@@ -193,6 +208,11 @@ class MetricsEngine:
         ov = self.overlap_efficiency()
         if ov is not None:
             out["comm_overlap_efficiency"] = ov
+        wr = self.wire_ratio()
+        if wr is not None:
+            out["comm_wire_ratio"] = wr
+            out["comm_wire_bytes"] = float(self.comm_wire_bytes)
+            out["comm_logical_bytes"] = float(self.comm_logical_bytes)
         if len(self.token_latency):
             out.update({f"token_latency_{k}_s": v for k, v in
                         self.token_latency.percentiles().items()})

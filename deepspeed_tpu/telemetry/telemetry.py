@@ -126,9 +126,12 @@ class Telemetry:
     # -- comm records (dist.record_collective feed) ----------------------
     def record_collective(self, op: str, nbytes: int, axes,
                           overlapped: Optional[bool] = None,
-                          count: int = 1) -> None:
-        self.trace.comm(op, nbytes, axes, overlapped, count)
-        self.metrics.record_comm(nbytes, overlapped, count)
+                          count: int = 1,
+                          wire_bytes: Optional[int] = None) -> None:
+        self.trace.comm(op, nbytes, axes, overlapped, count,
+                        wire_bytes=wire_bytes)
+        self.metrics.record_comm(nbytes, overlapped, count,
+                                 wire_bytes=wire_bytes)
 
     # -- serving ---------------------------------------------------------
     def record_wave(self, kind: str, tokens: int, duration_s: float,
@@ -269,7 +272,8 @@ class NullTelemetry:
     def step_end(self, step, tokens=0):
         pass
 
-    def record_collective(self, op, nbytes, axes, overlapped=None, count=1):
+    def record_collective(self, op, nbytes, axes, overlapped=None, count=1,
+                          wire_bytes=None):
         pass
 
     def record_wave(self, *a, **k):

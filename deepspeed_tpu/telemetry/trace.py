@@ -135,13 +135,18 @@ class TraceRecorder:
                         **({"args": args} if args else {})})
 
     def comm(self, op: str, nbytes: int, axes, overlapped: Optional[bool],
-             count: int = 1) -> None:
+             count: int = 1, wire_bytes: Optional[int] = None) -> None:
         """One ``record_collective`` record (trace-time: sizes/schedule
-        class, not wall time — see utils/comms_logging.py)."""
+        class, not wall time — see utils/comms_logging.py). ``wire``
+        carries the on-link bytes when the transport plan narrows the
+        width (docs/COLLECTIVES.md)."""
         with self._lock:
             self._push({"kind": "comm", "op": op,
                         "phase": _COMM_PHASE.get(op, PHASE_OTHER),
-                        "bytes": int(nbytes), "axes": str(axes),
+                        "bytes": int(nbytes),
+                        "wire": int(nbytes if wire_bytes is None
+                                    else wire_bytes),
+                        "axes": str(axes),
                         "overlapped": overlapped, "count": int(count),
                         "ts": clock.now() - self._epoch})
 

@@ -43,42 +43,57 @@ class CommsLogger:
         self.enabled = getattr(config, "enabled", True) if config is not None else True
         self.verbose = getattr(config, "verbose", False) if config is not None else False
         self.prof_ops = getattr(config, "prof_ops", []) if config is not None else []
-        # {op_name: {(size, axes, overlapped): count}} — ``overlapped``
+        # {op_name: {(size, wire, axes, overlapped): count}} — ``overlapped``
         # classifies the launch's schedule: True = issued concurrently with
         # independent compute (the layer-granular ZeRO overlap schedule's
         # in-scan prefetch/reduce-scatter), False = on the critical path
         # (barrier schedule, edge-of-step collectives), None = unclassified
-        # (generic comm frontend calls).
-        self.comms_dict: Dict[str, Dict[Tuple[int, str, object], int]] = \
-            defaultdict(lambda: defaultdict(int))
+        # (generic comm frontend calls). ``wire`` is the bytes actually on
+        # the links (quantized transport: int8 payload + scale sideband);
+        # equals ``size`` for full-width launches.
+        self.comms_dict: Dict[str, Dict[Tuple[int, int, str, object], int]] \
+            = defaultdict(lambda: defaultdict(int))
         # newest records in arrival order — the stall watchdog's comms
         # tail (telemetry/watchdog.py): when a step hangs, the ops closest
         # to the hang are the diagnostic
         self.recent: deque = deque(maxlen=32)
 
     def append(self, op_name: str, size: int, axis, overlapped=None,
-               count: int = 1) -> None:
+               count: int = 1, wire_bytes=None) -> None:
         if not self.enabled:
             return
         if self.prof_ops and op_name not in self.prof_ops:
             return
-        key = (size, str(axis), overlapped)
+        wire = size if wire_bytes is None else int(wire_bytes)
+        key = (size, wire, str(axis), overlapped)
         # count: executions per trace of this site (scan bodies trace once
         # but launch per iteration) — the byte totals must reflect launches
         self.comms_dict[op_name][key] += count
         self.recent.append((op_name, size, str(axis), overlapped, count))
         if self.verbose:
             logger.info(f"comm op: {op_name} | axes: {axis} | msg size: "
-                        f"{convert_size(size)} | sched: "
-                        f"{_SCHED_NAMES[overlapped]} (traced)")
+                        f"{convert_size(size)} | wire: {convert_size(wire)}"
+                        f" | sched: {_SCHED_NAMES[overlapped]} (traced)")
 
     def _sched_totals(self) -> Dict[object, int]:
-        """Traced bytes by schedule class (size x trace-count)."""
+        """Traced LOGICAL bytes by schedule class (size x trace-count)."""
         totals: Dict[object, int] = defaultdict(int)
         for entries in self.comms_dict.values():
-            for (size, _axes, overlapped), count in entries.items():
+            for (size, _wire, _axes, overlapped), count in entries.items():
                 totals[overlapped] += size * count
         return totals
+
+    def byte_totals(self) -> Tuple[int, int]:
+        """(logical_bytes, wire_bytes) over every record — the
+        wire-vs-logical ratio is the transport planner's scoreboard
+        (docs/COLLECTIVES.md): 1.0 = full-width everywhere, ~0.26 = int8
+        transport on the dominant launches."""
+        logical = wire = 0
+        for entries in self.comms_dict.values():
+            for (size, w, _axes, _ov), count in entries.items():
+                logical += size * count
+                wire += w * count
+        return logical, wire
 
     def sched_totals(self) -> Tuple[int, int]:
         """(overlapped_bytes, exposed_bytes) — the split telemetry's
@@ -102,13 +117,14 @@ class CommsLogger:
         # Count = trace sites weighted by executions-per-step (scan-body
         # collectives launch once per iteration of a single trace)
         lines = [f"{'Comm. Op':<22}{'Axes':<24}{'Message Size':<16}"
-                 f"{'Sched':<12}{'Count':<12}"]
+                 f"{'Wire':<16}{'Sched':<12}{'Count':<12}"]
         for op_name, entries in sorted(self.comms_dict.items()):
-            for (size, axes, overlapped), count in sorted(
-                    entries.items(), key=lambda kv: (kv[0][0], kv[0][1],
-                                                     str(kv[0][2]))):
+            for (size, wire, axes, overlapped), count in sorted(
+                    entries.items(), key=lambda kv: (kv[0][0], kv[0][2],
+                                                     str(kv[0][3]))):
                 lines.append(f"{op_name:<22}{axes:<24}"
                              f"{convert_size(size):<16}"
+                             f"{convert_size(wire):<16}"
                              f"{_SCHED_NAMES[overlapped]:<12}{count:<12}")
         totals = self._sched_totals()
         ov, ex = totals.get(True, 0), totals.get(False, 0)
@@ -121,6 +137,11 @@ class CommsLogger:
             lines.append(f"traced bytes: overlapped {convert_size(ov)} / "
                          f"exposed {convert_size(ex)} "
                          f"(overlapped fraction {frac:.2f})")
+        logical, wire = self.byte_totals()
+        if logical:
+            lines.append(f"wire bytes: {convert_size(wire)} / logical "
+                         f"{convert_size(logical)} "
+                         f"(ratio {wire / logical:.2f})")
         logger.info("Communication summary (sizes recorded at trace time):\n" + "\n".join(lines))
 
     def reset(self) -> None:

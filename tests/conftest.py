@@ -37,6 +37,10 @@ def _reset_topology():
     # watchdog thread / close-time export) into the next test
     from deepspeed_tpu.telemetry import reset_telemetry
     reset_telemetry()
+    # nor may a test's comm_transport policy (engine config block or
+    # direct configure_transport call) leak into the next test
+    from deepspeed_tpu import comm as dist
+    dist.reset_transport()
 
 
 @pytest.fixture

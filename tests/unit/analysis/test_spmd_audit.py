@@ -308,22 +308,23 @@ HloModule jit_fn, is_scheduled=true, input_output_alias={ {0}: (1, {}, may-alias
 
 ENTRY %main {
   %param = f32[8,64]{1,0} parameter(0)
-  %ag = f32[64,64]{1,0} all-gather(%param), dimensions={0}
-  %ags = (f32[8,64]{1,0}, f32[64,64]{1,0}) all-gather-start(%param)
-  %agd = f32[64,64]{1,0} all-gather-done(%ags)
-  %ar.s = bf16[128]{0} all-reduce-start(%x)
-  %ar.d = bf16[128]{0} all-reduce-done(%ar.s)
-  %cp = (s32[4]{0}, s32[4]{0}) collective-permute(%y, %z)
-  ROOT %dot = f32[16,32]{1,0} fusion(%ag), kind=kOutput, calls=%fused
+  %ag = f32[64,64]{1,0} all-gather(f32[8,64]{1,0} %param), dimensions={0}
+  %ags = (f32[8,64]{1,0}, f32[64,64]{1,0}) all-gather-start(f32[8,64]{1,0} %param)
+  %agd = f32[64,64]{1,0} all-gather-done((f32[8,64]{1,0}, f32[64,64]{1,0}) %ags)
+  %ar.s = bf16[128]{0} all-reduce-start(bf16[128]{0} %x)
+  %ar.d = bf16[128]{0} all-reduce-done(bf16[128]{0} %ar.s)
+  %cp = (s32[4]{0}, s32[4]{0}) collective-permute(s32[4]{0} %y, s32[4]{0} %z)
+  ROOT %dot = f32[16,32]{1,0} fusion(f32[64,64]{1,0} %ag), kind=kOutput, calls=%fused
 }
 """
 
 
 def test_collective_summary_parses_shapes_async_and_tuples():
     summary = collective_summary(_SYNTHETIC_HLO)
-    # the async all-gather-start tuple is (operand alias, result): only the
-    # result half is charged, and -done is never double-counted
-    assert summary["all-gather"] == (2, 2 * 64 * 64 * 4)
+    # OPERAND-side bytes (ISSUE 8: the wire convention shared with Layer D
+    # and record_collective): each launch charges its input payload —
+    # -start carries the operands, -done is never double-counted
+    assert summary["all-gather"] == (2, 2 * 8 * 64 * 4)
     assert summary["all-reduce"] == (1, 128 * 2)   # -start counted, -done not
     assert summary["collective-permute"] == (1, 2 * 4 * 4)
 

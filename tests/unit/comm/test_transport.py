@@ -1,0 +1,433 @@
+"""Transport planner (ISSUE 8): per-bucket width/algorithm resolution,
+quantized + hierarchical collective numerics on the 8-device CPU mesh,
+error-feedback convergence, the DSTPU_COMM_QUANT escape hatch, and the
+wire-byte ledger accounting. See docs/COLLECTIVES.md for the contract."""
+
+import functools
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.comm import comm as comm_mod
+from deepspeed_tpu.ops.quantizer import (ef_quantized_reduce_scatter,
+                                         fp8_reduce_scatter,
+                                         quantized_all_reduce,
+                                         quantized_reduce_scatter)
+from deepspeed_tpu.runtime import topology as topo_mod
+from deepspeed_tpu.runtime.topology import MeshTopology, TopologyConfig
+from deepspeed_tpu.utils.jax_compat import shard_map
+
+AXES = ("data", "mics")
+SIZES = {"data": 4, "mics": 2, "seq": 1, "model": 1}
+
+
+def two_tier_mesh():
+    topo_mod.set_topology(MeshTopology(TopologyConfig(mics=2, data=-1)))
+    return topo_mod.get_topology().mesh
+
+
+def run_sharded(mesh, fn, x, in_spec, out_spec):
+    sm = shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                   check_vma=False)
+    return np.asarray(jax.jit(sm)(x))
+
+
+@pytest.fixture
+def x32():
+    rng = np.random.default_rng(0)
+    return jnp.asarray(rng.normal(size=(1024, 16)), jnp.float32)
+
+
+class TestPlanRouting:
+    """resolve_transport: width by kind/op/bytes, algo by mesh axes."""
+
+    def test_grad_defaults_int8(self):
+        tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                    ("data",), axis_sizes={"data": 8})
+        assert tp.width == "int8" and tp.algo == "flat"
+
+    def test_unclassified_is_exact(self):
+        tp = dist.resolve_transport(None, "reduce_scatter", 1 << 20,
+                                    ("data",), axis_sizes={"data": 8})
+        assert tp == comm_mod.FULL_FLAT_PLAN
+
+    def test_small_buckets_stay_full(self):
+        tp = dist.resolve_transport("grad", "reduce_scatter", 512,
+                                    ("data",), axis_sizes={"data": 8})
+        assert tp.width == "full"
+
+    def test_activation_widths_by_op(self):
+        a2a = dist.resolve_transport("activation", "all_to_all", 1 << 20,
+                                     ("expert",), axis_sizes={"expert": 4})
+        assert a2a.width == "bf16"
+        hop = dist.resolve_transport("activation", "ppermute", 1 << 20,
+                                     ("seq",), axis_sizes={"seq": 4})
+        assert hop.width == "int8"
+
+    def test_hierarchical_needs_data_plus_inner(self):
+        tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                    AXES, axis_sizes=SIZES)
+        assert tp.algo == "hierarchical"
+        assert tp.inner == ("mics",) and tp.outer == ("data",)
+        # single live axis -> flat
+        tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                    AXES, axis_sizes={"data": 8, "mics": 1})
+        assert tp.algo == "flat"
+
+    def test_width_normalized_per_op(self):
+        # bf16 cannot carry a reduction; all_to_all cannot carry scales
+        dist.configure_transport(grad_width="bf16")
+        tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                    ("data",), axis_sizes={"data": 8})
+        assert tp.width == "full"
+        dist.reset_transport()
+        dist.configure_transport(activation_width="int8")
+        tp = dist.resolve_transport("activation", "all_to_all", 1 << 20,
+                                    ("seq",), axis_sizes={"seq": 8})
+        assert tp.width == "bf16"
+
+    def test_kill_switch_and_hier_switch(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_COMM_QUANT", "0")
+        tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                    AXES, axis_sizes=SIZES)
+        assert tp.width == "full" and tp.algo == "flat"
+        monkeypatch.delenv("DSTPU_COMM_QUANT")
+        monkeypatch.setenv("DSTPU_COMM_HIER", "0")
+        tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                    AXES, axis_sizes=SIZES)
+        assert tp.width == "int8" and tp.algo == "flat"
+
+    def test_requested_width_survives_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_COMM_QUANT", "0")
+        tp = dist.resolve_transport("param", "all_gather", 1 << 20,
+                                    ("data",), axis_sizes={"data": 8},
+                                    requested="int8")
+        assert tp.width == "int8"
+
+    def test_configure_transport_validates(self):
+        with pytest.raises(ValueError, match="unknown comm_transport"):
+            dist.configure_transport(grads_width="int8")
+        with pytest.raises(ValueError, match="not in"):
+            dist.configure_transport(grad_width="int3")
+
+    def test_wire_bytes_estimator(self):
+        tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                    ("data",), axis_sizes={"data": 8})
+        n = 1 << 18
+        wire = tp.wire_bytes(n, 4)
+        assert wire < 0.3 * n * 4          # int8 + scale sideband
+        full = comm_mod.FULL_FLAT_PLAN.wire_bytes(n, 4)
+        assert full == n * 4
+
+
+class TestNumerics:
+    """CPU-mesh numerics: quantized/hierarchical frontends vs the flat
+    full-width reference."""
+
+    def test_hierarchical_matches_flat_fp32(self, eight_devices, x32):
+        mesh = two_tier_mesh()
+        flat_rs = run_sharded(
+            mesh, lambda t: jax.lax.psum_scatter(
+                t, AXES, scatter_dimension=0, tiled=True),
+            x32, P(AXES), P(AXES))
+        hier_rs = run_sharded(
+            mesh, lambda t: comm_mod._hier_psum_scatter(
+                t, AXES, ("mics",), ("data",)),
+            x32, P(AXES), P(AXES))
+        # two-tier regrouping only changes fp32 summation ORDER; the
+        # result is identical to round-off (measured <= 1e-6 abs)
+        np.testing.assert_allclose(hier_rs, flat_rs, rtol=1e-5, atol=1e-5)
+
+        flat_ar = run_sharded(mesh, lambda t: jax.lax.psum(t, AXES),
+                              x32, P(AXES), P(None))
+        hier_ar = run_sharded(
+            mesh, lambda t: comm_mod._hier_psum(t, ("mics",), ("data",)),
+            x32, P(AXES), P(None))
+        np.testing.assert_allclose(hier_ar, flat_ar, rtol=1e-5, atol=1e-5)
+
+    def test_hierarchical_all_gather_bitwise(self, eight_devices, x32):
+        mesh = two_tier_mesh()
+        flat = run_sharded(
+            mesh, lambda t: jax.lax.all_gather(t, AXES, axis=0, tiled=True),
+            x32, P(AXES), P(None))
+        hier = run_sharded(
+            mesh, lambda t: comm_mod._hier_all_gather(
+                t, AXES, ("mics",), ("data",)),
+            x32, P(AXES), P(None))
+        # pure data movement: the two-tier gather reorders blocks, it
+        # never recomputes them — bitwise equality required
+        np.testing.assert_array_equal(flat, hier)
+
+    def test_quantized_all_reduce_parity(self, eight_devices, x32):
+        mesh = two_tier_mesh()
+        ref = run_sharded(mesh, lambda t: jax.lax.psum(t, AXES),
+                          x32, P(AXES), P(None))
+        got = run_sharded(
+            mesh, lambda t: dist.all_reduce(t, axis=AXES, kind="grad"),
+            x32, P(AXES), P(None))
+        assert np.max(np.abs(got - ref)) <= 2.5e-2 * np.max(np.abs(ref))
+
+    def test_quantized_hier_reduce_scatter_parity(self, eight_devices, x32):
+        mesh = two_tier_mesh()
+        ref = run_sharded(
+            mesh, lambda t: jax.lax.psum_scatter(
+                t, AXES, scatter_dimension=0, tiled=True),
+            x32, P(AXES), P(AXES))
+        got = run_sharded(
+            mesh, lambda t: dist.reduce_scatter(t, axis=AXES, kind="grad"),
+            x32, P(AXES), P(AXES))
+        assert np.max(np.abs(got - ref)) <= 2.5e-2 * np.max(np.abs(ref))
+
+    def test_fp8_reduce_scatter_parity(self, eight_devices, x32):
+        mesh = two_tier_mesh()
+        ref = run_sharded(
+            mesh, lambda t: jax.lax.psum_scatter(
+                t, AXES, scatter_dimension=0, tiled=True),
+            x32, P(AXES), P(AXES))
+        got = run_sharded(
+            mesh, lambda t: fp8_reduce_scatter(t, AXES),
+            x32, P(AXES), P(AXES))
+        # e4m3: 3 mantissa bits -> coarser than int8-with-scales
+        assert np.max(np.abs(got - ref)) <= 8e-2 * np.max(np.abs(ref))
+
+    def test_all_to_all_bf16_cast(self, eight_devices, x32):
+        topo_mod.set_topology(MeshTopology(TopologyConfig(seq=8, data=-1)))
+        mesh = topo_mod.get_topology().mesh
+        ref = run_sharded(
+            mesh, lambda t: jax.lax.all_to_all(
+                t, "seq", split_axis=0, concat_axis=0, tiled=True),
+            x32, P("seq"), P("seq"))
+        got = run_sharded(
+            mesh, lambda t: dist.all_to_all(t, axis="seq",
+                                            kind="activation"),
+            x32, P("seq"), P("seq"))
+        assert got.dtype == np.float32            # logical dtype restored
+        np.testing.assert_allclose(got, ref, rtol=8e-3, atol=8e-3)
+
+    def test_kill_switch_bitwise(self, eight_devices, x32, monkeypatch):
+        """DSTPU_COMM_QUANT=0: kind-classified calls are BITWISE the
+        pre-planner full-width program."""
+        monkeypatch.setenv("DSTPU_COMM_QUANT", "0")
+        mesh = two_tier_mesh()
+        ref = run_sharded(
+            mesh, lambda t: jax.lax.psum_scatter(
+                t, AXES, scatter_dimension=0, tiled=True),
+            x32, P(AXES), P(AXES))
+        got = run_sharded(
+            mesh, lambda t: dist.reduce_scatter(t, axis=AXES, kind="grad"),
+            x32, P(AXES), P(AXES))
+        np.testing.assert_array_equal(got, ref)
+
+
+class TestErrorFeedback:
+
+    def test_ef_telescopes_over_micro_steps(self, eight_devices, x32):
+        """Accumulating K compensated reductions of the same gradient:
+        the EF stream's accumulated error is bounded by ~one step's
+        quantization error while the uncompensated stream's grows
+        linearly — the convergence property EF exists for."""
+        mesh = two_tier_mesh()
+        K = 8
+        n = 8
+
+        def ef_loop(t):
+            err = jnp.zeros_like(t)
+            acc = jnp.zeros((t.shape[0] // n,) + t.shape[1:], jnp.float32)
+            for _ in range(K):
+                o, err = ef_quantized_reduce_scatter(t, err, AXES)
+                acc = acc + o
+            return acc
+
+        def raw_loop(t):
+            acc = jnp.zeros((t.shape[0] // n,) + t.shape[1:], jnp.float32)
+            for _ in range(K):
+                acc = acc + quantized_reduce_scatter(t, AXES)
+            return acc
+
+        ref = K * run_sharded(
+            mesh, lambda t: jax.lax.psum_scatter(
+                t, AXES, scatter_dimension=0, tiled=True),
+            x32, P(AXES), P(AXES))
+        ef = run_sharded(mesh, ef_loop, x32, P(AXES), P(AXES))
+        raw = run_sharded(mesh, raw_loop, x32, P(AXES), P(AXES))
+        ef_err = np.max(np.abs(ef - ref))
+        raw_err = np.max(np.abs(raw - ref))
+        assert ef_err < raw_err / 3, (ef_err, raw_err)
+
+    def test_ef_wire_layout_matches_plain(self, eight_devices, x32):
+        """Zero starting residual: the EF call IS the plain quantized
+        reduce-scatter (same wire, same layout)."""
+        mesh = two_tier_mesh()
+        plain = run_sharded(
+            mesh, lambda t: quantized_reduce_scatter(t, AXES),
+            x32, P(AXES), P(AXES))
+        ef = run_sharded(
+            mesh, lambda t: ef_quantized_reduce_scatter(
+                t, jnp.zeros_like(t), AXES)[0],
+            x32, P(AXES), P(AXES))
+        np.testing.assert_array_equal(plain, ef)
+
+    def test_treecomm_ef_roundtrip(self, eight_devices):
+        """TreeComm.scatter(err=...) applies EF on eligible buckets and
+        returns carriable residuals."""
+        from jax.sharding import PartitionSpec
+        from deepspeed_tpu.runtime.zero.overlap import build_tree_comm
+
+        topo_mod.set_topology(MeshTopology(TopologyConfig(data=-1)))
+        mesh = topo_mod.get_topology().mesh
+        dist.configure_transport(error_feedback=True)
+        spec = {"w": PartitionSpec("data")}
+        struct = {"w": jax.ShapeDtypeStruct((1024, 16), jnp.float32)}
+        tc = build_tree_comm(
+            spec, spec, struct, axis_sizes={"data": 8}, all_dp=("data",),
+            n_dp=8, quant_weights=False, quant_grads=False,
+            allgather_bucket=10**9, reduce_bucket=10**9,
+            overlapped=False, name="t")
+        structs = tc.err_struct()
+        assert any(s is not None for s in structs)
+        rng = np.random.default_rng(1)
+        g = jnp.asarray(rng.normal(size=(1024, 16)), jnp.float32)
+
+        def body(t):
+            errs = [jnp.zeros(s.shape, s.dtype) if s is not None else None
+                    for s in tc.err_struct()]
+            out1, errs = tc.scatter({"w": t}, err=errs)
+            out2, errs = tc.scatter({"w": t}, err=errs)
+            return out1["w"] + out2["w"]
+
+        def ref_body(t):
+            return 2 * jax.lax.psum_scatter(
+                t, "data", scatter_dimension=0, tiled=True) / 8
+
+        # the ZeRO scatter contract: every rank holds the FULL per-layer
+        # gradient (replicated input) and receives its 1/n shard back
+        got = run_sharded(mesh, body, g, P(None), P("data"))
+        ref = run_sharded(mesh, ref_body, g, P(None), P("data"))
+        # two EF steps: accumulated error ~ one quantization step's
+        assert np.max(np.abs(got - ref)) <= 3e-2 * np.max(np.abs(ref))
+
+
+class TestReviewRegressions:
+    """Pinned fixes from the PR's review pass."""
+
+    def test_ef_handles_non_group_multiple_chunks(self, eight_devices):
+        """Per-destination chunk not a group multiple: the residual must
+        pad/unpad internally and come back in the CALLER's shape (a valid
+        scan carry), not the padded internal layout."""
+        mesh = two_tier_mesh()
+        rng = np.random.default_rng(3)
+        # 8 destinations x 100-elem chunks; group_size 64 -> pad 28
+        x = jnp.asarray(rng.normal(size=(800,)), jnp.float32)
+
+        def body(t):
+            err = jnp.zeros_like(t)
+            o1, err = ef_quantized_reduce_scatter(t, err, AXES,
+                                                  group_size=64)
+            assert err.shape == t.shape
+            o2, err = ef_quantized_reduce_scatter(t, err, AXES,
+                                                  group_size=64)
+            return o1 + o2
+
+        ref = 2 * run_sharded(
+            mesh, lambda t: jax.lax.psum_scatter(
+                t, AXES, scatter_dimension=0, tiled=True),
+            x, P(None), P(AXES))
+        got = run_sharded(mesh, body, x, P(None), P(AXES))
+        assert np.max(np.abs(got - ref)) <= 5e-2 * np.max(np.abs(ref))
+
+    def test_hier_tolerates_dead_axes_in_tuple(self, eight_devices, x32):
+        """A size-1 axis inside the compound tuple (excluded from the
+        plan's tiers) must not break the regroup — it contributes factor
+        1 to the block layout."""
+        mesh = two_tier_mesh()
+        axes3 = ("data", "mics", "seq")          # seq is size 1 here
+        flat = run_sharded(
+            mesh, lambda t: jax.lax.psum_scatter(
+                t, axes3, scatter_dimension=0, tiled=True),
+            x32, P(AXES), P(AXES))
+        hier = run_sharded(
+            mesh, lambda t: comm_mod._hier_psum_scatter(
+                t, axes3, ("mics",), ("data",)),
+            x32, P(AXES), P(AXES))
+        np.testing.assert_allclose(hier, flat, rtol=1e-5, atol=1e-5)
+
+    def test_treecomm_gather_wire_never_exceeds_logical(self,
+                                                        eight_devices):
+        """Full-width gathers on a two-tier mesh execute flat and must be
+        RECORDED flat — a wire estimate above logical bytes means a
+        phantom hierarchical leg was charged."""
+        from jax.sharding import PartitionSpec
+        from deepspeed_tpu.runtime.zero.overlap import build_tree_comm
+
+        topo_mod.set_topology(MeshTopology(TopologyConfig(mics=2, data=-1)))
+        spec = {"w": PartitionSpec(AXES)}
+        struct = {"w": jax.ShapeDtypeStruct((1024, 16), jnp.float32)}
+        tc = build_tree_comm(
+            spec, spec, struct, axis_sizes={"data": 4, "mics": 2},
+            all_dp=AXES, n_dp=8, quant_weights=False, quant_grads=False,
+            allgather_bucket=10**9, reduce_bucket=10**9,
+            overlapped=False, name="t")
+        ledger = dist.CollectiveLedger()
+        x = jnp.zeros((128, 16), jnp.float32)   # local shard view
+        with dist.record_into(ledger):
+            with topo_mod.get_topology().mesh:
+                from deepspeed_tpu.utils.jax_compat import shard_map
+                shard_map(lambda t: tc.gather({"w": t})["w"],
+                          mesh=topo_mod.get_topology().mesh,
+                          in_specs=P(AXES), out_specs=P(None),
+                          check_vma=False)(jnp.zeros((1024, 16),
+                                                     jnp.float32))
+        gathers = [r for r in ledger.records if r["op"] == "all_gather"]
+        assert gathers
+        assert all(r["wire_bytes"] <= r["bytes"] for r in gathers), gathers
+
+    def test_chunked_hierarchical_scatter_matches_unchunked(
+            self, eight_devices, x32):
+        mesh = two_tier_mesh()
+        one = lambda c: comm_mod._hier_psum_scatter(
+            c, AXES, ("mics",), ("data",))
+        from deepspeed_tpu.ops.quantizer import quantizer as qz
+        chunked = run_sharded(
+            mesh, lambda t: qz.scatter_in_row_chunks(one, t, 8, 4),
+            x32, P(AXES), P(AXES))
+        unchunked = run_sharded(mesh, one, x32, P(AXES), P(AXES))
+        np.testing.assert_array_equal(chunked, unchunked)
+
+
+class TestLedgerWireBytes:
+
+    def test_ledger_split_uses_wire_bytes(self):
+        ledger = dist.CollectiveLedger()
+        ledger.append("all_to_all", 4096, ("data",), overlapped=True,
+                      wire_bytes=1056)
+        ledger.append("reduce_scatter", 4096, ("data",), overlapped=False)
+        assert ledger.split() == {"overlapped_bytes": 1056,
+                                  "exposed_bytes": 4096}
+        assert ledger.split(wire=False) == {"overlapped_bytes": 4096,
+                                            "exposed_bytes": 4096}
+
+    def test_comms_logger_wire_totals(self):
+        from deepspeed_tpu.utils.comms_logging import CommsLogger
+        log = CommsLogger()
+        log.append("all_to_all", 4096, ("data",), overlapped=True,
+                   count=2, wire_bytes=1056)
+        log.append("all_gather", 1000, ("data",))
+        logical, wire = log.byte_totals()
+        assert logical == 4096 * 2 + 1000
+        assert wire == 1056 * 2 + 1000
+        log.log_all()   # renders the wire column without raising
+
+    def test_telemetry_wire_ratio(self):
+        from deepspeed_tpu.telemetry.metrics import MetricsEngine
+        m = MetricsEngine()
+        m.record_comm(4096, True, wire_bytes=1056)
+        m.record_comm(4096, False)
+        assert abs(m.wire_ratio() - (1056 + 4096) / 8192) < 1e-9
+        s = m.summary()
+        assert "comm_wire_ratio" in s and s["comm_wire_bytes"] == 5152.0

@@ -6,6 +6,9 @@ whole-tree barrier schedule. Plus the bucket planner (the
 reduce/allgather_bucket_size knobs finally bind) and the comms logger's
 overlapped/exposed split."""
 
+import contextlib
+import os
+
 import numpy as np
 import pytest
 
@@ -19,6 +22,24 @@ from deepspeed_tpu.runtime.topology import MeshTopology, TopologyConfig
 from deepspeed_tpu.runtime.zero.partition import BucketEntry, plan_comm_buckets
 
 CFG = dict(max_seq_len=32, vocab_size=256, remat=False)
+
+
+@contextlib.contextmanager
+def transport_off():
+    """DSTPU_COMM_QUANT=0 — the transport-planner escape hatch (ISSUE 8):
+    collective plans revert to full-width/flat, which is bit-for-bit the
+    pre-planner program. The exact-parity tests below run under it; the
+    quantized DEFAULT is covered by TestTransportDefaults. The env is read
+    at trace time, so it must wrap the first forward, not just the build."""
+    old = os.environ.get("DSTPU_COMM_QUANT")
+    os.environ["DSTPU_COMM_QUANT"] = "0"
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("DSTPU_COMM_QUANT", None)
+        else:
+            os.environ["DSTPU_COMM_QUANT"] = old
 
 
 def make_engine(zero_extra=None, topology=None, seed=11):
@@ -71,8 +92,9 @@ def dense_grads():
 
 @pytest.fixture(scope="module")
 def overlap_grads():
-    eng = make_engine({"overlap_comm": True})
-    g = micro_grads(eng)
+    with transport_off():
+        eng = make_engine({"overlap_comm": True})
+        g = micro_grads(eng)
     assert eng._stage3_overlap and eng._explicit_micro
     assert eng._overlap_active, eng._overlap_fallback
     return g
@@ -82,9 +104,10 @@ class TestOverlapNumerics:
 
     def test_overlap_matches_dense_micro(self, eight_devices, dense_grads,
                                          overlap_grads):
-        """The pipelined stage-3 schedule (explicit overlap_comm, no
-        quantization) reproduces the dense ``_micro_step_fn`` gradients
-        within fp32 reduction-order tolerance."""
+        """The pipelined stage-3 schedule under the transport escape
+        hatch (full-width/flat — the pre-ISSUE-8 program) reproduces the
+        dense ``_micro_step_fn`` gradients within fp32 reduction-order
+        tolerance: the default-off escape is exact."""
         assert_grads_close(dense_grads, overlap_grads, rtol=2e-5)
 
     def test_overlap_quantized_matches_dense_micro(self, eight_devices,
@@ -108,10 +131,11 @@ class TestOverlapNumerics:
                                              dense_grads):
         """hpZ: forward/backward gathers read the mics-sharded SECONDARY
         partition; gradients still land on the primary shards and match
-        the dense step."""
-        topo = MeshTopology(TopologyConfig(mics=2, data=-1))
-        hpz = make_engine({"zero_hpz_partition_size": 2}, topology=topo)
-        got = micro_grads(hpz)
+        the dense step (escape hatch: exact fp32 comparison)."""
+        with transport_off():
+            topo = MeshTopology(TopologyConfig(mics=2, data=-1))
+            hpz = make_engine({"zero_hpz_partition_size": 2}, topology=topo)
+            got = micro_grads(hpz)
         assert hpz._overlap_active, hpz._overlap_fallback
         assert_grads_close(dense_grads, got, rtol=2e-5)
 
@@ -119,22 +143,93 @@ class TestOverlapNumerics:
                                            overlap_grads):
         """Tiny bucket sizes force splitting (and defeat fusing); the
         gradients must be identical to the default fused plan's."""
-        ch = make_engine({"overlap_comm": True,
-                          "allgather_bucket_size": 2000,
-                          "reduce_bucket_size": 2000})
-        got = micro_grads(ch)
+        with transport_off():
+            ch = make_engine({"overlap_comm": True,
+                              "allgather_bucket_size": 2000,
+                              "reduce_bucket_size": 2000})
+            got = micro_grads(ch)
         assert ch._overlap_active
         assert_grads_close(overlap_grads, got, rtol=2e-5)
 
     def test_gas_accumulation(self, eight_devices, overlap_grads):
         """gas>1: the pipelined micro accumulates into the donated shard
         buffer exactly like the barrier schedule."""
-        ov2 = make_engine({"overlap_comm": True})
-        ov2.forward(dict(BATCH)); ov2.backward()
-        ov2.forward(dict(BATCH)); ov2.backward()
+        with transport_off():
+            ov2 = make_engine({"overlap_comm": True})
+            ov2.forward(dict(BATCH)); ov2.backward()
+            ov2.forward(dict(BATCH)); ov2.backward()
         two = jax.tree.map(np.asarray, ov2.state["grad_acc"])
         assert_grads_close(jax.tree.map(lambda a: 2 * a, overlap_grads),
                            two, rtol=2e-5)
+
+
+class TestTransportDefaults:
+    """ISSUE 8: quantized + hierarchical transport is the DEFAULT for
+    gradient reductions — no ZeRO++ config required."""
+
+    def test_default_grad_transport_matches_dense(self, eight_devices,
+                                                  dense_grads):
+        """Plain stage-3 pipelined engine, planner defaults: grads ride
+        the int8 wire and must track the dense gradients within
+        quantization tolerance (global-scale atol floor — k_proj/bias
+        grads are analytically zero)."""
+        eng = make_engine({"overlap_comm": True})
+        got = micro_grads(eng)
+        assert eng._overlap_active
+        assert_grads_close(dense_grads, got, rtol=0.25, atol_frac=2e-2)
+        losses = [float(eng.train_batch(dict(BATCH))) for _ in range(3)]
+        assert losses[-1] < losses[0]
+
+    def test_default_grad_wire_bytes_reduced(self, eight_devices):
+        """The acceptance bar made runtime-visible: tracing the pipelined
+        micro under a recording ledger, the gradient-reduction wire bytes
+        must be >= 40% below the logical (full-width) bytes."""
+        from deepspeed_tpu import comm as dist
+        eng = make_engine({"overlap_comm": True})
+        eng._build_jits()
+        micro = eng._build_zeropp_micro()
+        args = (eng.state["grad_acc"], eng.state["loss_scale"]["cur_scale"],
+                eng.state["params"], eng._prepare_batch(dict(BATCH)))
+        ledger = dist.CollectiveLedger()
+        with dist.record_into(ledger):
+            with eng.mesh:
+                jax.eval_shape(micro, *args)
+        red = [r for r in ledger.records
+               if r["op"] in ("all_to_all", "reduce_scatter")]
+        assert red, "no gradient reductions recorded"
+        logical = sum(r["bytes"] * r["count"] for r in red)
+        wire = sum(r["wire_bytes"] * r["count"] for r in red)
+        assert wire <= 0.6 * logical, (wire, logical)
+        # and the quantized wire is declared as the qgZ-style all-to-all
+        assert any(r["op"] == "all_to_all" for r in red)
+
+    def test_default_hpz_hierarchical_matches_dense(self, eight_devices,
+                                                    dense_grads):
+        """mics=2 x data=4: grad buckets whose dp axes span ('data',
+        'mics') take the two-tier decomposition (intra-'mics' quantized
+        reduce-scatter + cross-'data' leg) and still track dense grads."""
+        topo = MeshTopology(TopologyConfig(mics=2, data=-1))
+        hpz = make_engine({"zero_hpz_partition_size": 2}, topology=topo)
+        got = micro_grads(hpz)
+        assert hpz._overlap_active, hpz._overlap_fallback
+        assert_grads_close(dense_grads, got, rtol=0.25, atol_frac=2e-2)
+
+    def test_escape_hatch_is_flat_full(self, eight_devices):
+        """DSTPU_COMM_QUANT=0 resolves every plan to full/flat (the
+        pre-ISSUE-8 program) regardless of kind/size/mesh."""
+        from deepspeed_tpu import comm as dist
+        with transport_off():
+            tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                        ("data", "mics"),
+                                        axis_sizes={"data": 4, "mics": 2})
+        assert tp.width == "full" and tp.algo == "flat"
+        # explicit qgZ width requests survive the kill switch (user
+        # contract, not planner default)
+        with transport_off():
+            tp = dist.resolve_transport("grad", "reduce_scatter", 1 << 20,
+                                        ("data",), axis_sizes={"data": 8},
+                                        requested="int8")
+        assert tp.width == "int8"
 
 
 class TestEscapeHatchAndRouting:

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""A/B: quantized + hierarchical collective transport (the ISSUE 8
+default) vs full-width flat transport on the SAME pipelined ZeRO-3 step —
+the measured half of the acceptance bar (the static half is the per-kind
+re-pin in tools/memory_budgets.json).
+
+Both arms run the identical plain-stage-3 layer-granular schedule
+(engine ``_build_zeropp_micro_overlap`` via explicit ``overlap_comm:
+true`` — NO ZeRO++ quantization config, so the transport PLANNER is the
+only variable): the ``quant`` arm takes the planner defaults (grad
+reduce-scatters on the int8 wire, hierarchical decomposition where the
+dp axes span tiers), the ``off`` arm pins ``DSTPU_COMM_QUANT=0`` (every
+plan resolves full/flat — byte-identical to the pre-planner program).
+
+Each child also traces one micro step under a ``CollectiveLedger`` and
+reports the wire-vs-logical byte ratio, so the printed line pairs the
+step-time ratio with the byte reduction that bought it. Acceptance:
+wire bytes on gradient reductions down >= 40%, step time no worse.
+
+Interleaving is at PROCESS granularity via tools/ab_common.py (two 125M
+stage-3 engines do not reliably fit HBM together):
+
+Run:  python tools/comm_quant_ab.py
+      python tools/comm_quant_ab.py --single quant|off
+"""
+
+import json
+import os
+import sys
+import time
+
+STEPS = 30
+
+
+def build(variant):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2_model
+    from deepspeed_tpu.runtime import topology as topo_mod
+
+    topo_mod.reset()
+    if variant == "off":
+        os.environ["DSTPU_COMM_QUANT"] = "0"
+    model = gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True)
+    micro, seq = 8, 1024
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        # plain stage 3 + explicit overlap_comm: the pipelined schedule
+        # WITHOUT qwZ/qgZ — transport defaults are the only variable
+        "zero_optimization": {"stage": 3, "overlap_comm": True,
+                              "stage3_param_persistence_threshold": 0},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "data_types": {"grad_accum_dtype": "bf16"},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    ids = np.random.default_rng(0).integers(
+        0, model.config.vocab_size, size=(micro, seq))
+    return engine, {"input_ids": ids}, micro * seq
+
+
+def wire_ratio(engine, batch):
+    """Trace one micro step under a recording ledger -> (wire, logical)."""
+    import jax
+
+    from deepspeed_tpu import comm as dist
+
+    micro = engine._build_zeropp_micro()
+    args = (engine.state["grad_acc"], engine.state["loss_scale"]["cur_scale"],
+            engine.state["params"], engine._prepare_batch(dict(batch)))
+    ledger = dist.CollectiveLedger()
+    with dist.record_into(ledger):
+        with engine.mesh:
+            jax.eval_shape(micro, *args)
+    logical = sum(r["bytes"] * r["count"] for r in ledger.records)
+    wire = sum(r["wire_bytes"] * r["count"] for r in ledger.records)
+    red = [r for r in ledger.records
+           if r["op"] in ("all_to_all", "reduce_scatter")]
+    red_logical = sum(r["bytes"] * r["count"] for r in red)
+    red_wire = sum(r["wire_bytes"] * r["count"] for r in red)
+    return wire, logical, red_wire, red_logical
+
+
+def run_single(variant):
+    import jax
+    import jax.numpy as jnp
+
+    def sync(x):
+        return float(jax.device_get(jnp.ravel(x)[0]))
+
+    try:
+        engine, batch, tokens = build(variant)
+        sync(engine.train_batch(batch))  # compile + settle
+        if not engine._overlap_active:
+            print(json.dumps({"variant": variant,
+                              "error": "overlap schedule did not engage: "
+                                       + engine._overlap_fallback}),
+                  flush=True)
+            return
+        w, l, rw, rl = wire_ratio(engine, batch)
+        sync(engine.train_batch(batch))
+        best = float("inf")
+        for _ in range(4):
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                loss = engine.train_batch(batch)
+            sync(loss)
+            leaf = jax.tree.leaves(engine.state["params"])[0]
+            sync(jnp.ravel(leaf)[0])
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({
+            "variant": variant, "best_window_s": best,
+            "tokens_per_sec": round(tokens * STEPS / best, 1),
+            "wire_bytes": w, "logical_bytes": l,
+            "wire_ratio": round(w / max(l, 1), 4),
+            "grad_reduce_wire_bytes": rw,
+            "grad_reduce_logical_bytes": rl,
+            "grad_reduce_wire_ratio": round(rw / max(rl, 1), 4),
+        }), flush=True)
+    except Exception as e:  # noqa: BLE001 — a crashed variant is a result
+        print(json.dumps({"variant": variant,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+
+
+def main():
+    if "--single" in sys.argv:
+        return run_single(sys.argv[sys.argv.index("--single") + 1])
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ab_common import run_interleaved
+
+    best = run_interleaved(
+        ["quant", "off"],
+        lambda name: [sys.executable, os.path.abspath(__file__),
+                      "--single", name],
+        rounds=2, timeout=2400)
+    if "quant" in best and "off" in best:
+        q, o = best["quant"], best["off"]
+        print(json.dumps({
+            "metric": "quantized transport speedup (tokens/sec ratio) "
+                      "+ grad-reduce wire reduction",
+            "vs_quant_off": round(q["tokens_per_sec"]
+                                  / o["tokens_per_sec"], 3),
+            "quant_tokens_per_sec": q["tokens_per_sec"],
+            "off_tokens_per_sec": o["tokens_per_sec"],
+            "grad_reduce_wire_reduction": round(
+                1.0 - q["grad_reduce_wire_bytes"]
+                / max(o["grad_reduce_wire_bytes"], 1), 4),
+            "wire_ratio_quant": q["wire_ratio"],
+            "wire_ratio_off": o["wire_ratio"],
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

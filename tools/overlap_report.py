@@ -15,16 +15,20 @@ compute:
   wire payloads (quantized collectives count their quantized bytes).
 - **runtime** — the ``dist.record_collective`` ledger captured at trace
   time: the schedule classes the comm layer *declares* (TreeComm's
-  overlapped/exposed tags, pipeline edges marked exposed). Bytes follow
-  the logger's full-precision convention.
+  overlapped/exposed tags, pipeline edges marked exposed). Since ISSUE 8
+  every record carries ``wire_bytes`` (the transport plan's on-link
+  payload: int8 + scale sideband under quantized transport), and the
+  ledger split charges WIRE bytes — the same convention as the static
+  side, which reads actual HLO operand bytes.
 
-The two use different byte conventions, so the comparable number is the
-overlapped FRACTION of each split — the tier-1 parity test
-(tests/unit/analysis/test_schedule_audit.py) holds them within 10% on
-the pipelined ZeRO entry. A growing gap means either the compiler
-stopped scheduling the overlap the comm layer promises (static drops),
-or the comm layer's tags rot (runtime drifts) — this scoreboard is the
-human-readable view for ROADMAP items 1-2.
+The comparable number is the overlapped FRACTION of each split — the
+tier-1 parity test (tests/unit/analysis/test_schedule_audit.py) holds
+them within 10% on the pipelined ZeRO entry. A growing gap means either
+the compiler stopped scheduling the overlap the comm layer promises
+(static drops), or the comm layer's tags rot (runtime drifts) — this
+scoreboard is the human-readable view for ROADMAP items 1-2. The
+wire-vs-logical ratio line is the transport planner's byte win
+(docs/COLLECTIVES.md).
 """
 
 import argparse
@@ -46,10 +50,15 @@ def report_entry(name: str) -> int:
     from deepspeed_tpu.analysis.entry_points import build_spec
     from deepspeed_tpu.analysis.schedule_audit import (
         CLASS_EXPOSED, CLASS_OVERLAPPED, CLASS_SERIALIZED,
-        audit_spec_schedule, trace_runtime_split)
+        audit_spec_schedule, trace_runtime_ledger)
 
     spec = build_spec(name)
-    runtime = trace_runtime_split(spec)
+    # ONE trace serves both views: jax caches traces per (fn, avals), so
+    # a second eval_shape would record nothing (trace_runtime_ledger)
+    ledger = trace_runtime_ledger(spec)
+    runtime = ledger.split()
+    logical = sum(r["bytes"] * r["count"] for r in ledger.records)
+    wire = sum(r["wire_bytes"] * r["count"] for r in ledger.records)
     findings, rep = audit_spec_schedule(spec)
     if rep is None:
         print(f"{name}: schedule audit failed:", file=sys.stderr)
@@ -78,6 +87,10 @@ def report_entry(name: str) -> int:
         delta = abs(sf - rf)
         verdict = "OK (<= 0.10)" if delta <= 0.10 else "DRIFT (> 0.10)"
         print(f"{'parity delta':28}{delta:>24.3f}{verdict:>20}")
+    if logical:
+        print(f"{'wire / logical bytes':28}"
+              f"{f'{wire} / {logical}':>24}"
+              f"{wire / logical:>20.3f}")
     print(f"\nper-collective placement ({len(rep.records)} in schedule "
           f"order; x = executions from loop trip counts):")
     for r in rep.records:

@@ -32,6 +32,10 @@ line):
       planner's int8 grad wire + hierarchical decomposition, default-on)
       vs full-width flat (DSTPU_COMM_QUANT=0, fresh subprocess
       denominator)                             -> tokens/sec + vs_quant_off
+  [11c] GPT-2 125M ZeRO-3 overlap, map-driven OVERLAP PLANNER (ISSUE 9:
+      edge-split head launches + deferred replicated flush, default-on)
+      vs the hand-written schedule (DSTPU_OVERLAP_PLAN=0, fresh
+      subprocess denominator)                  -> tokens/sec + vs_plan_off
   [12] FULL-DEPTH llama2-7b (32 layers, real dims) int4 WOQ + fp8 KV,
       16 requests, served from a real-format HF checkpoint dir via
       build_hf_engine + continuous batching    -> output tok/s + TTFT
@@ -479,7 +483,7 @@ def bench_attn_32k(peak_tflops):
     return line
 
 
-N_TPU_RUNS = 19     # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 20     # build_runs(on_tpu=True) length — asserted in child mode
 N_SERVING_RUNS = 6  # ... of which the LAST SIX are serving lines
 #                     (7B 512-prompt, 7B long-context, MoE-6req, and the
 #                     32/64/128 concurrency ladder) — one sample
@@ -627,6 +631,30 @@ def _zero_overlap_denominator():
         _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3, peak))
 
 
+def _overlap_plan_denominator():
+    """Child mode: the SAME gpt2-125m stage-3 pipelined schedule with the
+    overlap PLANNER's escape hatch (DSTPU_OVERLAP_PLAN=0 — the
+    hand-written PR 3 schedule: no edge split, no deferred replicated
+    flush, no EF carry), in a fresh process (HBM isolation). The
+    pipelined schedule and the transport defaults stay ON: the only
+    variable is the planner's placement decisions."""
+    os.environ["DSTPU_OVERLAP_PLAN"] = "0"
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import gpt2_model
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
+    steps = 30 if on_tpu else 3
+    _emit(bench_train(
+        "gpt2-125m ZeRO-3 hand-schedule (denominator)",
+        gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+        _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3, peak))
+
+
 def main():
     if "--offload-denominator" in sys.argv:
         return _offload_denominator()
@@ -634,6 +662,8 @@ def main():
         return _zero_overlap_denominator()
     if "--comm-quant-denominator" in sys.argv:
         return _comm_quant_denominator()
+    if "--overlap-plan-denominator" in sys.argv:
+        return _overlap_plan_denominator()
     if "--one" not in sys.argv and _probe_backend() not in ("cpu",):
         return _dispatch_tpu()  # client-free parent
     return _run_configs()
@@ -985,6 +1015,39 @@ def _run_configs():
                 line["quant_off_tokens_per_sec"] = off_line["value"]
             return line
         runs.append(comm_quant_run)
+
+        def overlap_plan_run():
+            # Map-driven overlap planner (ISSUE 9 tentpole): the SAME
+            # gpt2-125m stage-3 pipelined step, planner ON (edge-split
+            # head launches, deferred replicated flush, map-derived
+            # prefetch) vs the hand-written PR 3 schedule in its OWN
+            # subprocess (DSTPU_OVERLAP_PLAN=0,
+            # _overlap_plan_denominator) — the placement decisions are
+            # the only variable. Acceptance: numerics-equal (tier-1
+            # test_zero_overlap), step time no worse (vs_plan_off >=
+            # ~1.0); the byte-placement win is pinned statically by the
+            # exposure budgets.
+            line = bench_train(
+                "gpt2-125m ZeRO-3 overlap PLANNER bf16",
+                gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+                _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3,
+                peak, note=", map-driven overlap plan (scan-carry + "
+                           "edge split)")
+            import subprocess
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--overlap-plan-denominator"],
+                    capture_output=True, text=True, timeout=2400)
+                off_line = _last_metric_line(r.stdout)
+            except subprocess.TimeoutExpired:
+                off_line = None
+            if off_line and off_line.get("value"):
+                line["vs_plan_off"] = round(
+                    line["value"] / off_line["value"], 3)
+                line["plan_off_tokens_per_sec"] = off_line["value"]
+            return line
+        runs.append(overlap_plan_run)
 
         def serving_7b_run():
             # FULL-DEPTH llama2-7b (32 layers, real dims) at int8 WOQ

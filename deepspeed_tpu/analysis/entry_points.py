@@ -219,21 +219,32 @@ def build_moe_dispatch() -> EntrySpec:
     constraints over the expert axis — those specs must name canonical axes
     of the configured topology, and the partitioner materializes the
     exchange (all-to-all/permute/gather + the combine all-reduce), which is
-    the declared expected_spmd set."""
+    the declared expected_spmd set. Since ISSUE 9 the input rides the data
+    axis (the production layout, where dispatch is a REAL exchange) and
+    the overlap planner's scan-carry chunking pipelines that exchange
+    under expert compute — the entry declares an ``overlap_contract``:
+    the dispatch-side bytes must stay hidden, the combine-side epilogue
+    is the budget-justified edge (tools/exposure_budgets.json)."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
     from deepspeed_tpu.moe.layer import MoE
     from deepspeed_tpu.runtime import topology as topo_mod
-    from deepspeed_tpu.runtime.topology import TopologyConfig
+    from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
 
     topo = topo_mod.initialize(TopologyConfig(expert=2, data=-1), force=True)
-    moe = MoE(hidden_size=16, intermediate_size=32, num_experts=4, top_k=2)
+    # intermediate 64: a representative FFN-to-exchange ratio (real MoE
+    # FFNs are 2-4x hidden) — the dispatch chunk must have enough expert
+    # compute beside it to classify overlapped on the audit mesh
+    moe = MoE(hidden_size=16, intermediate_size=64, num_experts=4, top_k=2)
     params = moe.init(jax.random.PRNGKey(0))
-    x = jnp.zeros((4, 8, 16), jnp.float32)
+    x = jax.device_put(jnp.zeros((4, 8, 16), jnp.float32),
+                       NamedSharding(topo.mesh, P(DATA_AXIS)))
     args = (params, x)
     return EntrySpec(
         name="moe-dispatch", fn=lambda p, t: moe(p, t)[0], args=args,
         mesh=topo.mesh, retrace_args=[args, args], gate_cheap=True,
+        overlap_contract=True,
         expected_spmd=frozenset({"all-reduce", "all-gather", "all-to-all",
                                  "collective-permute"}))
 
@@ -258,7 +269,13 @@ def build_ring_attention() -> EntrySpec:
 
 def build_ulysses_attention() -> EntrySpec:
     """Ulysses: the head-scatter/seq-gather all-to-alls over the seq axis —
-    explicit in the source jaxpr, so expected_spmd is empty."""
+    explicit in the source jaxpr, so expected_spmd is empty. Since ISSUE 9
+    the exchanges ride the transport planner's activation-kind bf16 wire
+    (half the exposed bytes) and the entry declares an
+    ``overlap_contract``: the reshard is a dependence chain, so its
+    remaining exposure is budget-pinned rather than hideable — a byte
+    REGRESSION (e.g. the wire silently reverting to full width) is the
+    hard ``exposed-collective`` finding."""
     import jax.numpy as jnp
     from deepspeed_tpu.runtime import topology as topo_mod
     from deepspeed_tpu.runtime.topology import TopologyConfig
@@ -271,12 +288,18 @@ def build_ulysses_attention() -> EntrySpec:
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / q.shape[-1] ** 0.5
         return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
 
+    # at this toy size the exchange sits below the transport planner's
+    # min_bytes floor, so the audited wire is full width by DESIGN (tiny
+    # exchanges are latency-bound; narrowing buys nothing) — the bf16
+    # activation wire is pinned by tests/unit/runtime/test_ulysses.py,
+    # whose payloads clear the floor
     q = jnp.zeros((4, 8, 4, 8), jnp.float32)
     args = (q, q, q)
     # attn is a static callable, not a traced array — close over it.
     return EntrySpec(name="ulysses-attention",
                      fn=lambda q, k, v: ulysses_attention(attn, q, k, v),
-                     args=args, retrace_args=[args, args], gate_cheap=True)
+                     args=args, retrace_args=[args, args], gate_cheap=True,
+                     overlap_contract=True)
 
 
 def build_flash_kernel() -> EntrySpec:

@@ -670,6 +670,11 @@ def all_to_all(tensor, axis: AxisNames = SEQ_AXIS, split_axis: int = 0,
     _record("all_to_all", tensor, axis, plan=plan)
     wire = tensor
     if plan.width == WIDTH_BF16 and tensor.dtype.itemsize > 2:
+        # NOTE: TPU backends move this natively at bf16; the CPU audit
+        # backend LEGALIZES a bf16 all-to-all back to an f32 wire
+        # wrapped in converts (values still bf16-rounded), so the
+        # committed Layer-D maps charge these launches full width —
+        # the ledger's wire_bytes column carries the plan's real wire
         wire = tensor.astype(jnp.bfloat16)
     out = jax.lax.all_to_all(wire, axis, split_axis=split_axis,
                              concat_axis=concat_axis, tiled=True)

@@ -162,6 +162,15 @@ class TransformerConfig:
 
 class TransformerLM:
 
+    #: top-level param keys :meth:`embed` reads — the overlap planner's
+    #: edge-split schedule (engine._build_zeropp_micro_overlap) keeps
+    #: exactly these leaves at the exposed step edges and hoists every
+    #: other rest leaf across the block scans. MUST stay in sync with
+    #: embed(): a leaf embed reads but this tuple omits would be
+    #: classified head-side and its embed-path gradient silently dropped
+    #: (the split differentiates embed only w.r.t. these leaves).
+    embed_param_keys = ("wte", "wpe", "ln_emb", "wtt")
+
     def __init__(self, config: TransformerConfig):
         self.config = config
         c = config
@@ -499,7 +508,8 @@ class TransformerLM:
                               keep: Optional[jax.Array] = None,
                               attn_mask: Optional[jax.Array] = None,
                               layers_per_step: int = 1,
-                              comm_scope=None, comm_edge=None):
+                              comm_scope=None, comm_edge=None,
+                              scatter_err=None):
         """Layer-granular ZeRO overlap schedule over SHARDED stacked block
         params (the engine's pipelined ZeRO++/stage-3 micro step; see
         runtime/zero/overlap.py for the comm half).
@@ -534,6 +544,18 @@ class TransformerLM:
         epilogue grad flush, which have no compute to hide under — so
         they are recorded exposed rather than inheriting the tree's
         blanket class; the engine passes ``TreeComm.schedule_class``.
+
+        ``scatter_err`` (optional; the overlap planner's error-feedback
+        carry, runtime/overlap_planner.py) is a pytree whose leaves have
+        a leading ``n_steps`` dim: per-step quantization residual state
+        for ``scatter``. When provided, ``scatter(tree, err=slice)``
+        must return ``(tree, new_err)``; step *s*'s slice rides the
+        backward scan's xs/ys (the launch at reverse iteration *s*
+        scatters step *s+1*'s grads, so xs carry ``scatter_err[1:]`` and
+        the epilogue flush consumes slot 0) and ``pullback`` returns the
+        updated stack as a THIRD element — the engine threads it through
+        the micro-step carry so residuals telescope across accumulation
+        steps (docs/COLLECTIVES.md "Error feedback").
 
         Returns ``(x_out, moe_aux_sum, pullback)``.
         """
@@ -587,6 +609,15 @@ class TransformerLM:
             (x_out, pf_last, aux_sum), acts = jax.lax.scan(
                 fwd_body, (x, pf0, jnp.zeros((), jnp.float32)), xs)
 
+        # error-feedback carry plumbing: without scatter_err the scatter
+        # call and the return arity are EXACTLY the pre-planner form
+        if scatter_err is None:
+            scat = lambda t, e: (scatter(t), None)
+            take_err = lambda i: None
+        else:
+            scat = lambda t, e: scatter(t, err=e)
+            take_err = lambda i: jax.tree.map(lambda a: a[i], scatter_err)
+
         def pullback(dx_out, daux):
             daux_ = jnp.asarray(daux, jnp.float32)
             wb_last = None if winb is None else winb[-1]
@@ -601,8 +632,11 @@ class TransformerLM:
                 lambda a: a.reshape((L,) + a.shape[2:]), t)
             if n_steps == 1:
                 with edge(False):  # epilogue flush: step's last launch
-                    ds0 = scatter(dp)
-                return unbundle(jax.tree.map(lambda a: a[None], ds0)), dx
+                    ds0, ne0 = scat(dp, take_err(0))
+                dblocks = unbundle(jax.tree.map(lambda a: a[None], ds0))
+                if scatter_err is None:
+                    return dblocks, dx
+                return dblocks, dx, jax.tree.map(lambda a: a[None], ne0)
             pb0 = gather(take(blocksb, n_steps - 2))
             # reverse prefetch: slot s carries step s-1's shard (slot 0 a
             # dead self-gather — the price of one scan body shape)
@@ -613,29 +647,39 @@ class TransformerLM:
                     "keep": keepb[:n_steps - 1]}
             if winb is not None:
                 xs_b["win"] = winb[:n_steps - 1]
+            if scatter_err is not None:
+                # reverse iteration s scatters step s+1's grads, so its
+                # xs slot carries residual stack slice [1:]; slot 0 is
+                # the epilogue flush's
+                xs_b["err"] = jax.tree.map(lambda a: a[1:], scatter_err)
 
             def bwd_body(carry, xs_s):
                 dxx, pb, pending = carry
                 # layer l+1's grads reduce-scatter while layer l computes
-                ds_prev = scatter(pending)
+                ds_prev, ne = scat(pending, xs_s.get("err"))
                 nb = gather(xs_s["shard"])
                 _, vjp_f = jax.vjp(
                     lambda p, xx: unit_call(p, xx, xs_s["keep"],
                                             xs_s.get("win")),
                     pb, xs_s["act"])
                 dp_s, dxx_new = vjp_f((dxx, daux_))
-                return (dxx_new, nb, dp_s), ds_prev
+                return (dxx_new, nb, dp_s), (ds_prev, ne)
 
             with scope(n_steps - 1):
-                (dx0, _, pending0), ds_stack = jax.lax.scan(
+                (dx0, _, pending0), (ds_stack, ne_stack) = jax.lax.scan(
                     bwd_body, (dx, pb0, dp), xs_b, reverse=True)
             with edge(False):  # epilogue: flush step 0's grads, exposed
-                ds0 = scatter(pending0)
+                ds0, ne0 = scat(pending0, take_err(0))
             # ds_stack[s] holds step s+1's sharded grads; step 0 is ds0
             dblocksb = jax.tree.map(
                 lambda h, t: jnp.concatenate([h[None], t], axis=0),
                 ds0, ds_stack)
-            return unbundle(dblocksb), dx0
+            if scatter_err is None:
+                return unbundle(dblocksb), dx0
+            new_err = jax.tree.map(
+                lambda h, t: jnp.concatenate([h[None], t], axis=0),
+                ne0, ne_stack)
+            return unbundle(dblocksb), dx0, new_err
 
         return x_out, aux_sum, pullback
 

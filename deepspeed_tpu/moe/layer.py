@@ -73,6 +73,24 @@ class MoE:
             out["wi"] = expert_w
         return out
 
+    def _expert_ffn(self, params: Params, expert_in: jax.Array,
+                    dtype) -> jax.Array:
+        """The expert FFN as batched einsums over the (expert-sharded)
+        expert dim — the grouped-GEMM on the MXU. Operates on any
+        capacity extent, so the overlap planner's chunked dispatch can
+        run it per capacity chunk (bitwise: each slot's row contracts
+        the same operands either way)."""
+        if self.activation == "silu_gated":
+            gate = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in,
+                                          params["wi_gate"].astype(dtype)))
+            up = jnp.einsum("ech,ehf->ecf", expert_in,
+                            params["wi_up"].astype(dtype))
+            mid = gate * up
+        else:
+            mid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in,
+                                         params["wi"].astype(dtype)))
+        return jnp.einsum("ecf,efh->ech", mid, params["wo"].astype(dtype))
+
     def __call__(self, params: Params, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
         """x: [batch, seq, hidden] → (out, aux_loss)."""
         b, s, h = x.shape
@@ -122,27 +140,21 @@ class MoE:
         # row would too). DSTPU_MOE_MASK_PAD=1 forces the masked form
         # (trace-time; for A/B).
         import os
-        gathered = tokens[jnp.maximum(src - 1, 0)]
-        if x.dtype == jnp.float16 or os.environ.get("DSTPU_MOE_MASK_PAD") == "1":
-            gathered = jnp.where((src > 0)[:, None], gathered,
-                                 jnp.zeros((), x.dtype))
-        if pipelined:
-            gathered = _c(gathered, P(None, None))
-        expert_in = gathered.reshape(e, cap, h)
         # Dispatch/combine transport plan (ISSUE 8, docs/COLLECTIVES.md):
-        # the expert exchange is GSPMD-mediated (the constraint below makes
+        # the expert exchange is GSPMD-mediated (the constraints below make
         # the partitioner emit the all-to-all), so the wire narrows by
         # CASTING the dispatched activations — bf16 by default, exact
         # no-op when the model already computes in a <=2-byte dtype. Only
         # a live expert axis pays an exchange; without one the cast would
         # cost accuracy for zero wire bytes.
         from .. import comm as dist
+        from ..runtime import overlap_planner as op_mod
         live_ep = (topo_mod.is_initialized()
                    and topo_mod.get_topology().expert_parallel_size > 1)
         wire_dtype = None
         if live_ep and x.dtype.itemsize > 2:
             tp = dist.resolve_transport(
-                "activation", "all_to_all", expert_in.size * x.dtype.itemsize,
+                "activation", "all_to_all", e * cap * h * x.dtype.itemsize,
                 (EXPERT_AXIS,))
             if tp.width == "bf16":
                 wire_dtype = jnp.bfloat16
@@ -152,19 +164,77 @@ class MoE:
                 return _c(t, spec)
             return _c(t.astype(wire_dtype), spec).astype(x.dtype)
 
-        # all-to-all over ICI: expert dim sharded across the expert axis
-        expert_in = _exchange(expert_in, P(EXPERT_AXIS, BATCH_AXES, None))
+        mask_pad = (x.dtype == jnp.float16
+                    or os.environ.get("DSTPU_MOE_MASK_PAD") == "1")
 
-        # expert FFN as batched einsum over the (sharded) expert dim
-        if self.activation == "silu_gated":
-            gate = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in,
-                                          params["wi_gate"].astype(x.dtype)))
-            up = jnp.einsum("ech,ehf->ecf", expert_in, params["wi_up"].astype(x.dtype))
-            mid = gate * up
+        # Overlap plan (ISSUE 9, runtime/overlap_planner.py): the planner's
+        # scan-carry placement chunks the dispatch over the CAPACITY dim —
+        # chunk c+1's token gather + expert exchange are issued from the
+        # scan carry while chunk c's expert FFN computes, so the dispatch
+        # wire hides under expert compute instead of fully preceding it.
+        # Exact: each slot's gather row and FFN contraction are identical;
+        # only launch placement changes. The combine-side exchange stays
+        # at the epilogue (every token's k slots span all chunks — there
+        # is no per-chunk combine without masked re-gathers), which is the
+        # entry's budget-justified edge exposure. Chunking is clamped to a
+        # divisor of the capacity and skipped entirely under pipeline
+        # composition (the stage vmap pins its own constraints) or a dead
+        # expert axis.
+        plan = op_mod.plan_for("moe-dispatch")
+        # the plan decides PLACEMENT; the chunk count scales with THIS
+        # layer's actual exchange bytes (the committed n_chunks records
+        # the audit entry's decision, not a production layer's)
+        nc = (op_mod.moe_chunks_for_bytes(e * cap * h * x.dtype.itemsize)
+              if (plan.placement == op_mod.PLACEMENT_SCAN_CARRY
+                  and live_ep and not pipelined) else 1)
+        while nc > 1 and cap % nc:
+            nc -= 1
+
+        if nc > 1:
+            src_chunks = src.reshape(e, nc, cap // nc).transpose(1, 0, 2)
+
+            def fetch(sc):
+                flat = sc.reshape(-1)
+                g = tokens[jnp.maximum(flat - 1, 0)]
+                if mask_pad:
+                    g = jnp.where((flat > 0)[:, None], g,
+                                  jnp.zeros((), x.dtype))
+                return _exchange(g.reshape(e, cap // nc, h),
+                                 P(EXPERT_AXIS, BATCH_AXES, None))
+
+            chunk_elems = e * (cap // nc) * h
+            wire = chunk_elems * (2 if wire_dtype is not None
+                                  else x.dtype.itemsize)
+            logical = chunk_elems * x.dtype.itemsize
+            # prologue fetch is the pipeline edge (nothing to hide it);
+            # the in-scan prefetches overlap the previous chunk's FFN
+            dist.record_collective("all_to_all", logical, (EXPERT_AXIS,),
+                                   overlapped=False, wire_bytes=wire)
+            dist.record_collective("all_to_all", logical, (EXPERT_AXIS,),
+                                   overlapped=True, count=nc - 1,
+                                   wire_bytes=wire)
+            cur = fetch(src_chunks[0])
+
+            def body(carry, sc):
+                nxt = fetch(sc)  # independent of the FFN below
+                return nxt, self._expert_ffn(params, carry, x.dtype)
+
+            last, ys = jax.lax.scan(body, cur, src_chunks[1:])
+            y_last = self._expert_ffn(params, last, x.dtype)
+            expert_out = jnp.concatenate([ys, y_last[None]], axis=0)
+            expert_out = expert_out.transpose(1, 0, 2, 3).reshape(e, cap, h)
         else:
-            mid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in,
-                                         params["wi"].astype(x.dtype)))
-        expert_out = jnp.einsum("ecf,efh->ech", mid, params["wo"].astype(x.dtype))
+            gathered = tokens[jnp.maximum(src - 1, 0)]
+            if mask_pad:
+                gathered = jnp.where((src > 0)[:, None], gathered,
+                                     jnp.zeros((), x.dtype))
+            if pipelined:
+                gathered = _c(gathered, P(None, None))
+            expert_in = gathered.reshape(e, cap, h)
+            # all-to-all over ICI: expert dim sharded across the expert axis
+            expert_in = _exchange(expert_in, P(EXPERT_AXIS, BATCH_AXES, None))
+            # expert FFN as batched einsum over the (sharded) expert dim
+            expert_out = self._expert_ffn(params, expert_in, x.dtype)
 
         # inverse all-to-all + combine back to tokens: per-token gather of
         # its k slots, weighted sum — O(tokens*k*hidden). The return

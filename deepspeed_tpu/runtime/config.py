@@ -198,6 +198,11 @@ class DeepSpeedConfig:
         # ``comm.configure_transport`` — an invalid key/width raises at
         # engine build, not at first traced launch.
         self.comm_transport: dict = dict(pd.get("comm_transport", {}))
+        # map-driven overlap planner (runtime/overlap_planner.py, docs/
+        # OVERLAP_PLANNER.md): ``overlap_plan: false`` reverts every
+        # schedule builder to the hand-written pre-planner pipelines
+        # bitwise (same contract as DSTPU_OVERLAP_PLAN=0).
+        self.overlap_plan: bool = bool(pd.get("overlap_plan", True))
         # telemetry subsystem (telemetry/): off by default; the
         # DSTPU_TELEMETRY env var overrides either way at build time
         from ..telemetry.config import TelemetryConfig

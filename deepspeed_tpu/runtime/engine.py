@@ -456,26 +456,48 @@ class DeepSpeedEngine:
         from .. import comm as dist
         if config.comms_logger_enabled:
             dist.configure(config=config)
+        # install the overlap-planner config flag process-wide so the
+        # engineless consumers (moe/layer.py, sequence/layer.py) honor
+        # `overlap_plan: false` too — engine call sites still pass their
+        # own config explicitly
+        from .overlap_planner import configure_planner
+        configure_planner(config.overlap_plan)
         if config.comm_transport:
             # install the transport-planner policy BEFORE any micro step
             # traces (plans are resolved at trace time); invalid keys or
             # widths raise here, at engine build
             dist.configure_transport(**config.comm_transport)
             if config.comm_transport.get("error_feedback"):
-                # the residual carry is functional state the scan-based
-                # micro schedules do not thread yet (ROADMAP item 2's
-                # compiler-map planner owns that restructuring) — EF
-                # applies today to TreeComm.scatter(err=...) callers;
-                # see docs/COLLECTIVES.md "Error feedback"
-                logger.warning(
-                    "comm_transport.error_feedback: the engine micro "
-                    "schedules do not carry the residual state yet; "
-                    "error feedback is active only for explicit "
-                    "TreeComm.scatter(err=...) callers")
+                # the overlap planner threads the residual state through
+                # the pipelined micro's scan carries (ISSUE 9, closing the
+                # ROADMAP item 1(a) deferral) — but ONLY there: the
+                # barrier schedule, the fused GSPMD step and a disabled
+                # planner still leave EF to explicit
+                # TreeComm.scatter(err=...) callers. Whether the carry is
+                # actually LIVE is known only when the micro builds
+                # (overlap eligibility, int8-eligible buckets) — the
+                # builder logs the definitive slot count then; this is
+                # only the definite-no warning.
+                from .overlap_planner import planner_enabled
+                may_carry = (self._explicit_micro
+                             and bool(self.config.zero_config.overlap_comm)
+                             and planner_enabled(self.config.overlap_plan))
+                if not may_carry:
+                    logger.warning(
+                        "comm_transport.error_feedback: this engine's "
+                        "schedule does not carry the residual state "
+                        "(pipelined micro + overlap planner required); "
+                        "error feedback is active only for explicit "
+                        "TreeComm.scatter(err=...) callers")
 
         self._jit_micro_step = None
         self._jit_apply_step = None
         self._jit_train_step = None
+        # overlap-planner state (set for real when the pipelined micro
+        # builds; defaults keep non-overlap engines on the plain carry)
+        self._ef_carry_active = False
+        self._ef_state = None
+        self._overlap_plan = None
 
     # ------------------------------------------------------------------
     # telemetry construction
@@ -1312,7 +1334,8 @@ class DeepSpeedEngine:
         return micro_step
 
     def _build_zeropp_micro_overlap(self):
-        """The layer-granular pipelined micro step (ISSUE 3 tentpole).
+        """The layer-granular pipelined micro step (ISSUE 3 tentpole;
+        ISSUE 9 made it the overlap PLANNER's first client).
 
         Same shard_map signature and gradient math as the barrier schedule,
         but the block-stack gather/compute/scatter is restructured around
@@ -1323,11 +1346,34 @@ class DeepSpeedEngine:
         layer *l*'s gradient reduce-scatter is issued during layer *l−1*'s
         backward compute. Collectives are bucket-planned
         (``reduce_bucket_size``/``allgather_bucket_size``) so small leaves
-        fuse into one launch and huge leaves split for pipelining. The
-        embedding/head ("rest") leaves keep whole-tensor collectives at the
-        step's edges, where no compute exists to hide them.
+        fuse into one launch and huge leaves split for pipelining.
+
+        The schedule's parameters now come from the map-driven
+        :class:`~..runtime.overlap_planner.OverlapPlan` for
+        ``zeropp-micro-overlap`` (runtime/overlap_planner.py,
+        docs/OVERLAP_PLANNER.md) instead of being hand-pinned:
+
+        - **edge split** (``split_edge_leaves``): head-side rest leaves
+          (final norm, an untied LM head — often the step's largest
+          reduce, i.e. the optimizer-step reduce) gather BEFORE the
+          forward scan and scatter BEFORE the backward scan, so the
+          scans' FLOPs hide them; only the embed-side leaves keep truly
+          exposed edge launches.
+        - **deferred replicated flush** (``defer_replicated``):
+          replicated-w.r.t.-dp block leaves stop paying one psum per
+          scan iteration — their grads leave the scan locally and fuse
+          into ONE flat boundary all-reduce (exact).
+        - **error-feedback carry** (``carry_error_feedback`` + the
+          ``comm_transport.error_feedback`` policy): the PR 8 residual
+          state rides the backward scan's xs/ys and the micro-step
+          carry, closing the ROADMAP item 1(a) deferral.
+
+        ``DSTPU_OVERLAP_PLAN=0`` / ``overlap_plan: false`` pins the
+        identity plan — the hand-written PR 3 schedule, bitwise.
         """
         from ..utils.jax_compat import shard_map
+        from .. import comm as dist
+        from . import overlap_planner as op_mod
         from .zero.overlap import build_tree_comm
 
         mesh = self.mesh
@@ -1338,6 +1384,13 @@ class DeepSpeedEngine:
          gather_src_specs) = self._zeropp_micro_env()
         axis_sizes = dict(self.topology.mesh.shape)
         is_p = lambda s: isinstance(s, P)
+
+        plan = op_mod.plan_for("zeropp-micro-overlap",
+                               config_flag=self.config.overlap_plan)
+        planned = plan.placement == op_mod.PLACEMENT_SCAN_CARRY
+        self._overlap_plan = plan
+        ag_bucket = plan.allgather_bucket or zc.allgather_bucket_size
+        rs_bucket = plan.reduce_bucket or zc.reduce_bucket_size
 
         c = model.config
         L = int(c.num_layers)
@@ -1373,18 +1426,49 @@ class DeepSpeedEngine:
             axis_sizes=axis_sizes, all_dp=all_dp, n_dp=n_dp,
             quant_weights=zc.zero_quantized_weights,
             quant_grads=zc.zero_quantized_gradients,
-            allgather_bucket=zc.allgather_bucket_size,
-            reduce_bucket=zc.reduce_bucket_size,
-            overlapped=True, name="blocks")
-        rest_comm = build_tree_comm(
-            rest_src_specs, rest_grad_specs, rest_struct,
-            axis_sizes=axis_sizes, all_dp=all_dp, n_dp=n_dp,
-            quant_weights=zc.zero_quantized_weights,
-            quant_grads=zc.zero_quantized_gradients,
-            allgather_bucket=zc.allgather_bucket_size,
-            reduce_bucket=zc.reduce_bucket_size,
-            overlapped=False, name="rest")
-        oversize = blk_comm.oversize + rest_comm.oversize
+            allgather_bucket=ag_bucket, reduce_bucket=rs_bucket,
+            overlapped=True, name="blocks",
+            defer_replicated=planned and plan.defer_replicated)
+
+        # the MODEL declares which rest leaves its embed() reads
+        # (TransformerLM.embed_param_keys — defined next to embed so the
+        # two cannot silently drift); a model family without the
+        # declaration gets no edge split rather than a wrong one
+        embed_keys = getattr(model, "embed_param_keys", None)
+        head_keys = (tuple(k for k in rest_struct if k not in embed_keys)
+                     if embed_keys is not None else ())
+        use_split = (planned and plan.split_edge_leaves and bool(head_keys))
+        pick = lambda tree, keys: {k: tree[k] for k in tree if k in keys}
+        drop = lambda tree, keys: {k: tree[k] for k in tree
+                                   if k not in keys}
+
+        def rest_tree_comm(subtree_of, overlapped, name):
+            return build_tree_comm(
+                subtree_of(rest_src_specs), subtree_of(rest_grad_specs),
+                subtree_of(rest_struct),
+                axis_sizes=axis_sizes, all_dp=all_dp, n_dp=n_dp,
+                quant_weights=zc.zero_quantized_weights,
+                quant_grads=zc.zero_quantized_gradients,
+                allgather_bucket=ag_bucket, reduce_bucket=rs_bucket,
+                overlapped=overlapped, name=name)
+
+        if use_split:
+            # head-side leaves HOIST across the scans (straight-line
+            # placement): gathered before the forward scan / scattered
+            # before the backward scan, their launches sit beside
+            # independent scan compute — recorded (and, in the compiled
+            # schedule, classified) overlapped
+            embed_comm = rest_tree_comm(
+                lambda t: drop(t, head_keys), False, "rest-embed")
+            head_comm = rest_tree_comm(
+                lambda t: pick(t, head_keys), True, "rest-head")
+            rest_comms = (embed_comm, head_comm)
+        else:
+            rest_comm = rest_tree_comm(lambda t: t, False, "rest")
+            rest_comms = (rest_comm,)
+
+        oversize = blk_comm.oversize + sum(
+            (cm.oversize for cm in rest_comms), [])
         if oversize and not getattr(self, "_bucket_warned", False):
             # warn ONCE instead of silently ignoring the knob (satellite):
             # these leaves exceed the bucket even after the best split
@@ -1394,28 +1478,82 @@ class DeepSpeedEngine:
                 f"allgather/reduce bucket sizes even after splitting "
                 f"(first: {oversize[0]}) — raise the bucket knobs or "
                 f"accept single oversized launches")
-        log_dist(f"zero overlap schedule: {L} layers x {lps}/step; "
-                 f"{blk_comm.plan_summary()}; {rest_comm.plan_summary()}",
-                 ranks=[0])
+        log_dist(
+            f"zero overlap schedule ({'plan: ' + plan.summary() if planned else 'hand'}): "
+            f"{L} layers x {lps}/step; {blk_comm.plan_summary()}; "
+            + "; ".join(cm.plan_summary() for cm in rest_comms), ranks=[0])
+
+        # --- error-feedback residual carry (the planner owns the scan
+        # carries, so the PR 8 state can finally ride them) -------------
+        ef_on = (planned and plan.carry_error_feedback
+                 and bool(dist.transport_config()["error_feedback"]))
+        ef_local_struct = None
+        if ef_on:
+            stack_step = lambda s: (None if s is None else
+                                    jax.ShapeDtypeStruct(
+                                        (n_steps,) + tuple(s.shape), s.dtype))
+            ef_local_struct = {"blocks": [stack_step(s)
+                                          for s in blk_comm.err_struct()]}
+            if use_split:
+                ef_local_struct["rest_embed"] = embed_comm.err_struct()
+                ef_local_struct["rest_head"] = head_comm.err_struct()
+            else:
+                ef_local_struct["rest"] = rest_comm.err_struct()
+            if not jax.tree.leaves(ef_local_struct):
+                ef_on = False   # nothing EF-eligible (kill switch / fp8 /
+                ef_local_struct = None  # hierarchical-only buckets)
+        self._ef_carry_active = ef_on
+        # device-local state across shard_map calls: a leading dp axis
+        # (the 1-bit optimizers' worker_error precedent) makes each
+        # device's residual its own shard of one global array
+        self._ef_struct = None
+        self._ef_spec = None
+        if ef_on:
+            self._ef_struct = jax.tree.map(
+                lambda s: jax.ShapeDtypeStruct((n_dp,) + tuple(s.shape),
+                                               s.dtype), ef_local_struct)
+            self._ef_spec = jax.tree.map(lambda s: P(all_dp),
+                                         ef_local_struct)
+            log_dist("zero overlap schedule: error-feedback residuals ride "
+                     "the micro-step carry "
+                     f"({len(jax.tree.leaves(self._ef_struct))} slots)",
+                     ranks=[0])
 
         batch_rep = self._REPLICATED_BATCH_KEYS
 
-        def local_micro(param_shards, gacc_shards, scale, batch):
+        def local_micro(param_shards, gacc_shards, ef, scale, batch):
             rest_shards, blocks = split(param_shards)
             input_ids = batch["input_ids"]
             # loss ingredients SHARED with model.loss (derive_labels /
             # head_loss / combine_aux) so both schedules train the same
             # objective by construction
             labels = model.derive_labels(batch)
-            # edge-of-step leaves: gathered once, exposed (no compute yet)
-            rest_full = rest_comm.gather(rest_shards)
+            ef_local = (jax.tree.map(lambda a: a[0], ef)
+                        if ef is not None else None)
+            if use_split:
+                # head-side leaves launch EARLY — consumed only after the
+                # forward scan, whose compute hides them
+                head_full = head_comm.gather(pick(rest_shards, head_keys))
+                embed_full = embed_comm.gather(drop(rest_shards, head_keys))
+                rest_full = {**embed_full, **head_full}
+            else:
+                # edge-of-step leaves: gathered once, exposed (no compute
+                # yet)
+                rest_full = rest_comm.gather(rest_shards)
             positions = jnp.arange(input_ids.shape[1])[None, :]
 
-            def embed_f(rf):
-                x, _ = model.embed(rf, input_ids,
-                                   batch.get("token_type_ids"))
-                return x
-            x0, embed_vjp = jax.vjp(embed_f, rest_full)
+            if use_split:
+                def embed_f(ef_tree):
+                    x, _ = model.embed({**ef_tree, **head_full}, input_ids,
+                                       batch.get("token_type_ids"))
+                    return x
+                x0, embed_vjp = jax.vjp(embed_f, embed_full)
+            else:
+                def embed_f(rf):
+                    x, _ = model.embed(rf, input_ids,
+                                       batch.get("token_type_ids"))
+                    return x
+                x0, embed_vjp = jax.vjp(embed_f, rest_full)
 
             layer_mask = batch.get("layer_mask")
             x_out, aux_sum, pullback = model.scan_blocks_pipelined(
@@ -1424,36 +1562,104 @@ class DeepSpeedEngine:
                 keep=layer_mask, attn_mask=batch.get("attention_mask"),
                 layers_per_step=lps,
                 comm_scope=blk_comm.trace_executions,
-                comm_edge=blk_comm.schedule_class)
+                comm_edge=blk_comm.schedule_class,
+                scatter_err=(ef_local["blocks"] if ef_local is not None
+                             else None))
 
-            def head_f(rf, xx):
-                return model.head_loss(rf, xx, labels,
-                                       extra_mask=batch.get("loss_mask"))
-            ce, head_vjp = jax.vjp(head_f, rest_full, x_out)
-            loss = model.combine_aux(ce, aux_sum)
-            s = (scale / gas).astype(jnp.float32)
-            drf_h, dx_out = head_vjp(s)
+            s_ = (scale / gas).astype(jnp.float32)
             # d(loss)/d(aux) derived FROM combine_aux so a changed aux
             # weighting can never drift between the two schedules
-            daux = s * jax.grad(
+            daux = s_ * jax.grad(
                 lambda a: model.combine_aux(jnp.zeros(()), a))(
                     jnp.zeros(()))
-            dblocks, dx0 = pullback(dx_out, daux)
-            (drf_e,) = embed_vjp(dx0)
-            drest_full = jax.tree.map(jnp.add, drf_h, drf_e)
-            drest = rest_comm.scatter(drest_full)
-            grads = dict(drest)
+            new_ef = {}
+            if use_split:
+                def head_f(ef_tree, hf, xx):
+                    return model.head_loss({**ef_tree, **hf}, xx, labels,
+                                           extra_mask=batch.get("loss_mask"))
+                ce, head_vjp = jax.vjp(head_f, embed_full, head_full,
+                                       x_out)
+                loss = model.combine_aux(ce, aux_sum)
+                drf_e_h, drf_head, dx_out = head_vjp(s_)
+                # head-side grads scatter NOW, before the backward scan —
+                # its compute hides the launch (an untied LM head makes
+                # this the optimizer-step's dominant reduce)
+                if ef_local is not None:
+                    dhead, new_ef["rest_head"] = head_comm.scatter(
+                        drf_head, err=ef_local["rest_head"])
+                else:
+                    dhead = head_comm.scatter(drf_head)
+                pb = pullback(dx_out, daux)
+                if ef_local is not None:
+                    dblocks, dx0, new_ef["blocks"] = pb
+                else:
+                    dblocks, dx0 = pb
+                (drf_e_e,) = embed_vjp(dx0)
+                drest_embed = jax.tree.map(jnp.add, drf_e_h, drf_e_e)
+                if ef_local is not None:
+                    dembed, new_ef["rest_embed"] = embed_comm.scatter(
+                        drest_embed, err=ef_local["rest_embed"])
+                else:
+                    dembed = embed_comm.scatter(drest_embed)
+                grads = {**dembed, **dhead}
+            else:
+                def head_f(rf, xx):
+                    return model.head_loss(rf, xx, labels,
+                                           extra_mask=batch.get("loss_mask"))
+                ce, head_vjp = jax.vjp(head_f, rest_full, x_out)
+                loss = model.combine_aux(ce, aux_sum)
+                drf_h, dx_out = head_vjp(s_)
+                pb = pullback(dx_out, daux)
+                if ef_local is not None:
+                    dblocks, dx0, new_ef["blocks"] = pb
+                else:
+                    dblocks, dx0 = pb
+                (drf_e,) = embed_vjp(dx0)
+                drest_full = jax.tree.map(jnp.add, drf_h, drf_e)
+                if ef_local is not None:
+                    drest, new_ef["rest"] = rest_comm.scatter(
+                        drest_full, err=ef_local["rest"])
+                else:
+                    drest = rest_comm.scatter(drest_full)
+                grads = dict(drest)
+            # deferred replicated-leaf reduction: ONE fused flat boundary
+            # launch instead of one psum per scan iteration (exact)
+            with blk_comm.schedule_class(False):
+                dblocks = blk_comm.flush_deferred(dblocks)
             grads["blocks"] = dblocks
             gacc = jax.tree.map(lambda a, g: a + g.astype(grad_dtype),
                                 gacc_shards, grads)
-            return gacc, jax.lax.pmean(loss, all_dp)
+            loss_out = jax.lax.pmean(loss, all_dp)
+            if ef_local is not None:
+                return gacc, jax.tree.map(lambda a: a[None], new_ef), \
+                    loss_out
+            return gacc, loss_out
 
         gacc_specs = grad_specs
+
+        if ef_on:
+            ef_specs = self._ef_spec
+
+            def micro_step(carry, cur_scale, secondary, batch):
+                gacc_in, ef_in = carry
+                batch_specs = {k: (P() if k in batch_rep else P(BATCH_AXES))
+                               for k in batch}
+                sm = shard_map(local_micro, mesh=mesh,
+                               in_specs=(gather_src_specs, gacc_specs,
+                                         ef_specs, P(), batch_specs),
+                               out_specs=((gacc_specs, ef_specs, P())),
+                               check_vma=False)
+                gacc, ef_out, loss = sm(secondary, gacc_in, ef_in,
+                                        cur_scale, batch)
+                return (gacc, ef_out), loss
+
+            return micro_step
 
         def micro_step(gacc_in, cur_scale, secondary, batch):
             batch_specs = {k: (P() if k in batch_rep else P(BATCH_AXES))
                            for k in batch}
-            sm = shard_map(local_micro, mesh=mesh,
+            local = lambda p, g, sc, b: local_micro(p, g, None, sc, b)
+            sm = shard_map(local, mesh=mesh,
                            in_specs=(gather_src_specs, gacc_specs, P(),
                                      batch_specs),
                            out_specs=(gacc_specs, P()), check_vma=False)
@@ -1524,10 +1730,32 @@ class DeepSpeedEngine:
                 # whole state would copy params + fp32 optimizer state every
                 # micro step. The secondary (params at hpz=1) is a plain
                 # non-donated input, so the aliasing stays valid.
-                self._jit_micro_step = jax.jit(
-                    self._build_zeropp_micro(), donate_argnums=(0,),
-                    in_shardings=(shardings["grad_acc"], rep, None, None),
-                    out_shardings=(shardings["grad_acc"], rep))
+                micro = self._build_zeropp_micro()
+                if getattr(self, "_ef_carry_active", False):
+                    # planner EF carry: the residual state rides the donated
+                    # micro carry next to grad_acc (device-local via the
+                    # leading dp axis; persists across optimizer steps so
+                    # the quantization error telescopes)
+                    ef_sh = jax.tree.map(
+                        lambda s: NamedSharding(self.mesh, s),
+                        self._ef_spec, is_leaf=lambda s: isinstance(s, P))
+                    if getattr(self, "_ef_state", None) is None:
+                        with self.mesh:
+                            self._ef_state = jax.jit(
+                                lambda: jax.tree.map(
+                                    lambda s: jnp.zeros(s.shape, s.dtype),
+                                    self._ef_struct),
+                                out_shardings=ef_sh)()
+                    self._jit_micro_step = jax.jit(
+                        micro, donate_argnums=(0,),
+                        in_shardings=((shardings["grad_acc"], ef_sh), rep,
+                                      None, None),
+                        out_shardings=((shardings["grad_acc"], ef_sh), rep))
+                else:
+                    self._jit_micro_step = jax.jit(
+                        micro, donate_argnums=(0,),
+                        in_shardings=(shardings["grad_acc"], rep, None, None),
+                        out_shardings=(shardings["grad_acc"], rep))
             if self._jit_apply_step is None:
                 self._jit_apply_step = jax.jit(
                     self._apply_step_fn, donate_argnums=(0,),
@@ -1742,10 +1970,17 @@ class DeepSpeedEngine:
                                   step=self.global_steps):
             with self.mesh:
                 if self._explicit_micro:
-                    gacc, loss = self._jit_micro_step(
-                        self.state["grad_acc"],
-                        self.state["loss_scale"]["cur_scale"],
-                        self._secondary, batch)
+                    if getattr(self, "_ef_carry_active", False):
+                        (gacc, ef), loss = self._jit_micro_step(
+                            (self.state["grad_acc"], self._ef_state),
+                            self.state["loss_scale"]["cur_scale"],
+                            self._secondary, batch)
+                        self._ef_state = ef
+                    else:
+                        gacc, loss = self._jit_micro_step(
+                            self.state["grad_acc"],
+                            self.state["loss_scale"]["cur_scale"],
+                            self._secondary, batch)
                     self.state["grad_acc"] = gacc
                 else:
                     self.state, loss = self._jit_micro_step(self.state, batch)

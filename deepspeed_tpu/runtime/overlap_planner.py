@@ -1,0 +1,477 @@
+"""Map-driven overlap planner: ONE scheduler derives prefetch/overlap
+structure for every exposed collective path (ISSUE 9 tentpole).
+
+PR 3 hand-pipelined exactly one schedule (the ZeRO++ per-layer scan) and
+PR 7 built the machinery that knows where every other collective actually
+lands in the compiled graph (``analysis/schedule_audit.py`` emits
+``tools/collective_maps/<entry>.json`` with per-collective
+exposed/overlapped/serialized classifications, hideable-FLOP slack
+windows, bytes and loop context). This module closes the loop T3
+(arXiv:2401.16677) argues for: the *general* form of compute/collective
+overlap must be driven by where collectives sit in the compiled graph —
+so the schedule builders stop hand-writing per-path pipelines and instead
+execute a declarative :class:`OverlapPlan` derived from the committed
+maps.
+
+Vocabulary (one placement language for every path):
+
+- ``scan-carry`` — prefetch via a ``lax.scan`` carry: iteration *i*
+  issues launch *i+1* while computing unit *i* (the pipelined ZeRO block
+  schedule; the chunked MoE dispatch). Layer D sees in-body collectives
+  with the whole body as circular slack window — the software pipelining
+  the carry exists for.
+- ``straight-line`` — launch early / consume late in straight-line code:
+  collectives whose consumer sits across a big compute region are issued
+  before it (the head-side edge leaves of the ZeRO micro gather before
+  the block scan and scatter before the backward scan, hiding under the
+  scan's FLOPs).
+- ``inline`` — no restructuring; the plan only binds the transport
+  (width/kind) of the launch (Ulysses all-to-all: bf16 activation wire).
+
+Consumers execute the plan, they do not re-derive it:
+
+- ``runtime/engine.py`` ``_build_zeropp_micro_overlap`` (scan-carry
+  prefetch depth, bucket sizing, edge-leaf split placement, the deferred
+  replicated-grad boundary flush, and the PR 8 error-feedback residual
+  carry — the planner owns the scan carries, so the residual state rides
+  the micro-step carry it could not before);
+- ``moe/layer.py`` (capacity-chunked scan-carry dispatch under expert
+  compute);
+- ``sequence/layer.py`` (activation-kind transport binding);
+- ``runtime/zero/overlap.py`` ``TreeComm`` (deferred replicated flush,
+  EF carry structs).
+
+Escape hatches: ``DSTPU_OVERLAP_PLAN=0`` (env) or ``overlap_plan:
+false`` (engine config) revert every consumer to the hand-written
+pre-planner schedule BITWISE — same contract as the transport planner's
+``DSTPU_COMM_QUANT=0``.
+
+Committed plan artifacts live in ``tools/overlap_plans/<entry>.json``
+(deterministic; regenerate with ``python -m
+deepspeed_tpu.runtime.overlap_planner --update`` after a map refresh).
+A tier-1 lockstep test holds: every entry point declaring an
+``overlap_contract`` has a committed plan artifact that matches what
+:func:`plan_entry` derives from the committed map. See
+docs/OVERLAP_PLANNER.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+PLACEMENT_SCAN_CARRY = "scan-carry"
+PLACEMENT_STRAIGHT_LINE = "straight-line"
+PLACEMENT_INLINE = "inline"
+_PLACEMENTS = (PLACEMENT_SCAN_CARRY, PLACEMENT_STRAIGHT_LINE,
+               PLACEMENT_INLINE)
+
+#: chunked-pipeline floor: a dispatch exchange below this many bytes is
+#: not worth a scan's loop overhead (the launch itself is latency-bound).
+MOE_PIPELINE_MIN_BYTES = 512
+#: target per-chunk payload for scan-carry chunking; the chunk count is
+#: bytes/target clamped to [2, MOE_MAX_CHUNKS].
+MOE_CHUNK_TARGET_BYTES = 256 * 1024
+MOE_MAX_CHUNKS = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class OverlapPlan:
+    """One entry point's overlap decision — what the schedule builder
+    executes instead of a hand-written pipeline. Fields are POLICY; the
+    executor clamps them to what its shapes support (e.g. ``n_chunks``
+    must divide the MoE capacity) and records the effective values."""
+    entry: str
+    placement: str = PLACEMENT_INLINE
+    #: scan-carry: how many steps ahead the carry prefetches (the
+    #: executors implement depth 1 — a deeper recommendation is recorded
+    #: in ``notes`` and clamped).
+    prefetch_depth: int = 0
+    #: scan-carry chunk count for paths that chunk a single exchange
+    #: (MoE capacity chunks); 1 = unchunked.
+    n_chunks: int = 1
+    #: bucket sizing fed to ``build_tree_comm`` (None = keep the engine
+    #: config knobs — the planner only overrides when the map argues).
+    allgather_bucket: Optional[int] = None
+    reduce_bucket: Optional[int] = None
+    #: transport-planner kind bound to the path's launches (None = the
+    #: caller's existing binding).
+    transport_kind: Optional[str] = None
+    #: thread the PR 8 error-feedback residual state through the
+    #: schedule's carries (effective only when the transport policy
+    #: enables ``error_feedback`` — the plan declares the carry exists).
+    carry_error_feedback: bool = False
+    #: split the edge ("rest") leaves by consumer side: head-only leaves
+    #: gather before / scatter after the big scan region so its FLOPs
+    #: hide them (straight-line placement inside a scan-carry entry).
+    split_edge_leaves: bool = False
+    #: hoist replicated-leaf grad reductions out of the scan body into
+    #: ONE fused flat all-reduce at the micro-step boundary (exact: psum
+    #: commutes with the stack).
+    defer_replicated: bool = False
+    #: 'map' when derived from a committed collective map, 'default'
+    #: when no map exists (conservative identity plan).
+    source: str = "default"
+    notes: Tuple[str, ...] = ()
+
+    def summary(self) -> str:
+        bits = [self.placement]
+        if self.placement == PLACEMENT_SCAN_CARRY:
+            bits.append(f"prefetch={self.prefetch_depth}")
+        if self.n_chunks > 1:
+            bits.append(f"chunks={self.n_chunks}")
+        if self.transport_kind:
+            bits.append(f"kind={self.transport_kind}")
+        if self.carry_error_feedback:
+            bits.append("ef-carry")
+        if self.split_edge_leaves:
+            bits.append("edge-split")
+        if self.defer_replicated:
+            bits.append("defer-repl")
+        return "/".join(bits)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["notes"] = list(self.notes)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "OverlapPlan":
+        known = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in known}
+        kw["notes"] = tuple(kw.get("notes") or ())
+        return cls(**kw)
+
+
+IDENTITY_PLAN = OverlapPlan(entry="", placement=PLACEMENT_INLINE)
+
+
+def moe_chunks_for_bytes(nbytes: int) -> int:
+    """Scan-carry chunk count for a dispatch exchange of ``nbytes`` —
+    the SAME floor/target/max policy the map derivation applies, but
+    against the RUNTIME exchange size: the committed plan decides the
+    PLACEMENT (its ``n_chunks`` records the audit-observed decision);
+    a production layer's chunk count must scale with its actual bytes,
+    exactly as ``resolve_transport`` sizes the wire from the actual
+    payload. Callers still clamp to a divisor of their capacity."""
+    if nbytes < MOE_PIPELINE_MIN_BYTES:
+        return 1
+    return min(MOE_MAX_CHUNKS,
+               max(2, round(nbytes / MOE_CHUNK_TARGET_BYTES)))
+
+
+# ---------------------------------------------------------------------------
+# gates
+# ---------------------------------------------------------------------------
+
+#: process-global ``overlap_plan`` config flag (None = unset). The engine
+#: INSTALLS its config here at build (same pattern as
+#: ``comm.configure_transport``) so engineless consumers — the MoE layer,
+#: the Ulysses wrapper — honor ``overlap_plan: false`` too, not just the
+#: env kill switch. Last engine built wins, like the transport policy.
+_CONFIG = {"enabled": None}
+
+
+def configure_planner(enabled: Optional[bool]) -> None:
+    """Install the engine config's ``overlap_plan`` flag process-wide."""
+    _CONFIG["enabled"] = None if enabled is None else bool(enabled)
+
+
+def planner_enabled(config_flag: Optional[bool] = None) -> bool:
+    """The planner gate. ``DSTPU_OVERLAP_PLAN=0`` (env kill switch) or
+    ``overlap_plan: false`` (engine config — passed explicitly as
+    ``config_flag`` by engine call sites, or read from the installed
+    process-global flag by engineless consumers) reverts every consumer
+    to the hand-written schedule bitwise."""
+    if os.environ.get("DSTPU_OVERLAP_PLAN", "1") == "0":
+        return False
+    if config_flag is None:
+        config_flag = _CONFIG["enabled"]
+    if config_flag is not None and not config_flag:
+        return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# map ingestion
+# ---------------------------------------------------------------------------
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def default_maps_dir() -> str:
+    return os.path.join(_repo_root(), "tools", "collective_maps")
+
+
+def default_plans_dir() -> str:
+    return os.path.join(_repo_root(), "tools", "overlap_plans")
+
+
+_MAP_CACHE: Dict[str, Optional[Dict[str, Any]]] = {}
+_PLAN_CACHE: Dict[str, OverlapPlan] = {}
+
+
+def reset_plans() -> None:
+    """Drop the process-global map/plan caches AND the installed config
+    flag (tests; map refresh)."""
+    _MAP_CACHE.clear()
+    _PLAN_CACHE.clear()
+    _CONFIG["enabled"] = None
+
+
+def load_map(entry: str, maps_dir: Optional[str] = None
+             ) -> Optional[Dict[str, Any]]:
+    """The committed Layer-D collective map for ``entry`` (None when the
+    entry has no committed map — the plan degrades to defaults, never
+    crashes a trace)."""
+    key = f"{maps_dir or ''}|{entry}"
+    if key not in _MAP_CACHE:
+        path = os.path.join(maps_dir or default_maps_dir(),
+                            f"{entry}.json")
+        data = None
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                data = json.load(fh)
+        except (OSError, ValueError):
+            data = None
+        _MAP_CACHE[key] = data
+    return _MAP_CACHE[key]
+
+
+def _records(mp: Optional[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    return list(mp.get("collectives", [])) if mp else []
+
+
+def _moved(rec: Dict[str, Any]) -> int:
+    return int(rec.get("operand_bytes", 0)) * int(rec.get("executions", 1))
+
+
+def _split_bytes(mp: Optional[Dict[str, Any]]) -> Dict[str, int]:
+    out = {"overlapped": 0, "exposed": 0, "serialized": 0}
+    for rec in _records(mp):
+        cls = rec.get("classification", "exposed")
+        out[cls] = out.get(cls, 0) + _moved(rec)
+    return out
+
+
+def _loop_exposed_bytes(mp: Optional[Dict[str, Any]]) -> int:
+    """Exposed bytes of collectives sitting INSIDE a compiled loop — the
+    ones a deeper scan-carry prefetch could still hide."""
+    return sum(_moved(r) for r in _records(mp)
+               if r.get("loop") and r.get("classification") != "overlapped")
+
+
+# ---------------------------------------------------------------------------
+# per-entry derivations (policy; executors clamp to mechanism)
+# ---------------------------------------------------------------------------
+
+def _plan_zeropp(entry: str, mp: Optional[Dict[str, Any]]) -> OverlapPlan:
+    """The pipelined ZeRO++/stage-3 micro (the planner's first client —
+    the PR 3 hand schedule becomes one derivation). The scan-carry
+    prefetch stays depth 1 while the map shows the in-loop collectives
+    overlapped; exposed in-loop bytes would argue for a deeper carry
+    (recorded, executor clamps to 1). The plan additionally owns what
+    the hand schedule could not express:
+
+    - ``split_edge_leaves``: head-only edge leaves (final norm, an
+      untied LM head — often the step's largest reduce) hoist across the
+      block scans, hiding under their FLOPs instead of sitting exposed
+      at the step edges;
+    - ``defer_replicated``: replicated-leaf grad psums leave the
+      backward scan body (one launch per layer) for ONE fused flat
+      boundary launch — exact, since psum commutes with the stack;
+    - ``carry_error_feedback``: the PR 8 residual state rides the
+      backward scan's xs/ys and the micro-step carry (closing the
+      ROADMAP item 1(a) deferral)."""
+    notes: List[str] = []
+    depth = 1
+    loop_exposed = _loop_exposed_bytes(mp)
+    if loop_exposed:
+        notes.append(f"map shows {loop_exposed} exposed in-loop bytes; a "
+                     f"prefetch depth of 2 is recommended (executor "
+                     f"implements depth 1)")
+    return OverlapPlan(
+        entry=entry, placement=PLACEMENT_SCAN_CARRY, prefetch_depth=depth,
+        carry_error_feedback=True, split_edge_leaves=True,
+        defer_replicated=True, source="map" if mp else "default",
+        notes=tuple(notes))
+
+
+def _plan_moe(entry: str, mp: Optional[Dict[str, Any]]) -> OverlapPlan:
+    """MoE dispatch: chunk the token->expert exchange over the capacity
+    dim and prefetch chunk *c+1*'s exchange in a scan carry while chunk
+    *c*'s expert FFN computes. The chunk count scales with the exchange
+    bytes the map observed (clamped to what the runtime capacity
+    divides); below the pipeline floor the plan stays unchunked — a
+    tiny exchange is latency-bound and a loop would only add overhead.
+    The combine-side exchange stays at the epilogue edge
+    (budget-justified: every token's slots span all chunks)."""
+    split = _split_bytes(mp)
+    total = sum(split.values())
+    notes: List[str] = []
+    if not total or total < MOE_PIPELINE_MIN_BYTES:
+        notes.append(
+            "no committed map — conservative unchunked default" if not mp
+            else f"exchange bytes {total} below pipeline floor "
+                 f"{MOE_PIPELINE_MIN_BYTES}; staying unchunked")
+        return OverlapPlan(entry=entry, placement=PLACEMENT_INLINE,
+                           transport_kind="activation",
+                           source="map" if mp else "default",
+                           notes=tuple(notes))
+    n_chunks = moe_chunks_for_bytes(total)
+    return OverlapPlan(
+        entry=entry, placement=PLACEMENT_SCAN_CARRY, prefetch_depth=1,
+        n_chunks=n_chunks, transport_kind="activation",
+        source="map" if mp else "default", notes=tuple(notes))
+
+
+def _plan_ulysses(entry: str, mp: Optional[Dict[str, Any]]) -> OverlapPlan:
+    """Ulysses all-to-all: the head<->sequence reshard is a dependence
+    chain (attention needs the full sequence before one FLOP runs), so
+    no placement can hide it — the plan binds the TRANSPORT instead:
+    the activation-kind bf16 wire halves the exposed bytes (ROADMAP
+    item 1(c))."""
+    return OverlapPlan(entry=entry, placement=PLACEMENT_INLINE,
+                       transport_kind="activation",
+                       source="map" if mp else "default")
+
+
+def _plan_engine_step(entry: str, mp: Optional[Dict[str, Any]]
+                      ) -> OverlapPlan:
+    """The fused GSPMD train step: its boundary collectives (the dp grad
+    all-reduce, the ZeRO-1 optimizer-step exchange) are partitioner-
+    placed — no explicit launch to move. The plan binds the grad-kind
+    transport and records the exposure the explicit-micro engines
+    eliminate (their boundary collectives execute through the
+    zeropp-micro plan above)."""
+    split = _split_bytes(mp)
+    notes: List[str] = []
+    if split["exposed"] or split["serialized"]:
+        notes.append(
+            f"{split['exposed'] + split['serialized']} exposed bytes are "
+            f"GSPMD-placed boundary/optimizer-step reductions; the "
+            f"explicit micro schedules route them through the "
+            f"zeropp-micro-overlap plan instead")
+    return OverlapPlan(entry=entry, placement=PLACEMENT_INLINE,
+                       transport_kind="grad",
+                       source="map" if mp else "default",
+                       notes=tuple(notes))
+
+
+def _plan_serving(entry: str, mp: Optional[Dict[str, Any]]) -> OverlapPlan:
+    """The ragged serving wave holds a zero-collective contract — the
+    plan records that nothing is left to overlap (the lockstep test
+    still wants the artifact: a contract entry without a plan is a
+    planner coverage hole)."""
+    split = _split_bytes(mp)
+    notes = ()
+    if sum(split.values()):
+        notes = (f"zero-collective contract entry carries "
+                 f"{sum(split.values())} collective bytes — the pool "
+                 f"sharding regressed; see docs/SERVING.md",)
+    return OverlapPlan(entry=entry, placement=PLACEMENT_INLINE,
+                       source="map" if mp else "default", notes=notes)
+
+
+#: entry -> derivation. Entries not named here get the identity plan
+#: (inline, no restructuring) — adding a path to the planner is adding
+#: one derivation plus its executor hook.
+PLAN_DERIVATIONS = {
+    "zeropp-micro-overlap": _plan_zeropp,
+    "moe-dispatch": _plan_moe,
+    "ulysses-attention": _plan_ulysses,
+    "engine-train-step": _plan_engine_step,
+    "ragged-paged-attention": _plan_serving,
+}
+
+
+def plan_entry(entry: str, maps_dir: Optional[str] = None) -> OverlapPlan:
+    """Derive ``entry``'s :class:`OverlapPlan` from its committed
+    collective map (pure: same committed map -> same plan, which is what
+    lets the plan artifacts be committed and lockstep-tested)."""
+    derive = PLAN_DERIVATIONS.get(entry)
+    if derive is None:
+        return dataclasses.replace(IDENTITY_PLAN, entry=entry)
+    return derive(entry, load_map(entry, maps_dir))
+
+
+def plan_for(entry: str, config_flag: Optional[bool] = None,
+             maps_dir: Optional[str] = None) -> OverlapPlan:
+    """The runtime entry point: ``entry``'s plan, or the identity plan
+    when the planner is disabled (env/config escape hatch). Cached per
+    process — plans are resolved at trace time on hot paths."""
+    if not planner_enabled(config_flag):
+        return dataclasses.replace(IDENTITY_PLAN, entry=entry)
+    key = f"{maps_dir or ''}|{entry}"
+    if key not in _PLAN_CACHE:
+        _PLAN_CACHE[key] = plan_entry(entry, maps_dir)
+    return _PLAN_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# committed plan artifacts
+# ---------------------------------------------------------------------------
+
+def write_plan_artifact(plans_dir: str, plan: OverlapPlan) -> str:
+    os.makedirs(plans_dir, exist_ok=True)
+    path = os.path.join(plans_dir, f"{plan.entry}.json")
+    payload = dict(plan.to_dict())
+    payload["comment"] = (
+        "Committed overlap plan (runtime/overlap_planner.py). Derived "
+        "from tools/collective_maps/<entry>.json — regenerate with "
+        "`python -m deepspeed_tpu.runtime.overlap_planner --update` "
+        "after a map refresh; hand edits will fail the lockstep test.")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_plan_artifact(plans_dir: str, entry: str) -> Optional[OverlapPlan]:
+    path = os.path.join(plans_dir, f"{entry}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return OverlapPlan.from_dict(json.load(fh))
+
+
+def refresh_plan_artifacts(plans_dir: Optional[str] = None,
+                           maps_dir: Optional[str] = None) -> List[str]:
+    """Re-derive and write every registered derivation's artifact."""
+    out = []
+    for entry in sorted(PLAN_DERIVATIONS):
+        plan = plan_entry(entry, maps_dir)
+        out.append(write_plan_artifact(plans_dir or default_plans_dir(),
+                                       plan))
+    return out
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="derive/write committed overlap plan artifacts")
+    parser.add_argument("--update", action="store_true",
+                        help="write tools/overlap_plans/<entry>.json for "
+                             "every registered derivation")
+    parser.add_argument("--plans-dir", default=None)
+    parser.add_argument("--maps-dir", default=None)
+    args = parser.parse_args(argv)
+    if args.update:
+        for path in refresh_plan_artifacts(args.plans_dir, args.maps_dir):
+            print(f"wrote {path}")
+        return 0
+    for entry in sorted(PLAN_DERIVATIONS):
+        plan = plan_entry(entry, args.maps_dir)
+        print(f"{entry:28} {plan.summary()}   [{plan.source}]")
+        for note in plan.notes:
+            print(f"{'':28}   note: {note}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

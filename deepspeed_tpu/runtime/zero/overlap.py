@@ -115,13 +115,22 @@ def build_tree_comm(gather_spec_tree, grad_spec_tree, struct_tree,
                     *, axis_sizes, all_dp, n_dp,
                     quant_weights: bool, quant_grads: bool,
                     allgather_bucket: int, reduce_bucket: int,
-                    overlapped: bool, name: str = ""):
+                    overlapped: bool, name: str = "",
+                    defer_replicated: bool = False):
     """Build the gather/scatter pair for one leaf tree.
 
     ``gather_spec_tree``: where forward/backward gathers read from (the
     hpZ SECONDARY specs when hpZ is on, else the primary param specs).
     ``grad_spec_tree``: where gradient shards land (always primary).
     ``struct_tree``: abstract leaves (full, per-layer shapes/dtypes).
+    ``defer_replicated`` (the overlap planner's ``defer-repl`` decision,
+    runtime/overlap_planner.py): replicated-w.r.t.-dp leaves skip their
+    per-:meth:`scatter` psum and return LOCAL grads — the caller runs
+    :meth:`flush_deferred` ONCE at the micro-step boundary, which fuses
+    every deferred leaf into a single flat all-reduce per dtype (exact:
+    the psum commutes with the stack, each element is reduced once
+    either way — but a scan-body caller pays one launch per iteration
+    without it).
     Returns an object with ``.gather(tree)``, ``.scatter(tree)``,
     ``.oversize`` (leaf names whose size exceeds the bucket even after the
     best split — the caller warns once), and ``.plan_summary()``.
@@ -193,14 +202,17 @@ def build_tree_comm(gather_spec_tree, grad_spec_tree, struct_tree,
                          oversize=sorted({names[i] for i in g_over}
                                          | {names[i] for i in s_over}),
                          n_dp=n_dp, all_dp=all_dp,
-                         overlapped=overlapped, name=name)
+                         overlapped=overlapped, name=name,
+                         defer_replicated=defer_replicated,
+                         axis_sizes=dict(axis_sizes))
 
 
 class _TreeCommImpl:
 
     def __init__(self, treedef, names, gcomms, scomms, gather_plan,
                  scatter_plan, gather_tp, scatter_tp, *, oversize,
-                 n_dp, all_dp, overlapped, name):
+                 n_dp, all_dp, overlapped, name, defer_replicated=False,
+                 axis_sizes=None):
         self.treedef = treedef
         self.names = names
         self.gcomms = gcomms
@@ -214,6 +226,13 @@ class _TreeCommImpl:
         self.all_dp = all_dp
         self.overlapped = overlapped
         self.name = name
+        self.axis_sizes = axis_sizes or {}
+        self.defer_replicated = defer_replicated
+        #: leaf indices whose scatter reduction is deferred to
+        #: :meth:`flush_deferred` (replicated-w.r.t.-dp leaves only)
+        self.deferred_leaves = tuple(
+            i for i, lc in enumerate(scomms)
+            if lc.dim is None) if defer_replicated else ()
         self._exec_mult = 1  # executions per trace of the enclosing region
 
     @contextlib.contextmanager
@@ -358,6 +377,11 @@ class _TreeCommImpl:
     def _scatter_one(self, g, lc: LeafComm, chunks: int, tp: TransportPlan,
                      err=None):
         if lc.dim is None:
+            if self.defer_replicated:
+                # planner 'defer-repl': the reduction moves to the ONE
+                # fused flush at the micro boundary (flush_deferred) —
+                # a scan-body caller stops paying a launch per iteration
+                return g, None
             self._rec("all_reduce", g.size * g.dtype.itemsize,
                       self.all_dp)
             return jax.lax.psum(g, self.all_dp) / self.n_dp, None
@@ -439,6 +463,33 @@ class _TreeCommImpl:
             outs.append(jnp.moveaxis(seg, 0, lc.dim) / self.n_dp)
         return outs, new_err
 
+    def flush_deferred(self, tree):
+        """Apply the deferred replicated-leaf reduction (the planner's
+        boundary flush): every leaf :meth:`scatter` left unreduced is
+        fused — per dtype, so the math is bitwise the per-leaf psum's —
+        into ONE flat all-reduce and divided by ``n_dp``. ``tree`` may be
+        the per-bundle tree or the full stacked tree (same structure;
+        psum commutes with the layer stack). No-op when nothing was
+        deferred."""
+        if not self.deferred_leaves:
+            return tree
+        leaves = self.treedef.flatten_up_to(tree)
+        by_dtype = {}
+        for i in self.deferred_leaves:
+            by_dtype.setdefault(jnp.result_type(leaves[i]), []).append(i)
+        for dt, idx in by_dtype.items():
+            flats = [leaves[i].reshape(-1) for i in idx]
+            buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            self._rec("all_reduce", buf.size * buf.dtype.itemsize,
+                      self.all_dp)
+            red = jax.lax.psum(buf, self.all_dp) / self.n_dp
+            off = 0
+            for i in idx:
+                k = leaves[i].size
+                leaves[i] = red[off:off + k].reshape(leaves[i].shape)
+                off += k
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
     def err_struct(self):
         """Error-feedback carry shapes, one slot per scatter launch
         (None where EF does not apply — full-width, fp8, hierarchical
@@ -462,7 +513,12 @@ class _TreeCommImpl:
                                   if d != lc.dim))
                 out.append(jax.ShapeDtypeStruct(mshape, jnp.float32))
             else:
-                n = axis_size(lcs[0].axes)
+                # host-known mesh sizes: err_struct must work OUTSIDE the
+                # shard_map region too (the engine sizes the carry state
+                # at build time)
+                n = int(np.prod([self.axis_sizes.get(a, 1)
+                                 for a in lcs[0].axes])) \
+                    if self.axis_sizes else axis_size(lcs[0].axes)
                 total = 0
                 for lc in lcs:
                     k = int(np.prod(lc.shape)) // n

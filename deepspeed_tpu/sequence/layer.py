@@ -69,15 +69,30 @@ def _constraint_form(attn_fn: Callable, q, k, v, kwargs):
 
 def _all_to_all_form(attn_fn: Callable, q, k, v, mesh, kwargs):
     """Explicit Ulysses: two all-to-alls per tensor inside one shard_map
-    region (reference sequence/layer.py:15 ``single_all_to_all``)."""
+    region (reference sequence/layer.py:15 ``single_all_to_all``).
+
+    The exchanges ride the comm frontend with the overlap planner's
+    transport binding (ROADMAP item 1(c)): ``kind="activation"`` resolves
+    the bf16 wire for fp32 activations — a pure-movement cast, restored
+    on receive; attention itself computes in the logical dtype. The
+    Ulysses reshard is a dependence chain (attention needs the full
+    sequence before one FLOP runs), so the planner binds WIDTH rather
+    than placement — see runtime/overlap_planner.py ``_plan_ulysses``.
+    ``DSTPU_OVERLAP_PLAN=0`` / ``DSTPU_COMM_QUANT=0`` keep the exchange
+    full-width bitwise."""
+    from ..runtime.overlap_planner import plan_for
+
+    plan = plan_for("ulysses-attention")
+    wire_kind = plan.transport_kind  # None when the planner is disabled
 
     def local_fn(q, k, v):
         # per-shard [b, s/sp, h/tp, d] -> [b, s, h/(tp*sp), d]
-        gather_seq = lambda x: jax.lax.all_to_all(
-            x, SEQ_AXIS, split_axis=2, concat_axis=1, tiled=True)
+        gather_seq = lambda x: comm.all_to_all(
+            x, axis=SEQ_AXIS, split_axis=2, concat_axis=1, kind=wire_kind)
         out = attn_fn(gather_seq(q), gather_seq(k), gather_seq(v), **kwargs)
         # inverse: scatter sequence, gather heads
-        return jax.lax.all_to_all(out, SEQ_AXIS, split_axis=1, concat_axis=2, tiled=True)
+        return comm.all_to_all(out, axis=SEQ_AXIS, split_axis=1,
+                               concat_axis=2, kind=wire_kind)
 
     return shard_map(local_fn, mesh=mesh,
                      in_specs=(SEQ_SHARDED, SEQ_SHARDED, SEQ_SHARDED),

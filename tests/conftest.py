@@ -41,6 +41,10 @@ def _reset_topology():
     # direct configure_transport call) leak into the next test
     from deepspeed_tpu import comm as dist
     dist.reset_transport()
+    # nor an engine-installed overlap_plan flag (the plan/map caches are
+    # static committed files; only the config flag is test-varying)
+    from deepspeed_tpu.runtime.overlap_planner import configure_planner
+    configure_planner(None)
 
 
 @pytest.fixture

@@ -1,0 +1,192 @@
+"""Overlap planner units + the committed-plan-artifact lockstep gate.
+
+The planner (runtime/overlap_planner.py) is the ISSUE 9 tentpole: one
+scheduler deriving prefetch/overlap structure for every exposed
+collective path from the committed Layer-D collective maps. These tests
+pin (a) the derivation policy on synthetic maps, (b) the escape hatches,
+and (c) the LOCKSTEP contract: every entry point declaring an
+``overlap_contract`` has a committed ``tools/overlap_plans/<entry>.json``
+artifact that matches what :func:`plan_entry` re-derives from the
+committed map — a refreshed map without a refreshed plan (or a hand
+edit) fails here, in tier 1, not in production.
+"""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.runtime import overlap_planner as op
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plans():
+    op.reset_plans()
+    yield
+    op.reset_plans()
+
+
+def _write_map(tmp_path, entry, collectives):
+    payload = {"entry": entry, "mesh_devices": 8, "bytes_per_flop": 0.05,
+               "collectives": collectives, "summary": {}}
+    path = tmp_path / f"{entry}.json"
+    path.write_text(json.dumps(payload))
+    return str(tmp_path)
+
+
+def _coll(bytes_, classification="exposed", loop=None, executions=1):
+    return {"kind": "all-to-all", "operand_bytes": bytes_,
+            "classification": classification, "loop": loop,
+            "executions": executions}
+
+
+class TestDerivations:
+
+    def test_zeropp_plan_shape(self):
+        plan = op.plan_entry("zeropp-micro-overlap")
+        assert plan.placement == op.PLACEMENT_SCAN_CARRY
+        assert plan.prefetch_depth == 1
+        assert plan.carry_error_feedback and plan.split_edge_leaves \
+            and plan.defer_replicated
+        assert plan.source == "map"  # the committed map exists
+
+    def test_zeropp_notes_exposed_loop_bytes(self, tmp_path):
+        maps = _write_map(tmp_path, "zeropp-micro-overlap", [
+            _coll(4096, "exposed", loop={"while": "w", "trip_count": 4},
+                  executions=4)])
+        plan = op.plan_entry("zeropp-micro-overlap", maps)
+        assert any("in-loop" in n for n in plan.notes)
+
+    def test_moe_unchunked_below_floor(self, tmp_path):
+        maps = _write_map(tmp_path, "moe-dispatch", [_coll(64)])
+        plan = op.plan_entry("moe-dispatch", maps)
+        assert plan.placement == op.PLACEMENT_INLINE
+        assert plan.n_chunks == 1
+        assert plan.transport_kind == "activation"
+
+    def test_moe_chunked_above_floor(self, tmp_path):
+        maps = _write_map(tmp_path, "moe-dispatch", [_coll(4096)])
+        plan = op.plan_entry("moe-dispatch", maps)
+        assert plan.placement == op.PLACEMENT_SCAN_CARRY
+        assert plan.n_chunks == 2
+
+    def test_moe_chunks_scale_with_bytes_and_clamp(self, tmp_path):
+        big = 10 * op.MOE_CHUNK_TARGET_BYTES
+        maps = _write_map(tmp_path, "moe-dispatch", [_coll(big)])
+        plan = op.plan_entry("moe-dispatch", maps)
+        assert plan.n_chunks == op.MOE_MAX_CHUNKS
+
+    def test_moe_no_map_is_conservative(self, tmp_path):
+        plan = op.plan_entry("moe-dispatch", str(tmp_path))
+        assert plan.placement == op.PLACEMENT_INLINE
+        assert plan.source == "default"
+
+    def test_ulysses_binds_width_not_placement(self):
+        plan = op.plan_entry("ulysses-attention")
+        assert plan.placement == op.PLACEMENT_INLINE
+        assert plan.transport_kind == "activation"
+
+    def test_unregistered_entry_gets_identity(self):
+        plan = op.plan_entry("flash-attention-kernel")
+        assert plan.placement == op.PLACEMENT_INLINE
+        assert plan.transport_kind is None
+
+
+class TestGates:
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_OVERLAP_PLAN", "0")
+        plan = op.plan_for("zeropp-micro-overlap")
+        assert plan.placement == op.PLACEMENT_INLINE
+        assert not plan.carry_error_feedback
+
+    def test_config_flag(self):
+        plan = op.plan_for("zeropp-micro-overlap", config_flag=False)
+        assert plan.placement == op.PLACEMENT_INLINE
+        # and config True keeps the derived plan
+        assert op.plan_for("zeropp-micro-overlap",
+                           config_flag=True).placement \
+            == op.PLACEMENT_SCAN_CARRY
+
+    def test_plan_cache_bypassed_when_disabled(self, monkeypatch):
+        assert op.plan_for("moe-dispatch").entry == "moe-dispatch"
+        monkeypatch.setenv("DSTPU_OVERLAP_PLAN", "0")
+        assert op.plan_for("moe-dispatch").placement == op.PLACEMENT_INLINE
+
+    def test_installed_config_reaches_engineless_consumers(self):
+        """`overlap_plan: false` is installed process-wide by the engine
+        (configure_planner), so plan_for calls WITHOUT an explicit
+        config_flag — the MoE layer, the Ulysses wrapper — honor it."""
+        op.configure_planner(False)
+        try:
+            assert op.plan_for("moe-dispatch").placement \
+                == op.PLACEMENT_INLINE
+            assert op.plan_for("ulysses-attention").transport_kind is None
+            # an explicit True at an engine call site overrides
+            assert op.plan_for("moe-dispatch", config_flag=True).placement \
+                == op.PLACEMENT_SCAN_CARRY
+        finally:
+            op.configure_planner(None)
+        assert op.plan_for("moe-dispatch").placement \
+            == op.PLACEMENT_SCAN_CARRY
+
+    def test_moe_chunks_for_bytes_policy(self):
+        assert op.moe_chunks_for_bytes(op.MOE_PIPELINE_MIN_BYTES - 1) == 1
+        assert op.moe_chunks_for_bytes(op.MOE_PIPELINE_MIN_BYTES) == 2
+        assert op.moe_chunks_for_bytes(10 * op.MOE_CHUNK_TARGET_BYTES) \
+            == op.MOE_MAX_CHUNKS
+
+
+class TestArtifacts:
+
+    def test_roundtrip(self, tmp_path):
+        plan = op.plan_entry("zeropp-micro-overlap")
+        op.write_plan_artifact(str(tmp_path), plan)
+        loaded = op.load_plan_artifact(str(tmp_path),
+                                       "zeropp-micro-overlap")
+        assert loaded == plan
+
+    def test_refresh_writes_every_derivation(self, tmp_path):
+        paths = op.refresh_plan_artifacts(str(tmp_path))
+        assert len(paths) == len(op.PLAN_DERIVATIONS)
+        for entry in op.PLAN_DERIVATIONS:
+            assert op.load_plan_artifact(str(tmp_path), entry) is not None
+
+
+class TestLockstep:
+    """Tier-1 gate: committed plans exist and match the committed maps."""
+
+    def test_every_contract_entry_has_committed_plan(self):
+        # the pinned contract list (building every spec to read its
+        # overlap_contract flag would boot engines; the consistency test
+        # below holds the cheap subset honest)
+        for entry in ("zeropp-micro-overlap", "ragged-paged-attention",
+                      "moe-dispatch", "ulysses-attention"):
+            plan = op.load_plan_artifact(op.default_plans_dir(), entry)
+            assert plan is not None, (
+                f"{entry} declares an overlap contract but has no "
+                f"committed tools/overlap_plans artifact — run `python "
+                f"-m deepspeed_tpu.runtime.overlap_planner --update`")
+            assert plan == op.plan_entry(entry), (
+                f"{entry}: committed plan artifact is stale relative to "
+                f"the committed collective map — regenerate with "
+                f"`python -m deepspeed_tpu.runtime.overlap_planner "
+                f"--update`")
+
+    def test_contract_flags_match_pinned_list(self):
+        # cheap (no-engine) specs only; zeropp/ragged contract flags are
+        # exercised by their own builders in test_schedule_audit
+        from deepspeed_tpu.analysis.entry_points import build_spec
+        for entry in ("moe-dispatch", "ulysses-attention"):
+            assert build_spec(entry).overlap_contract, entry
+
+    def test_committed_artifacts_are_deterministic(self):
+        # to_dict/from_dict round-trips through the exact committed JSON
+        for entry in op.PLAN_DERIVATIONS:
+            path = os.path.join(op.default_plans_dir(), f"{entry}.json")
+            if not os.path.exists(path):
+                continue
+            with open(path) as fh:
+                payload = json.load(fh)
+            assert op.OverlapPlan.from_dict(payload).to_dict() == {
+                k: v for k, v in payload.items() if k != "comment"}

@@ -68,3 +68,78 @@ def test_ulysses_lowers_to_all_to_all(eight_devices):
         hlo = engine._jit_micro_step.lower(
             engine.state, engine._device_batch(batch)).compile().as_text()
     assert "all-to-all" in hlo
+
+
+class TestActivationWire:
+    """ISSUE 9 / ROADMAP 1(c): the Ulysses all-to-alls ride the transport
+    planner with ``kind="activation"`` — fp32 activations move at bf16
+    when the payload clears the min_bytes floor, and both escape hatches
+    (DSTPU_OVERLAP_PLAN=0, DSTPU_COMM_QUANT=0) restore the full-width
+    exchange bitwise."""
+
+    def _run(self, monkeypatch, env=None):
+        from deepspeed_tpu.runtime import topology as topo_mod
+        from deepspeed_tpu.runtime.topology import TopologyConfig
+        from deepspeed_tpu.sequence.layer import ulysses_attention
+
+        for k in ("DSTPU_COMM_QUANT", "DSTPU_OVERLAP_PLAN"):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in (env or {}).items():
+            monkeypatch.setenv(k, v)
+        topo_mod.reset()
+        topo = topo_mod.initialize(TopologyConfig(seq=2, data=-1),
+                                   force=True)
+
+        def attn(q, k, v):
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / q.shape[-1] ** 0.5
+            return jnp.einsum("bhqk,bkhd->bqhd",
+                              jax.nn.softmax(s, axis=-1), v)
+
+        # payload must clear the transport planner's min_bytes floor
+        # per-device: [1, 16, 4, 16] local = 4 KiB
+        r = jax.random.PRNGKey(0)
+        q = jax.random.normal(r, (4, 32, 4, 16), jnp.float32)
+        with topo.mesh:
+            out = jax.jit(
+                lambda q, k, v: ulysses_attention(attn, q, k, v))(q, q, q)
+        return np.asarray(out)
+
+    def test_bf16_wire_within_tolerance(self, eight_devices, monkeypatch):
+        full = self._run(monkeypatch, {"DSTPU_COMM_QUANT": "0"})
+        wired = self._run(monkeypatch)
+        # bf16 has ~3 decimal digits; the softmax keeps values O(1)
+        np.testing.assert_allclose(wired, full, atol=2e-2, rtol=2e-2)
+        assert not np.array_equal(wired, full), \
+            "activation wire did not engage (outputs bitwise equal)"
+
+    def test_kill_switches_restore_full_width_bitwise(self, eight_devices,
+                                                      monkeypatch):
+        full = self._run(monkeypatch, {"DSTPU_COMM_QUANT": "0"})
+        plan_off = self._run(monkeypatch, {"DSTPU_OVERLAP_PLAN": "0"})
+        np.testing.assert_array_equal(full, plan_off)
+
+    def test_ledger_carries_halved_wire_bytes(self, eight_devices,
+                                              monkeypatch):
+        from deepspeed_tpu import comm as dist
+        from deepspeed_tpu.runtime import topology as topo_mod
+        from deepspeed_tpu.runtime.topology import TopologyConfig
+        from deepspeed_tpu.sequence.layer import ulysses_attention
+
+        topo_mod.reset()
+        topo = topo_mod.initialize(TopologyConfig(seq=2, data=-1),
+                                   force=True)
+
+        def attn(q, k, v):
+            return q + k + v
+
+        q = jnp.zeros((4, 32, 4, 16), jnp.float32)
+        ledger = dist.CollectiveLedger()
+        with dist.record_into(ledger):
+            with topo.mesh:
+                jax.eval_shape(
+                    lambda q, k, v: ulysses_attention(attn, q, k, v),
+                    q, q, q)
+        a2a = [r for r in ledger.records if r["op"] == "all_to_all"]
+        assert len(a2a) == 4  # q/k/v gather-seq + the inverse on out
+        for r in a2a:
+            assert r["wire_bytes"] * 2 == r["bytes"], r

@@ -46,7 +46,22 @@ def frac(split):
             if total else None)
 
 
-def report_entry(name: str) -> int:
+def _plan_cell(plan, rec) -> str:
+    """The planner's decision for one collective row: in-loop launches
+    ride the scan carry; straight-line launches under a scan-carry plan
+    are the schedule's edges (budget-justified exposure); inline plans
+    only bind transport. Reading this column against the static
+    classification is how plan-vs-reality drift shows up — a 'carry'
+    row classified exposed means the compiler stopped scheduling the
+    overlap the plan promises."""
+    from deepspeed_tpu.runtime.overlap_planner import PLACEMENT_SCAN_CARRY
+    if plan.placement == PLACEMENT_SCAN_CARRY:
+        return f"carry(d{plan.prefetch_depth})" if rec.loop else "edge"
+    kind = f"+{plan.transport_kind}" if plan.transport_kind else ""
+    return f"{plan.placement}{kind}"
+
+
+def report_entry(name: str, show_plan: bool = False) -> int:
     from deepspeed_tpu.analysis.entry_points import build_spec
     from deepspeed_tpu.analysis.schedule_audit import (
         CLASS_EXPOSED, CLASS_OVERLAPPED, CLASS_SERIALIZED,
@@ -91,14 +106,22 @@ def report_entry(name: str) -> int:
         print(f"{'wire / logical bytes':28}"
               f"{f'{wire} / {logical}':>24}"
               f"{wire / logical:>20.3f}")
+    plan = None
+    if show_plan:
+        from deepspeed_tpu.runtime.overlap_planner import plan_entry
+        plan = plan_entry(name)
+        print(f"{'overlap plan':28}{plan.summary():>24}{plan.source:>20}")
+        for note in plan.notes:
+            print(f"  plan note: {note}")
     print(f"\nper-collective placement ({len(rep.records)} in schedule "
           f"order; x = executions from loop trip counts):")
     for r in rep.records:
         loop = f" in {r.loop['while']}(x{r.loop['trip_count']})" \
             if r.loop else ""
+        pcol = f" plan {_plan_cell(plan, r):12}" if plan is not None else ""
         print(f"  {r.classification:10} {r.kind:20} x{r.executions} "
               f"{r.operand_bytes:>9} B  hideable {r.hideable_flops:>12} "
-              f"flops  {r.source}{loop}")
+              f"flops {pcol} {r.source}{loop}")
     for f in findings:
         print(f"finding: [{f.rule_id}] {f.message}")
     return 0
@@ -112,6 +135,11 @@ def main(argv=None) -> int:
                              "pipelined ZeRO micro)")
     parser.add_argument("--all", action="store_true",
                         help="report every registered entry point")
+    parser.add_argument("--plan", action="store_true",
+                        help="show the overlap planner's decision "
+                             "(placement / prefetch depth / width) next "
+                             "to each collective's static and runtime "
+                             "classification")
     args = parser.parse_args(argv)
 
     from deepspeed_tpu.analysis.entry_points import SPEC_BUILDERS
@@ -124,7 +152,7 @@ def main(argv=None) -> int:
         return 2
     rc = 0
     for name in names:
-        rc = max(rc, report_entry(name))
+        rc = max(rc, report_entry(name, show_plan=args.plan))
     return rc
 
 

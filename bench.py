@@ -36,6 +36,11 @@ line):
       edge-split head launches + deferred replicated flush, default-on)
       vs the hand-written schedule (DSTPU_OVERLAP_PLAN=0, fresh
       subprocess denominator)                  -> tokens/sec + vs_plan_off
+  [11d] GPT-2 125M ZeRO-3 overlap, FUSED OPT KERNEL (ISSUE 10: one
+      Pallas launch per dtype bucket for the Adam step + in-kernel SR,
+      default-on on TPU) vs the XLA elementwise tree
+      (DSTPU_OPT_KERNEL=xla, fresh subprocess denominator)
+                                               -> tokens/sec + vs_opt_kernel_off
   [12] FULL-DEPTH llama2-7b (32 layers, real dims) int4 WOQ + fp8 KV,
       16 requests, served from a real-format HF checkpoint dir via
       build_hf_engine + continuous batching    -> output tok/s + TTFT
@@ -118,8 +123,33 @@ def _flops_per_token(cfg, seq):
     return 6 * n_active + attn * cfg.num_layers * cfg.hidden_size * seq
 
 
+def _forced_remat_factor(cfg, seq) -> float:
+    """Hardware-FLOPs multiplier for a config that forces remat (this
+    environment's compile helper crashes on the no-remat fused backward,
+    so every dense line trains rematerialized): the silicon executes the
+    counted FLOPs PLUS the recomputed forward. Full remat re-runs the
+    whole forward (counted/3 -> x8/6), 'alternating' half the layers
+    (x7/6), 'attention_only' only the [B,H,S,S] attention-score forward
+    (the attention term's forward third). Recorded UNIFORMLY on every
+    remat line (ISSUE 10 satellite) so the >=0.6 MFU target (ROADMAP 4)
+    is measured consistently; ``vs_baseline`` stays on honest counted
+    FLOPs."""
+    if not getattr(cfg, "remat", False):
+        return 1.0
+    counted = _flops_per_token(cfg, seq)
+    policy = getattr(cfg, "remat_policy", "nothing_saveable")
+    if policy == "attention_only":
+        attn = 6 if getattr(cfg, "causal", True) else 12
+        extra = (attn / 3) * cfg.num_layers * cfg.hidden_size * seq
+    elif policy == "alternating":
+        extra = counted / 6
+    else:  # nothing_saveable and friends: the whole forward re-runs
+        extra = counted / 3
+    return (counted + extra) / counted
+
+
 def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
-                peak_tflops, note="", remat_forced=False):
+                peak_tflops, note=""):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -197,14 +227,14 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
         line["offload_stall_frac"] = round(
             engine.last_offload_stall_s
             / max(engine.last_offload_compute_s, 1e-9), 3)
-    if remat_forced and mfu is not None:
-        # this environment's remote compile helper crashes (HTTP 500) on
-        # the fused no-remat backward at these dims, so the config is
-        # FORCED to full rematerialization: the hardware executes ~8 FLOPs
-        # per 6 counted (forward recomputed once in the backward). This
-        # field reports utilization of the silicon including that forced
-        # recompute; vs_baseline stays on the honest counted-FLOPs MFU.
-        line["mfu_hw_incl_forced_remat"] = round(mfu * 8 / 6, 4)
+    if mfu is not None:
+        factor = _forced_remat_factor(model.config, seq)
+        if factor > 1.0:
+            # hardware utilization including the forced recompute (see
+            # _forced_remat_factor) — previously recorded on only 2 of
+            # the dense lines, and at the full-remat 8/6 factor even for
+            # attention_only configs; now uniform and policy-exact
+            line["mfu_hw_incl_forced_remat"] = round(mfu * factor, 4)
     del engine
     gc.collect()
     return line
@@ -483,7 +513,7 @@ def bench_attn_32k(peak_tflops):
     return line
 
 
-N_TPU_RUNS = 20     # build_runs(on_tpu=True) length — asserted in child mode
+N_TPU_RUNS = 21     # build_runs(on_tpu=True) length — asserted in child mode
 N_SERVING_RUNS = 6  # ... of which the LAST SIX are serving lines
 #                     (7B 512-prompt, 7B long-context, MoE-6req, and the
 #                     32/64/128 concurrency ladder) — one sample
@@ -655,9 +685,35 @@ def _overlap_plan_denominator():
         _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3, peak))
 
 
+def _opt_kernel_denominator():
+    """Child mode: the SAME gpt2-125m stage-3 pipelined schedule with the
+    optimizer kernel's bitwise escape hatch (DSTPU_OPT_KERNEL=xla — the
+    per-leaf XLA elementwise update tree + host-side SR pass, the
+    pre-ISSUE-10 program), in a fresh process (HBM isolation). Schedule,
+    transport, and planner defaults stay ON: the only variable is the
+    optimizer-step implementation."""
+    os.environ["DSTPU_OPT_KERNEL"] = "xla"
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import gpt2_model
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
+    steps = 30 if on_tpu else 3
+    _emit(bench_train(
+        "gpt2-125m ZeRO-3 xla-opt-step (denominator)",
+        gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+        _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3, peak))
+
+
 def main():
     if "--offload-denominator" in sys.argv:
         return _offload_denominator()
+    if "--opt-kernel-denominator" in sys.argv:
+        return _opt_kernel_denominator()
     if "--zero-overlap-denominator" in sys.argv:
         return _zero_overlap_denominator()
     if "--comm-quant-denominator" in sys.argv:
@@ -1048,6 +1104,57 @@ def _run_configs():
                 line["plan_off_tokens_per_sec"] = off_line["value"]
             return line
         runs.append(overlap_plan_run)
+
+        def opt_kernel_run():
+            # Fused Pallas optimizer kernel (ISSUE 10 tentpole): the SAME
+            # gpt2-125m stage-3 pipelined step with the fused bucket Adam
+            # kernel (DSTPU_OPT_KERNEL auto = Pallas on TPU: one launch
+            # per dtype bucket, fp32 in-register chain, in-kernel SR +
+            # bf16 compute-param cast in the same pass) vs the per-leaf
+            # XLA elementwise tree in its OWN subprocess
+            # (DSTPU_OPT_KERNEL=xla, _opt_kernel_denominator) — the
+            # optimizer-step implementation is the only variable.
+            # Acceptance (ISSUE 10): numerics within fp32 tolerance
+            # (tests/unit/runtime/test_opt_kernel_engine.py), step time
+            # no worse (vs_opt_kernel_off >= ~1.0); the HBM round-trip
+            # win is the kernel's to show on hardware — the perf claim
+            # is deferred to TPU, the CPU path asserts parity only
+            # (tools/opt_step_ab.py).
+            line = bench_train(
+                "gpt2-125m ZeRO-3 overlap FUSED-OPT-KERNEL bf16",
+                gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True),
+                _zero_overlap_cfg(True), 8, 1024, steps, REF_MFU_ZERO3,
+                peak, note=", fused Pallas bucket Adam step (one launch "
+                           "per dtype bucket, in-kernel SR)")
+            # HONESTY MARKER: on auto the engine pins the XLA tree on a
+            # multi-device mesh (engine._opt_kernel_choice — GSPMD would
+            # reshard the flat buckets); record what actually ran, and
+            # skip the A/B when the kernel was pinned off — both arms
+            # would run the identical program and vs_opt_kernel_off≈1.0
+            # would read as a passing perf claim the kernel never made.
+            import jax
+            forced = os.environ.get("DSTPU_OPT_KERNEL", "").strip().lower()
+            resolved = forced if forced in ("xla", "pallas") else (
+                "pallas" if jax.device_count() == 1
+                else "xla (multi-device auto-pin)")
+            line["opt_kernel_resolved"] = resolved
+            if resolved != "pallas":
+                return line
+            import subprocess
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--opt-kernel-denominator"],
+                    capture_output=True, text=True, timeout=2400)
+                off_line = _last_metric_line(r.stdout)
+            except subprocess.TimeoutExpired:
+                off_line = None
+            if off_line and off_line.get("value"):
+                line["vs_opt_kernel_off"] = round(
+                    line["value"] / off_line["value"], 3)
+                line["opt_kernel_off_tokens_per_sec"] = off_line["value"]
+            return line
+        runs.append(opt_kernel_run)
 
         def serving_7b_run():
             # FULL-DEPTH llama2-7b (32 layers, real dims) at int8 WOQ

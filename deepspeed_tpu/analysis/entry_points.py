@@ -458,6 +458,87 @@ def build_quantized_transport() -> EntrySpec:
                      gate_cheap=True)
 
 
+def build_fused_optimizer_step() -> EntrySpec:
+    """The fused Pallas optimizer step (ISSUE 10 tentpole,
+    ops/adam/pallas_adam.py via ``Optimizer.update(kernel='pallas')``):
+    one launch per flat bucket over a ZeRO-1-style dp-sharded state with
+    bf16 SR moments and the in-pass bf16 param cast — the program every
+    step path dispatches under ``DSTPU_OPT_KERNEL`` on TPU. ``step``
+    (inside the donated state) and ``lr`` trace ABSTRACT, so a regression
+    that bakes either into the kernel's static configuration cannot
+    concretize a tracer (the flash/ragged scalar-prefetch discipline).
+
+    DONATED MOMENT BUFFERS are the machine-checked contract: the kernel
+    wrapper aliases master/moment operands in place
+    (``input_output_aliases``) and the spec donates the state, so a
+    layout change that breaks the aliasing chain (a pad or concat
+    creeping into the single-leaf path) surfaces as a hard
+    ``dead-donation`` finding — without it the fp32+bf16 moments exist
+    twice at peak, exactly the copy the fused step exists to avoid.
+
+    The step runs as a ``shard_map`` over the dp axis with LOCAL flat
+    shards — the multi-chip composition the engine's mesh-aware auto
+    refinement defers to (engine ``_opt_kernel_choice``; under plain
+    GSPMD the flat-bucket layout makes the partitioner rematerialize the
+    sharded state, which is the finding this entry would raise). The
+    update is per-rank elementwise math, so NO collective belongs in the
+    compiled program (``expected_spmd`` empty, zero-byte collective map
+    committed — the paged-decode discipline)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.runtime.optimizers import Optimizer
+    from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    topo = topo_mod.initialize(TopologyConfig(data=-1), force=True)
+    mesh = topo.mesh
+    d = DATA_AXIS
+    opt = Optimizer(name="adamw", lr=1e-3, weight_decay=0.01,
+                    moment_dtype=jnp.bfloat16, moment_sq_dtype=jnp.bfloat16)
+    put = lambda x, *spec: jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    # a dp-sharded matmul-weight leaf + a replicated bias leaf — the two
+    # sharding classes a ZeRO-1 optimizer state mixes
+    spec_of = {"w": P(d), "b": P()}
+    tree_spec = lambda: dict(spec_of)
+    params = {"w": put(jnp.zeros((2048, 128), jnp.float32), d),
+              "b": put(jnp.zeros((128,), jnp.float32))}
+    state = opt.init(params)
+    place = lambda t: {k: put(v, *(spec_of[k] or ()))
+                       for k, v in t.items()}
+    state = {"step": put(state["step"]),
+             "master": place(state["master"]),
+             "exp_avg": place(state["exp_avg"]),
+             "exp_avg_sq": place(state["exp_avg_sq"])}
+    grads = {"w": put(jnp.zeros((2048, 128), jnp.bfloat16), d),
+             "b": put(jnp.zeros((128,), jnp.bfloat16))}
+
+    def local_update(g, opt_state, lr):
+        # bucket_elems=1: every leaf stands alone = the alias (in-place)
+        # path — the donation contract under machine check. Replicated
+        # leaves step identically on every rank (the SR stream is a pure
+        # function of (step, slot, bucket) x element index).
+        return opt.update(g, opt_state, lr, param_dtype=jnp.bfloat16,
+                          kernel="pallas", bucket_elems=1)
+
+    state_specs = {"step": P(), "master": tree_spec(),
+                   "exp_avg": tree_spec(), "exp_avg_sq": tree_spec()}
+    fn = shard_map(local_update, mesh=mesh,
+                   in_specs=(tree_spec(), state_specs, P()),
+                   out_specs=(tree_spec(), state_specs),
+                   check_vma=False)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    args = (grads, state, lr)
+    sh = lambda tree: jax.tree.map(lambda x: x.sharding, tree)
+    return EntrySpec(
+        name="fused-optimizer-step", fn=fn, args=args,
+        donate_argnums=(1,), mesh=mesh, retrace_args=[args, args],
+        jit_kwargs=dict(in_shardings=(sh(grads), sh(state), None),
+                        out_shardings=(sh(grads), sh(state))),
+        gate_cheap=True)
+
+
 def build_telemetry_off_parity() -> EntrySpec:
     """The telemetry zero-overhead contract (docs/OBSERVABILITY.md): the
     engine step entry point's jaxpr must be IDENTICAL with telemetry off
@@ -529,6 +610,7 @@ SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
     "paged-decode": build_paged_decode,
     "quantized-transport": build_quantized_transport,
     "ragged-paged-attention": build_ragged_paged_attention,
+    "fused-optimizer-step": build_fused_optimizer_step,
     "telemetry-off-parity": build_telemetry_off_parity,
 }
 
@@ -572,8 +654,9 @@ ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
 #: Pinned rather than computed — building every spec just to read its
 #: gate_cheap flag would boot engines; a test asserts the two agree.
 GATE_SPMD_ENTRY_POINTS: Tuple[str, ...] = (
-    "moe-dispatch", "paged-decode", "quantized-transport",
-    "ragged-paged-attention", "ring-attention", "ulysses-attention")
+    "fused-optimizer-step", "moe-dispatch", "paged-decode",
+    "quantized-transport", "ragged-paged-attention", "ring-attention",
+    "ulysses-attention")
 
 
 def audit_entry_points(names=None) -> List[Finding]:

@@ -4,8 +4,16 @@ Counterpart of the reference ``ops/adam/cpu_adam.py`` (``DeepSpeedCPUAdam``)
 over the C++ kernel in ``csrc/optimizers/cpu_optimizers.cpp`` (reference
 ``csrc/adam/cpu_adam_impl.cpp`` AVX path). Operates in place on flat numpy
 fp32 buffers — the ZeRO-Offload layout where the host owns the master
-params + moments and the TPU only sees bf16 params. Falls back to a numpy
-implementation when no C++ toolchain is available.
+params + moments and the TPU only sees bf16 params.
+
+Since ISSUE 10 these classes are legacy-API shims over the shared kernel
+dispatch: when no C++ toolchain is available, the fallback math routes
+through the HOST backend of :mod:`.pallas_adam` (``host_adam_step`` /
+``host_lion_step`` / ``host_adagrad_step``) — one statement of the update
+shared with the Pallas bucket kernels, so the reference surface cannot
+drift from the engine's fused path. Direct construction warns once; the
+sanctioned internal users (``runtime/zero/offload_optimizer.py``,
+``runtime/zero/param_stream.py``) pass ``_sanctioned=True``.
 """
 
 from __future__ import annotations
@@ -15,18 +23,31 @@ from typing import Optional
 
 import numpy as np
 
+from ...utils.logging import warning_once
 from ..op_builder.all_ops import CPUAdamBuilder
+from .pallas_adam import host_adagrad_step, host_adam_step, host_lion_step
 
 
 def _fp(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
 
 
+def _warn_direct(name: str, sanctioned: bool) -> None:
+    if not sanctioned:
+        warning_once(
+            f"ops.adam.cpu_adam.{name} is a legacy shim (reference "
+            "DeepSpeedCPUAdam surface); the offload/paged engines reach it "
+            "through runtime/zero — its fallback math is the shared host "
+            "backend of ops/adam/pallas_adam.py (DSTPU_OPT_KERNEL owns "
+            "the device-side dispatch)")
+
+
 class DeepSpeedCPUAdam:
 
     def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8,
                  weight_decay: float = 0.0, adamw_mode: bool = True,
-                 fp32_optimizer_states: bool = True):
+                 fp32_optimizer_states: bool = True, _sanctioned: bool = False):
+        _warn_direct("DeepSpeedCPUAdam", _sanctioned)
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.eps = eps
@@ -56,25 +77,18 @@ class DeepSpeedCPUAdam:
                 params.size, lr, self.beta1, self.beta2, self.eps,
                 self.weight_decay, step, int(self.adamw_mode))
             return
-        # numpy fallback (same math as the kernel)
-        g = grads if self.adamw_mode else grads + self.weight_decay * params
-        exp_avg *= self.beta1
-        exp_avg += (1 - self.beta1) * g
-        exp_avg_sq *= self.beta2
-        exp_avg_sq += (1 - self.beta2) * g * g
-        bc1 = 1.0 / (1.0 - self.beta1 ** step)
-        bc2 = 1.0 / (1.0 - self.beta2 ** step)
-        update = (exp_avg * bc1) / (np.sqrt(exp_avg_sq * bc2) + self.eps)
-        if self.adamw_mode:
-            update = update + self.weight_decay * params
-        params -= lr * update
+        # shared host backend (same math as the Pallas bucket kernel)
+        host_adam_step(params, grads, exp_avg, exp_avg_sq, step=step, lr=lr,
+                       beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+                       weight_decay=self.weight_decay, adamw=self.adamw_mode)
 
 
 class DeepSpeedCPULion:
     """Reference ``ops/lion/cpu_lion.py`` over csrc lion kernel."""
 
     def __init__(self, lr: float = 1e-4, betas=(0.9, 0.99),
-                 weight_decay: float = 0.0):
+                 weight_decay: float = 0.0, _sanctioned: bool = False):
+        _warn_direct("DeepSpeedCPULion", _sanctioned)
         self.lr = lr
         self.beta1, self.beta2 = betas
         self.weight_decay = weight_decay
@@ -88,17 +102,16 @@ class DeepSpeedCPULion:
                                        params.size, lr, self.beta1, self.beta2,
                                        self.weight_decay)
             return
-        c = self.beta1 * exp_avg + (1 - self.beta1) * grads
-        params -= lr * (np.sign(c) + self.weight_decay * params)
-        exp_avg *= self.beta2
-        exp_avg += (1 - self.beta2) * grads
+        host_lion_step(params, grads, exp_avg, lr=lr, beta1=self.beta1,
+                       beta2=self.beta2, weight_decay=self.weight_decay)
 
 
 class DeepSpeedCPUAdagrad:
     """Reference ``ops/adagrad/cpu_adagrad.py``."""
 
     def __init__(self, lr: float = 1e-2, eps: float = 1e-10,
-                 weight_decay: float = 0.0):
+                 weight_decay: float = 0.0, _sanctioned: bool = False):
+        _warn_direct("DeepSpeedCPUAdagrad", _sanctioned)
         self.lr = lr
         self.eps = eps
         self.weight_decay = weight_decay
@@ -112,6 +125,5 @@ class DeepSpeedCPUAdagrad:
                                           params.size, lr, self.eps,
                                           self.weight_decay)
             return
-        g = grads + self.weight_decay * params
-        sq_sum += g * g
-        params -= lr * g / (np.sqrt(sq_sum) + self.eps)
+        host_adagrad_step(params, grads, sq_sum, lr=lr, eps=self.eps,
+                          weight_decay=self.weight_decay)

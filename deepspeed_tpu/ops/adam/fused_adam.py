@@ -1,101 +1,54 @@
-"""Pallas fused Adam.
+"""Legacy fused-Adam shim over the bucket kernel dispatch.
 
-TPU-native counterpart of the reference's multi-tensor fused Adam
-(``csrc/adam/multi_tensor_adam.cu``, ``fused_adam_frontend.cpp:22``): one
-kernel pass updating params + both moments in place over a flat shard,
-avoiding one HBM round-trip per tensor per quantity that a naive chain of
-elementwise jnp ops could incur if XLA declined to fuse.
+The reference-API surface (``FusedAdam`` over ``csrc/adam/
+multi_tensor_adam.cu``, ``fused_adam_frontend.cpp:22``) kept alive as a
+thin router: since ISSUE 10 the actual kernel lives in
+:mod:`.pallas_adam` (one launch per flat bucket, in-kernel SR, aliasing)
+and the engine dispatches it through ``Optimizer.update`` behind
+``DSTPU_OPT_KERNEL`` — direct calls here warn once and forward to the
+same kernel so the two surfaces cannot drift numerically.
 
-The kernel runs on 1-D flat buffers (the ZeRO flat-partition layout) tiled
-into VMEM blocks; bias correction is precomputed on the host side of the
-trace (scalars). On CPU (tests) the kernel runs in interpret mode with
-identical semantics.
+``fused_adam_reference`` (the pure-jnp mirror the parity tests pin) is
+unchanged.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
-_BLOCK = 1024 * 128  # elements per grid step; multiple of (8,128) tiles
+from ...utils.logging import warning_once
+from .pallas_adam import adam_bucket_update
 
-
-def _adam_kernel(g_ref, p_ref, m_ref, v_ref, scal_ref,
-                 p_out, m_out, v_out):
-    lr = scal_ref[0]
-    beta1 = scal_ref[1]
-    beta2 = scal_ref[2]
-    eps = scal_ref[3]
-    wd = scal_ref[4]
-    bc1 = scal_ref[5]  # 1 / (1 - b1^t)
-    bc2 = scal_ref[6]  # 1 / (1 - b2^t)
-    decoupled = scal_ref[7]  # 1.0 => adamw
-
-    g = g_ref[:]
-    p = p_ref[:]
-    # adam-style (coupled) weight decay folds into the gradient
-    g = jnp.where(decoupled > 0, g, g + wd * p)
-    m = beta1 * m_ref[:] + (1.0 - beta1) * g
-    v = beta2 * v_ref[:] + (1.0 - beta2) * g * g
-    update = (m * bc1) / (jnp.sqrt(v * bc2) + eps)
-    update = jnp.where(decoupled > 0, update + wd * p, update)
-    p_out[:] = p - lr * update
-    m_out[:] = m
-    v_out[:] = v
+_BLOCK = 1024 * 128  # legacy block size in ELEMENTS (multiple of (8,128))
 
 
-@functools.partial(jax.jit, static_argnames=("adamw", "interpret", "block_size"))
 def fused_adam_update(grads: jax.Array, params: jax.Array, exp_avg: jax.Array,
                       exp_avg_sq: jax.Array, step: jax.Array, lr, beta1=0.9,
                       beta2=0.999, eps=1e-8, weight_decay=0.0, adamw: bool = True,
                       interpret: bool = False,
                       block_size: int = _BLOCK) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One Adam step on flat fp32 buffers. Returns (params, m, v)."""
+    """One Adam step on flat fp32 buffers. Returns (params, m, v).
+
+    Legacy entry point — routes through the ISSUE 10 bucket kernel
+    (:func:`.pallas_adam.adam_bucket_update`, fp32 moments, no SR). New
+    code should let ``Optimizer.update`` dispatch (``DSTPU_OPT_KERNEL``)
+    so moment dtypes, stochastic rounding and the param cast ride along."""
+    warning_once(
+        "ops.adam.fused_adam_update is a legacy shim; the engine "
+        "dispatches the fused optimizer kernels via runtime/optimizers.py "
+        "(DSTPU_OPT_KERNEL) — routing this call through ops/adam/"
+        "pallas_adam.py")
     assert grads.ndim == 1, "fused_adam_update operates on flat shards"
-    n = grads.shape[0]
-    stepf = step.astype(jnp.float32)
-    scalars = jnp.stack([
-        jnp.asarray(lr, jnp.float32),
-        jnp.asarray(beta1, jnp.float32),
-        jnp.asarray(beta2, jnp.float32),
-        jnp.asarray(eps, jnp.float32),
-        jnp.asarray(weight_decay, jnp.float32),
-        1.0 / (1.0 - jnp.asarray(beta1, jnp.float32) ** stepf),
-        1.0 / (1.0 - jnp.asarray(beta2, jnp.float32) ** stepf),
-        jnp.asarray(1.0 if adamw else 0.0, jnp.float32),
-    ])
-
-    block = min(block_size, n)
-    if n % block != 0:  # pad to a whole number of blocks
-        pad = block - n % block
-        grads = jnp.pad(grads, (0, pad))
-        params_p = jnp.pad(params, (0, pad))
-        m_p = jnp.pad(exp_avg, (0, pad))
-        v_p = jnp.pad(exp_avg_sq, (0, pad))
-    else:
-        pad = 0
-        params_p, m_p, v_p = params, exp_avg, exp_avg_sq
-
-    total = grads.shape[0]
-    grid = (total // block,)
-    spec = pl.BlockSpec((block,), lambda i: (i,))
-    scal_spec = pl.BlockSpec((8,), lambda i: (0,))
-    out_shape = [jax.ShapeDtypeStruct((total,), jnp.float32)] * 3
-    p_new, m_new, v_new = pl.pallas_call(
-        _adam_kernel,
-        grid=grid,
-        in_specs=[spec, spec, spec, spec, scal_spec],
-        out_specs=[spec, spec, spec],
-        out_shape=out_shape,
-        interpret=interpret,
-    )(grads.astype(jnp.float32), params_p.astype(jnp.float32), m_p, v_p, scalars)
-    if pad:
-        p_new, m_new, v_new = p_new[:n], m_new[:n], v_new[:n]
-    return p_new, m_new, v_new
+    p, _, m, v = adam_bucket_update(
+        grads.astype(jnp.float32), params.astype(jnp.float32),
+        exp_avg, exp_avg_sq, step=step, lr=lr, beta1=beta1, beta2=beta2,
+        eps=eps, weight_decay=weight_decay,
+        mode="adamw" if adamw else "adam", sr=False,
+        block_rows=max(1, block_size // 128), interpret=interpret)
+    return p, m, v
 
 
 def fused_adam_reference(grads, params, m, v, step, lr, beta1=0.9, beta2=0.999,

@@ -1,0 +1,3 @@
+"""Lion optimizer kernels (reference ``ops/lion`` / ``csrc/lion``)."""
+
+from .pallas_lion import lion_bucket_update  # noqa: F401

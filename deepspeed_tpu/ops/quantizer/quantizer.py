@@ -84,6 +84,17 @@ def quantize_blockwise(x: jax.Array, num_bits: int = 8, group_size: int = 256,
 
     qmax = (1 << (num_bits - 1)) - 1  # 127 / 7
     qmin = -qmax - 1
+    if symmetric and num_bits == 8:
+        # fused quantize+pack Pallas kernel (ISSUE 10 satellite): one
+        # launch computes absmax/scale/round/cast per group-row block —
+        # byte-identical to the XLA chain below (pallas_quant.py's
+        # contract), so the transport planner's wire payloads and the
+        # committed Layer-C wire budgets are unchanged. Lane-aligned
+        # int8-symmetric only; everything else keeps the XLA ops.
+        from .pallas_quant import quant_kernel_enabled, quantize_rows_int8
+        if quant_kernel_enabled(group_size, num_bits, symmetric):
+            q, scale = quantize_rows_int8(groups)
+            return q, scale, jnp.zeros_like(scale)
     if symmetric:
         absmax = jnp.max(jnp.abs(groups), axis=1, keepdims=True)
         scale = absmax / qmax

@@ -950,6 +950,25 @@ class DeepSpeedEngine:
         state["grad_acc"] = new_acc
         return state, loss
 
+    def _opt_kernel_choice(self) -> Optional[str]:
+        """The engine's mesh-aware refinement of the ``DSTPU_OPT_KERNEL``
+        auto default: forced values ('xla'/'pallas') pass through
+        untouched; on auto, a MULTI-device mesh pins the XLA tree even on
+        TPU — the fused path's flat-bucket layout would make GSPMD
+        reshard (fully rematerialize) the ZeRO-sharded optimizer state
+        every step, the exact copy the kernel exists to avoid. The
+        single-chip meshes the dense MFU bench lines run on take the
+        kernel; the multi-chip enablement needs a shard_map'd local
+        flat-partition layout (docs/KERNELS.md). Returning ``None``
+        lets ``Optimizer.update`` resolve the env (TPU -> pallas,
+        CPU -> xla)."""
+        mode = os.environ.get("DSTPU_OPT_KERNEL", "").strip().lower()
+        if mode in ("xla", "pallas"):
+            return mode
+        if self.mesh.size > 1:
+            return "xla"
+        return None
+
     def _apply_step_fn(self, state, lr):
         """Optimizer boundary: unscale, clip, update, recast, scale bookkeeping."""
         return self._apply_from_grads(state, state["grad_acc"], lr)
@@ -980,9 +999,14 @@ class DeepSpeedEngine:
             factor = inv * clip
 
         def do_update(_):
-            new_master, new_opt = self.optimizer.update(
-                grads, state["opt"], lr, grad_scale=factor)
-            new_params = jax.tree.map(lambda m: m.astype(self.param_dtype), new_master)
+            # param_dtype: the compute-param cast happens INSIDE update —
+            # in-kernel on the fused Pallas path (DSTPU_OPT_KERNEL, one
+            # write instead of a separate recast program), the identical
+            # astype composition on the XLA path (bitwise pre-PR)
+            new_params, new_opt = self.optimizer.update(
+                grads, state["opt"], lr, grad_scale=factor,
+                param_dtype=self.param_dtype,
+                kernel=self._opt_kernel_choice())
             return new_params, new_opt
 
         def skip_update(_):
@@ -2203,10 +2227,12 @@ class DeepSpeedEngine:
                     dtype = self.param_dtype
 
                     def dev_step(dg, opt, lr_val, gs):
-                        new_master, new_opt = self.optimizer.update(
-                            dg, opt, lr_val, grad_scale=gs)
-                        new_params = jax.tree.map(
-                            lambda m: m.astype(dtype), new_master)
+                        # cast inside update (fused kernel writes it in
+                        # the same pass; XLA path bitwise pre-PR)
+                        new_params, new_opt = self.optimizer.update(
+                            dg, opt, lr_val, grad_scale=gs,
+                            param_dtype=dtype,
+                            kernel=self._opt_kernel_choice())
                         return new_params, new_opt
 
                     # donate the optimizer state: it is replaced by the

@@ -37,13 +37,57 @@ full-depth bench configs do, and say so).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, Optional, Tuple
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 Params = Any
 OptState = Dict[str, Any]
+
+#: optimizers served by the fused Pallas bucket kernels
+#: (ops/adam/pallas_adam.py, ops/lion/pallas_lion.py; LAMB rides the Adam
+#: kernel with a trust-ratio epilogue). The 1-bit variants keep their own
+#: shard_map machinery and adagrad/sgd stay on the XLA tree (single cheap
+#: slot — no fusion win to buy).
+_FUSED_KERNEL_NAMES = frozenset(
+    {"adam", "adamw", "muadam", "muadamw", "lamb", "lion"})
+
+#: fused-bucket cap in ELEMENTS: leaves greedy-pack into flat buckets up
+#: to this size (one launch serves many small leaves — the overlap.py
+#: fused-buffer discipline); a leaf at or above the cap stands alone,
+#: which is also the in-place aliasing path (no concat copy).
+_OPT_BUCKET_ELEMS = 1 << 20
+
+
+def _opt_bucket_elems() -> int:
+    return int(os.environ.get("DSTPU_OPT_BUCKET", _OPT_BUCKET_ELEMS))
+
+
+def _plan_opt_buckets(sizes: List[int], keys: List[str],
+                      cap: int) -> List[List[int]]:
+    """Greedy in-order packing of leaf indices into flat buckets: leaves
+    sharing a grad dtype fuse until the bucket reaches ``cap`` elements;
+    an oversize leaf forms its own (alias-eligible) bucket."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_key, cur_n = None, 0
+    for i, (n, key) in enumerate(zip(sizes, keys)):
+        if n >= cap:
+            if cur:
+                buckets.append(cur)
+                cur, cur_key, cur_n = [], None, 0
+            buckets.append([i])
+            continue
+        if cur and (key != cur_key or cur_n + n > cap):
+            buckets.append(cur)
+            cur, cur_n = [], 0
+        cur.append(i)
+        cur_key, cur_n = key, cur_n + n
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 def _tree_zeros_like(tree, dtype=jnp.float32):
@@ -161,18 +205,37 @@ class Optimizer:
 
     # -- pytree update -------------------------------------------------------
     def update(self, grads: Params, state: OptState, lr,
-               grad_scale=None) -> Tuple[Params, OptState]:
+               grad_scale=None, param_dtype=None,
+               kernel: Optional[str] = None,
+               bucket_elems: Optional[int] = None) -> Tuple[Params, OptState]:
         """Apply one step on the master params (computed in fp32, stored in
-        ``master_dtype``/``moment_dtype``). Returns (new_master_fp32, new_state);
-        the returned master is the full-precision result so the caller's
-        param recast does not round twice.
+        ``master_dtype``/``moment_dtype``). Returns ``(new_master_fp32,
+        new_state)`` — or ``(new_params, new_state)`` when ``param_dtype``
+        is given, with the compute-param cast applied by the update itself
+        (in-kernel on the fused path, the same ``astype`` the caller ran
+        pre-PR on the XLA path, so ``DSTPU_OPT_KERNEL=xla`` stays bitwise).
 
         ``grad_scale``: optional scalar folded into the per-leaf fp32 cast
         (loss-scale unscaling x clipping). Passing it here instead of
         pre-multiplying the tree keeps XLA from materializing a full fp32
         gradient copy — 4.4 GiB at 1.1B params — between the backward and
         the update (the job of the reference's fused multi-tensor
-        scale-and-apply kernels, csrc/adam/multi_tensor_adam.cu)."""
+        scale-and-apply kernels, csrc/adam/multi_tensor_adam.cu).
+
+        ``kernel``: ``None`` resolves ``DSTPU_OPT_KERNEL`` (''=auto:
+        Pallas on TPU / XLA tree on CPU meshes, 'xla'=bitwise escape
+        hatch, 'pallas'=force, interpret mode on CPU); an explicit value
+        pins the path (tests, the ``fused-optimizer-step`` lint entry).
+        The fused path serves adam/adamw/lamb/lion; other optimizers run
+        the XLA tree regardless."""
+        from ..ops.adam.pallas_adam import opt_kernel_mode
+
+        mode = kernel if kernel is not None else opt_kernel_mode()
+        if (mode == "pallas" and self.name in _FUSED_KERNEL_NAMES
+                and jax.tree.leaves(grads)):
+            return self._update_fused(grads, state, lr, grad_scale,
+                                      param_dtype,
+                                      bucket_elems or _opt_bucket_elems())
         f32 = jnp.float32
         c32 = lambda x: x.astype(f32)
         if grad_scale is None:
@@ -227,6 +290,164 @@ class Optimizer:
         for i, (key, dt) in enumerate(slot_dtypes.items()):
             if key in new_state:
                 new_state[key] = _narrow_state_tree(new_state[key], dt, step, i + 1)
+        if param_dtype is not None:
+            # same astype the caller ran pre-PR — moving it inside keeps
+            # the xla path bitwise while letting the fused path emit the
+            # cast from the kernel pass
+            return (jax.tree.map(lambda m: m.astype(param_dtype), new_master),
+                    new_state)
+        return new_master, new_state
+
+    # -- fused Pallas bucket path (ISSUE 10 tentpole) ------------------------
+    def _update_fused(self, grads: Params, state: OptState, lr, grad_scale,
+                      param_dtype, bucket_elems: int
+                      ) -> Tuple[Params, OptState]:
+        """One Pallas launch per flat dtype-bucket of leaves
+        (ops/adam/pallas_adam.py, ops/lion/pallas_lion.py): grad + fp32
+        master + moments are read once, the update computes in fp32
+        in-register, and the narrowed moments (in-kernel stochastic
+        rounding, seeded ``(step, slot, bucket)``) plus the compute-param
+        cast write in the same pass. Leaves fuse into lane-padded flat
+        buckets (the ``runtime/zero/overlap.py`` fused-buffer layout:
+        per-leaf segments padded to 128-lane multiples, zero padding
+        inert); a leaf at/above the bucket cap stands alone and aliases
+        its operands in place. LAMB runs the Adam kernel without bias
+        correction and applies the per-leaf trust ratio as an XLA
+        epilogue (norms are per-leaf reductions)."""
+        from ..ops.adam.pallas_adam import (adam_bucket_update,
+                                            lamb_trust_epilogue,
+                                            opt_kernel_interpret, sr_seed)
+        from ..ops.lion.pallas_lion import lion_bucket_update
+
+        f32 = jnp.float32
+        lanes = 128
+        interpret = opt_kernel_interpret()
+        step = state["step"] + 1
+        is_lamb = self.name in ("lamb",)
+        is_lion = self.name == "lion"
+        decoupled = self.name in ("adamw", "muadamw")
+        kmode = ("lamb" if is_lamb
+                 else ("adamw" if decoupled else "adam"))
+        mdt = self.master_dtype or f32
+        sdt = self.moment_dtype or f32
+        sqdt = self.moment_sq_dtype or f32
+
+        gleaves, treedef = jax.tree_util.tree_flatten(grads)
+        pleaves = treedef.flatten_up_to(state["master"])
+        mleaves = treedef.flatten_up_to(state["exp_avg"])
+        vleaves = (None if is_lion
+                   else treedef.flatten_up_to(state["exp_avg_sq"]))
+        sizes = [int(g.size) for g in gleaves]
+        gkeys = [str(jnp.result_type(g)) for g in gleaves]
+        # zero-size leaves skip the kernel entirely (a 0-element segment
+        # would still lane-pad to 128 inside a fused bucket, shifting
+        # every later leaf's offset); they pass through below exactly as
+        # the XLA tree treats them — an empty update is a no-op
+        live = [i for i in range(len(gleaves)) if sizes[i] > 0]
+        buckets = [[live[j] for j in b] for b in _plan_opt_buckets(
+            [sizes[i] for i in live], [gkeys[i] for i in live],
+            bucket_elems)]
+
+        def flat(x):
+            return x.reshape(-1)
+
+        def seg(x, k):
+            """Lane-pad a leaf's flat segment (fused buckets only)."""
+            f = flat(x)
+            kp = -(-k // lanes) * lanes
+            return jnp.pad(f, (0, kp - k)) if kp != k else f
+
+        new_p = [None] * len(gleaves)   # fp32 master out
+        new_pc = [None] * len(gleaves)  # param-dtype cast out
+        new_m = [None] * len(gleaves)
+        new_v = [None] * len(gleaves)
+
+        for i in range(len(gleaves)):
+            if sizes[i]:
+                continue
+            pi = pleaves[i].astype(f32)
+            new_p[i] = pi
+            if param_dtype is not None:
+                new_pc[i] = pi.astype(param_dtype)
+            new_m[i] = mleaves[i]
+            if vleaves is not None:
+                new_v[i] = vleaves[i]
+
+        for b_idx, idxs in enumerate(buckets):
+            single = len(idxs) == 1
+            if single:
+                i = idxs[0]
+                gb = flat(gleaves[i])
+                pb = flat(pleaves[i]).astype(mdt)
+                mb = flat(mleaves[i])
+                vb = flat(vleaves[i]) if vleaves is not None else None
+            else:
+                gb = jnp.concatenate([seg(gleaves[i], sizes[i])
+                                      for i in idxs])
+                pb = jnp.concatenate([seg(pleaves[i], sizes[i])
+                                      for i in idxs])
+                mb = jnp.concatenate([seg(mleaves[i], sizes[i])
+                                      for i in idxs])
+                vb = (jnp.concatenate([seg(vleaves[i], sizes[i])
+                                       for i in idxs])
+                      if vleaves is not None else None)
+            if is_lion:
+                pm, pc, mo = lion_bucket_update(
+                    gb, pb, mb, lr=lr, beta1=self.betas[0],
+                    beta2=self.betas[1], weight_decay=self.weight_decay,
+                    grad_scale=grad_scale,
+                    seed_m=sr_seed(step, 1, b_idx), m_dtype=sdt,
+                    param_dtype=param_dtype, interpret=interpret)
+                vo = None
+            else:
+                pm, pc, mo, vo = adam_bucket_update(
+                    gb, pb, mb, vb, step=step, lr=lr, beta1=self.betas[0],
+                    beta2=self.betas[1], eps=self.eps,
+                    weight_decay=self.weight_decay, mode=kmode,
+                    grad_scale=grad_scale,
+                    seed_m=sr_seed(step, 1, b_idx),
+                    seed_v=sr_seed(step, 2, b_idx),
+                    m_dtype=sdt, v_dtype=sqdt,
+                    param_dtype=None if is_lamb else param_dtype,
+                    interpret=interpret)
+            off = 0
+            for i in idxs:
+                k = sizes[i]
+                kp = k if single else -(-k // lanes) * lanes
+                shape = gleaves[i].shape
+                take = lambda b: b[off:off + k].reshape(shape)
+                if is_lamb:
+                    # trust-ratio epilogue: pm holds the un-scaled update
+                    p_f32 = flat(pleaves[i]).astype(f32)
+                    pi = lamb_trust_epilogue(
+                        p_f32, pm[off:off + k], lr=lr,
+                        min_coeff=self.min_coeff,
+                        max_coeff=self.max_coeff).reshape(shape)
+                    new_p[i] = pi
+                    if param_dtype is not None:
+                        new_pc[i] = pi.astype(param_dtype)
+                else:
+                    new_p[i] = take(pm)
+                    if pc is not None:
+                        new_pc[i] = take(pc)
+                new_m[i] = take(mo)
+                if vo is not None:
+                    new_v[i] = take(vo)
+                off += kp
+
+        unflat = lambda leaves: jax.tree_util.tree_unflatten(treedef, leaves)
+        new_master = unflat(new_p)
+        new_state: OptState = {
+            "step": step,
+            "master": (new_master if jnp.dtype(mdt) == jnp.dtype(f32)
+                       else jax.tree.map(lambda x: x.astype(mdt),
+                                         new_master)),
+            "exp_avg": unflat(new_m),
+        }
+        if not is_lion:
+            new_state["exp_avg_sq"] = unflat(new_v)
+        if param_dtype is not None:
+            return unflat(new_pc), new_state
         return new_master, new_state
 
 

@@ -47,14 +47,16 @@ class OffloadedOptimizerRunner:
         if self.opt_type in ("adam", "adamw"):
             self._opt = DeepSpeedCPUAdam(lr=lr, betas=betas, eps=eps,
                                          weight_decay=wd,
-                                         adamw_mode=self.opt_type == "adamw")
+                                         adamw_mode=self.opt_type == "adamw",
+                                         _sanctioned=True)
             self._slots = 2  # m, v
         elif self.opt_type == "lion":
             self._opt = DeepSpeedCPULion(lr=lr, betas=betas or (0.9, 0.99),
-                                         weight_decay=wd)
+                                         weight_decay=wd, _sanctioned=True)
             self._slots = 1
         elif self.opt_type == "adagrad":
-            self._opt = DeepSpeedCPUAdagrad(lr=lr, eps=eps, weight_decay=wd)
+            self._opt = DeepSpeedCPUAdagrad(lr=lr, eps=eps, weight_decay=wd,
+                                            _sanctioned=True)
             self._slots = 1
         else:
             raise ValueError(f"offload unsupported for optimizer '{opt_type}' "

@@ -151,18 +151,20 @@ class ParamStreamRunner:
                 betas=tuple(opt_params.get("betas", (0.9, 0.999))),
                 eps=opt_params.get("eps", 1e-8),
                 weight_decay=opt_params.get("weight_decay", 0.0),
-                adamw_mode="w" in opt_type)
+                adamw_mode="w" in opt_type, _sanctioned=True)
             self._slots = 2
         elif opt_type in ("lion", "fusedlion"):
             self._opt = DeepSpeedCPULion(
                 lr=self.lr_default,
                 betas=tuple(opt_params.get("betas", (0.9, 0.99))),
-                weight_decay=opt_params.get("weight_decay", 0.0))
+                weight_decay=opt_params.get("weight_decay", 0.0),
+                _sanctioned=True)
             self._slots = 1
         elif opt_type == "adagrad":
             self._opt = DeepSpeedCPUAdagrad(
                 lr=self.lr_default, eps=opt_params.get("eps", 1e-8),
-                weight_decay=opt_params.get("weight_decay", 0.0))
+                weight_decay=opt_params.get("weight_decay", 0.0),
+                _sanctioned=True)
             self._slots = 1
         else:
             raise ValueError(f"paged_training host optimizer supports "

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""A/B: fused Pallas optimizer kernels (DSTPU_OPT_KERNEL, ISSUE 10) vs the
+XLA elementwise tree on the SAME gpt2-125m step.
+
+Both arms run the identical single-chip fused train step (gas==1, ZeRO-1,
+bf16 params + SR bf16 moments — the full-depth bench precision recipe);
+the ONLY variable is the optimizer-update program: the ``fused`` arm
+forces ``DSTPU_OPT_KERNEL=pallas`` (one launch per flat bucket, in-kernel
+stochastic rounding + param cast), the ``xla`` arm pins
+``DSTPU_OPT_KERNEL=xla`` (the per-leaf elementwise tree — bitwise the
+pre-ISSUE-10 program). Each child also reports its final loss so the
+parity half of the acceptance is visible next to the wall-clock half.
+
+Interleaving is at PROCESS granularity via tools/ab_common.py (the env
+gate binds at trace time, and two 125M engines do not reliably fit HBM
+together).
+
+On a CPU backend the script automatically shrinks to a smoke shape
+(gpt2-tiny, 2 steps, interpret-mode kernels) — the acceptance's "runs
+clean in CPU interpret mode" check:
+
+Run:  python tools/opt_step_ab.py
+      python tools/opt_step_ab.py --single fused|xla
+"""
+
+import json
+import os
+import sys
+import time
+
+# repo root on sys.path: children re-run this file directly, and python
+# seeds sys.path[0] with tools/, not the package root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 30
+SMOKE_STEPS = 2
+
+
+def _on_cpu():
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def build(variant, smoke):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import gpt2_model
+    from deepspeed_tpu.runtime import topology as topo_mod
+
+    topo_mod.reset()
+    os.environ["DSTPU_OPT_KERNEL"] = \
+        "pallas" if variant == "fused" else "xla"
+    if smoke:
+        model = gpt2_model("gpt2-tiny", dtype=jnp.bfloat16, remat=False,
+                           max_seq_len=64, vocab_size=512)
+        micro, seq = 2, 32
+    else:
+        model = gpt2_model("gpt2-125m", dtype=jnp.bfloat16, remat=True)
+        micro, seq = 8, 1024
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 1},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        # the production full-depth precision recipe: SR bf16 moments —
+        # the narrowing the in-kernel SR path replaces host-side
+        "data_types": {"optimizer_moment_dtype": "bf16",
+                       "optimizer_moment_sq_dtype": "bf16"},
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    ids = np.random.default_rng(0).integers(
+        0, model.config.vocab_size, size=(micro, seq))
+    return engine, {"input_ids": ids}, micro * seq
+
+
+def run_single(variant):
+    import jax
+    import jax.numpy as jnp
+
+    def sync(x):
+        return float(jax.device_get(jnp.ravel(x)[0]))
+
+    smoke = _on_cpu()
+    steps = SMOKE_STEPS if smoke else STEPS
+    try:
+        engine, batch, tokens = build(variant, smoke)
+        sync(engine.train_batch(batch))  # compile + settle
+        sync(engine.train_batch(batch))
+        best = float("inf")
+        loss = None
+        for _ in range(2 if smoke else 4):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.train_batch(batch)
+            sync(loss)
+            leaf = jax.tree.leaves(engine.state["params"])[0]
+            sync(jnp.ravel(leaf)[0])
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({
+            "variant": variant, "smoke": smoke, "best_window_s": best,
+            "tokens_per_sec": round(tokens * steps / best, 1),
+            "loss_last": round(float(loss), 6),
+            "moment_dtype": str(jax.tree.leaves(
+                engine.state["opt"]["exp_avg"])[0].dtype),
+        }), flush=True)
+    except Exception as e:  # noqa: BLE001 — a crashed variant is a result
+        print(json.dumps({"variant": variant,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+
+
+def main():
+    if "--single" in sys.argv:
+        return run_single(sys.argv[sys.argv.index("--single") + 1])
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ab_common import run_interleaved
+
+    best = run_interleaved(
+        ["fused", "xla"],
+        lambda name: [sys.executable, os.path.abspath(__file__),
+                      "--single", name],
+        rounds=2, timeout=2400)
+    if "fused" in best and "xla" in best:
+        f, x = best["fused"], best["xla"]
+        print(json.dumps({
+            "metric": "fused optimizer-kernel speedup "
+                      "(tokens/sec ratio, fused vs DSTPU_OPT_KERNEL=xla)",
+            "vs_opt_kernel_off": round(f["tokens_per_sec"]
+                                       / x["tokens_per_sec"], 3),
+            "fused_tokens_per_sec": f["tokens_per_sec"],
+            "xla_tokens_per_sec": x["tokens_per_sec"],
+            "loss_last_fused": f["loss_last"],
+            "loss_last_xla": x["loss_last"],
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,22 @@ def summarize(records, top=15, phase=None):
             lines.append(f"  {ph:<14}{t * 1e3:>12.2f} ms"
                          f"  ({100 * t / max(total, 1e-12):.1f}%)")
         lines.append("")
+        # optimizer wall-fraction (ISSUE 10 observability): the apply/
+        # optimizer dispatch's share of training wall — the number the
+        # fused bucket kernels exist to shrink. Only the SPLIT step path
+        # (DSTPU_FUSED_STEP=0 / gas>1) records an 'optimizer' span; its
+        # wall is the sum of the sequential per-step phases (data/fwd/
+        # bwd/optimizer host intervals). The fused gas==1 dispatch is
+        # one program — its optimizer slice is device-internal and
+        # belongs to the XLA profiler, so no line is printed there.
+        opt_t = by_phase.get("optimizer", 0.0)
+        wall_t = sum(t for ph, t in by_phase.items() if ph != "step")
+        if phase is None and opt_t > 0 and wall_t > 0:
+            lines.append(f"optimizer wall-fraction: {opt_t / wall_t:.3f} "
+                         f"of step ({opt_t * 1e3:.2f} / {wall_t * 1e3:.2f} ms"
+                         f" — fused opt kernels target this slice, "
+                         f"docs/KERNELS.md)")
+            lines.append("")
 
     ov = ex = 0
     for r in records:

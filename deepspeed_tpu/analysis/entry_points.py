@@ -539,6 +539,75 @@ def build_fused_optimizer_step() -> EntrySpec:
         gate_cheap=True)
 
 
+def build_fused_moe_dispatch() -> EntrySpec:
+    """The fused Pallas MoE dispatch/combine kernel pair (ISSUE 11,
+    ops/transformer/pallas_moe.py via ``MoE(kernel='pallas')``): route
+    select + capacity scatter, the slot gather + wire cast, and the
+    grouped expert-FFN + combine-scatter as hand launches, traced in
+    interpret mode (the CPU parity suite's program — the flash/ragged
+    discipline).
+
+    The audited composition is a ``shard_map`` over the data axis: each
+    rank runs the kernel forward on its LOCAL token slice against
+    replicated expert weights — the dead-EP data-parallel regime the
+    kernel serves (a live expert/pipeline axis keeps the GSPMD exchange
+    path, ``moe/layer.py``). Everything is rank-local by construction,
+    so NO collective belongs in the compiled program: ``expected_spmd``
+    is empty and the committed collective map is zero-byte (the
+    paged-decode / fused-optimizer-step discipline) — any
+    partitioner-inserted gather here means the wrapper's sharding
+    regressed into exactly the rematerialization the auto-gate guards
+    against.
+
+    ``n_chunks=2`` exercises the overlap planner's scan-carry placement
+    on the kernel path (chunk c+1's gather+cast prefetched from the
+    carry under chunk c's FFN+combine). The token/logits operands trace
+    ABSTRACT — a regression that concretizes a routing tracer into the
+    kernels' static configuration surfaces as a hard trace-failed
+    finding. DONATED TOKEN BUFFER is the machine-checked capacity-buffer
+    contract: the token-major output reuses the donated input's buffer
+    (same shape/dtype/sharding) while the capacity-slot payload and the
+    expert outputs stay internal to the launches — a layout change that
+    breaks the alias (the output growing a pad, the payload escaping to
+    HBM as a program output) surfaces as a hard ``dead-donation``
+    finding."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.moe.layer import MoE
+    from deepspeed_tpu.ops.transformer import pallas_moe
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    topo = topo_mod.initialize(TopologyConfig(data=-1), force=True)
+    mesh = topo.mesh
+    dp = mesh.shape[DATA_AXIS]
+    d = DATA_AXIS
+    # intermediate 64: the representative FFN-to-dispatch ratio the
+    # moe-dispatch entry uses (real MoE FFNs are 2-4x hidden)
+    moe = MoE(hidden_size=16, intermediate_size=64, num_experts=4, top_k=2)
+    fwd = pallas_moe.make_moe_forward(
+        top_k=2, capacity=10, activation="silu_gated", mask_pad=False,
+        n_chunks=2, interpret=True)
+    fn = shard_map(lambda p, t: fwd(p, t)[0], mesh=mesh,
+                   in_specs=(jax.tree.map(lambda _: P(), moe.specs(),
+                                          is_leaf=lambda s: s is None
+                                          or isinstance(s, P)), P(d)),
+                   out_specs=P(d), check_vma=False)
+    put = lambda x, *spec: jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    params = jax.tree.map(put, moe.init(jax.random.PRNGKey(0)))
+    tokens = put(jnp.zeros((dp * 32, 16), jnp.float32), d)
+    args = (params, tokens)
+    sh = lambda tree: jax.tree.map(lambda x: x.sharding, tree)
+    return EntrySpec(
+        name="fused-moe-dispatch", fn=fn, args=args,
+        donate_argnums=(1,), mesh=mesh, retrace_args=[args, args],
+        jit_kwargs=dict(in_shardings=(sh(params), tokens.sharding),
+                        out_shardings=tokens.sharding),
+        gate_cheap=True)
+
+
 def build_telemetry_off_parity() -> EntrySpec:
     """The telemetry zero-overhead contract (docs/OBSERVABILITY.md): the
     engine step entry point's jaxpr must be IDENTICAL with telemetry off
@@ -604,6 +673,7 @@ SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
     "zero-gather-partition": build_zero_gather_partition,
     "zeropp-micro-overlap": build_zeropp_micro_overlap,
     "moe-dispatch": build_moe_dispatch,
+    "fused-moe-dispatch": build_fused_moe_dispatch,
     "ring-attention": build_ring_attention,
     "ulysses-attention": build_ulysses_attention,
     "flash-attention-kernel": build_flash_kernel,
@@ -654,9 +724,9 @@ ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
 #: Pinned rather than computed — building every spec just to read its
 #: gate_cheap flag would boot engines; a test asserts the two agree.
 GATE_SPMD_ENTRY_POINTS: Tuple[str, ...] = (
-    "fused-optimizer-step", "moe-dispatch", "paged-decode",
-    "quantized-transport", "ragged-paged-attention", "ring-attention",
-    "ulysses-attention")
+    "fused-moe-dispatch", "fused-optimizer-step", "moe-dispatch",
+    "paged-decode", "quantized-transport", "ragged-paged-attention",
+    "ring-attention", "ulysses-attention")
 
 
 def audit_entry_points(names=None) -> List[Finding]:

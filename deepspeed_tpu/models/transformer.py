@@ -508,6 +508,7 @@ class TransformerLM:
                               keep: Optional[jax.Array] = None,
                               attn_mask: Optional[jax.Array] = None,
                               layers_per_step: int = 1,
+                              prefetch_depth: int = 1,
                               comm_scope=None, comm_edge=None,
                               scatter_err=None):
         """Layer-granular ZeRO overlap schedule over SHARDED stacked block
@@ -534,6 +535,19 @@ class TransformerLM:
         shape: the schedule pipelines two-layer bundles — half the
         collective launches (bigger buckets) and half the saved boundary
         activations, at the same per-layer recompute.
+
+        ``prefetch_depth=2`` (ISSUE 11; the overlap planner derives it
+        when the committed map still shows exposed in-scan bytes at
+        depth 1) TRIPLE-buffers the gather prefetch: the carry holds TWO
+        gathered layers and iteration *l* issues layer *l+2*'s gather,
+        giving each all-gather two layers of compute to hide under — at
+        the cost of one more layer's full params live. Applies to the
+        forward prefetch and the backward re-gather; the grad
+        reduce-scatter stays one-behind (grads exist only after their
+        layer's backward — there is nothing to deepen). Clamped to 1
+        when fewer than 3 steps (a deeper carry would only re-gather the
+        final step). Depth 1 is byte-identical to the pre-ISSUE-11
+        schedule.
 
         ``comm_scope(k)`` (optional) is entered around each scan so the
         comm layer can account its in-body collectives as executing ``k``
@@ -589,25 +603,53 @@ class TransformerLM:
                 aux = aux + a
             return xx, aux
 
-        # xs slot s prefetches step s+1's shard; the last slot re-gathers
-        # the final step, seeding the backward's first full buffer for free
-        nxt = jax.tree.map(lambda a: jnp.concatenate([a[1:], a[-1:]], axis=0),
-                           blocksb)
+        depth = int(prefetch_depth)
+        if depth < 1:
+            raise ValueError(f"prefetch_depth={depth} must be >= 1")
+        # a deeper carry needs >= 3 steps (at 2 every deep slot would
+        # just re-gather the final step); the executor implements 1 and 2
+        depth = 1 if n_steps <= 2 else min(depth, 2)
+
+        if depth == 1:
+            # xs slot s prefetches step s+1's shard; the last slot
+            # re-gathers the final step, seeding the backward's first
+            # full buffer for free
+            nxt = jax.tree.map(
+                lambda a: jnp.concatenate([a[1:], a[-1:]], axis=0), blocksb)
+        else:
+            # depth 2: xs slot s prefetches step s+2's shard (the last
+            # two slots re-gather the final step — same seeding)
+            nxt = jax.tree.map(
+                lambda a: jnp.concatenate([a[2:], a[-1:], a[-1:]], axis=0),
+                blocksb)
         xs = {"shard": nxt, "keep": keepb}
         if winb is not None:
             xs["win"] = winb
         with edge(False):  # prologue: nothing runs yet to hide it
             pf0 = gather(take(blocksb, 0))
+            pf1 = gather(take(blocksb, 1)) if depth == 2 else None
 
-        def fwd_body(carry, xs_s):
-            xx, pf, aux_acc = carry
-            nf = gather(xs_s["shard"])  # independent of the compute below
-            y, aux = unit_call(pf, xx, xs_s["keep"], xs_s.get("win"))
-            return (y, nf, aux_acc + aux), xx
+        if depth == 1:
+            def fwd_body(carry, xs_s):
+                xx, pf, aux_acc = carry
+                nf = gather(xs_s["shard"])  # independent of compute below
+                y, aux = unit_call(pf, xx, xs_s["keep"], xs_s.get("win"))
+                return (y, nf, aux_acc + aux), xx
 
-        with scope(n_steps):
-            (x_out, pf_last, aux_sum), acts = jax.lax.scan(
-                fwd_body, (x, pf0, jnp.zeros((), jnp.float32)), xs)
+            with scope(n_steps):
+                (x_out, pf_last, aux_sum), acts = jax.lax.scan(
+                    fwd_body, (x, pf0, jnp.zeros((), jnp.float32)), xs)
+        else:
+            def fwd_body(carry, xs_s):
+                xx, pf_a, pf_b, aux_acc = carry
+                nf = gather(xs_s["shard"])  # two steps ahead
+                y, aux = unit_call(pf_a, xx, xs_s["keep"], xs_s.get("win"))
+                return (y, pf_b, nf, aux_acc + aux), xx
+
+            with scope(n_steps):
+                (x_out, pf_last, _, aux_sum), acts = jax.lax.scan(
+                    fwd_body, (x, pf0, pf1, jnp.zeros((), jnp.float32)),
+                    xs)
 
         # error-feedback carry plumbing: without scatter_err the scatter
         # call and the return arity are EXACTLY the pre-planner form
@@ -638,11 +680,22 @@ class TransformerLM:
                     return dblocks, dx
                 return dblocks, dx, jax.tree.map(lambda a: a[None], ne0)
             pb0 = gather(take(blocksb, n_steps - 2))
-            # reverse prefetch: slot s carries step s-1's shard (slot 0 a
-            # dead self-gather — the price of one scan body shape)
-            prv = jax.tree.map(
-                lambda a: jnp.concatenate([a[:1], a[:-1]],
-                                          axis=0)[:n_steps - 1], blocksb)
+            if depth == 1:
+                # reverse prefetch: slot s carries step s-1's shard (slot
+                # 0 a dead self-gather — the price of one scan body shape)
+                prv = jax.tree.map(
+                    lambda a: jnp.concatenate([a[:1], a[:-1]],
+                                              axis=0)[:n_steps - 1],
+                    blocksb)
+            else:
+                # depth 2: slot s carries step s-2's shard (slots 0/1
+                # dead clamp-gathers; depth >= 2 implies n_steps >= 3)
+                prv = jax.tree.map(
+                    lambda a: jnp.concatenate([a[:1], a[:1], a[:-2]],
+                                              axis=0)[:n_steps - 1],
+                    blocksb)
+            pb1 = (gather(take(blocksb, n_steps - 3))
+                   if depth == 2 else None)
             xs_b = {"shard": prv, "act": acts[:n_steps - 1],
                     "keep": keepb[:n_steps - 1]}
             if winb is not None:
@@ -653,21 +706,39 @@ class TransformerLM:
                 # the epilogue flush's
                 xs_b["err"] = jax.tree.map(lambda a: a[1:], scatter_err)
 
-            def bwd_body(carry, xs_s):
-                dxx, pb, pending = carry
-                # layer l+1's grads reduce-scatter while layer l computes
-                ds_prev, ne = scat(pending, xs_s.get("err"))
-                nb = gather(xs_s["shard"])
-                _, vjp_f = jax.vjp(
-                    lambda p, xx: unit_call(p, xx, xs_s["keep"],
-                                            xs_s.get("win")),
-                    pb, xs_s["act"])
-                dp_s, dxx_new = vjp_f((dxx, daux_))
-                return (dxx_new, nb, dp_s), (ds_prev, ne)
+            if depth == 1:
+                def bwd_body(carry, xs_s):
+                    dxx, pb, pending = carry
+                    # layer l+1's grads reduce-scatter while layer l
+                    # computes
+                    ds_prev, ne = scat(pending, xs_s.get("err"))
+                    nb = gather(xs_s["shard"])
+                    _, vjp_f = jax.vjp(
+                        lambda p, xx: unit_call(p, xx, xs_s["keep"],
+                                                xs_s.get("win")),
+                        pb, xs_s["act"])
+                    dp_s, dxx_new = vjp_f((dxx, daux_))
+                    return (dxx_new, nb, dp_s), (ds_prev, ne)
 
-            with scope(n_steps - 1):
-                (dx0, _, pending0), (ds_stack, ne_stack) = jax.lax.scan(
-                    bwd_body, (dx, pb0, dp), xs_b, reverse=True)
+                with scope(n_steps - 1):
+                    (dx0, _, pending0), (ds_stack, ne_stack) = jax.lax.scan(
+                        bwd_body, (dx, pb0, dp), xs_b, reverse=True)
+            else:
+                def bwd_body(carry, xs_s):
+                    dxx, pb_a, pb_b, pending = carry
+                    ds_prev, ne = scat(pending, xs_s.get("err"))
+                    nb = gather(xs_s["shard"])  # two steps behind
+                    _, vjp_f = jax.vjp(
+                        lambda p, xx: unit_call(p, xx, xs_s["keep"],
+                                                xs_s.get("win")),
+                        pb_a, xs_s["act"])
+                    dp_s, dxx_new = vjp_f((dxx, daux_))
+                    return (dxx_new, pb_b, nb, dp_s), (ds_prev, ne)
+
+                with scope(n_steps - 1):
+                    (dx0, _, _, pending0), (ds_stack, ne_stack) = \
+                        jax.lax.scan(bwd_body, (dx, pb0, pb1, dp), xs_b,
+                                     reverse=True)
             with edge(False):  # epilogue: flush step 0's grads, exposed
                 ds0, ne0 = scat(pending0, take_err(0))
             # ds_stack[s] holds step s+1's sharded grads; step 0 is ds0

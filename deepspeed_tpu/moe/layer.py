@@ -17,6 +17,7 @@ inference/v2/kernels/cutlass_ops).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Any, Dict, Tuple
 
 import jax
@@ -35,6 +36,49 @@ def _c(x, spec):
     return with_sharding_constraint(x, spec)
 
 
+def moe_reference_forward(params: Params, tokens: jax.Array, *,
+                          top_k: int, capacity: int, activation: str,
+                          mask_pad: bool) -> Tuple[jax.Array, jax.Array]:
+    """The dead-EP XLA expert path as ONE pure statement: gating ->
+    capacity-slot gather -> grouped-einsum FFN -> weighted combine.
+    ``tokens`` [T, H] -> (out [T, H], aux). This is the numerics
+    reference the fused Pallas kernel pair (ISSUE 11,
+    ``ops/transformer/pallas_moe.py``) is held to — its interpret-mode
+    parity suite compares against this function, and the kernel path's
+    ``custom_vjp`` backward IS this function's VJP (one statement of the
+    gradient math shared with the ``DSTPU_MOE_KERNEL=xla`` hatch)."""
+    n_tok, h = tokens.shape
+    e = params["gate"].shape[-1]
+    logits = tokens @ params["gate"].astype(tokens.dtype)
+    eidx, pos, keep, weight, aux, _ = top_k_gating_indices(
+        logits, top_k, capacity)
+    cap = capacity
+    slot = jnp.where(keep, eidx * cap + pos, e * cap).reshape(-1)
+    src = jnp.zeros((e * cap + 1,), jnp.int32).at[slot].set(
+        jnp.repeat(jnp.arange(n_tok, dtype=jnp.int32), top_k) + 1,
+        mode="drop")[:e * cap]
+    gathered = tokens[jnp.maximum(src - 1, 0)]
+    if mask_pad:
+        gathered = jnp.where((src > 0)[:, None], gathered,
+                             jnp.zeros((), tokens.dtype))
+    expert_in = gathered.reshape(e, cap, h)
+    if activation == "silu_gated":
+        gate = jax.nn.silu(jnp.einsum("ech,ehf->ecf", expert_in,
+                                      params["wi_gate"].astype(tokens.dtype)))
+        up = jnp.einsum("ech,ehf->ecf", expert_in,
+                        params["wi_up"].astype(tokens.dtype))
+        mid = gate * up
+    else:
+        mid = jax.nn.gelu(jnp.einsum("ech,ehf->ecf", expert_in,
+                                     params["wi"].astype(tokens.dtype)))
+    expert_out = jnp.einsum("ecf,efh->ech", mid,
+                            params["wo"].astype(tokens.dtype))
+    flat_out = expert_out.reshape(e * cap, h)
+    picked = flat_out[jnp.where(keep, eidx * cap + pos, 0)]
+    w = (weight * keep).astype(tokens.dtype)
+    return jnp.sum(picked * w[:, :, None], axis=1), aux
+
+
 @dataclasses.dataclass(frozen=True)
 class MoE:
     hidden_size: int
@@ -45,6 +89,12 @@ class MoE:
     min_capacity: int = 4
     activation: str = "silu_gated"  # 'silu_gated' | 'gelu'
     init_scale: float = 0.02
+    #: fused Pallas kernel dispatch (ISSUE 11): None = the
+    #: ``DSTPU_MOE_KERNEL`` env gate (auto: Pallas on single-chip TPU,
+    #: XLA elsewhere); 'xla'/'pallas' pin per-layer (lint entries,
+    #: parity tests). The kernel serves the dead-EP composition only —
+    #: a live expert/pipeline mesh keeps the GSPMD exchange path.
+    kernel: Any = None
 
     def init(self, rng, dtype=jnp.float32) -> Params:
         e, h, f = self.num_experts, self.hidden_size, self.intermediate_size
@@ -98,6 +148,44 @@ class MoE:
         n_tok = b * s
         cap = _capacity(n_tok, self.num_experts, self.capacity_factor, self.min_capacity)
 
+        # Fused Pallas kernel path (ISSUE 11, ops/transformer/pallas_moe
+        # .py): route select + capacity scatter, the slot gather + wire
+        # cast, and the grouped FFN + combine-scatter run as hand
+        # kernels instead of the XLA op chain. DSTPU_MOE_KERNEL follows
+        # the PR 10 discipline (auto = Pallas on single-chip TPU, XLA
+        # elsewhere; 'xla' = bitwise hatch — this method's XLA path is
+        # untouched; 'pallas' = force, interpret off-TPU). The kernel
+        # serves the dead-EP/no-pipe composition: with a live expert
+        # axis the exchange is GSPMD-mediated and stays XLA (the
+        # multi-chip note in docs/KERNELS.md).
+        from ..ops.transformer import pallas_moe
+        from ..runtime import overlap_planner as op_mod
+        if pallas_moe.moe_kernel_resolution(
+                top_k=self.top_k, activation=self.activation,
+                dtype=x.dtype, tokens=n_tok,
+                num_experts=self.num_experts, hidden=h,
+                kernel=self.kernel) == "pallas":
+            # wired under the planner's chunked-dispatch scan: the plan's
+            # scan-carry placement chunks the capacity dim so chunk c+1's
+            # gather+cast launch issues from the carry under chunk c's
+            # FFN+combine kernel (depth 1 — the kernel executor's clamp).
+            # The carry rides the FUSED combine epilogue only: shapes
+            # over the fused-combine VMEM budget run the split FFN +
+            # token-major combine launches straight-line, so derive no
+            # chunk count there (a derived nc the kernel cannot execute
+            # would silently overstate the schedule).
+            plan = op_mod.plan_for("moe-dispatch")
+            nbytes = self.num_experts * cap * h * x.dtype.itemsize
+            nc = (op_mod.moe_chunks_for_bytes(nbytes)
+                  if (plan.placement == op_mod.PLACEMENT_SCAN_CARRY
+                      and pallas_moe.moe_fused_combine_fits(n_tok, h))
+                  else 1)
+            fwd = pallas_moe.make_moe_forward(
+                top_k=self.top_k, capacity=cap,
+                activation=self.activation, mask_pad=False, n_chunks=nc)
+            out2d, aux = fwd(params, tokens)
+            return out2d.reshape(b, s, h), aux
+
         logits = tokens @ params["gate"].astype(x.dtype)
         eidx, pos, keep, weight, aux, _ = top_k_gating_indices(
             logits, self.top_k, cap)
@@ -139,7 +227,6 @@ class MoE:
         # fp32's exponent range, so a pad row overflows only where a real
         # row would too). DSTPU_MOE_MASK_PAD=1 forces the masked form
         # (trace-time; for A/B).
-        import os
         # Dispatch/combine transport plan (ISSUE 8, docs/COLLECTIVES.md):
         # the expert exchange is GSPMD-mediated (the constraints below make
         # the partitioner emit the all-to-all), so the wire narrows by
@@ -173,25 +260,39 @@ class MoE:
         # scan carry while chunk c's expert FFN computes, so the dispatch
         # wire hides under expert compute instead of fully preceding it.
         # Exact: each slot's gather row and FFN contraction are identical;
-        # only launch placement changes. The combine-side exchange stays
-        # at the epilogue (every token's k slots span all chunks — there
-        # is no per-chunk combine without masked re-gathers), which is the
-        # entry's budget-justified edge exposure. Chunking is clamped to a
-        # divisor of the capacity and skipped entirely under pipeline
-        # composition (the stage vmap pins its own constraints) or a dead
-        # expert axis.
+        # only launch placement changes. Since ISSUE 11 the COMBINE-side
+        # exchange also rides the scan body: each chunk's expert rows
+        # re-gather to tokens under a chunk mask right after that chunk's
+        # FFN (every token's k slots span chunks, so the mask selects the
+        # choices whose capacity slot lives in this chunk), which puts
+        # nc-1 of the nc combine launches inside the body's circular
+        # slack window — Layer D classifies them overlapped — leaving
+        # only the LAST chunk's combine as the budget-justified epilogue
+        # edge. Chunking is clamped to a divisor of the capacity and
+        # skipped entirely under pipeline composition (the stage vmap
+        # pins its own constraints) or a dead expert axis.
         plan = op_mod.plan_for("moe-dispatch")
         # the plan decides PLACEMENT; the chunk count scales with THIS
         # layer's actual exchange bytes (the committed n_chunks records
-        # the audit entry's decision, not a production layer's)
+        # the audit entry's decision, not a production layer's). top_k>2
+        # pins nc=1: the masked per-chunk combine below reassociates a
+        # token's k weighted terms into chunk order, exact only while at
+        # most two terms exist — beyond that the unchunked program is the
+        # exactness contract.
         nc = (op_mod.moe_chunks_for_bytes(e * cap * h * x.dtype.itemsize)
               if (plan.placement == op_mod.PLACEMENT_SCAN_CARRY
-                  and live_ep and not pipelined) else 1)
+                  and live_ep and not pipelined and self.top_k <= 2)
+              else 1)
         while nc > 1 and cap % nc:
             nc -= 1
 
         if nc > 1:
-            src_chunks = src.reshape(e, nc, cap // nc).transpose(1, 0, 2)
+            capc = cap // nc
+            src_chunks = src.reshape(e, nc, capc).transpose(1, 0, 2)
+            # token-side chunk membership: choice (t, k)'s capacity slot
+            # lives in chunk pos // capc at local position pos % capc
+            chunk_of = pos // capc
+            pos_in = pos - chunk_of * capc
 
             def fetch(sc):
                 flat = sc.reshape(-1)
@@ -199,10 +300,35 @@ class MoE:
                 if mask_pad:
                     g = jnp.where((flat > 0)[:, None], g,
                                   jnp.zeros((), x.dtype))
-                return _exchange(g.reshape(e, cap // nc, h),
+                return _exchange(g.reshape(e, capc, h),
                                  P(EXPERT_AXIS, BATCH_AXES, None))
 
-            chunk_elems = e * (cap // nc) * h
+            def combine_chunk(y_c, c_idx):
+                # masked per-chunk re-gather (ISSUE 11): the return
+                # exchange materializes at this row gather, so placing it
+                # here — inside the scan body / before the epilogue's
+                # final adds — is what moves the combine wire off the
+                # step edge. Algebraically exact vs the whole-capacity
+                # epilogue gather for top-k <= 2 (each kept choice
+                # contributes from exactly one chunk, masked-out choices
+                # multiply by an exact 0, two-term addition commutes) —
+                # and bitwise in the pinned tests/unit/moe composition;
+                # across a LIVE expert exchange the partitioner may
+                # reassociate the shard reduction around the weighted
+                # sum, so engine-level parity with the unchunked program
+                # is float-tolerance there (same class as the backward,
+                # which PR 9 already pinned at tolerance).
+                if wire_dtype is not None:
+                    y_c = y_c.astype(wire_dtype)
+                y_c = _c(y_c, P(EXPERT_AXIS, BATCH_AXES, None))
+                flat_c = y_c.reshape(e * capc, h)
+                in_chunk = keep & (chunk_of == c_idx)
+                rows = flat_c[jnp.where(in_chunk, eidx * capc + pos_in, 0)]
+                w_c = (weight * in_chunk).astype(x.dtype)
+                return jnp.sum(rows.astype(x.dtype) * w_c[:, :, None],
+                               axis=1)
+
+            chunk_elems = e * capc * h
             wire = chunk_elems * (2 if wire_dtype is not None
                                   else x.dtype.itemsize)
             logical = chunk_elems * x.dtype.itemsize
@@ -213,16 +339,30 @@ class MoE:
             dist.record_collective("all_to_all", logical, (EXPERT_AXIS,),
                                    overlapped=True, count=nc - 1,
                                    wire_bytes=wire)
+            # combine side: nc-1 masked re-gathers ride the scan body
+            # (hidden in the circular slack window); the last chunk's
+            # combine is the epilogue edge
+            dist.record_collective("all_to_all", logical, (EXPERT_AXIS,),
+                                   overlapped=True, count=nc - 1,
+                                   wire_bytes=wire)
+            dist.record_collective("all_to_all", logical, (EXPERT_AXIS,),
+                                   overlapped=False, wire_bytes=wire)
             cur = fetch(src_chunks[0])
 
-            def body(carry, sc):
-                nxt = fetch(sc)  # independent of the FFN below
-                return nxt, self._expert_ffn(params, carry, x.dtype)
+            def body(carry, xs_c):
+                payload, acc = carry
+                nxt = fetch(xs_c["src"])  # independent of the FFN below
+                y_c = self._expert_ffn(params, payload, x.dtype)
+                acc = acc + combine_chunk(y_c, xs_c["idx"])
+                return (nxt, acc), None
 
-            last, ys = jax.lax.scan(body, cur, src_chunks[1:])
+            (last, acc), _ = jax.lax.scan(
+                body, (cur, jnp.zeros((n_tok, h), x.dtype)),
+                {"src": src_chunks[1:],
+                 "idx": jnp.arange(nc - 1, dtype=jnp.int32)})
             y_last = self._expert_ffn(params, last, x.dtype)
-            expert_out = jnp.concatenate([ys, y_last[None]], axis=0)
-            expert_out = expert_out.transpose(1, 0, 2, 3).reshape(e, cap, h)
+            out = acc + combine_chunk(y_last, jnp.int32(nc - 1))
+            return out.reshape(b, s, h), aux
         else:
             gathered = tokens[jnp.maximum(src - 1, 0)]
             if mask_pad:

@@ -45,6 +45,15 @@ def top_k_gating_indices(logits: jax.Array, top_k: int, capacity_: int):
     reference's einsum dispatch, sharded_moe.py:425) costs
     O(tokens*experts*capacity*hidden) FLOPs — quadratic in tokens; the
     gather/scatter dispatch built from indices is O(tokens*k*hidden).
+
+    ROUTE-PARITY CONTRACT (ISSUE 11): the fused Pallas route kernel
+    (``ops/transformer/pallas_moe.py::_route_kernel``) replicates this
+    function's fp32 operation sequence EXACTLY — same softmax, same
+    lowest-index tie rule (``lax.top_k`` == masked re-argmax), same
+    cumsum position ranks, capacity clamps and weight normalization —
+    so kernel- and XLA-path routing decisions are bit-identical. Any
+    change here must be mirrored there;
+    ``tests/unit/ops/test_pallas_moe.py::TestRoute`` pins the pair.
     """
     tokens, num_experts = logits.shape
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)

@@ -1,0 +1,673 @@
+"""Fused Pallas MoE dispatch/combine kernel pair (ISSUE 11 tentpole).
+
+TPU-native replacement for the XLA-default expert path in
+``moe/layer.py`` — the csrc-port mission named by the SNIPPETS header
+(the reference's cutlass ``moe_gather``/``moe_scatter`` layout kernels +
+``moe_gemm`` grouped GEMM, ``inference/v2/kernels/cutlass_ops``). The
+XLA path spends its bytes on buffers that exist only to feed the next
+op: the gathered ``[E*C, H]`` dispatch buffer, its wire-cast copy, the
+``[E, C, H]`` expert output, and the ``[T, K, H]`` picked rows all
+round-trip HBM between fusion boundaries. The kernel pair does the same
+math in three launches that each read their operands once:
+
+1. **route kernel** — top-k route select fused with the capacity-slot
+   scatter: softmax, top-k pick, per-expert position ranks, capacity
+   clamp, weight normalization and the inverse slot→token map
+   (``src``/``slot_w``) emerge from ONE launch over the logits instead
+   of the ~20-op XLA gating chain.
+2. **dispatch gather+cast kernel** — the capacity-slot gather fused with
+   the WIRE cast: a scalar-prefetched grid (one slot row per step, the
+   paged-attention table-lookup idiom) reads each routed token row from
+   HBM exactly once and writes the exchange payload directly at wire
+   width. The cast never materializes a full-width copy in HBM first —
+   the FlexLink (arXiv:2510.15882) compute-collective fusion framing.
+   ``quantize_int8=True`` extends the ``pallas_quant``
+   byte-identical-payload contract to int8 dispatch traffic: payload +
+   scale sideband match ``quantize_rows_int8`` (and therefore
+   ``quantize_blockwise``) byte-for-byte inside jitted programs; the
+   bf16 payload is byte-identical to the XLA ``astype`` it replaces.
+3. **grouped expert-FFN + combine kernel** — all local experts'
+   up/act/down projections run as ONE grid over (expert, capacity-block,
+   ffn-block) with the weighted combine-scatter fused into the epilogue:
+   after a capacity block's last ffn-block, its rows scatter-accumulate
+   straight into the token-major output, so neither ``expert_out`` nor
+   the picked rows ever hit HBM. When the token output exceeds the VMEM
+   residency budget the combine falls back to a separate token-major
+   gather kernel (one launch, online accumulation over the k slots) and
+   the FFN kernel writes ``[E, C, H]`` once.
+
+Dispatch
+--------
+``DSTPU_MOE_KERNEL`` follows the PR 10 discipline
+(``ops/adam/pallas_adam.py``):
+
+- ``''``/``'auto'``: Pallas on a SINGLE-CHIP TPU, XLA elsewhere. A live
+  expert/pipeline mesh keeps the XLA path — the sharding-constraint
+  exchange is GSPMD-mediated and a ``pallas_call`` over sharded operands
+  would make the partitioner rematerialize the dispatch buffers (the
+  same reasoning as ``engine._opt_kernel_choice``; the multi-chip
+  enablement is the shard_map composition the ``fused-moe-dispatch``
+  lint entry already exercises).
+- ``'xla'``: bitwise escape hatch — the pre-kernel layer program.
+- ``'pallas'``: force (interpret mode off-TPU — the tests' path).
+
+Numerics contract: routing decisions (top-k picks, positions, capacity
+clamps, combine weights) are computed in fp32 with the exact operation
+sequence of ``sharded_moe.top_k_gating_indices`` — bit-identical routes.
+The FFN computes fp32 in-register (vs the XLA path's compute-dtype
+einsums), so outputs agree to dtype tolerance, not bitwise; the ``xla``
+hatch is the bitwise anchor. The backward is the XLA reference VJP
+(``moe/layer.py`` ``moe_reference_forward``) via ``jax.custom_vjp`` —
+recompute-style residuals (the layer input), one statement of the
+gradient math shared with the hatch path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..adam.pallas_adam import opt_kernel_interpret
+
+#: VMEM residency budget for the fused combine-scatter epilogue: the
+#: token-major output must stay resident across the whole FFN grid.
+_FUSED_OUT_BUDGET = 4 * 1024 * 1024
+#: route kernel VMEM budget for the [T, E] gating intermediates.
+_ROUTE_BUDGET = 4 * 1024 * 1024
+#: FFN kernel VMEM budget for one grid step's working set (payload +
+#: weight blocks double-buffered by the Mosaic pipeline, plus the f32
+#: accumulator scratch) — shapes over it keep the XLA path.
+_FFN_BUDGET = 12 * 1024 * 1024
+#: capacity/ffn block caps (divisor-clamped to the actual extents).
+_CAP_BLOCK = 256
+_FFN_BLOCK = 512
+
+
+def moe_kernel_mode(env_var: str = "DSTPU_MOE_KERNEL") -> str:
+    """Resolve the MoE kernel gate to 'pallas' | 'xla'. Auto is
+    single-chip-TPU-only — stricter than ``opt_kernel_mode`` — because
+    the kernel replaces a GSPMD-mediated exchange path (see module
+    docstring)."""
+    mode = os.environ.get(env_var, "").strip().lower()
+    if mode not in ("", "auto", "xla", "pallas"):
+        raise ValueError(f"{env_var} must be ''|'auto'|'xla'|'pallas', "
+                         f"got {mode!r}")
+    if mode in ("xla", "pallas"):
+        return mode
+    return ("pallas" if jax.default_backend() == "tpu"
+            and jax.device_count() == 1 else "xla")
+
+
+def moe_kernel_interpret() -> bool:
+    return opt_kernel_interpret()
+
+
+def moe_kernel_supported(*, top_k: int, activation: str, dtype,
+                         tokens: int, num_experts: int,
+                         hidden: int) -> bool:
+    """True when the kernel pair serves this geometry. Unsupported
+    shapes keep the XLA path (never an error): top-k beyond 2 (the
+    in-kernel pick is a masked-argmax chain), exotic activations, fp16
+    (the pad-row overflow case the XLA path masks), token counts whose
+    gating intermediates exceed the route kernel's VMEM budget, and
+    hidden sizes whose FFN-grid working set (a [cap_block, H] payload
+    block + three [H, ffn_block] weight blocks, double-buffered, plus
+    the [cap_block, H] f32 accumulator) exceeds the FFN budget."""
+    if top_k not in (1, 2):
+        return False
+    if activation not in ("silu_gated", "gelu"):
+        return False
+    if jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16)):
+        return False
+    if tokens * num_experts * 4 > _ROUTE_BUDGET:
+        return False
+    itemsize = jnp.dtype(dtype).itemsize
+    ffn_step = hidden * (2 * (_CAP_BLOCK + 3 * _FFN_BLOCK) * itemsize
+                         + _CAP_BLOCK * 4)
+    if ffn_step > _FFN_BUDGET:
+        return False
+    return True
+
+
+def moe_kernel_resolution(*, top_k: int, activation: str, dtype,
+                          tokens: int, num_experts: int, hidden: int,
+                          kernel: Optional[str] = None) -> str:
+    """The layer's FULL kernel gate as one resolver: mode (env or the
+    per-layer ``kernel=`` override), the live expert/pipe-axis pin, the
+    ``DSTPU_MOE_MASK_PAD`` pin, and the geometry support check — in the
+    same order ``moe/layer.py`` applies them. Returns ``'pallas'`` or
+    ``'xla'``/``'xla (<reason>)'``; the reason string is the bench
+    honesty marker's, so the A/B is skipped for exactly the pins the
+    layer actually takes."""
+    mode = kernel if kernel in ("xla", "pallas") else moe_kernel_mode()
+    if mode == "xla":
+        forced = os.environ.get("DSTPU_MOE_KERNEL", "").strip().lower()
+        if (kernel != "xla" and forced not in ("xla", "pallas")
+                and jax.device_count() > 1):
+            return "xla (multi-device auto-pin)"
+        return "xla"
+    from ...runtime import topology as topo_mod
+    if topo_mod.is_initialized() and (
+            topo_mod.get_topology().expert_parallel_size > 1
+            or topo_mod.get_topology().pipe_parallel_size > 1):
+        return "xla (live expert/pipe axis pin)"
+    if os.environ.get("DSTPU_MOE_MASK_PAD") == "1":
+        return "xla (mask-pad pin)"
+    if not moe_kernel_supported(top_k=top_k, activation=activation,
+                                dtype=dtype, tokens=tokens,
+                                num_experts=num_experts, hidden=hidden):
+        return "xla (unsupported geometry)"
+    return "pallas"
+
+
+def moe_fused_combine_fits(tokens: int, hidden: int) -> bool:
+    """True when the token-major f32 combine output stays VMEM-resident
+    across the FFN grid (``moe_ffn_combine``'s epilogue scatter). Shapes
+    over the budget take the split FFN + token-major combine kernels —
+    which also means the planner's chunked scan-carry placement does NOT
+    execute (the per-chunk accumulation rides the fused epilogue); the
+    layer gates its chunk derivation on this so a derived ``n_chunks``
+    is never silently ignored."""
+    return tokens * hidden * 4 <= _FUSED_OUT_BUDGET
+
+
+def _divisor_block(extent: int, cap: int) -> int:
+    """Largest divisor of ``extent`` that is <= ``cap`` (>= 1)."""
+    b = min(extent, cap)
+    while extent % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# 1. route kernel: top-k select + capacity-slot scatter in one launch
+# ---------------------------------------------------------------------------
+
+def _route_kernel(logits_ref, src_ref, slw_ref, slot_tk_ref, w_tk_ref,
+                  me_ref, ce_ref, *, top_k: int, cap: int):
+    """One launch over [T, E] logits. Replicates
+    ``top_k_gating_indices``'s fp32 operation sequence exactly (argmax ==
+    ``lax.top_k``'s lowest-index tie rule; the k=2 pick is a masked
+    re-argmax), then scatters the inverse slot→token map: ``src[slot]`` =
+    token index + 1 (0 = unfilled), ``slot_w[slot]`` = that choice's
+    normalized combine weight. Token-major combine metadata
+    (``slot_tk``/``w_tk``) feeds the split combine path."""
+    logits = logits_ref[...].astype(jnp.float32)        # [T, E]
+    T, E = logits.shape
+    S = E * cap
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    src_ref[...] = jnp.zeros_like(src_ref)
+    slw_ref[...] = jnp.zeros_like(slw_ref)
+
+    counts = jnp.zeros((E,), jnp.int32)
+    gate_sum = jnp.zeros((T,), jnp.float32)
+    picked = gates
+    idxs, poss, keeps, gatews = [], [], [], []
+    for k in range(top_k):
+        idx_k = jnp.argmax(picked, axis=1).astype(jnp.int32)     # [T]
+        mask_k = jax.nn.one_hot(idx_k, E, dtype=jnp.int32)
+        if k == 0:
+            me_ref[...] = jnp.mean(gates, axis=0)
+            ce_ref[...] = jnp.mean(mask_k.astype(jnp.float32), axis=0)
+        pos_in_expert = jnp.cumsum(mask_k, axis=0) - mask_k
+        pos_k = (jnp.sum(pos_in_expert * mask_k, axis=1)
+                 + jnp.sum(mask_k * counts[None, :], axis=1))
+        keep = pos_k < cap
+        gate_k = jnp.sum(gates * mask_k.astype(jnp.float32), axis=1) * keep
+        idxs.append(idx_k)
+        poss.append(jnp.minimum(pos_k, cap - 1).astype(jnp.int32))
+        keeps.append(keep)
+        gatews.append(gate_k)
+        counts = counts + jnp.sum(mask_k * keep[:, None].astype(jnp.int32),
+                                  axis=0)
+        gate_sum = gate_sum + gate_k
+        picked = jnp.where(mask_k > 0, -jnp.inf, picked)
+
+    denom = jnp.maximum(gate_sum, 1e-9)
+    for k in range(top_k):
+        w_k = gatews[k] / denom                                   # [T]
+        slot_k = jnp.where(keeps[k], idxs[k] * cap + poss[k], S)
+        slot_tk_ref[:, k] = jnp.where(keeps[k], slot_k, 0).astype(jnp.int32)
+        w_tk_ref[:, k] = w_k * keeps[k]
+
+        def body(t, _):
+            slot = slot_k[t]
+
+            @pl.when(slot < S)
+            def _():
+                src_ref[slot] = t + 1
+                slw_ref[slot] = w_k[t]
+            return 0
+
+        jax.lax.fori_loop(0, T, body, 0)
+
+
+def moe_route(logits: jax.Array, *, top_k: int, capacity: int,
+              interpret: Optional[bool] = None):
+    """Fused gating -> ``(src [E*C] i32, slot_w [E*C] f32,
+    slot_tk [T, K] i32, w_tk [T, K] f32, me [E] f32, ce [E] f32)``.
+    ``aux = sum(me * ce) * E`` (GShard) is left to the caller — a 3-op
+    epilogue, not a launch."""
+    if interpret is None:
+        interpret = moe_kernel_interpret()
+    T, E = logits.shape
+    S = E * capacity
+    full2 = pl.BlockSpec((T, E), lambda i: (0, 0))
+    vec = lambda n: pl.BlockSpec((n,), lambda i: (0,))
+    tk = pl.BlockSpec((T, top_k), lambda i: (0, 0))
+    return pl.pallas_call(
+        functools.partial(_route_kernel, top_k=top_k, cap=capacity),
+        grid=(1,),
+        in_specs=[full2],
+        out_specs=[vec(S), vec(S), tk, tk, vec(E), vec(E)],
+        out_shape=[jax.ShapeDtypeStruct((S,), jnp.int32),
+                   jax.ShapeDtypeStruct((S,), jnp.float32),
+                   jax.ShapeDtypeStruct((T, top_k), jnp.int32),
+                   jax.ShapeDtypeStruct((T, top_k), jnp.float32),
+                   jax.ShapeDtypeStruct((E,), jnp.float32),
+                   jax.ShapeDtypeStruct((E,), jnp.float32)],
+        interpret=interpret,
+    )(logits)
+
+
+# ---------------------------------------------------------------------------
+# 2. dispatch gather + wire cast (payload emerges launch-ready)
+# ---------------------------------------------------------------------------
+
+def _gather_kernel(src_ref, tok_ref, out_ref, *, mask_pad: bool):
+    i = pl.program_id(0)
+    row = tok_ref[0, :].astype(jnp.float32)
+    if mask_pad:
+        row = jnp.where(src_ref[i] > 0, row, 0.0)
+    out_ref[0, :] = row.astype(out_ref.dtype)
+
+
+def _gather_int8_kernel(src_ref, tok_ref, q_ref, s_ref, *, mask_pad: bool):
+    i = pl.program_id(0)
+    row = tok_ref[0, :].astype(jnp.float32)
+    if mask_pad:
+        row = jnp.where(src_ref[i] > 0, row, 0.0)
+    # quantize_rows_int8 / quantize_blockwise symmetric int8 math,
+    # byte-for-byte (absmax/127, zero-scale -> 1, round-half-even, clip)
+    absmax = jnp.max(jnp.abs(row))
+    scale = absmax / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q_ref[0, :] = jnp.clip(jnp.round(row / scale), -128, 127
+                           ).astype(jnp.int8)
+    s_ref[0] = scale
+
+
+def moe_dispatch_gather(tokens: jax.Array, src: jax.Array, *,
+                        wire_dtype=None, mask_pad: bool = False,
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """The fused capacity-slot gather + wire cast: one scalar-prefetched
+    grid step per slot DMAs exactly the routed token row (the
+    ``src``-lookup IS the index map) and stores it at wire width —
+    payload ``[S, H]`` in ``wire_dtype`` (default: the compute dtype),
+    byte-identical to ``tokens[max(src-1, 0)].astype(wire_dtype)``."""
+    from jax.experimental.pallas import tpu as pltpu
+    if interpret is None:
+        interpret = moe_kernel_interpret()
+    S = src.shape[0]
+    T, H = tokens.shape
+    out_dtype = jnp.dtype(wire_dtype) if wire_dtype is not None \
+        else tokens.dtype
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S,),
+        in_specs=[pl.BlockSpec((1, H),
+                               lambda i, src: (jnp.maximum(src[i] - 1, 0),
+                                               0))],
+        out_specs=pl.BlockSpec((1, H), lambda i, src: (i, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_kernel, mask_pad=mask_pad),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H), out_dtype),
+        interpret=interpret,
+    )(src.astype(jnp.int32), tokens)
+
+
+def moe_dispatch_gather_int8(tokens: jax.Array, src: jax.Array, *,
+                             mask_pad: bool = False,
+                             interpret: Optional[bool] = None):
+    """int8 wire fusion: gather + symmetric per-row int8 quantize in one
+    launch -> ``(q [S, H] int8, scale [S] f32)``, byte-identical to
+    ``quantize_rows_int8(tokens[max(src-1, 0)])`` inside jitted programs
+    (the ``pallas_quant`` contract, extended to dispatch traffic)."""
+    from jax.experimental.pallas import tpu as pltpu
+    if interpret is None:
+        interpret = moe_kernel_interpret()
+    S = src.shape[0]
+    T, H = tokens.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S,),
+        in_specs=[pl.BlockSpec((1, H),
+                               lambda i, src: (jnp.maximum(src[i] - 1, 0),
+                                               0))],
+        out_specs=[pl.BlockSpec((1, H), lambda i, src: (i, 0)),
+                   pl.BlockSpec((1,), lambda i, src: (i,))],
+    )
+    return pl.pallas_call(
+        functools.partial(_gather_int8_kernel, mask_pad=mask_pad),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((S, H), jnp.int8),
+                   jax.ShapeDtypeStruct((S,), jnp.float32)],
+        interpret=interpret,
+    )(src.astype(jnp.int32), tokens)
+
+
+# ---------------------------------------------------------------------------
+# 3. grouped expert FFN + fused combine-scatter epilogue
+# ---------------------------------------------------------------------------
+
+def _ffn_block(x, wg_ref, wu_ref, wo_ref, activation):
+    """One (capacity-block, ffn-block) partial: fp32 on the MXU."""
+    if activation == "silu_gated":
+        g = jax.lax.dot_general(x, wg_ref[0].astype(jnp.float32),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        u = jax.lax.dot_general(x, wu_ref[0].astype(jnp.float32),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        mid = jax.nn.silu(g) * u
+    else:
+        g = jax.lax.dot_general(x, wg_ref[0].astype(jnp.float32),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        mid = jax.nn.gelu(g)
+    return jax.lax.dot_general(mid, wo_ref[0].astype(jnp.float32),
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _ffn_combine_kernel(x_ref, wg_ref, wu_ref, wo_ref, src_ref, slw_ref,
+                        out_ref, y_acc, *, activation: str, cap: int,
+                        cap_block: int):
+    """Grid (E, C/Cb, F/Fb), f innermost. The last f step of each
+    capacity block runs the fused combine epilogue: every filled slot
+    row scatter-accumulates ``slot_w * y`` into its token's output row —
+    ``expert_out`` never exists in HBM."""
+    e, c, f = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nf = pl.num_programs(2)
+
+    @pl.when((e == 0) & (c == 0) & (f == 0))
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    x = x_ref[0].astype(jnp.float32)                    # [Cb, H]
+    y = _ffn_block(x, wg_ref, wu_ref, wo_ref, activation)
+
+    @pl.when(f == 0)
+    def _first():
+        y_acc[...] = y
+
+    @pl.when(f > 0)
+    def _accum():
+        y_acc[...] = y_acc[...] + y
+
+    @pl.when(f == nf - 1)
+    def _combine():
+        base = e * cap + c * cap_block
+
+        def body(r, _):
+            slot = base + r
+
+            @pl.when(src_ref[slot] > 0)
+            def _():
+                t = src_ref[slot] - 1
+                out_ref[t, :] = (out_ref[t, :]
+                                 + slw_ref[slot] * y_acc[r, :])
+            return 0
+
+        jax.lax.fori_loop(0, cap_block, body, 0)
+
+
+def _ffn_kernel(x_ref, wg_ref, wu_ref, wo_ref, y_ref, y_acc, *,
+                activation: str):
+    """Plain grouped FFN (split combine path): grid (E, C/Cb, F/Fb)."""
+    f = pl.program_id(2)
+    nf = pl.num_programs(2)
+    x = x_ref[0].astype(jnp.float32)
+    y = _ffn_block(x, wg_ref, wu_ref, wo_ref, activation)
+
+    @pl.when(f == 0)
+    def _first():
+        y_acc[...] = y
+
+    @pl.when(f > 0)
+    def _accum():
+        y_acc[...] = y_acc[...] + y
+
+    @pl.when(f == nf - 1)
+    def _store():
+        y_ref[0] = y_acc[...]
+
+
+def _combine_kernel(slots_ref, w_tk_ref, y_ref, out_ref):
+    """Split combine: grid (T, K), k innermost — token t's output block
+    is revisited K times, accumulating its picked rows online."""
+    t, k = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[0, :] = jnp.zeros_like(out_ref[0, :])
+    out_ref[0, :] = out_ref[0, :] + w_tk_ref[0, k] * y_ref[0, :]
+
+
+def _ffn_specs(E, C, H, F, cap_block, ffn_block):
+    xspec = pl.BlockSpec((1, cap_block, H), lambda e, c, f: (e, c, 0))
+    wspec = pl.BlockSpec((1, H, ffn_block), lambda e, c, f: (e, 0, f))
+    wospec = pl.BlockSpec((1, ffn_block, H), lambda e, c, f: (e, f, 0))
+    return xspec, wspec, wospec
+
+
+def moe_ffn_combine(payload: jax.Array, wi_gate: jax.Array,
+                    wi_up: Optional[jax.Array], wo: jax.Array,
+                    src: jax.Array, slot_w: jax.Array, n_tokens: int, *,
+                    activation: str,
+                    interpret: Optional[bool] = None) -> jax.Array:
+    """Fused grouped-FFN + combine-scatter: ``payload`` [E, C, H] (wire
+    or compute dtype) -> token-major partial output [n_tokens, H] f32.
+    ``src``/``slot_w`` must match the payload's slot layout (length
+    E*C) — capacity-chunked callers pass the chunk's slices. The caller
+    sums partials over chunks and casts once."""
+    if interpret is None:
+        interpret = moe_kernel_interpret()
+    E, C, H = payload.shape
+    F = wi_gate.shape[-1]
+    gated = activation == "silu_gated"
+    cap_block = _divisor_block(C, _CAP_BLOCK)
+    ffn_block = _divisor_block(F, _FFN_BLOCK)
+    xspec, wspec, wospec = _ffn_specs(E, C, H, F, cap_block, ffn_block)
+    S = src.shape[0]
+    assert S == E * C, (S, E, C)
+    vec_i = pl.BlockSpec((S,), lambda e, c, f: (0,))
+    out_spec = pl.BlockSpec((n_tokens, H), lambda e, c, f: (0, 0))
+    from jax.experimental.pallas import tpu as pltpu
+    wu = wi_up if gated else wi_gate
+    return pl.pallas_call(
+        functools.partial(_ffn_combine_kernel, activation=activation,
+                          cap=C, cap_block=cap_block),
+        grid=(E, C // cap_block, F // ffn_block),
+        in_specs=[xspec, wspec, wspec, wospec, vec_i, vec_i],
+        out_specs=out_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tokens, H), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((cap_block, H), jnp.float32)],
+        interpret=interpret,
+    )(payload, wi_gate, wu, wo, src.astype(jnp.int32), slot_w)
+
+
+def moe_ffn(payload: jax.Array, wi_gate: jax.Array,
+            wi_up: Optional[jax.Array], wo: jax.Array, *,
+            activation: str, interpret: Optional[bool] = None
+            ) -> jax.Array:
+    """Split path: grouped FFN only -> [E, C, H] f32 expert outputs."""
+    if interpret is None:
+        interpret = moe_kernel_interpret()
+    E, C, H = payload.shape
+    F = wi_gate.shape[-1]
+    gated = activation == "silu_gated"
+    cap_block = _divisor_block(C, _CAP_BLOCK)
+    ffn_block = _divisor_block(F, _FFN_BLOCK)
+    xspec, wspec, wospec = _ffn_specs(E, C, H, F, cap_block, ffn_block)
+    yspec = pl.BlockSpec((1, cap_block, H), lambda e, c, f: (e, c, 0))
+    from jax.experimental.pallas import tpu as pltpu
+    wu = wi_up if gated else wi_gate
+    return pl.pallas_call(
+        functools.partial(_ffn_kernel, activation=activation),
+        grid=(E, C // cap_block, F // ffn_block),
+        in_specs=[xspec, wspec, wspec, wospec],
+        out_specs=yspec,
+        out_shape=jax.ShapeDtypeStruct((E, C, H), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((cap_block, H), jnp.float32)],
+        interpret=interpret,
+    )(payload, wi_gate, wu, wo)
+
+
+def moe_combine(y: jax.Array, slot_tk: jax.Array, w_tk: jax.Array, *,
+                interpret: Optional[bool] = None) -> jax.Array:
+    """Split combine: flat expert outputs ``y`` [S, H] + token-major
+    combine metadata -> [T, H] f32 (grid (T, K), scalar-prefetched slot
+    table — dropped choices carry weight 0 on slot 0)."""
+    from jax.experimental.pallas import tpu as pltpu
+    if interpret is None:
+        interpret = moe_kernel_interpret()
+    S, H = y.shape
+    T, K = slot_tk.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(T, K),
+        in_specs=[pl.BlockSpec((1, K), lambda t, k, st: (t, 0)),
+                  pl.BlockSpec((1, H), lambda t, k, st: (st[t * K + k], 0))],
+        out_specs=pl.BlockSpec((1, H), lambda t, k, st: (t, 0)),
+    )
+    return pl.pallas_call(
+        _combine_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, H), jnp.float32),
+        interpret=interpret,
+    )(slot_tk.astype(jnp.int32).reshape(-1), w_tk, y)
+
+
+# ---------------------------------------------------------------------------
+# the full kernel-path forward (custom VJP; backward = XLA reference)
+# ---------------------------------------------------------------------------
+
+def make_moe_forward(*, top_k: int, capacity: int, activation: str,
+                     mask_pad: bool, n_chunks: int = 1,
+                     wire_dtype=None, interpret: Optional[bool] = None):
+    """Build the kernel-path MoE forward ``(params, tokens) -> (out
+    [T, H] tokens.dtype, aux f32)`` for one static geometry.
+
+    ``n_chunks`` > 1 executes the overlap planner's scan-carry placement
+    on the kernel path: the capacity dim is chunked and chunk c+1's
+    dispatch gather+cast launches from the scan carry while chunk c's
+    FFN+combine kernel computes (depth 1 — the executor clamp for a
+    deeper plan recommendation). Exact per slot: chunking changes launch
+    placement only.
+
+    Backward: ``jax.custom_vjp`` whose bwd is the VJP of the XLA
+    reference path (``moe_reference_forward``) — recompute-style, one
+    statement of the gradient math shared with the ``xla`` hatch.
+    """
+    if interpret is None:
+        interpret = moe_kernel_interpret()
+    cap = capacity
+    gated = activation == "silu_gated"
+
+    def _impl(params, tokens):
+        T, H = tokens.shape
+        E = params["gate"].shape[-1]
+        logits = tokens @ params["gate"].astype(tokens.dtype)
+        src, slot_w, slot_tk, w_tk, me, ce = moe_route(
+            logits.astype(jnp.float32), top_k=top_k, capacity=cap,
+            interpret=interpret)
+        aux = jnp.sum(me * ce) * E
+        wi_gate = params["wi_gate"] if gated else params["wi"]
+        wi_up = params.get("wi_up")
+        wo = params["wo"]
+        fused = moe_fused_combine_fits(T, H)
+
+        nc = n_chunks
+        while nc > 1 and cap % nc:
+            nc -= 1
+        if nc > 1 and fused:
+            capc = cap // nc
+            # slot-major src is [E, cap]; chunk c is columns
+            # [c*capc, (c+1)*capc) of every expert row — the chunk's
+            # src/slot_w slices feed both the prefetch gather and the
+            # combine epilogue (same slot layout as its payload)
+            src_c = src.reshape(E, nc, capc).transpose(1, 0, 2)\
+                .reshape(nc, E * capc)
+            slw_c = slot_w.reshape(E, nc, capc).transpose(1, 0, 2)\
+                .reshape(nc, E * capc)
+
+            def fetch(sc):
+                return moe_dispatch_gather(
+                    tokens, sc, wire_dtype=wire_dtype,
+                    mask_pad=mask_pad,
+                    interpret=interpret).reshape(E, capc, H)
+
+            def chunk_out(payload, sc, wc):
+                return moe_ffn_combine(
+                    payload, wi_gate, wi_up, wo, sc, wc, T,
+                    activation=activation, interpret=interpret)
+
+            cur = fetch(src_c[0])
+
+            def body(carry, xs):
+                buf, sc_cur, wc_cur, acc = carry
+                sc_nxt, wc_nxt = xs
+                nxt = fetch(sc_nxt)     # independent of the FFN below
+                acc = acc + chunk_out(buf, sc_cur, wc_cur)
+                return (nxt, sc_nxt, wc_nxt, acc), 0
+
+            init = (cur, src_c[0], slw_c[0],
+                    jnp.zeros((T, H), jnp.float32))
+            (last, sc_last, wc_last, acc), _ = jax.lax.scan(
+                body, init, (src_c[1:], slw_c[1:]))
+            out = acc + chunk_out(last, sc_last, wc_last)
+        elif fused:
+            payload = moe_dispatch_gather(
+                tokens, src, wire_dtype=wire_dtype, mask_pad=mask_pad,
+                interpret=interpret).reshape(E, cap, H)
+            out = moe_ffn_combine(payload, wi_gate, wi_up, wo, src,
+                                  slot_w, T, activation=activation,
+                                  interpret=interpret)
+        else:
+            payload = moe_dispatch_gather(
+                tokens, src, wire_dtype=wire_dtype, mask_pad=mask_pad,
+                interpret=interpret).reshape(E, cap, H)
+            y = moe_ffn(payload, wi_gate, wi_up, wo,
+                        activation=activation, interpret=interpret)
+            out = moe_combine(y.reshape(E * cap, H), slot_tk, w_tk,
+                              interpret=interpret)
+        return out.astype(tokens.dtype), aux
+
+    @jax.custom_vjp
+    def fwd(params, tokens):
+        return _impl(params, tokens)
+
+    def fwd_fwd(params, tokens):
+        return _impl(params, tokens), (params, tokens)
+
+    def fwd_bwd(res, cts):
+        from ...moe.layer import moe_reference_forward
+        params, tokens = res
+        _, vjp = jax.vjp(
+            lambda p, t: moe_reference_forward(
+                p, t, top_k=top_k, capacity=cap, activation=activation,
+                mask_pad=mask_pad), params, tokens)
+        return vjp(cts)
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    return fwd

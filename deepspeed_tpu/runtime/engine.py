@@ -1585,6 +1585,10 @@ class DeepSpeedEngine:
                 gather=blk_comm.gather, scatter=blk_comm.scatter,
                 keep=layer_mask, attn_mask=batch.get("attention_mask"),
                 layers_per_step=lps,
+                # the plan deepens to 2 when the committed map still
+                # shows exposed in-scan bytes at depth 1 (ISSUE 11);
+                # plan-off keeps the hand schedule's depth 1 bitwise
+                prefetch_depth=(plan.prefetch_depth if planned else 1),
                 comm_scope=blk_comm.trace_executions,
                 comm_edge=blk_comm.schedule_class,
                 scatter_err=(ef_local["blocks"] if ef_local is not None

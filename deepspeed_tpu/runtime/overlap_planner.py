@@ -85,9 +85,11 @@ class OverlapPlan:
     must divide the MoE capacity) and records the effective values."""
     entry: str
     placement: str = PLACEMENT_INLINE
-    #: scan-carry: how many steps ahead the carry prefetches (the
-    #: executors implement depth 1 — a deeper recommendation is recorded
-    #: in ``notes`` and clamped).
+    #: scan-carry: how many steps ahead the carry prefetches. Depth 2
+    #: (derived when the committed map still shows exposed in-scan bytes
+    #: at depth 1) triple-buffers the prefetch; the ZeRO block schedule
+    #: executes up to 2 (``scan_blocks_pipelined(prefetch_depth=)``),
+    #: the MoE kernel executor clamps to 1 (recorded in ``notes``).
     prefetch_depth: int = 0
     #: scan-carry chunk count for paths that chunk a single exchange
     #: (MoE capacity chunks); 1 = unchunked.
@@ -273,9 +275,11 @@ def _plan_zeropp(entry: str, mp: Optional[Dict[str, Any]]) -> OverlapPlan:
     """The pipelined ZeRO++/stage-3 micro (the planner's first client —
     the PR 3 hand schedule becomes one derivation). The scan-carry
     prefetch stays depth 1 while the map shows the in-loop collectives
-    overlapped; exposed in-loop bytes would argue for a deeper carry
-    (recorded, executor clamps to 1). The plan additionally owns what
-    the hand schedule could not express:
+    overlapped; exposed in-loop bytes mean one-ahead was not enough —
+    the derivation deepens to 2 and ``scan_blocks_pipelined`` executes
+    the triple-buffered carry (ISSUE 11; the pre-11 executors clamped
+    to 1). The plan additionally owns what the hand schedule could not
+    express:
 
     - ``split_edge_leaves``: head-only edge leaves (final norm, an
       untied LM head — often the step's largest reduce) hoist across the
@@ -291,9 +295,10 @@ def _plan_zeropp(entry: str, mp: Optional[Dict[str, Any]]) -> OverlapPlan:
     depth = 1
     loop_exposed = _loop_exposed_bytes(mp)
     if loop_exposed:
-        notes.append(f"map shows {loop_exposed} exposed in-loop bytes; a "
-                     f"prefetch depth of 2 is recommended (executor "
-                     f"implements depth 1)")
+        depth = 2
+        notes.append(f"map shows {loop_exposed} exposed in-loop bytes at "
+                     f"depth 1; deriving prefetch depth 2 (triple-buffered "
+                     f"carry, executed by scan_blocks_pipelined)")
     return OverlapPlan(
         entry=entry, placement=PLACEMENT_SCAN_CARRY, prefetch_depth=depth,
         carry_error_feedback=True, split_edge_leaves=True,
@@ -308,8 +313,11 @@ def _plan_moe(entry: str, mp: Optional[Dict[str, Any]]) -> OverlapPlan:
     bytes the map observed (clamped to what the runtime capacity
     divides); below the pipeline floor the plan stays unchunked — a
     tiny exchange is latency-bound and a loop would only add overhead.
-    The combine-side exchange stays at the epilogue edge
-    (budget-justified: every token's slots span all chunks)."""
+    Since ISSUE 11 the combine side rides the scan body too: each
+    chunk's expert rows re-gather to tokens under a chunk mask right
+    after that chunk's FFN, leaving only the LAST chunk's combine as
+    the budget-justified epilogue edge (top_k > 2 pins nc=1 — the
+    masked form is exact only for two-term sums)."""
     split = _split_bytes(mp)
     total = sum(split.values())
     notes: List[str] = []

@@ -50,12 +50,26 @@ class TestDerivations:
             and plan.defer_replicated
         assert plan.source == "map"  # the committed map exists
 
-    def test_zeropp_notes_exposed_loop_bytes(self, tmp_path):
+    def test_zeropp_exposed_loop_bytes_deepen_prefetch(self, tmp_path):
+        # ISSUE 11: exposed in-loop bytes at depth 1 mean one-ahead was
+        # not enough — the derivation deepens to 2 (triple-buffered
+        # carry, executed by scan_blocks_pipelined(prefetch_depth=2))
         maps = _write_map(tmp_path, "zeropp-micro-overlap", [
             _coll(4096, "exposed", loop={"while": "w", "trip_count": 4},
                   executions=4)])
         plan = op.plan_entry("zeropp-micro-overlap", maps)
+        assert plan.prefetch_depth == 2
         assert any("in-loop" in n for n in plan.notes)
+
+    def test_zeropp_overlapped_loop_bytes_stay_depth1(self, tmp_path):
+        # a map whose in-loop collectives classify overlapped keeps the
+        # double-buffered carry — deeper would spend HBM for nothing
+        maps = _write_map(tmp_path, "zeropp-micro-overlap", [
+            _coll(4096, "overlapped", loop={"while": "w", "trip_count": 4},
+                  executions=4),
+            _coll(512, "exposed")])  # straight-line exposure: not a
+        plan = op.plan_entry("zeropp-micro-overlap", maps)  # depth signal
+        assert plan.prefetch_depth == 1
 
     def test_moe_unchunked_below_floor(self, tmp_path):
         maps = _write_map(tmp_path, "moe-dispatch", [_coll(64)])

@@ -222,6 +222,32 @@ class TestChunkedDispatch:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-6, rtol=1e-5)
 
+    def test_top_k_beyond_two_pins_unchunked(self, eight_devices,
+                                             monkeypatch):
+        """The masked per-chunk combine reassociates a token's k weighted
+        terms into chunk order — exact only for k <= 2. top_k=3 must pin
+        nc=1 so plan-on stays BITWISE against the unchunked program."""
+        from deepspeed_tpu.moe.layer import MoE
+        from deepspeed_tpu.runtime import topology as topo_mod
+        from deepspeed_tpu.runtime.topology import TopologyConfig
+
+        topo_mod.reset()
+        topo = topo_mod.initialize(TopologyConfig(expert=2, data=-1),
+                                   force=True)
+        moe = MoE(hidden_size=16, intermediate_size=32, num_experts=4,
+                  top_k=3)
+        params = moe.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, 16),
+                              jnp.float32)
+        with topo.mesh:
+            on, aux_on = jax.jit(lambda p, t: moe(p, t))(params, x)
+        monkeypatch.setenv("DSTPU_OVERLAP_PLAN", "0")
+        with topo.mesh:
+            off, aux_off = jax.jit(lambda p, t: moe(p, t))(params, x)
+        np.testing.assert_array_equal(np.asarray(on), np.asarray(off))
+        np.testing.assert_array_equal(np.asarray(aux_on),
+                                      np.asarray(aux_off))
+
     def test_chunk_count_clamps_to_capacity_divisor(self, eight_devices,
                                                     monkeypatch):
         """A capacity the plan's chunk count does not divide must clamp,

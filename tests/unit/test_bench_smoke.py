@@ -1,0 +1,53 @@
+"""BENCH_SMOKE.json routing (ISSUE 11 satellite).
+
+The committed BENCH_SUMMARY.json holds TPU measurements; a chipless host
+running the CPU smoke path used to clobber it with 3-step smoke numbers.
+``_write_summary(..., smoke=True)`` must route to BENCH_SMOKE.json, and
+the CPU tail of ``_run_configs`` must pass the flag.
+"""
+
+import importlib.util
+import inspect
+import json
+import os
+
+
+def _load_bench():
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # module top level is stdlib-only
+    return mod
+
+
+def test_smoke_summary_routes_to_bench_smoke(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_BENCH_DIR", str(tmp_path))
+    lines = [{"metric": "m", "value": 1.0}]
+    bench._write_summary(lines, smoke=True)
+    assert json.loads(
+        (tmp_path / "BENCH_SMOKE.json").read_text()) == lines
+    assert not (tmp_path / "BENCH_SUMMARY.json").exists(), \
+        "smoke run clobbered the committed TPU summary"
+
+
+def test_tpu_summary_keeps_its_name(tmp_path, monkeypatch):
+    bench = _load_bench()
+    monkeypatch.setattr(bench, "_BENCH_DIR", str(tmp_path))
+    bench._write_summary([{"metric": "m", "value": 2.0}])
+    assert (tmp_path / "BENCH_SUMMARY.json").exists()
+    assert not (tmp_path / "BENCH_SMOKE.json").exists()
+
+
+def test_cpu_smoke_tail_passes_the_flag():
+    # wiring pin: the CPU in-process tail of _run_configs (the only
+    # caller that can run without a chip) must route by backend — a
+    # refactor that drops the flag regresses to the clobber
+    bench = _load_bench()
+    src = inspect.getsource(bench._run_configs)
+    assert "_write_summary(lines, smoke=not on_tpu)" in src
+    # and the dispatcher's TPU write stays on the committed file
+    src_tpu = inspect.getsource(bench._dispatch_tpu)
+    assert "_write_summary(lines)" in src_tpu

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""A/B: fused Pallas MoE dispatch/combine kernels (DSTPU_MOE_KERNEL,
+ISSUE 11) vs the XLA expert path on the SAME mixtral-style step.
+
+Both arms run the identical ZeRO-2 bf16 training step on the bench [3]
+mixtral-style architecture; the ONLY variable is the expert-path
+program: the ``kernel`` arm forces ``DSTPU_MOE_KERNEL=pallas`` (fused
+route+capacity-scatter, slot-gather+wire-cast, grouped FFN+combine
+launches — ops/transformer/pallas_moe.py), the ``xla`` arm pins
+``DSTPU_MOE_KERNEL=xla`` (the pre-ISSUE-11 layer program, bitwise).
+Each child also reports its final loss so the parity half of the
+acceptance is visible next to the wall-clock half.
+
+Interleaving is at PROCESS granularity via tools/ab_common.py (the env
+gate binds at trace time, and two engines do not reliably fit HBM
+together).
+
+On a CPU backend the script automatically shrinks to a smoke shape
+(mixtral-tiny, 2 steps, interpret-mode kernels) — the acceptance's
+"runs clean in CPU interpret mode" check. NOTE the single-chip
+requirement: on a multi-device mesh or a live expert/pipe axis the
+layer auto-pins the XLA path (docs/KERNELS.md multi-chip note), so the
+forced ``pallas`` arm is only honest where the kernel actually serves.
+
+Run:  python tools/moe_dispatch_ab.py
+      python tools/moe_dispatch_ab.py --single kernel|xla
+"""
+
+import json
+import os
+import sys
+import time
+
+# repo root on sys.path: children re-run this file directly, and python
+# seeds sys.path[0] with tools/, not the package root
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STEPS = 30
+SMOKE_STEPS = 2
+
+
+def _on_cpu():
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def build(variant, smoke):
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import mixtral_model
+    from deepspeed_tpu.runtime import topology as topo_mod
+
+    topo_mod.reset()
+    os.environ["DSTPU_MOE_KERNEL"] = \
+        "pallas" if variant == "kernel" else "xla"
+    if smoke:
+        model = mixtral_model("mixtral-tiny", dtype=jnp.float32,
+                              remat=False, max_seq_len=64, vocab_size=512)
+        micro, seq = 2, 32
+    else:
+        model = mixtral_model("mixtral-8x7b", dtype=jnp.bfloat16,
+                              remat=False, num_layers=4, hidden_size=1024,
+                              intermediate_size=3584, num_heads=16,
+                              num_kv_heads=8, max_seq_len=1024)
+        micro, seq = 8, 1024
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+    }
+    if not smoke:
+        cfg["bf16"] = {"enabled": True}
+        cfg["data_types"] = {"grad_accum_dtype": "bf16"}
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=cfg)
+    ids = np.random.default_rng(0).integers(
+        0, model.config.vocab_size, size=(micro, seq))
+    return engine, {"input_ids": ids}, micro * seq
+
+
+def run_single(variant):
+    import jax
+    import jax.numpy as jnp
+
+    def sync(x):
+        return float(jax.device_get(jnp.ravel(x)[0]))
+
+    smoke = _on_cpu()
+    steps = SMOKE_STEPS if smoke else STEPS
+    try:
+        engine, batch, tokens = build(variant, smoke)
+        sync(engine.train_batch(batch))  # compile + settle
+        sync(engine.train_batch(batch))
+        best = float("inf")
+        loss = None
+        for _ in range(2 if smoke else 4):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = engine.train_batch(batch)
+            sync(loss)
+            leaf = jax.tree.leaves(engine.state["params"])[0]
+            sync(jnp.ravel(leaf)[0])
+            best = min(best, time.perf_counter() - t0)
+        print(json.dumps({
+            "variant": variant, "smoke": smoke, "best_window_s": best,
+            "tokens_per_sec": round(tokens * steps / best, 1),
+            "loss_last": round(float(loss), 6),
+        }), flush=True)
+    except Exception as e:  # noqa: BLE001 — a crashed variant is a result
+        print(json.dumps({"variant": variant,
+                          "error": f"{type(e).__name__}: {e}"[:300]}),
+              flush=True)
+
+
+def main():
+    if "--single" in sys.argv:
+        return run_single(sys.argv[sys.argv.index("--single") + 1])
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from ab_common import run_interleaved
+
+    best = run_interleaved(
+        ["kernel", "xla"],
+        lambda name: [sys.executable, os.path.abspath(__file__),
+                      "--single", name],
+        rounds=2, timeout=2400)
+    if "kernel" in best and "xla" in best:
+        k, x = best["kernel"], best["xla"]
+        print(json.dumps({
+            "metric": "fused MoE dispatch/combine kernel speedup "
+                      "(tokens/sec ratio, kernel vs DSTPU_MOE_KERNEL=xla)",
+            "vs_moe_kernel_off": round(k["tokens_per_sec"]
+                                       / x["tokens_per_sec"], 3),
+            "kernel_tokens_per_sec": k["tokens_per_sec"],
+            "xla_tokens_per_sec": x["tokens_per_sec"],
+            "loss_last_kernel": k["loss_last"],
+            "loss_last_xla": x["loss_last"],
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

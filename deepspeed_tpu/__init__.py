@@ -15,6 +15,7 @@ Front door (reference ``deepspeed/__init__.py:64``):
 
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, Optional, Tuple
 
 __version__ = "0.1.0"
@@ -51,7 +52,6 @@ def initialize(args=None,
     assert model is not None, "deepspeed_tpu.initialize: model is required"
     config = config if config is not None else config_params
     if isinstance(config, str):  # JSON path (reference-supported form)
-        import json
         with open(config) as f:
             config = json.load(f)
 
@@ -72,6 +72,24 @@ def initialize(args=None,
         seed=seed,
         init_params=model_parameters,
     )
+
+    # elastic resume (dstpu-resilience, docs/RESILIENCE.md): a world
+    # (re)started by DSElasticAgent(checkpoint_dir=...) carries the
+    # checkpoint dir in DSTPU_ELASTIC — resume from the last committed
+    # tag so a restart (possibly at a different dp width; the store
+    # re-buckets shards on load) continues instead of re-initializing.
+    # No committed tag yet → fresh start; a corrupt `latest` falls back
+    # to the newest verified tag inside load_checkpoint.
+    from .resilience import parse_elastic_env
+    _ckpt_dir = parse_elastic_env().get("checkpoint_dir")
+    if _ckpt_dir:
+        tag, _ = engine.load_checkpoint(_ckpt_dir)
+        from .utils.logging import log_dist
+        log_dist(
+            "elastic resume: "
+            + (f"resumed tag {tag} at step {engine.global_steps}" if tag
+               else "no committed checkpoint yet — fresh start")
+            + f" (dir {_ckpt_dir})", ranks=[0])
 
     dataloader = None
     if training_data is not None:

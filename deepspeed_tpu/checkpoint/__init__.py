@@ -1,4 +1,5 @@
 from .checkpoint_engine import (AsyncCheckpointEngine, CheckpointEngine,  # noqa: F401
                                 NpzCheckpointEngine)
 from .ds_to_universal import ds_to_universal, load_universal  # noqa: F401
-from .store import load_checkpoint, save_checkpoint  # noqa: F401
+from .store import (load_checkpoint, resolve_tag, retire_old_tags,  # noqa: F401
+                    save_checkpoint, verify_tag)

@@ -47,11 +47,16 @@ class CheckpointEngine:
 
 
 class NpzCheckpointEngine(CheckpointEngine):
-    """Synchronous npz persistence (the reference's TorchCheckpointEngine)."""
+    """Synchronous npz persistence (the reference's TorchCheckpointEngine).
+    Writes ride the store's durable-write primitive: temp + fsync +
+    ``os.replace`` with retry-with-backoff (docs/RESILIENCE.md)."""
 
     def save(self, state_dict: Dict[str, np.ndarray], path: str) -> None:
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        np.savez(path, **state_dict)
+        from .store import _atomic_savez
+        if not path.endswith(".npz"):
+            path += ".npz"  # np.savez's own extension behavior, kept
+        _atomic_savez(path, state_dict)
 
     def load(self, path: str, map_location=None) -> Dict[str, np.ndarray]:
         with np.load(path, allow_pickle=False) as z:

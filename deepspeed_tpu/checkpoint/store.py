@@ -7,7 +7,7 @@ and the pluggable ``CheckpointEngine`` (checkpoint_engine.py:9).
 Layout (tag-based dirs like the reference):
 
     <dir>/<tag>/state.npz        # flattened pytree leaves (gathered to host)
-    <dir>/<tag>/meta.json        # treedef paths, dtypes, client state
+    <dir>/<tag>/meta.json        # treedef paths, dtypes, checksums, client state
     <dir>/latest                 # text file holding the newest tag
 
 Single-process runs save leaves *unsharded* (``jax.device_get`` gathers).
@@ -21,16 +21,42 @@ written under one topology/process count loads under any other — the
 "universal checkpoint" property the reference needs a whole offline tool
 for (``checkpoint/ds_to_universal.py``) falls out of addressing params by
 logical name.
+
+Durability contract (dstpu-resilience, docs/RESILIENCE.md):
+
+- every data file lands via temp-name + ``os.replace`` (+ fsync) — a kill
+  at any instruction leaves either the old bytes or the new bytes, never
+  a torn file under a committed name;
+- ``meta.json`` is the commit record, written after the data it describes
+  and carrying a crc32 per data file; ``latest`` repoints after that;
+- transient ``OSError`` s retry with exponential backoff
+  (``DSTPU_CKPT_RETRIES`` / ``DSTPU_CKPT_BACKOFF_S``);
+- :func:`load_checkpoint` verifies checksums (hatch:
+  ``DSTPU_CKPT_VERIFY=0``) and, when ``latest`` names a tag that fails
+  verification, falls back to the newest tag that passes — and raises
+  rather than silently re-initializing when none does;
+- :func:`retire_old_tags` implements keep-last-N retention without ever
+  deleting the tag ``latest`` names.
+
+Fault-injection seams (``resilience/fault_plan.py``) hook the write path
+at ``ckpt_io`` (before an attempt) and ``ckpt_tmp`` (between temp write
+and rename) — host-side only.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import shutil
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
+
+from ..resilience.fault_plan import fault_point
+from ..utils.logging import logger
 
 
 def _flatten_with_paths(tree) -> Dict[str, Any]:
@@ -68,41 +94,128 @@ def stage_state(state) -> Tuple[list, Dict[str, np.ndarray]]:
     return keys, {k: np.asarray(jax.device_get(flat[k])) for k in keys}
 
 
+# ---------------------------------------------------------------------------
+# durable-write primitives
+# ---------------------------------------------------------------------------
+def _io_retries() -> int:
+    return int(os.environ.get("DSTPU_CKPT_RETRIES", "3"))
+
+
+def _io_backoff_s() -> float:
+    return float(os.environ.get("DSTPU_CKPT_BACKOFF_S", "0.05"))
+
+
+def _fsync_file(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
+
+
+def _atomic_write(path: str, payload: Callable[[str], None],
+                  suffix: str = ".tmp") -> int:
+    """Write ``path`` crash-consistently: payload to a temp name, fsync,
+    crc, rename. Transient ``OSError`` s (including injected ones) retry
+    with exponential backoff; the temp file of a failed attempt is
+    removed. Returns the crc32 of the durable bytes."""
+    retries, backoff = _io_retries(), _io_backoff_s()
+    last: Optional[BaseException] = None
+    for attempt in range(retries + 1):
+        tmp = f"{path}.{os.getpid()}{suffix}"
+        try:
+            fault_point("ckpt_io", path=path)
+            payload(tmp)
+            _fsync_file(tmp)
+            crc = _crc32_file(tmp)
+            # torn-write injection lands HERE: between a complete temp
+            # file and the rename — the window the protocol closes
+            fault_point("ckpt_tmp", path=path, tmp=tmp)
+            os.replace(tmp, path)
+            return crc
+        except OSError as e:
+            last = e
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            if attempt >= retries:
+                break
+            delay = backoff * (2 ** attempt)
+            logger.warning(
+                f"checkpoint write of {os.path.basename(path)} failed "
+                f"({e}); retry {attempt + 1}/{retries} in {delay:.3f}s")
+            time.sleep(delay)
+    raise OSError(
+        f"checkpoint write of {path} failed after {retries + 1} attempts"
+    ) from last
+
+
+def _atomic_savez(path: str, arrays: Dict[str, np.ndarray]) -> int:
+    # np.savez appends '.npz' to names missing it — the temp suffix must
+    # keep the extension or the rename source won't exist
+    return _atomic_write(path, lambda tmp: np.savez(tmp, **arrays),
+                         suffix=".tmp.npz")
+
+
+def _atomic_json(path: str, obj: Any) -> int:
+    def payload(tmp: str) -> None:
+        with open(tmp, "w") as f:
+            json.dump(obj, f, indent=2, default=str)
+    return _atomic_write(path, payload)
+
+
+def _atomic_text(path: str, text: str) -> int:
+    def payload(tmp: str) -> None:
+        with open(tmp, "w") as f:
+            f.write(text)
+    return _atomic_write(path, payload)
+
+
 def write_latest(save_dir: str, tag: str) -> None:
     """Atomically repoint ``latest`` — the commit point of a checkpoint.
     Callers must only invoke this after every data file of ``tag`` is
     durable (the async engine orders it last in the same worker task)."""
-    tmp = os.path.join(save_dir, f".latest.{os.getpid()}.tmp")
-    with open(tmp, "w") as f:
-        f.write(tag)
-    os.replace(tmp, os.path.join(save_dir, "latest"))
+    _atomic_text(os.path.join(save_dir, "latest"), tag)
 
 
 def write_staged(save_dir: str, tag: str, keys, host: Dict[str, np.ndarray],
                  client_state: Dict[str, Any], save_latest: bool = True) -> None:
     """Write an already-staged (host-resident) single-process checkpoint:
-    data, then meta.json (the commit record), then — optionally — the
-    ``latest`` repoint. The IO half of a write-behind save; runs on the
-    async engine's worker thread."""
+    data, then meta.json (the commit record, carrying the data files'
+    checksums), then — optionally — the ``latest`` repoint. The IO half
+    of a write-behind save; runs on the async engine's worker thread."""
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
     # npz keys cannot contain some chars; index them
-    np.savez(os.path.join(path, "state.npz"),
-             **{f"leaf_{i}": host[k] for i, k in enumerate(keys)})
+    crc = _atomic_savez(os.path.join(path, "state.npz"),
+                        {f"leaf_{i}": host[k] for i, k in enumerate(keys)})
     # an elastic restart may re-save a tag previously written at
-    # another process count — stale rank files must not shadow this
+    # another process count — stale rank files (and their checksum
+    # sidecars, see the multi-host branch) must not shadow this
     import glob as _glob
-    for f in _glob.glob(os.path.join(path, "state.rank*.npz")):
+    for f in _glob.glob(os.path.join(path, "state.rank*.npz*")):
         os.remove(f)
     meta = {
         "keys": keys,
         "dtypes": {k: str(host[k].dtype) for k in keys},
         "shapes": {k: list(host[k].shape) for k in keys},
         "num_shard_files": 0,
+        "checksums": {"state.npz": crc},
         "client_state": client_state,
     }
-    with open(os.path.join(path, "meta.json"), "w") as f:
-        json.dump(meta, f, indent=2, default=str)
+    _atomic_json(os.path.join(path, "meta.json"), meta)
     if save_latest:
         write_latest(save_dir, tag)
 
@@ -129,8 +242,13 @@ def save_checkpoint(save_dir: str, tag: str, state, client_state: Dict[str, Any]
             pieces.update(_owned_pieces(i, v))
         elif jax.process_index() == 0:  # host scalars/ndarrays
             pieces[f"leaf_{i}__full"] = np.asarray(v)
-    np.savez(os.path.join(path, f"state.rank{jax.process_index()}.npz"),
-             **pieces)
+    fname = f"state.rank{jax.process_index()}.npz"
+    crc = _atomic_savez(os.path.join(path, fname), pieces)
+    # checksum handoff without a device collective: each rank drops a
+    # sidecar next to its shard file; rank 0 folds them into meta.json
+    # after the fence (the checkpoint dir is shared storage by
+    # construction — _PieceReader already requires it)
+    _atomic_text(os.path.join(path, fname + ".crc"), str(crc))
     # commit fence: every rank's shard file must be on disk before rank
     # 0 writes meta.json and repoints `latest` — otherwise a crash in
     # the window leaves `latest` naming an unreadable checkpoint
@@ -140,20 +258,181 @@ def save_checkpoint(save_dir: str, tag: str, state, client_state: Dict[str, Any]
         single = os.path.join(path, "state.npz")
         if os.path.exists(single):  # stale single-process format
             os.remove(single)
+        checksums = {}
+        for p in range(pcount):
+            fn = f"state.rank{p}.npz"
+            crc_path = os.path.join(path, fn + ".crc")
+            with open(crc_path) as f:
+                checksums[fn] = int(f.read().strip())
+            os.remove(crc_path)
         meta = {
             "keys": keys,
             "dtypes": {k: str(np.dtype(flat[k].dtype)) for k in keys},
             "shapes": {k: list(np.shape(flat[k])) for k in keys},
             "num_shard_files": pcount,
+            "checksums": checksums,
             "client_state": client_state,
         }
-        with open(os.path.join(path, "meta.json"), "w") as f:
-            json.dump(meta, f, indent=2, default=str)
+        _atomic_json(os.path.join(path, "meta.json"), meta)
         if save_latest:
             write_latest(save_dir, tag)
     # second fence: non-zero ranks must not return (and possibly
     # load_checkpoint) until rank 0 has committed meta.json/latest
     _comm.barrier()
+
+
+# ---------------------------------------------------------------------------
+# verification / retention / fallback
+# ---------------------------------------------------------------------------
+def verify_tag(path: str) -> Tuple[bool, str]:
+    """Is the tag directory at ``path`` a complete, uncorrupted
+    checkpoint? Checks the commit record (meta.json parses), that every
+    data file it names exists, and — when the meta carries checksums
+    (everything written since the durability contract landed) — that each
+    file's crc32 matches. Pre-contract checkpoints verify by existence
+    only. ``DSTPU_CKPT_VERIFY=0`` skips the byte scan (existence checks
+    remain)."""
+    meta_path = os.path.join(path, "meta.json")
+    if not os.path.exists(meta_path):
+        return False, "no meta.json (tag never committed)"
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (ValueError, OSError) as e:
+        return False, f"meta.json unreadable: {e}"
+    n = int(meta.get("num_shard_files") or 0)
+    files = ([f"state.rank{p}.npz" for p in range(n)] if n
+             else ["state.npz"])
+    checksums = meta.get("checksums") or {}
+    scan = os.environ.get("DSTPU_CKPT_VERIFY", "1").strip().lower() \
+        not in ("0", "off", "false")
+    for fn in files:
+        fp = os.path.join(path, fn)
+        if not os.path.exists(fp):
+            return False, f"missing data file {fn}"
+        if scan and fn in checksums:
+            actual = _crc32_file(fp)
+            if actual != int(checksums[fn]):
+                return False, (f"checksum mismatch on {fn} "
+                               f"(recorded {checksums[fn]}, found {actual})")
+    return True, "ok"
+
+
+def _committed_tags(save_dir: str) -> List[Tuple[float, int, str]]:
+    """Store-format tags under ``save_dir`` with a commit record, as
+    ``(meta mtime, client global_steps, tag)`` sorted oldest-first —
+    the retirement/fallback ordering."""
+    out = []
+    try:
+        entries = os.listdir(save_dir)
+    except OSError:
+        return []
+    for name in entries:
+        meta_path = os.path.join(save_dir, name, "meta.json")
+        if not os.path.isfile(meta_path):
+            continue
+        try:
+            with open(meta_path) as f:
+                steps = int(json.load(f).get("client_state", {})
+                            .get("global_steps", 0) or 0)
+        except (ValueError, OSError, TypeError):
+            steps = 0
+        out.append((os.path.getmtime(meta_path), steps, name))
+    out.sort()
+    return out
+
+
+def find_fallback_tag(load_dir: str, exclude: str) -> Optional[str]:
+    """Newest committed tag (≠ ``exclude``) that passes verification —
+    the recovery target when ``latest`` names a corrupt checkpoint."""
+    for _, _, tag in reversed(_committed_tags(load_dir)):
+        if tag == exclude:
+            continue
+        ok, reason = verify_tag(os.path.join(load_dir, tag))
+        if ok:
+            return tag
+        logger.warning(f"checkpoint fallback: tag {tag} also fails "
+                       f"verification ({reason}); continuing search")
+    return None
+
+
+def retire_old_tags(save_dir: str, keep_last: int,
+                    protect: Tuple[str, ...] = ()) -> List[str]:
+    """Keep-last-N retention: delete the oldest committed tags beyond
+    ``keep_last``, never touching the tag ``latest`` names (nor anything
+    in ``protect``). Returns the removed tag names. ``keep_last <= 0``
+    disables retention."""
+    if keep_last <= 0:
+        return []
+    keep = set(protect)
+    latest_path = os.path.join(save_dir, "latest")
+    if os.path.exists(latest_path):
+        try:
+            with open(latest_path) as f:
+                keep.add(f.read().strip())
+        except OSError:
+            pass
+    tags = [t for _, _, t in _committed_tags(save_dir)]
+    removable = [t for t in tags if t not in keep]
+    # the protected tags count toward the retention budget
+    n_protected_committed = len(tags) - len(removable)
+    excess = len(removable) - max(0, keep_last - n_protected_committed)
+    removed = []
+    for tag in removable[:max(0, excess)]:
+        try:
+            shutil.rmtree(os.path.join(save_dir, tag))
+            removed.append(tag)
+        except OSError as e:  # retention must never fail a save
+            logger.warning(f"checkpoint retention: could not remove "
+                           f"{tag}: {e}")
+    if removed:
+        logger.info(f"checkpoint retention: retired {removed} "
+                    f"(keep_last={keep_last})")
+    return removed
+
+
+def resolve_tag(load_dir: str, tag: Optional[str]) -> Tuple[Optional[str], bool]:
+    """Resolve the tag to load and verify it. Returns ``(tag, fresh)``
+    where ``fresh=True`` means "no checkpoint exists — initialize from
+    scratch". An *explicit* tag that fails verification raises (the
+    caller asked for those bytes); a corrupt tag named by ``latest``
+    falls back to the newest verifying tag, and raises — never silently
+    re-initializes — when there is none."""
+    explicit = tag is not None
+    if tag is None:
+        latest_path = os.path.join(load_dir, "latest")
+        if not os.path.exists(latest_path):
+            return None, True
+        with open(latest_path) as f:
+            tag = f.read().strip()
+    path = os.path.join(load_dir, tag)
+    ok, reason = verify_tag(path)
+    if ok:
+        return tag, False
+    if explicit:
+        if not os.path.exists(os.path.join(path, "meta.json")):
+            # preserved semantics: asking for a tag that was never
+            # committed means "no checkpoint", not corruption
+            return None, True
+        raise ValueError(
+            f"checkpoint tag '{tag}' failed verification: {reason}")
+    fb = find_fallback_tag(load_dir, exclude=tag)
+    if fb is not None:
+        logger.error(
+            f"checkpoint 'latest' names tag '{tag}' which failed "
+            f"verification ({reason}); falling back to newest verified "
+            f"tag '{fb}'")
+        return fb, False
+    if not os.path.exists(os.path.join(path, "meta.json")) and \
+            not _committed_tags(load_dir):
+        # nothing was ever committed here (e.g. a foreign-format dir
+        # whose `latest` belongs to the paged engine) — not corruption
+        return None, True
+    raise RuntimeError(
+        f"checkpoint 'latest' names tag '{tag}' which failed verification "
+        f"({reason}) and no other tag under {load_dir} verifies — refusing "
+        f"to silently re-initialize; inspect or delete the directory to "
+        f"start fresh")
 
 
 def _np_dtype(name: str) -> np.dtype:
@@ -258,20 +537,16 @@ class _PieceReader:
 def load_checkpoint(load_dir: str, tag: Optional[str], state_template, shardings,
                     load_optimizer_states: bool = True
                     ) -> Tuple[Optional[Any], Dict[str, Any], Optional[str]]:
-    if tag is None:
-        latest_path = os.path.join(load_dir, "latest")
-        if not os.path.exists(latest_path):
-            return None, {}, None
-        with open(latest_path) as f:
-            tag = f.read().strip()
-    path = os.path.join(load_dir, tag)
     # meta.json is the commit record (written LAST, after all data files):
-    # its absence means "no checkpoint"; once present, missing data files
-    # are corruption and fail loudly instead of silently re-initializing
-    meta_path = os.path.join(path, "meta.json")
-    if not os.path.exists(meta_path):
+    # its absence means "no checkpoint"; once present, failed verification
+    # (missing data file, checksum mismatch) either falls back to the
+    # newest verified tag (`latest`-resolved loads) or fails loudly
+    # (explicit tags) — never a silent re-initialize
+    tag, fresh = resolve_tag(load_dir, tag)
+    if fresh:
         return None, {}, None
-    with open(meta_path) as f:
+    path = os.path.join(load_dir, tag)
+    with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
     sharded_ckpt = int(meta.get("num_shard_files") or 0) > 0
     reader = by_key = None

@@ -14,9 +14,17 @@ is simply re-spawning per-slot processes with a fresh
 Workers read it before ``deepspeed_tpu.initialize`` to configure batches.
 
 Failure policy: on any worker failure the remaining world is torn down
-(collectives cannot survive a lost peer) and relaunched; with
-``shrink_on_failure`` each retry drops one slot, re-solving the batch
-config, until ``min_gpus`` — the reference's membership-change path.
+(collectives cannot survive a lost peer) and relaunched — after an
+exponential backoff (``restart_backoff_s``; a crash-looping script must
+not burn its restart budget in milliseconds); with ``shrink_on_failure``
+each retry drops one slot, re-solving the batch config, until
+``min_gpus`` — the reference's membership-change path.
+
+Elastic resume (dstpu-resilience): pass ``checkpoint_dir`` and the agent
+threads it through ``DSTPU_ELASTIC`` — ``deepspeed_tpu.initialize``
+resumes every (re)started world from the last *committed* tag there, at
+whatever dp width the restart solved (the checkpoint store's span
+assembly re-buckets ZeRO shards on load). See docs/RESILIENCE.md.
 """
 
 from __future__ import annotations
@@ -24,6 +32,7 @@ from __future__ import annotations
 import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -45,7 +54,10 @@ class DSElasticAgent:
                  master_addr: str = "localhost",
                  master_port: int = 29555,
                  extra_env: Optional[Dict[str, str]] = None,
-                 spawn_fn: Optional[Callable] = None):
+                 spawn_fn: Optional[Callable] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 restart_backoff_s: float = 1.0,
+                 max_backoff_s: float = 30.0):
         self.user_script = user_script
         self.user_args = list(user_args or [])
         self.ds_config = ds_config or {}
@@ -55,6 +67,9 @@ class DSElasticAgent:
         self.master_addr = master_addr
         self.master_port = master_port
         self.extra_env = dict(extra_env or {})
+        self.checkpoint_dir = checkpoint_dir
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.max_backoff_s = float(max_backoff_s)
         self.restart_count = 0
         self.world_history: List[int] = []
         self._spawn = spawn_fn or self._default_spawn
@@ -76,16 +91,57 @@ class DSElasticAgent:
                 f"(valid: {valid_gpus})")
         world = max(fit)
         per_gpu = final_batch // world
-        micro = max(m for m in el.get("micro_batch_sizes", [2, 4, 6])
-                    if per_gpu % m == 0)
+        sizes = el.get("micro_batch_sizes", [2, 4, 6])
+        divisible = [m for m in sizes if m >= 1 and per_gpu % m == 0]
+        if divisible:
+            micro = max(divisible)
+        else:
+            # no configured micro size divides per-gpu batch (e.g. prime
+            # per_gpu after a shrink): micro=1 always divides — degrade
+            # with a loud note instead of a bare max() ValueError
+            micro = 1
+            logger.warning(
+                f"elasticity: no micro_batch_sizes entry of {sizes} "
+                f"divides per-gpu batch {per_gpu} (train_batch "
+                f"{final_batch} over world {world}); falling back to "
+                f"micro_batch=1 x gas={per_gpu} — add a divisor of "
+                f"{per_gpu} to micro_batch_sizes to silence this")
         return {"world_size": world, "micro_batch": micro,
                 "train_batch": final_batch, "gas": per_gpu // micro}
 
     # -- spawning -----------------------------------------------------------
+    def _probe_port(self, base: int, tries: int = 64) -> int:
+        """First bindable coordinator port at or above ``base``. A fixed
+        ``master_port + attempt`` can collide with a lingering listener
+        (an unreaped coordinator from the PREVIOUS attempt, another job)
+        — and a world that dies on bind burns a restart credit for a
+        failure that is the agent's to dodge, not the script's."""
+        for port in range(base, base + tries):
+            try:
+                with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+                    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                    s.bind((self.master_addr, port))
+                return port
+            except OSError:
+                continue
+        raise RuntimeError(
+            f"no free coordinator port in [{base}, {base + tries}) on "
+            f"{self.master_addr} — set master_port to a free range")
+
+    def _world_env(self, world: Dict[str, Any], attempt: int) -> Dict[str, Any]:
+        payload = {**world, "restart_count": attempt}
+        if self.checkpoint_dir is not None:
+            # the elastic-resume thread: workers (deepspeed_tpu.initialize)
+            # resume from the last committed tag here
+            payload["checkpoint_dir"] = self.checkpoint_dir
+        return payload
+
     def _default_spawn(self, world: Dict[str, Any], attempt: int) -> List[subprocess.Popen]:
         procs = []
         n = world["world_size"]
-        port = self.master_port + attempt  # stale coordinator never rejoins
+        # advancing base per attempt keeps a stale coordinator from
+        # rejoining; probing dodges ports something else already holds
+        port = self._probe_port(self.master_port + attempt)
         for rank in range(n):
             env = dict(os.environ)
             env.update(self.extra_env)
@@ -93,7 +149,7 @@ class DSElasticAgent:
                 "JAX_COORDINATOR_ADDRESS": f"{self.master_addr}:{port}",
                 "JAX_NUM_PROCESSES": str(n),
                 "JAX_PROCESS_ID": str(rank),
-                "DSTPU_ELASTIC": json.dumps({**world, "restart_count": attempt}),
+                "DSTPU_ELASTIC": json.dumps(self._world_env(world, attempt)),
             })
             cmd = [sys.executable, self.user_script] + self.user_args
             procs.append(subprocess.Popen(cmd, env=env))
@@ -164,6 +220,14 @@ class DSElasticAgent:
                 return rc
             if self.shrink_on_failure and slots > 1:
                 slots -= 1
+            backoff = min(self.restart_backoff_s * (2 ** (self.restart_count - 1)),
+                          self.max_backoff_s) if self.restart_backoff_s > 0 else 0.0
             logger.warning(
                 f"elastic agent: worker failed (rc={rc}); restarting with "
-                f"{slots} slots ({self.restart_count}/{self.max_restarts})")
+                f"{slots} slots in {backoff:.1f}s "
+                f"({self.restart_count}/{self.max_restarts})")
+            if backoff > 0:
+                # a crash-looping script must not burn its whole restart
+                # budget in milliseconds; also gives the dead world's
+                # sockets/fds time to drain before the next rendezvous
+                time.sleep(backoff)

@@ -73,6 +73,13 @@ def parse_args(args=None):
                              "restart (shrinking the world if needed) on "
                              "failure instead of tearing the job down")
     parser.add_argument("--max_elastic_restarts", type=int, default=3)
+    parser.add_argument("--elastic_checkpoint_dir", type=str, default="",
+                        help="checkpoint dir threaded to elastic workers "
+                             "(DSTPU_ELASTIC): every (re)started world "
+                             "resumes from the last committed tag there")
+    parser.add_argument("--elastic_restart_backoff", type=float, default=1.0,
+                        help="base seconds of exponential backoff between "
+                             "elastic restarts (0 disables)")
     parser.add_argument("--deepspeed_config", type=str, default="",
                         help="ds_config json (elastic agent reads its "
                              "elasticity section)")
@@ -496,7 +503,9 @@ def _run_elastic(args, resource_pool: Optional[Dict[str, int]]) -> int:
         num_slots=slots, max_restarts=args.max_elastic_restarts,
         master_addr=args.master_addr or "localhost",
         master_port=args.master_port,
-        extra_env=_collect_env_exports())
+        extra_env=_collect_env_exports(),
+        checkpoint_dir=args.elastic_checkpoint_dir or None,
+        restart_backoff_s=args.elastic_restart_backoff)
     return agent.run()
 
 

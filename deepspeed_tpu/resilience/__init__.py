@@ -1,0 +1,22 @@
+"""dstpu-resilience: deterministic fault injection, crash-consistent
+checkpoints, and elastic resume — machine-checked failure handling the way
+dstpu-lint machine-checks overlap (see docs/RESILIENCE.md).
+
+Pieces:
+
+- :mod:`fault_plan` — seedable :class:`FaultPlan` firing crash / stall /
+  IO-error / torn-write events at host-side seams (engine step boundary,
+  checkpoint store writes), installed via ``DSTPU_FAULT_PLAN``.
+- ``checkpoint/store.py`` — the durability half (atomic renames, per-file
+  checksums in ``meta.json``, retry-with-backoff, keep-last-N retention,
+  verified-tag fallback) lives with the store, not here; this package owns
+  the *proof* machinery.
+- :mod:`chaos` — resume-parity comparison used by ``tools/chaos_run.py``
+  and the tier-1 chaos smoke.
+"""
+
+from .chaos import compare_trajectories, read_trajectory  # noqa: F401
+from .fault_plan import (CRASH_EXIT_CODE, STALL_EXIT_CODE, FaultEvent,  # noqa: F401
+                         FaultPlan, active_plan, clear_plan, fault_descriptor,
+                         fault_point, install_plan, maybe_install_from_env,
+                         parse_elastic_env)

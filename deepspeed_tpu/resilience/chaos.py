@@ -1,0 +1,92 @@
+"""Chaos-run bookkeeping: loss-trajectory capture and resume-parity
+comparison.
+
+A chaos run proves one property: *a world killed at an arbitrary step and
+restarted from the last committed tag produces the same loss trajectory
+as a world that was never killed.* Workers append one JSONL line per
+optimizer step (:func:`log_step`); after a crash the restarted attempt
+re-appends from the resume point, so :func:`read_trajectory` resolves
+duplicates last-write-wins — a replayed step (crash landed after the step
+but before its checkpoint committed) is *compared*, not skipped, which is
+exactly the replay-determinism the checkpoint protocol promises.
+
+Parity uses the repo's established global-scale atol floor (see
+``tests/unit/runtime/zero/test_zero_overlap.py::assert_grads_close``):
+``atol = frac * max(|reference|)`` — a shrunk world re-buckets its ZeRO
+shards and sums in a different order, so per-step relative error is the
+wrong yardstick.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+TRAJECTORY_FILE = "losses.rank{rank}.jsonl"
+
+
+def trajectory_path(out_dir: str, rank: int = 0) -> str:
+    return os.path.join(out_dir, TRAJECTORY_FILE.format(rank=rank))
+
+
+def log_step(out_dir: str, step: int, loss: float, rank: int = 0,
+             **extra) -> None:
+    """Append one step record. A single ``write`` of one line is atomic
+    enough for the one-writer-per-rank-per-attempt discipline; the record
+    carries the elastic attempt so a report can show where the resume
+    seam was."""
+    os.makedirs(out_dir, exist_ok=True)
+    from .fault_plan import _current_attempt_rank
+    attempt = _current_attempt_rank()[0]
+    rec = {"step": int(step), "loss": float(loss), "attempt": attempt}
+    rec.update(extra)
+    with open(trajectory_path(out_dir, rank), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def read_trajectory(out_dir: str, rank: int = 0) -> Dict[int, float]:
+    """step -> loss, duplicates resolved last-write-wins (the restarted
+    attempt's replay of an uncommitted step supersedes the original)."""
+    path = trajectory_path(out_dir, rank)
+    out: Dict[int, float] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            out[int(rec["step"])] = float(rec["loss"])
+    return out
+
+
+def compare_trajectories(reference: Dict[int, float],
+                         chaos: Dict[int, float],
+                         atol_frac: float = 1e-4,
+                         from_step: Optional[int] = None) -> Dict:
+    """Resume-parity report. Every step present in ``reference`` (from
+    ``from_step`` on) must appear in ``chaos`` and match within the
+    global-scale atol floor. Missing steps are failures — a resume that
+    silently skips work is exactly the bug this harness exists to catch."""
+    if not reference:
+        return {"ok": False, "reason": "empty reference trajectory"}
+    steps = sorted(s for s in reference
+                   if from_step is None or s >= from_step)
+    scale = max(abs(v) for v in reference.values())
+    atol = atol_frac * scale
+    missing = [s for s in steps if s not in chaos]
+    errs = {s: abs(chaos[s] - reference[s]) for s in steps if s in chaos}
+    max_err = max(errs.values()) if errs else float("inf")
+    ok = not missing and bool(errs) and max_err <= atol
+    return {
+        "ok": ok,
+        "steps_compared": len(errs),
+        "missing_steps": missing,
+        "max_abs_err": max_err if errs else None,
+        "atol": atol,
+        "atol_frac": atol_frac,
+        "scale": scale,
+        "per_step_err": {str(s): errs[s] for s in sorted(errs)},
+    }

@@ -40,6 +40,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..resilience.fault_plan import (STALL_EXIT_CODE, fault_point,
+                                     maybe_install_from_env)
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
                            NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
@@ -401,6 +403,15 @@ class DeepSpeedEngine:
         self.telemetry = self._build_telemetry()
         self._step_tokens = 0       # host-counted tokens of the open step
 
+        # -- resilience: a DSTPU_FAULT_PLAN env installs the deterministic
+        #    chaos schedule (resilience/fault_plan.py) — host-side seams
+        #    only, one None-check per step when absent -------------------
+        maybe_install_from_env()
+        # where the last save landed — the watchdog-escalation path
+        # checkpoints there (or checkpoint.escalation_dir) before exiting
+        self._last_save_dir: Optional[str] = None
+        self._escalation_exit = os._exit  # injectable for tests
+
         # -- checkpoint engine: sync npz writes, or write-behind when
         #    checkpoint: {async_save: true} (the previously-dead
         #    AsyncCheckpointEngine) — see save_checkpoint ---------------
@@ -525,6 +536,9 @@ class DeepSpeedEngine:
         if tele.watchdog is not None:
             from .. import comm as dist
             tele.watchdog.dump_fns.append(lambda: dist.comms_log_tail())
+            # hard-deadline escalation (watchdog.escalate_after_s):
+            # checkpoint-and-exit so a supervising elastic agent restarts
+            tele.escalation_handler = self._escalate_stall
         return tele
 
     def _telemetry_flops(self) -> float:
@@ -1878,6 +1892,10 @@ class DeepSpeedEngine:
         # armed — into the next call (same rule as forward())
         batch = self._prepare_batch(batch)
         self.telemetry.step_begin(self.global_steps)
+        # chaos seam: an injected stall sleeps INSIDE the open step span
+        # (host side) so the watchdog sees exactly what a wedged dispatch
+        # looks like; `step` is the step this dispatch will complete
+        fault_point("step_begin", step=self.global_steps + 1)
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = jnp.asarray(self.lr_scheduler.get_lr(), jnp.float32)
         with self.telemetry.phase("fused_dispatch", phase="step",
@@ -1993,6 +2011,7 @@ class DeepSpeedEngine:
         # watchdog armed — into the next step
         batch = self._prepare_batch(batch)
         self.telemetry.step_begin(self.global_steps)
+        fault_point("step_begin", step=self.global_steps + 1)
         self.timers(FORWARD_GLOBAL_TIMER).start()
         with self.telemetry.phase("micro_dispatch", phase="fwd",
                                   step=self.global_steps):
@@ -2099,6 +2118,10 @@ class DeepSpeedEngine:
             self.monitor.write_events([
                 ("Train/lr", self.lr_scheduler.get_lr(), self.global_steps),
             ])
+        # chaos seam: a crash injected "at step k" kills the process HERE,
+        # after step k's bookkeeping and before any checkpoint the caller
+        # would write for it — the preemption the elastic agent recovers
+        fault_point("step_end", step=self.global_steps)
 
     def _offload_jit(self, kind, key, build):
         """Per-leaf program cache for the offload path. The offload data
@@ -2415,12 +2438,14 @@ class DeepSpeedEngine:
                 batches = [self._apply_curriculum(b) for b in batches]
             dev = [self._device_batch(b) for b in batches]
         self.telemetry.step_begin(self.global_steps)
+        fault_point("step_begin", step=self.global_steps + 1)
         lr = float(self.lr_scheduler.get_lr())
         with self.telemetry.phase("paged_step", phase="step",
                                   step=self.global_steps):
             loss = self._param_stream.train_step(dev, lr)
         self.micro_steps += gas
         self.global_steps += 1
+        fault_point("step_end", step=self.global_steps)
         self.lr_scheduler.step()
         self._last_grad_norm = self._param_stream.last_grad_norm
         self.tput_timer.stop(global_step=True)
@@ -2669,27 +2694,22 @@ class DeepSpeedEngine:
 
     def _save_checkpoint_paged(self, save_dir, tag, client_state,
                                save_latest) -> None:
-        import json
         from .. import comm as dist
         d = os.path.join(save_dir, tag)
         os.makedirs(d, exist_ok=True)
         sd = self._param_stream.state_dict()
-        # atomic per-rank file; 'latest' flips only after EVERY rank's file
-        # is complete (barrier), so a crash mid-save never strands 'latest'
-        # on a tag with truncated shards
-        path = self._paged_ckpt_path(d)
-        tmp = f"{path}.{os.getpid()}.tmp.npz"
-        np.savez(tmp, **sd)
-        os.replace(tmp, path)
+        # atomic per-rank file (with the store's retry/fault seams);
+        # 'latest' flips only after EVERY rank's file is complete
+        # (barrier), so a crash mid-save never strands 'latest' on a tag
+        # with truncated shards
+        from ..checkpoint.store import _atomic_json, _atomic_savez, \
+            write_latest
+        _atomic_savez(self._paged_ckpt_path(d), sd)
         if jax.process_index() == 0:
-            with open(os.path.join(d, "client_state.json"), "w") as f:
-                json.dump(client_state, f)
+            _atomic_json(os.path.join(d, "client_state.json"), client_state)
         dist.barrier()
         if save_latest and jax.process_index() == 0:
-            ltmp = os.path.join(save_dir, f".latest.{os.getpid()}.tmp")
-            with open(ltmp, "w") as f:
-                f.write(tag)
-            os.replace(ltmp, os.path.join(save_dir, "latest"))
+            write_latest(save_dir, tag)
         log_dist(f"saved param-stream checkpoint {d}", ranks=[0])
 
     def _load_checkpoint_paged(self, load_dir, tag, load_optimizer_states):
@@ -2725,6 +2745,7 @@ class DeepSpeedEngine:
             self._require_params("save_checkpoint")
         from ..checkpoint.store import save_checkpoint as _save
         tag = tag or f"global_step{self.global_steps}"
+        self._last_save_dir = save_dir   # watchdog escalation target
         client_state = dict(client_state or {})
         client_state.update({
             "global_steps": self.global_steps,
@@ -2756,36 +2777,107 @@ class DeepSpeedEngine:
                            if self._offload is not None else None)
 
             def _write():
+                # sidecar FIRST: meta.json (inside write_staged) is the
+                # commit record — a tag whose meta verifies must have
+                # every file a load needs, or the corrupt-`latest`
+                # fallback could select a half-written tag
+                if sidecar is not None:
+                    from ..checkpoint.store import _atomic_savez
+                    os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+                    _atomic_savez(self._offload_ckpt_path(
+                        os.path.join(save_dir, tag)), sidecar)
                 write_staged(save_dir, tag, keys, host, client_state,
                              save_latest=False)
-                if sidecar is not None:
-                    np.savez(self._offload_ckpt_path(
-                        os.path.join(save_dir, tag)), **sidecar)
                 if save_latest:
                     write_latest(save_dir, tag)
+                self._retire_old_checkpoints(save_dir, tag)
 
             self.checkpoint_engine.submit(tag, _write)
             log_dist(f"staged checkpoint {save_dir}/{tag} "
                      "(async write-behind)", ranks=[0])
             return
         with self.telemetry.checkpoint_span("save_checkpoint", tag=tag):
-            # offload engines defer the `latest` repoint until the sidecar
-            # is durable too — same commit-fence ordering as the async
-            # branch (a crash between repoint and sidecar write must not
-            # leave `latest` naming an unloadable checkpoint)
-            defer_latest = save_latest and self._offload is not None
-            _save(save_dir, tag, self.state, client_state,
-                  save_latest=save_latest and not defer_latest)
+            # offload sidecar FIRST: meta.json (inside _save) is the
+            # commit record and `latest` repoints after it — a crash at
+            # any instruction leaves either an uncommitted tag or a
+            # complete one, never a committed tag missing its sidecar
+            # (the corrupt-`latest` fallback trusts committed tags)
             if self._offload is not None:
-                np.savez(self._offload_ckpt_path(os.path.join(save_dir, tag)),
-                         **self._offload_sidecar_arrays())
-            if defer_latest:
-                from .. import comm as dist
-                from ..checkpoint.store import write_latest
-                dist.barrier()  # every rank's sidecar on disk first
-                if jax.process_index() == 0:
-                    write_latest(save_dir, tag)
+                from ..checkpoint.store import _atomic_savez
+                os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
+                _atomic_savez(self._offload_ckpt_path(
+                    os.path.join(save_dir, tag)),
+                    self._offload_sidecar_arrays())
+                if jax.process_count() > 1:
+                    from .. import comm as dist
+                    dist.barrier()  # every rank's sidecar before commit
+            _save(save_dir, tag, self.state, client_state,
+                  save_latest=save_latest)
+            if jax.process_index() == 0:
+                self._retire_old_checkpoints(save_dir, tag)
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+
+    def _retire_old_checkpoints(self, save_dir: str, tag: str) -> None:
+        """keep-last-N retention (checkpoint: {keep_last_n: N}); 0 (the
+        default) keeps everything. Runs after the commit point, never
+        removes what `latest` names NOR the tag just written (a
+        save_latest=False milestone snapshot is not `latest` but must
+        survive its own save), and never fails a save."""
+        keep = int(self.config.checkpoint_config.get("keep_last_n", 0))
+        if keep > 0:
+            from ..checkpoint.store import retire_old_tags
+            retire_old_tags(save_dir, keep, protect=(tag,))
+
+    def _escalate_stall(self, step: int, elapsed: float) -> None:
+        """Watchdog escalation (telemetry.watchdog.escalate_after_s): a
+        step past the HARD deadline is declared dead — checkpoint what
+        the host still holds (the last completed step's state; best
+        effort, a truly wedged device cannot be drained) and exit with
+        STALL_EXIT_CODE so the elastic agent's restart loop takes over.
+        Runs on the watchdog thread: graceful degradation instead of a
+        hung world burning its allocation."""
+        target = self.config.checkpoint_config.get("escalation_dir") \
+            or self._last_save_dir
+        logger.error(
+            f"watchdog escalation: step {step} stalled {elapsed:.1f}s past "
+            f"the hard deadline; "
+            + (f"checkpointing to {target} and exiting"
+               if target else "no checkpoint dir known (no prior "
+               "save_checkpoint and no checkpoint.escalation_dir); exiting")
+            + f" with code {STALL_EXIT_CODE}")
+        if target is not None:
+            # the save itself can hang on the very runtime being escalated
+            # (device_get / multi-host barrier against a wedged peer) — a
+            # hang is not an Exception, so bound it with a daemon worker
+            # and a hard timeout: the EXIT is the guarantee, the
+            # checkpoint is best-effort
+            import threading
+
+            def _try_save():
+                try:
+                    self.save_checkpoint(
+                        target, tag=f"escalation_step{self.global_steps}")
+                    self.checkpoint_engine.commit("")  # async: fence
+                except Exception as e:  # noqa: BLE001 - must still exit
+                    logger.error(f"watchdog escalation: checkpoint failed "
+                                 f"({e}); exiting anyway")
+
+            budget = float(self.config.checkpoint_config.get(
+                "escalation_save_timeout_s", 120.0))
+            saver = threading.Thread(target=_try_save, daemon=True,
+                                     name="dstpu-escalation-save")
+            saver.start()
+            saver.join(timeout=budget)
+            if saver.is_alive():
+                logger.error(
+                    f"watchdog escalation: checkpoint did not finish in "
+                    f"{budget:.0f}s (checkpoint.escalation_save_timeout_s) "
+                    "— runtime is wedged; exiting without it")
+        try:
+            self.telemetry.close()  # flush spans/metrics for the autopsy
+        except Exception:  # noqa: BLE001
+            pass
+        self._escalation_exit(STALL_EXIT_CODE)
 
     def _offload_sidecar_arrays(self) -> Dict[str, Any]:
         """Host arrays of the offload optimizer sidecar file. Name-keyed

@@ -45,6 +45,12 @@ class WatchdogConfig(DeepSpeedConfigModel):
     deadline_factor: float = 3.0
     min_deadline_s: float = 60.0
     poll_s: float = 1.0
+    # HARD deadline (seconds) past which a stalled step escalates:
+    # checkpoint-and-exit so a supervising elastic agent restarts the
+    # world instead of a hung job burning its allocation (see
+    # docs/RESILIENCE.md). 0 (the default) disables escalation. Like the
+    # soft deadline, armed only once a first step has completed.
+    escalate_after_s: float = 0.0
 
 
 class TelemetryConfig(DeepSpeedConfigModel):

@@ -66,7 +66,12 @@ class Telemetry:
             deadline_factor=wd.deadline_factor,
             min_deadline_s=wd.min_deadline_s, poll_s=wd.poll_s,
             dump_fns=[self._dump_spans], on_stall=self._on_stall,
+            escalate_after_s=getattr(wd, "escalate_after_s", 0.0),
+            on_escalate=self._on_escalate,
         ) if wd.enabled else None
+        # set by the engine (_build_telemetry): the checkpoint-and-exit
+        # hard-deadline path (docs/RESILIENCE.md); None → log-only
+        self.escalation_handler: Optional[Callable[[int, float], None]] = None
         self.sinks: List[Any] = [s for s in (sinks or [])
                                  if getattr(s, "enabled", True)]
         self._step_span = None
@@ -250,6 +255,15 @@ class Telemetry:
     def _on_stall(self, step: int, elapsed: float) -> None:
         self.trace.instant("stall", phase=PHASE_STEP, step=step,
                            elapsed_s=round(elapsed, 3))
+
+    def _on_escalate(self, step: int, elapsed: float) -> None:
+        """Hard-deadline escalation: record the event (the trace is about
+        to be exported by the handler's exit path), then hand off to the
+        engine's checkpoint-and-exit handler."""
+        self.trace.instant("stall_escalation", phase=PHASE_STEP, step=step,
+                           elapsed_s=round(elapsed, 3))
+        if self.escalation_handler is not None:
+            self.escalation_handler(step, elapsed)
 
 
 class NullTelemetry:

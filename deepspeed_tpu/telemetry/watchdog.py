@@ -35,17 +35,26 @@ class StallWatchdog:
                  min_deadline_s: float = 60.0,
                  poll_s: float = 1.0,
                  dump_fns: Optional[List[Callable[[], str]]] = None,
-                 on_stall: Optional[Callable[[int, float], None]] = None):
+                 on_stall: Optional[Callable[[int, float], None]] = None,
+                 escalate_after_s: float = 0.0,
+                 on_escalate: Optional[Callable[[int, float], None]] = None):
         self.deadline_factor = float(deadline_factor)
         self.min_deadline_s = float(min_deadline_s)
         self.poll_s = max(0.01, float(poll_s))
         self.dump_fns = list(dump_fns or [])
         self.on_stall = on_stall
+        # hard deadline: a step open this long past its start escalates
+        # (checkpoint-and-exit, docs/RESILIENCE.md); 0 disables. Like the
+        # soft deadline it arms only after a first completed step — the
+        # compile-carrying first step has no meaningful budget.
+        self.escalate_after_s = float(escalate_after_s)
+        self.on_escalate = on_escalate
         self._durations: deque = deque(maxlen=64)
         self._lock = threading.Lock()
         self._cur_step: Optional[int] = None
         self._cur_start = 0.0
         self._fired_step: Optional[int] = None
+        self._escalated_step: Optional[int] = None
         self.stall_count = 0
         self.last_stall_step: Optional[int] = None
         self._stop = threading.Event()
@@ -92,23 +101,30 @@ class StallWatchdog:
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_s):
+            fire_stall = fire_escalate = None
             with self._lock:
                 step = self._cur_step
-                if step is None or self._fired_step == step:
-                    continue
-                if not self._durations:
+                if step is None or not self._durations:
                     # no completed step yet: the first step carries the
                     # whole XLA compile, routinely minutes at scale — a
                     # deadline is only meaningful once a baseline exists
                     continue
                 elapsed = clock.now() - self._cur_start
                 deadline = self._deadline_locked()
-                if elapsed <= deadline:
-                    continue
-                self._fired_step = step
-                self.stall_count += 1
-                self.last_stall_step = step
-            self._fire(step, elapsed, deadline)
+                if self._fired_step != step and elapsed > deadline:
+                    self._fired_step = step
+                    self.stall_count += 1
+                    self.last_stall_step = step
+                    fire_stall = (step, elapsed, deadline)
+                if (self.escalate_after_s > 0
+                        and self._escalated_step != step
+                        and elapsed > self.escalate_after_s):
+                    self._escalated_step = step
+                    fire_escalate = (step, elapsed)
+            if fire_stall is not None:
+                self._fire(*fire_stall)
+            if fire_escalate is not None:
+                self._escalate(*fire_escalate)
 
     def _fire(self, step: int, elapsed: float, deadline: float) -> None:
         lines = [f"STALL: step {step} running {elapsed:.1f}s "
@@ -128,8 +144,22 @@ class StallWatchdog:
             except Exception:  # noqa: BLE001
                 pass
 
+    def _escalate(self, step: int, elapsed: float) -> None:
+        logger.error(
+            f"STALL ESCALATION: step {step} running {elapsed:.1f}s, past "
+            f"the hard deadline of {self.escalate_after_s:.1f}s — handing "
+            "off to the escalation callback (checkpoint-and-exit)")
+        if self.on_escalate is not None:
+            try:
+                self.on_escalate(step, elapsed)
+            except Exception as e:  # noqa: BLE001 - the dog must survive
+                logger.error(f"stall escalation callback failed: {e}")
+
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5 * self.poll_s)
-            self._thread = None
+        t = self._thread
+        # the escalation path closes telemetry FROM the watchdog thread —
+        # joining ourselves would raise and abort the trace export
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5 * self.poll_s)
+        self._thread = None

@@ -1,0 +1,191 @@
+"""Checkpoint durability contract (dstpu-resilience): atomic renames,
+per-file checksums in meta.json, verified loads with fallback to the
+newest good tag, keep-last-N retention, and the async-save commit fence
+under a simulated kill. Store-level — no engine builds, so the whole
+file costs milliseconds inside the tier-1 wall budget."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.checkpoint.checkpoint_engine import AsyncCheckpointEngine
+from deepspeed_tpu.checkpoint import store
+
+
+def _write_tag(d, tag, value, steps, save_latest=True):
+    store.write_staged(str(d), tag, ["w"],
+                       {"w": np.full(16, value, np.float32)},
+                       {"global_steps": steps}, save_latest=save_latest)
+
+
+def _flip_byte(path, offset=30):
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_meta_records_checksums(tmp_path):
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    with open(tmp_path / "t1" / "meta.json") as f:
+        meta = json.load(f)
+    assert set(meta["checksums"]) == {"state.npz"}
+    assert meta["checksums"]["state.npz"] == \
+        store._crc32_file(str(tmp_path / "t1" / "state.npz"))
+    assert store.verify_tag(str(tmp_path / "t1")) == (True, "ok")
+
+
+def test_no_temp_litter_after_write(tmp_path):
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    names = os.listdir(tmp_path / "t1")
+    assert not [n for n in names if ".tmp" in n], names
+
+
+def test_flipped_byte_detected_and_falls_back(tmp_path):
+    """`latest` names a tag whose data file was corrupted on disk: the
+    load refuses it and falls back to the previous verified tag."""
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    _write_tag(tmp_path, "t2", 2.0, 2)
+    _flip_byte(tmp_path / "t2" / "state.npz")
+    ok, reason = store.verify_tag(str(tmp_path / "t2"))
+    assert not ok and "checksum mismatch" in reason
+    template = {"w": np.zeros(16, np.float32)}
+    state, client, tag = store.load_checkpoint(
+        str(tmp_path), None, template, {"w": None})
+    assert tag == "t1"
+    assert client["global_steps"] == 1
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.full(16, 1.0, np.float32))
+
+
+def test_corruption_without_fallback_raises(tmp_path):
+    """No verified tag left: refuse loudly rather than silently
+    re-initializing (the worst failure mode for a training service)."""
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    _flip_byte(tmp_path / "t1" / "state.npz")
+    with pytest.raises(RuntimeError, match="refusing to silently"):
+        store.load_checkpoint(str(tmp_path), None,
+                              {"w": np.zeros(16, np.float32)}, {"w": None})
+
+
+def test_explicit_corrupt_tag_raises_without_fallback(tmp_path):
+    """An explicitly-requested tag never falls back — the caller asked
+    for those bytes."""
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    _write_tag(tmp_path, "t2", 2.0, 2)
+    _flip_byte(tmp_path / "t2" / "state.npz")
+    with pytest.raises(ValueError, match="failed verification"):
+        store.load_checkpoint(str(tmp_path), "t2",
+                              {"w": np.zeros(16, np.float32)}, {"w": None})
+
+
+def test_missing_rank_file_is_loud(tmp_path):
+    """Sharded checkpoint with a lost rank file: verification names the
+    missing file; an explicit-tag load refuses."""
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    # forge a committed multi-host meta over a single rank file
+    tag = tmp_path / "t1"
+    os.rename(tag / "state.npz", tag / "state.rank0.npz")
+    with open(tag / "meta.json") as f:
+        meta = json.load(f)
+    meta["num_shard_files"] = 2
+    meta["checksums"] = {
+        "state.rank0.npz": store._crc32_file(str(tag / "state.rank0.npz"))}
+    with open(tag / "meta.json", "w") as f:
+        json.dump(meta, f)
+    ok, reason = store.verify_tag(str(tag))
+    assert not ok and "missing data file state.rank1.npz" in reason
+    with pytest.raises(ValueError, match="state.rank1.npz"):
+        store.load_checkpoint(str(tmp_path), "t1",
+                              {"w": np.zeros(16, np.float32)}, {"w": None})
+
+
+def test_legacy_checkpoint_without_checksums_verifies_by_existence(tmp_path):
+    """Checkpoints written before the durability contract carry no
+    checksums — they must keep loading (existence checks only)."""
+    _write_tag(tmp_path, "t1", 3.0, 1)
+    with open(tmp_path / "t1" / "meta.json") as f:
+        meta = json.load(f)
+    del meta["checksums"]
+    with open(tmp_path / "t1" / "meta.json", "w") as f:
+        json.dump(meta, f)
+    assert store.verify_tag(str(tmp_path / "t1")) == (True, "ok")
+    _, client, tag = store.load_checkpoint(
+        str(tmp_path), None, {"w": np.zeros(16, np.float32)}, {"w": None})
+    assert tag == "t1"
+
+
+def test_verify_env_hatch_skips_byte_scan(tmp_path, monkeypatch):
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    _flip_byte(tmp_path / "t1" / "state.npz")
+    monkeypatch.setenv("DSTPU_CKPT_VERIFY", "0")
+    assert store.verify_tag(str(tmp_path / "t1"))[0]  # existence only
+
+
+def test_retention_keeps_last_n_and_latest(tmp_path):
+    for i in range(1, 6):
+        _write_tag(tmp_path, f"t{i}", float(i), i)
+    removed = store.retire_old_tags(str(tmp_path), keep_last=2)
+    assert removed == ["t1", "t2", "t3"]
+    assert sorted(os.listdir(tmp_path)) == ["latest", "t4", "t5"]
+    # keep_last larger than the population: no-op
+    assert store.retire_old_tags(str(tmp_path), keep_last=10) == []
+    # disabled: no-op
+    assert store.retire_old_tags(str(tmp_path), keep_last=0) == []
+
+
+def test_retention_protects_the_tag_just_written(tmp_path):
+    """Engine retention passes protect=(tag,): a save_latest=False
+    milestone snapshot (not named by `latest`) must survive its own
+    save's retention pass."""
+    _write_tag(tmp_path, "t1", 1.0, 1)               # latest -> t1
+    _write_tag(tmp_path, "t2", 2.0, 2, save_latest=False)
+    removed = store.retire_old_tags(str(tmp_path), keep_last=1,
+                                    protect=("t2",))
+    assert "t2" not in removed
+    assert (tmp_path / "t2").exists()
+    assert (tmp_path / "latest").read_text() == "t1"
+
+
+def test_retention_never_removes_what_latest_names(tmp_path):
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    _write_tag(tmp_path, "t2", 2.0, 2)
+    # repoint latest BACK to t1 (e.g. a fallback happened)
+    store.write_latest(str(tmp_path), "t1")
+    removed = store.retire_old_tags(str(tmp_path), keep_last=1)
+    assert "t1" not in removed
+    assert (tmp_path / "t1").exists()
+
+
+def test_async_kill_before_commit_leaves_latest_on_previous_tag(tmp_path,
+                                                                monkeypatch):
+    """The satellite scenario: the async worker dies after the data write
+    but before the `latest` repoint. `latest` must still name the
+    previous tag and a load must get the previous state — no torn tag,
+    no silent re-init."""
+    _write_tag(tmp_path, "a", 1.0, 1)
+    eng = AsyncCheckpointEngine()
+
+    def write_b_then_die():
+        # data + meta of 'b' land...
+        _write_tag(tmp_path, "b", 2.0, 2, save_latest=False)
+        # ...but the process "dies" before the commit repoint
+        raise OSError("simulated kill before commit")
+
+    eng.submit("b", write_b_then_die)
+    assert eng.commit("b") is False  # failure surfaces
+    eng.close()
+    assert (tmp_path / "latest").read_text() == "a"
+    state, client, tag = store.load_checkpoint(
+        str(tmp_path), None, {"w": np.zeros(16, np.float32)}, {"w": None})
+    assert tag == "a" and client["global_steps"] == 1
+    np.testing.assert_array_equal(np.asarray(state["w"]),
+                                  np.full(16, 1.0, np.float32))
+
+
+def test_resolve_tag_fresh_when_nothing_committed(tmp_path):
+    assert store.resolve_tag(str(tmp_path), None) == (None, True)
+    assert store.resolve_tag(str(tmp_path), "nope") == (None, True)

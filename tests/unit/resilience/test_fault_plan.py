@@ -1,0 +1,173 @@
+"""FaultPlan unit coverage: determinism, serialization, scoping (site /
+step / rank / attempt / skip), the seam no-op contract, and the io_error
+x retry interaction with the checkpoint store's durable writes."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.resilience import (FaultEvent, FaultPlan, active_plan,
+                                      clear_plan, fault_point, install_plan,
+                                      maybe_install_from_env)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+def test_json_round_trip():
+    plan = FaultPlan([FaultEvent("crash", step=3, rank=0),
+                      FaultEvent("io_error", match="state*.npz", count=2,
+                                 skip=1)], seed=7)
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.seed == 7
+    assert back.events == plan.events
+
+
+def test_sample_is_deterministic():
+    a = FaultPlan.sample(seed=11, max_step=100, kinds=("crash", "stall"))
+    b = FaultPlan.sample(seed=11, max_step=100, kinds=("crash", "stall"))
+    assert a.to_json() == b.to_json()
+    c = FaultPlan.sample(seed=12, max_step=100, kinds=("crash", "stall"))
+    assert a.to_json() != c.to_json()
+
+
+def test_unknown_kind_rejected():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultEvent("meteor")
+
+
+def test_fault_point_no_plan_is_noop():
+    assert active_plan() is None
+    fault_point("step_end", step=1)  # must not raise
+    fault_point("ckpt_io", path="/x/state.npz")
+
+
+def test_io_error_fires_count_times_then_stops():
+    install_plan(FaultPlan([FaultEvent("io_error", count=2)]))
+    for _ in range(2):
+        with pytest.raises(OSError, match="injected"):
+            fault_point("ckpt_io", path="/d/state.npz")
+    fault_point("ckpt_io", path="/d/state.npz")  # budget spent
+
+
+def test_skip_lets_first_matches_pass():
+    install_plan(FaultPlan([FaultEvent("io_error", skip=2, count=1)]))
+    fault_point("ckpt_io", path="/d/state.npz")
+    fault_point("ckpt_io", path="/d/state.npz")
+    with pytest.raises(OSError):
+        fault_point("ckpt_io", path="/d/state.npz")
+
+
+def test_match_scopes_io_events():
+    install_plan(FaultPlan([FaultEvent("io_error", match="state.rank0.npz")]))
+    fault_point("ckpt_io", path="/d/meta.json")       # no match
+    fault_point("ckpt_io", path="/d/state.rank1.npz")  # no match
+    with pytest.raises(OSError):
+        fault_point("ckpt_io", path="/d/state.rank0.npz")
+
+
+def test_step_and_site_scoping():
+    install_plan(FaultPlan([FaultEvent("stall", step=3, delay_s=0.05)]))
+    t0 = time.monotonic()
+    fault_point("step_begin", step=2)   # wrong step
+    fault_point("step_end", step=3)     # wrong site (stall => step_begin)
+    assert time.monotonic() - t0 < 0.04
+    fault_point("step_begin", step=3)
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_rank_scoping(monkeypatch):
+    monkeypatch.setenv("JAX_PROCESS_ID", "1")
+    install_plan(FaultPlan([FaultEvent("io_error", rank=0)]))
+    fault_point("ckpt_io", path="/d/state.npz")  # we are rank 1
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    with pytest.raises(OSError):
+        fault_point("ckpt_io", path="/d/state.npz")
+
+
+def test_attempt_scoping(monkeypatch):
+    """An event bound to attempt 0 must NOT re-fire in the restarted
+    world (attempt 1) — the property that stops a crash-loop."""
+    install_plan(FaultPlan([FaultEvent("io_error", attempt=0)]))
+    monkeypatch.setenv("DSTPU_ELASTIC", json.dumps(
+        {"world_size": 2, "restart_count": 1}))
+    fault_point("ckpt_io", path="/d/state.npz")  # attempt 1: skip
+    monkeypatch.setenv("DSTPU_ELASTIC", json.dumps(
+        {"world_size": 2, "restart_count": 0}))
+    with pytest.raises(OSError):
+        fault_point("ckpt_io", path="/d/state.npz")
+
+
+def test_env_install_inline_and_file(monkeypatch, tmp_path):
+    plan = FaultPlan([FaultEvent("crash", step=9)])
+    monkeypatch.setenv("DSTPU_FAULT_PLAN", plan.to_json())
+    maybe_install_from_env()
+    assert active_plan() is not None
+    assert active_plan().events[0].step == 9
+    clear_plan()
+    p = tmp_path / "plan.json"
+    p.write_text(plan.to_json())
+    monkeypatch.setenv("DSTPU_FAULT_PLAN", f"@{p}")
+    maybe_install_from_env()
+    assert active_plan().events[0].kind == "crash"
+
+
+def test_env_install_absent_is_noop(monkeypatch):
+    monkeypatch.delenv("DSTPU_FAULT_PLAN", raising=False)
+    maybe_install_from_env()
+    assert active_plan() is None
+
+
+def test_crash_event_sigkills_process():
+    """The crash kind must die the way a preempted worker dies — SIGKILL,
+    no cleanup — so run it in a scratch process."""
+    code = (
+        "from deepspeed_tpu.resilience import FaultPlan, FaultEvent, "
+        "install_plan, fault_point\n"
+        "install_plan(FaultPlan([FaultEvent('crash', step=2)]))\n"
+        "fault_point('step_end', step=1)\n"
+        "fault_point('step_end', step=2)\n"
+        "print('UNREACHABLE')\n")
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_", "DSTPU_"))}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == -9, (r.returncode, r.stdout, r.stderr)
+    assert "UNREACHABLE" not in r.stdout
+
+
+def test_retry_rides_out_transient_io_errors(tmp_path, monkeypatch):
+    """count=2 injected IO errors < the store's 3 retries: the durable
+    write succeeds and the data is intact."""
+    monkeypatch.setenv("DSTPU_CKPT_BACKOFF_S", "0.001")
+    from deepspeed_tpu.checkpoint.store import _atomic_savez, _crc32_file
+    install_plan(FaultPlan([FaultEvent("io_error", count=2,
+                                       match="data.npz")]))
+    path = tmp_path / "data.npz"
+    crc = _atomic_savez(str(path), {"a": np.arange(8)})
+    assert path.exists()
+    assert _crc32_file(str(path)) == crc
+    with np.load(path) as z:
+        np.testing.assert_array_equal(z["a"], np.arange(8))
+
+
+def test_retry_budget_exhausts_loudly(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSTPU_CKPT_BACKOFF_S", "0.001")
+    from deepspeed_tpu.checkpoint.store import _atomic_savez
+    install_plan(FaultPlan([FaultEvent("io_error", count=10,
+                                       match="data.npz")]))
+    with pytest.raises(OSError, match="failed after"):
+        _atomic_savez(str(tmp_path / "data.npz"), {"a": np.arange(8)})
+    assert not (tmp_path / "data.npz").exists()

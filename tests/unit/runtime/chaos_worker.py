@@ -1,0 +1,97 @@
+"""Chaos worker (NOT a pytest module): the training script the resilience
+chaos tests run under ``DSElasticAgent``.
+
+World shape: this environment's jaxlib cannot run cross-process CPU
+collectives at all ("Multiprocess computations aren't implemented on the
+CPU backend" — pre-existing; tests/unit/runtime/test_multiprocess.py hits
+the same wall), so the 8-device CPU audit mesh is the repo's standard
+single-process virtual form (tests/conftest.py): rank 0 hosts
+``4 x world_size`` virtual devices and non-zero ranks exit immediately,
+donating their slot to rank 0's mesh. The agent machinery stays fully
+real — spawn, SIGKILL, reap, restart, shrink, DSTPU_ELASTIC threading —
+and a shrink from 2 slots to 1 genuinely halves the dp width (8 -> 4),
+which is the ZeRO re-bucket the resume path must survive.
+
+Trains a tiny ZeRO-2 gpt2 to ``total_steps`` with a checkpoint committed
+after every optimizer step and one loss logged per step through
+``resilience.chaos.log_step``. Resume comes for free: the agent threads
+``checkpoint_dir`` through ``DSTPU_ELASTIC`` and
+``deepspeed_tpu.initialize`` reloads the last committed tag, so this
+script has NO resume branch — the property under test is that a
+restarted world continues mid-trajectory without one. The global batch
+(8 sequences, seeded per optimizer step) is identical at every world
+size, so loss trajectories are comparable across dp widths.
+"""
+
+import json
+import os
+import sys
+
+if int(os.environ.get("JAX_PROCESS_ID", "0")) != 0:
+    sys.exit(0)  # slot donated to rank 0's virtual mesh (see docstring)
+
+_EL = json.loads(os.environ["DSTPU_ELASTIC"])
+_DEVICES = 4 * int(_EL["world_size"])
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={_DEVICES}").strip()
+os.environ["DSTPU_ACCELERATOR"] = "cpu"
+# single-process world: the coordinator rendezvous the agent exported
+# must not be joined (the donated ranks are gone)
+for _v in ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
+           "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+    os.environ.pop(_v, None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+
+import deepspeed_tpu  # noqa: E402
+from deepspeed_tpu.models import gpt2_model  # noqa: E402
+from deepspeed_tpu.resilience import chaos  # noqa: E402
+
+GLOBAL_BATCH = 8
+SEQ_LEN = 8
+
+
+def step_batch(step: int):
+    """The global batch of optimizer step ``step`` — a pure function of
+    the step index, so an uninterrupted run, a killed-and-resumed run,
+    and a shrunk-world resume all consume identical data."""
+    rng = np.random.default_rng(1000 + step)
+    return {"input_ids": rng.integers(0, 128, size=(GLOBAL_BATCH, SEQ_LEN))}
+
+
+def main(out_dir: str, total_steps: int = 4) -> int:
+    assert jax.device_count() == _DEVICES, jax.device_count()
+    assert GLOBAL_BATCH % _DEVICES == 0, (GLOBAL_BATCH, _DEVICES)
+
+    model = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128,
+                       remat=False)
+    # initialize() resumes from DSTPU_ELASTIC's checkpoint_dir last
+    # committed tag (fresh start when nothing committed yet)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": GLOBAL_BATCH // _DEVICES,
+        "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2},
+    }, seed=3)
+
+    while engine.global_steps < total_steps:
+        step = engine.global_steps + 1
+        loss = float(engine.train_batch(step_batch(step)))
+        assert np.isfinite(loss), (step, loss)
+        # an injected crash at step k dies inside train_batch (step_end
+        # seam) — before this step's loss is logged or its tag commits,
+        # so the resumed attempt replays it from tag k-1
+        chaos.log_step(out_dir, step, loss, rank=0,
+                       world=_EL.get("world_size"))
+        engine.save_checkpoint(_EL["checkpoint_dir"])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1],
+                  int(sys.argv[2]) if len(sys.argv) > 2 else 4))

@@ -1,0 +1,148 @@
+"""Chaos suite (ISSUE 12 acceptance): SIGKILL a worker at an arbitrary
+step on the 8-device CPU audit mesh, let the elastic agent restart the
+world — same size and shrunk by one slot — and assert the resumed loss
+trajectory matches an uninterrupted run within the repo's global-scale
+atol floor. Plus: an injected torn write leaves ``latest`` on the
+previous committed tag, which the resumed world loads.
+
+Runs the whole thing in subprocess trees (the agent spawns real worker
+processes), so the parent pytest process's 8-device backend is
+untouched. The mesh is the repo's standard single-process virtual form
+(this jaxlib cannot run cross-process CPU collectives — pre-existing,
+see chaos_worker.py): rank 0 hosts 4 x world_size virtual devices, so
+the agent's spawn/SIGKILL/reap/restart/shrink machinery is fully real
+and a 2 -> 1 shrink genuinely re-buckets ZeRO from dp=8 to dp=4. The
+uninterrupted reference trajectory is module-scoped — one extra world
+spin-up shared by every comparison.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.resilience import FaultEvent, FaultPlan
+from deepspeed_tpu.resilience.chaos import compare_trajectories, read_trajectory
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+WORKER = os.path.join(os.path.dirname(__file__), "chaos_worker.py")
+TOTAL_STEPS = 4
+CRASH_STEP = 2
+# loss sums re-order when the world reshapes (dp8 -> dp4 re-buckets every
+# ZeRO shard); the established global-scale floor absorbs that while
+# still catching a wrong-weights resume (losses differ at the 1e-1 scale)
+ATOL_FRAC = 1e-4
+
+AGENT_DRIVER = """
+import json, sys
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+spec = json.loads(sys.argv[1])
+agent = DSElasticAgent(
+    spec["script"], spec["args"], num_slots=spec["slots"],
+    max_restarts=spec["max_restarts"],
+    shrink_on_failure=spec["shrink"],
+    master_port=spec["port"], extra_env=spec["env"],
+    checkpoint_dir=spec["ckpt"], restart_backoff_s=0)
+rc = agent.run()
+print("WORLD_HISTORY", json.dumps(agent.world_history))
+sys.exit(rc)
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_agent(tmp_path, name, slots=2, shrink=False, plan=None,
+               max_restarts=2):
+    """Drive chaos_worker under a DSElasticAgent in a subprocess; returns
+    (world_history, rank-0 trajectory)."""
+    out = tmp_path / name
+    out.mkdir(parents=True, exist_ok=True)
+    env_clean = {k: v for k, v in os.environ.items()
+                 if not k.startswith(("JAX_", "XLA_", "DSTPU_"))}
+    env_clean["PYTHONPATH"] = REPO + os.pathsep + env_clean.get("PYTHONPATH", "")
+    worker_env = {}
+    if plan is not None:
+        worker_env["DSTPU_FAULT_PLAN"] = plan.to_json()
+    spec = {"script": WORKER, "args": [str(out), str(TOTAL_STEPS)],
+            "slots": slots, "max_restarts": max_restarts, "shrink": shrink,
+            "port": _free_port(), "env": worker_env,
+            "ckpt": str(out / "ckpt")}
+    r = subprocess.run(
+        [sys.executable, "-c", AGENT_DRIVER, json.dumps(spec)],
+        env=env_clean, capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert r.returncode == 0, \
+        f"stdout:\n{r.stdout[-3000:]}\nstderr:\n{r.stderr[-5000:]}"
+    history = json.loads(r.stdout.split("WORLD_HISTORY")[1].strip().split("\n")[0])
+    return history, read_trajectory(str(out), rank=0), out
+
+
+@pytest.fixture(scope="module")
+def reference(tmp_path_factory):
+    """Uninterrupted 8-device (2 slots x 4 virtual devices) run — the
+    parity baseline every chaos scenario compares against."""
+    tmp = tmp_path_factory.mktemp("chaos_ref")
+    history, traj, _ = _run_agent(tmp, "ref", slots=2, shrink=False)
+    assert history == [2]
+    assert sorted(traj) == list(range(1, TOTAL_STEPS + 1)), traj
+    return traj
+
+
+def _crash_plan():
+    return FaultPlan([FaultEvent("crash", step=CRASH_STEP, rank=0)])
+
+
+def test_chaos_kill_resume_same_world(tmp_path, reference):
+    """SIGKILL rank 0 at step 2; the agent restarts the SAME 2-slot world,
+    which resumes from tag global_step1 and replays steps 2..4. The full
+    merged trajectory (replayed step included) must match the
+    uninterrupted run."""
+    history, traj, out = _run_agent(tmp_path, "same", slots=2, shrink=False,
+                                    plan=_crash_plan())
+    assert history == [2, 2]
+    # the crash landed before step 2's tag committed: resume replayed it
+    report = compare_trajectories(reference, traj, atol_frac=ATOL_FRAC)
+    assert report["ok"], report
+    # the run actually checkpointed: last committed tag is the final step
+    latest = (out / "ckpt" / "latest").read_text()
+    assert latest == f"global_step{TOTAL_STEPS}"
+
+
+def test_chaos_kill_resume_shrunk_world(tmp_path, reference):
+    """Same kill, but shrink_on_failure drops 2 slots -> 1: the restarted
+    dp=4 world loads a checkpoint written at dp=8 (the store re-buckets
+    the ZeRO shards through _PieceReader span assembly) and continues the
+    SAME trajectory — elastic resume across a topology change."""
+    history, traj, out = _run_agent(tmp_path, "shrunk", slots=2, shrink=True,
+                                    plan=_crash_plan())
+    assert history == [2, 1]
+    report = compare_trajectories(reference, traj, atol_frac=ATOL_FRAC)
+    assert report["ok"], report
+    # the shrunk (dp=4) world kept committing to the same store
+    tagdir = out / "ckpt" / f"global_step{TOTAL_STEPS}"
+    assert (tagdir / "state.npz").exists()
+    assert (tagdir / "meta.json").exists()
+
+
+def test_chaos_torn_write_falls_back(tmp_path, reference):
+    """A kill between the temp write and the rename (the classic torn-
+    write window) at step 3's save: `latest` must still name step 2's
+    tag, and the restarted world resumes from it — replaying step 3 —
+    to the same trajectory."""
+    # skip=2: saves after steps 1 and 2 land; the write of step 3's data
+    # file is torn (temp truncated, process SIGKILLed before the rename)
+    plan = FaultPlan([FaultEvent("torn_write", match="state.npz",
+                                 rank=0, skip=2)])
+    history, traj, out = _run_agent(tmp_path, "torn", slots=2, shrink=False,
+                                    plan=plan)
+    assert history == [2, 2]
+    report = compare_trajectories(reference, traj, atol_frac=ATOL_FRAC)
+    assert report["ok"], report
+    assert (out / "ckpt" / "latest").read_text() == \
+        f"global_step{TOTAL_STEPS}"

@@ -42,7 +42,8 @@ def test_restart_after_failure(tmp_path):
             sys.exit(13)
     """)
     agent = DSElasticAgent(script, num_slots=2, max_restarts=2,
-                           shrink_on_failure=False, master_port=29610)
+                           shrink_on_failure=False, master_port=29610,
+                           restart_backoff_s=0)
     assert agent.run() == 0
     assert agent.restart_count == 1
     assert agent.world_history == [2, 2]
@@ -63,7 +64,8 @@ def test_shrink_on_failure_resolves_batch(tmp_path):
             sys.exit(7)
     """)
     agent = DSElasticAgent(script, ds_config=ELASTIC_CFG, num_slots=4,
-                           max_restarts=3, master_port=29640)
+                           max_restarts=3, master_port=29640,
+                           restart_backoff_s=0)
     assert agent.run() == 0
     assert agent.world_history[0] == 4
     assert agent.world_history[-1] < 4
@@ -73,7 +75,7 @@ def test_shrink_on_failure_resolves_batch(tmp_path):
 def test_restart_budget_exhausted(tmp_path):
     script = _write(tmp_path, "worker.py", "import sys; sys.exit(5)\n")
     agent = DSElasticAgent(script, num_slots=1, max_restarts=1,
-                           master_port=29670)
+                           master_port=29670, restart_backoff_s=0)
     assert agent.run() == 5
     assert agent.restart_count == 2  # initial + 1 allowed restart, both failed
 
@@ -119,3 +121,70 @@ def test_solve_world_elastic(tmp_path):
     assert w["world_size"] <= 8
     assert w["train_batch"] == w["micro_batch"] * w["world_size"] * w["gas"]
     assert w["train_batch"] <= 48
+
+
+def test_solve_world_micro_fallback(monkeypatch):
+    """ISSUE 12 satellite: when no micro_batch_sizes entry divides the
+    per-gpu batch, the solver used to die on a bare max()-of-empty
+    ValueError; it must fall back to micro=1 with a consistent config."""
+    from deepspeed_tpu.elasticity import elastic_agent as ea
+    monkeypatch.setattr(ea, "compute_elastic_config",
+                        lambda cfg: (21, [7]))  # per_gpu=3; sizes [2,4]
+    agent = DSElasticAgent("x.py", ds_config=ELASTIC_CFG, num_slots=7)
+    w = agent._solve_world(7)
+    assert w == {"world_size": 7, "micro_batch": 1,
+                 "train_batch": 21, "gas": 3}
+
+
+def test_spawn_dodges_occupied_port(tmp_path):
+    """A lingering listener on master_port must not burn a restart
+    credit: the agent probes forward to a free port."""
+    import socket
+
+    script = _write(tmp_path, "worker.py", """
+        import os
+        addr = os.environ["JAX_COORDINATOR_ADDRESS"]
+        open(os.environ["OUT_FILE"], "w").write(addr)
+    """)
+    with socket.socket() as blocker:
+        blocker.bind(("localhost", 0))
+        blocker.listen(1)
+        port = blocker.getsockname()[1]
+        out = tmp_path / "addr.txt"
+        agent = DSElasticAgent(script, num_slots=1, max_restarts=0,
+                               master_port=port, restart_backoff_s=0,
+                               extra_env={"OUT_FILE": str(out)})
+        assert agent.run() == 0
+        assert agent.restart_count == 0
+        used = int(out.read_text().rsplit(":", 1)[1])
+        assert used != port  # probed past the occupied one
+
+
+def test_checkpoint_dir_threaded_through_env(tmp_path):
+    """DSElasticAgent(checkpoint_dir=...) lands in DSTPU_ELASTIC — the
+    handle deepspeed_tpu.initialize resumes from."""
+    script = _write(tmp_path, "worker.py", """
+        import json, os
+        el = json.loads(os.environ["DSTPU_ELASTIC"])
+        open(os.environ["OUT_FILE"], "w").write(el["checkpoint_dir"])
+    """)
+    out = tmp_path / "ckpt_dir.txt"
+    agent = DSElasticAgent(script, num_slots=1, max_restarts=0,
+                           master_port=29720, restart_backoff_s=0,
+                           checkpoint_dir=str(tmp_path / "ckpt"),
+                           extra_env={"OUT_FILE": str(out)})
+    assert agent.run() == 0
+    assert out.read_text() == str(tmp_path / "ckpt")
+
+
+def test_restart_backoff_waits_between_attempts(tmp_path):
+    import time
+
+    script = _write(tmp_path, "worker.py", "import sys; sys.exit(3)\n")
+    agent = DSElasticAgent(script, num_slots=1, max_restarts=2,
+                           master_port=29740, restart_backoff_s=0.2,
+                           max_backoff_s=0.3)
+    t0 = time.monotonic()
+    assert agent.run() == 3
+    # two restarts: 0.2s + min(0.4, 0.3)s of backoff at minimum
+    assert time.monotonic() - t0 >= 0.5

@@ -111,6 +111,79 @@ def test_failing_dump_fn_does_not_break_the_dog():
         dog.stop()
 
 
+def test_escalation_fires_past_hard_deadline():
+    """escalate_after_s is a HARD deadline: once a baseline step exists,
+    a step open past it triggers on_escalate exactly once."""
+    escalations = []
+    dog = StallWatchdog(min_deadline_s=0.02, poll_s=0.01,
+                        escalate_after_s=0.06,
+                        on_escalate=lambda step, s: escalations.append(step))
+    try:
+        dog.step_begin(0)
+        dog.step_end(0, 0.001)
+        dog.step_begin(1)
+        assert _wait_for(lambda: escalations == [1])
+        time.sleep(0.1)  # still stalled: must not escalate twice
+        assert escalations == [1]
+        # the soft stall fired too (escalation implies way past deadline)
+        assert dog.stall_count == 1
+    finally:
+        dog.stop()
+
+
+def test_escalation_disabled_by_default():
+    escalations = []
+    dog = StallWatchdog(min_deadline_s=0.02, poll_s=0.01,
+                        on_escalate=lambda step, s: escalations.append(step))
+    try:
+        dog.step_begin(0)
+        dog.step_end(0, 0.001)
+        dog.step_begin(1)
+        assert _wait_for(lambda: dog.stall_count == 1)
+        time.sleep(0.05)
+        assert escalations == []  # escalate_after_s=0 → never
+    finally:
+        dog.stop()
+
+
+def test_escalation_needs_a_baseline_step():
+    """Same first-step rule as the soft deadline: the compile-carrying
+    first step must never be escalated on."""
+    escalations = []
+    dog = StallWatchdog(min_deadline_s=0.01, poll_s=0.01,
+                        escalate_after_s=0.02,
+                        on_escalate=lambda step, s: escalations.append(step))
+    try:
+        dog.step_begin(0)
+        time.sleep(0.1)
+        assert escalations == []
+    finally:
+        dog.stop()
+
+
+def test_telemetry_escalation_handler_and_trace(tmp_path):
+    """The facade records a stall_escalation instant and forwards to the
+    engine-installed handler (the checkpoint-and-exit path)."""
+    cfg = TelemetryConfig(
+        enabled=True, trace={"output_path": str(tmp_path)},
+        watchdog={"enabled": True, "min_deadline_s": 0.02,
+                  "poll_s": 0.01, "escalate_after_s": 0.06})
+    tele = Telemetry(config=cfg)
+    handled = []
+    tele.escalation_handler = lambda step, s: handled.append(step)
+    try:
+        tele.step_begin(0)
+        tele.step_end(0, tokens=1)
+        tele.step_begin(1)
+        with tele.phase("hold", phase="step", step=1):
+            assert _wait_for(lambda: handled == [1])
+        assert any(e["name"] == "stall_escalation"
+                   for e in tele.trace.events())
+        tele.step_end(1, tokens=1)
+    finally:
+        tele.watchdog.stop()
+
+
 def test_telemetry_stall_feeds_goodput_and_trace(tmp_path):
     cfg = TelemetryConfig(
         enabled=True,

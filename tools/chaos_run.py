@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""chaos_run: run a training script under the elastic agent with a
+deterministic fault plan, then prove resume parity.
+
+The executable form of the dstpu-resilience contract (docs/RESILIENCE.md):
+
+1. run the script once UNINTERRUPTED (no faults) — the reference loss
+   trajectory;
+2. run it again under ``DSElasticAgent`` with a fault plan installed via
+   ``DSTPU_FAULT_PLAN`` (default: SIGKILL rank 0 at ``--crash-step``) and
+   a checkpoint dir threaded through ``DSTPU_ELASTIC``;
+3. compare the merged chaos trajectory (crash, restart, resume, replay)
+   against the reference within the global-scale atol floor and emit a
+   JSON report.
+
+The script contract: log one loss per optimizer step with
+``deepspeed_tpu.resilience.chaos.log_step(out_dir, step, loss, rank=...)``
+where ``out_dir`` is the script's first argument, and checkpoint each
+step to ``DSTPU_ELASTIC``'s ``checkpoint_dir``
+(``tests/unit/runtime/chaos_worker.py`` is the canonical example).
+
+    python tools/chaos_run.py tests/unit/runtime/chaos_worker.py \
+        --slots 2 --crash-step 2 --steps 4 --shrink --out /tmp/chaos
+
+Exit code 0 iff the parity report says ok.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, REPO)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _run_world(script, script_args, out_dir, slots, shrink, max_restarts,
+               plan_json, extra_env):
+    """One supervised world in-process (the agent spawns the workers)."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+    env = dict(extra_env)
+    # spawned workers must find this repo regardless of the caller's cwd
+    env["PYTHONPATH"] = REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
+    if plan_json is not None:
+        env["DSTPU_FAULT_PLAN"] = plan_json
+    agent = DSElasticAgent(
+        script, [out_dir] + list(script_args),
+        num_slots=slots, max_restarts=max_restarts,
+        shrink_on_failure=shrink, master_port=_free_port(),
+        extra_env=env, checkpoint_dir=os.path.join(out_dir, "ckpt"),
+        restart_backoff_s=0.2)
+    rc = agent.run()
+    return rc, agent.world_history
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="kill-and-resume parity harness (docs/RESILIENCE.md)",
+        epilog="pass flags BEFORE the script; everything after the script "
+               "path is forwarded to it")
+    ap.add_argument("script", help="training script (chaos_worker contract)")
+    ap.add_argument("script_args", nargs=argparse.REMAINDER,
+                    help="extra args appended after the out dir")
+    ap.add_argument("--out", default="./chaos_out",
+                    help="report + trajectory directory")
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=4,
+                    help="total optimizer steps (passed as script arg 2)")
+    ap.add_argument("--crash-step", type=int, default=2,
+                    help="SIGKILL rank 0 at this step (ignored with --plan)")
+    ap.add_argument("--plan", default="",
+                    help="fault-plan JSON file overriding the default "
+                         "single-crash plan")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="with --random, seed for FaultPlan.sample")
+    ap.add_argument("--random", action="store_true",
+                    help="sample a random crash step in [1, steps-1] "
+                         "deterministically from --seed")
+    ap.add_argument("--shrink", action="store_true",
+                    help="shrink the world by one slot per restart")
+    ap.add_argument("--max-restarts", type=int, default=2)
+    ap.add_argument("--atol-frac", type=float, default=1e-4,
+                    help="global-scale atol floor fraction")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.resilience import FaultEvent, FaultPlan
+    from deepspeed_tpu.resilience.chaos import (compare_trajectories,
+                                                read_trajectory)
+
+    if args.plan:
+        with open(args.plan) as f:
+            plan = FaultPlan.from_json(f.read())
+    elif args.random:
+        plan = FaultPlan.sample(seed=args.seed,
+                                max_step=max(1, args.steps - 1))
+    else:
+        plan = FaultPlan([FaultEvent("crash", step=args.crash_step, rank=0)])
+
+    base_env = {}
+    script_args = [str(args.steps)] + args.script_args
+
+    ref_dir = os.path.join(args.out, "reference")
+    chaos_dir = os.path.join(args.out, "chaos")
+    os.makedirs(ref_dir, exist_ok=True)
+    os.makedirs(chaos_dir, exist_ok=True)
+
+    print(f"chaos_run: reference world ({args.slots} slots, "
+          f"{args.steps} steps)...")
+    rc, _ = _run_world(args.script, script_args, ref_dir, args.slots,
+                       False, 0, None, base_env)
+    if rc != 0:
+        print(f"chaos_run: reference run FAILED rc={rc}", file=sys.stderr)
+        return rc
+    reference = read_trajectory(ref_dir, rank=0)
+
+    print(f"chaos_run: chaos world (plan: {[e.kind for e in plan.events]}, "
+          f"shrink={args.shrink})...")
+    rc, history = _run_world(args.script, script_args, chaos_dir,
+                             args.slots, args.shrink, args.max_restarts,
+                             plan.to_json(), base_env)
+    if rc != 0:
+        print(f"chaos_run: chaos run did not recover rc={rc}",
+              file=sys.stderr)
+        return rc
+    chaos = read_trajectory(chaos_dir, rank=0)
+
+    report = compare_trajectories(reference, chaos,
+                                  atol_frac=args.atol_frac)
+    report["world_history"] = history
+    report["plan"] = json.loads(plan.to_json())
+    path = os.path.join(args.out, "chaos_report.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    verdict = "PARITY" if report["ok"] else "MISMATCH"
+    print(f"chaos_run: {verdict} — worlds {history}, "
+          f"{report['steps_compared']} steps compared, "
+          f"max|err| {report['max_abs_err']} vs atol {report['atol']:.3g} "
+          f"(report: {path})")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

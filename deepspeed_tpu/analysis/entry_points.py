@@ -668,6 +668,84 @@ def build_telemetry_off_parity() -> EntrySpec:
         expected_spmd=frozenset({"all-reduce", "all-gather", "all-to-all"}))
 
 
+def build_guardian_step_parity() -> EntrySpec:
+    """The guardian zero-overhead contract (ISSUE 13, docs/RESILIENCE.md):
+    a guardian-OFF engine's fused step jaxpr must be IDENTICAL to the
+    pre-guardian program — the sentinels exist only behind the
+    ``spike_thresh`` gate — and the guardian-ON step may add NOTHING
+    beyond the packed anomaly word riding the reductions the step
+    already computes. Three traces:
+
+    1. a pristine engine (guardian never configured) — the baseline;
+    2. a guardian-armed engine force-disarmed — must print the SAME
+       jaxpr as (1), else ``guardian-graph-drift`` fires;
+    3. the armed step (``_train_step_fn_guardian``) — the spec's fn, so
+       Layers B/C/D audit the SENTINEL path: collective axis binding,
+       donation, and a committed collective map that must stay
+       zero-delta against engine-train-step's (the anomaly word may not
+       launch new collectives; a tier-1 test diffs the two maps).
+
+    The threshold traces as an ABSTRACT f32 scalar — the rolling-stat
+    side stays on the host by construction (baking a concrete threshold
+    into the program would recompile every step the stats move)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .trace_harness import GUARDIAN_GRAPH_DRIFT, JaxprAuditor
+
+    lr = jnp.asarray(1e-3, jnp.float32)
+    # the pre-guardian baseline: an engine that never saw the config
+    base = _tiny_engine()
+    base_batch = _batch(base)
+    with base.mesh:
+        jaxpr_base = jax.make_jaxpr(base._train_step_fn)(
+            base.state, base_batch, lr)
+    # the guardian-armed engine, traced ON then force-disarmed for OFF
+    engine = _tiny_engine(config_extra={"guardian": {"enabled": True}})
+    assert engine._guardian is not None, \
+        "guardian config block did not arm the subsystem"
+    batch = _batch(engine)
+    thresh = jnp.asarray(float("inf"), jnp.float32)
+    with engine.mesh:
+        jaxpr_on = jax.make_jaxpr(engine._train_step_fn_guardian)(
+            engine.state, batch, lr, thresh)
+    guardian, engine._guardian = engine._guardian, None
+    with engine.mesh:
+        jaxpr_off = jax.make_jaxpr(engine._train_step_fn)(
+            engine.state, batch, lr)
+    engine._guardian = guardian
+    auditor = JaxprAuditor("guardian-step-parity")
+    auditor.walk(jaxpr_on.jaxpr)
+    extra = auditor.findings
+    if str(jaxpr_off) != str(jaxpr_base):
+        extra.append(Finding(
+            rule_id=GUARDIAN_GRAPH_DRIFT.rule_id,
+            path="<trace:guardian-step-parity>", line=0,
+            severity=SEVERITY_ERROR,
+            message="engine train-step jaxpr with the guardian disabled "
+                    "differs from the pre-guardian program",
+            fix_hint=GUARDIAN_GRAPH_DRIFT.fix_hint))
+    args = (engine.state, batch, lr, thresh)
+    return EntrySpec(
+        name="guardian-step-parity", fn=engine._train_step_fn_guardian,
+        args=args, donate_argnums=(0,), mesh=engine.mesh,
+        retrace_args=[args, args], extra_findings=extra,
+        jit_kwargs=_guardian_step_jit_kwargs(engine),
+        expected_spmd=frozenset({"all-reduce", "all-gather", "all-to-all"}))
+
+
+def _guardian_step_jit_kwargs(engine) -> Dict[str, Any]:
+    """The guardian-armed fused jit's production arguments
+    (engine._build_fused_jit, guardian branch): +1 replicated scalar in,
+    the anomaly word out."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    shardings = engine._state_shardings()
+    rep = NamedSharding(engine.mesh, P())
+    return dict(in_shardings=(shardings, None, None, None),
+                out_shardings=(shardings, rep, rep, rep, rep))
+
+
 SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
     "engine-train-step": build_engine_step,
     "zero-gather-partition": build_zero_gather_partition,
@@ -682,6 +760,7 @@ SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
     "ragged-paged-attention": build_ragged_paged_attention,
     "fused-optimizer-step": build_fused_optimizer_step,
     "telemetry-off-parity": build_telemetry_off_parity,
+    "guardian-step-parity": build_guardian_step_parity,
 }
 
 

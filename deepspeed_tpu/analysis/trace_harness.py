@@ -95,6 +95,18 @@ TELEMETRY_GRAPH_DRIFT = register(Rule(
     fix_hint="move the instrumentation outside the jit boundary; spans wrap "
              "dispatches, they never enter traced code"))
 
+GUARDIAN_GRAPH_DRIFT = register(Rule(
+    rule_id="guardian-graph-drift", layer=LAYER_JAXPR,
+    severity=SEVERITY_ERROR,
+    description="A guardian-OFF engine's step jaxpr differs from the "
+                "pre-guardian program — the zero-overhead contract "
+                "(docs/RESILIENCE.md): with the guardian disabled the "
+                "sentinels must leave no trace in the step; armed, the "
+                "anomaly word may only ride reductions the step already "
+                "computes",
+    fix_hint="keep the sentinel pack behind the spike_thresh=None gate in "
+             "_apply_from_grads; policy/rollback logic stays host-side"))
+
 # primitives that call back into Python from inside the compiled program
 _CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
                    "callback"}

@@ -190,6 +190,58 @@ def write_latest(save_dir: str, tag: str) -> None:
     _atomic_text(os.path.join(save_dir, "latest"), tag)
 
 
+# ---------------------------------------------------------------------------
+# last-known-good pinning (dstpu-guardian, docs/RESILIENCE.md)
+# ---------------------------------------------------------------------------
+#: sibling of ``latest``: the newest tag the numerics guardian has
+#: declared clean (committed only after a verified-clean window). The
+#: rollback target — retention never retires it, and the corrupt-
+#: ``latest`` fallback prefers it over "newest verified".
+KNOWN_GOOD_FILE = "known_good"
+
+
+def pin_known_good(save_dir: str, tag: str) -> None:
+    """Atomically pin ``tag`` as the last-known-good checkpoint. The
+    guardian calls this only after ``clean_window_for_pin`` consecutive
+    clean steps — a tag written during an anomaly streak never becomes
+    the rollback target."""
+    _atomic_text(os.path.join(save_dir, KNOWN_GOOD_FILE), tag)
+
+
+def read_known_good(save_dir: str) -> Optional[str]:
+    """The pinned tag, or ``None`` when nothing was ever pinned (or the
+    pin file is unreadable — a torn pin must not fail a load)."""
+    path = os.path.join(save_dir, KNOWN_GOOD_FILE)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            tag = f.read().strip()
+    except OSError:
+        return None
+    return tag or None
+
+
+def rollback_to_known_good(save_dir: str) -> Optional[str]:
+    """Repoint ``latest`` at the pinned known-good tag so the next resume
+    (elastic restart or in-process reload) loads it. Returns the tag, or
+    ``None`` when no pin exists or the pinned bytes no longer verify —
+    the caller then falls back to plain ``latest`` resolution (which
+    itself refuses to silently re-initialize)."""
+    tag = read_known_good(save_dir)
+    if tag is None:
+        return None
+    ok, reason = verify_tag(os.path.join(save_dir, tag))
+    if not ok:
+        logger.error(f"guardian rollback: pinned tag '{tag}' fails "
+                     f"verification ({reason}); leaving `latest` alone")
+        return None
+    write_latest(save_dir, tag)
+    logger.warning(f"guardian rollback: `latest` repointed to pinned "
+                   f"known-good tag '{tag}'")
+    return tag
+
+
 def write_staged(save_dir: str, tag: str, keys, host: Dict[str, np.ndarray],
                  client_state: Dict[str, Any], save_latest: bool = True) -> None:
     """Write an already-staged (host-resident) single-process checkpoint:
@@ -359,9 +411,10 @@ def find_fallback_tag(load_dir: str, exclude: str) -> Optional[str]:
 def retire_old_tags(save_dir: str, keep_last: int,
                     protect: Tuple[str, ...] = ()) -> List[str]:
     """Keep-last-N retention: delete the oldest committed tags beyond
-    ``keep_last``, never touching the tag ``latest`` names (nor anything
-    in ``protect``). Returns the removed tag names. ``keep_last <= 0``
-    disables retention."""
+    ``keep_last``, never touching the tag ``latest`` names, the pinned
+    known-good tag (the guardian's rollback target must outlive any
+    retention window), nor anything in ``protect``. Returns the removed
+    tag names. ``keep_last <= 0`` disables retention."""
     if keep_last <= 0:
         return []
     keep = set(protect)
@@ -372,6 +425,9 @@ def retire_old_tags(save_dir: str, keep_last: int,
                 keep.add(f.read().strip())
         except OSError:
             pass
+    pinned = read_known_good(save_dir)
+    if pinned is not None:
+        keep.add(pinned)
     tags = [t for _, _, t in _committed_tags(save_dir)]
     removable = [t for t in tags if t not in keep]
     # the protected tags count toward the retention budget
@@ -396,7 +452,10 @@ def resolve_tag(load_dir: str, tag: Optional[str]) -> Tuple[Optional[str], bool]
     where ``fresh=True`` means "no checkpoint exists — initialize from
     scratch". An *explicit* tag that fails verification raises (the
     caller asked for those bytes); a corrupt tag named by ``latest``
-    falls back to the newest verifying tag, and raises — never silently
+    falls back to the pinned known-good tag when one exists and
+    verifies (the guardian vouched for those bytes — a newer tag that
+    merely *verifies* may hold a numerically-poisoned state), else to
+    the newest verifying tag, and raises — never silently
     re-initializes — when there is none."""
     explicit = tag is not None
     if tag is None:
@@ -416,6 +475,14 @@ def resolve_tag(load_dir: str, tag: Optional[str]) -> Tuple[Optional[str], bool]
             return None, True
         raise ValueError(
             f"checkpoint tag '{tag}' failed verification: {reason}")
+    pinned = read_known_good(load_dir)
+    if pinned is not None and pinned != tag and \
+            verify_tag(os.path.join(load_dir, pinned))[0]:
+        logger.error(
+            f"checkpoint 'latest' names tag '{tag}' which failed "
+            f"verification ({reason}); falling back to the PINNED "
+            f"known-good tag '{pinned}' (preferred over newest verified)")
+        return pinned, False
     fb = find_fallback_tag(load_dir, exclude=tag)
     if fb is not None:
         logger.error(

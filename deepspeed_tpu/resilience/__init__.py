@@ -13,10 +13,19 @@ Pieces:
   the *proof* machinery.
 - :mod:`chaos` — resume-parity comparison used by ``tools/chaos_run.py``
   and the tier-1 chaos smoke.
+- :mod:`guardian` — the NUMERICS half (ISSUE 13): in-graph anomaly-word
+  sentinels, the deterministic detect → skip → rollback policy, the
+  last-known-good pin, and the SDC replay probe (docs/RESILIENCE.md).
 """
 
 from .chaos import compare_trajectories, read_trajectory  # noqa: F401
-from .fault_plan import (CRASH_EXIT_CODE, STALL_EXIT_CODE, FaultEvent,  # noqa: F401
+from .fault_plan import (CRASH_EXIT_CODE, GUARDIAN_EXIT_CODE,  # noqa: F401
+                         STALL_EXIT_CODE, FaultEvent,
                          FaultPlan, active_plan, clear_plan, fault_descriptor,
                          fault_point, install_plan, maybe_install_from_env,
                          parse_elastic_env)
+from .guardian import (ANOMALY_GNORM_SPIKE, ANOMALY_GRAD_NONFINITE,  # noqa: F401
+                       ANOMALY_GRAD_ZERO, ANOMALY_LOSS_NONFINITE,
+                       ANOMALY_LOSS_SPIKE, ANOMALY_SDC_REPLAY,
+                       GuardianConfig, GuardianPolicy, GuardianVerdict,
+                       build_guardian, decode_anomaly, pack_anomaly_word)

@@ -28,6 +28,23 @@ Event kinds:
 - ``torn_write`` — truncate the temp file to half its bytes and die before
   the ``os.replace`` commit (a kill mid-save; the atomic-rename protocol
   must leave ``latest`` on the previous tag).
+- ``grad_bitflip`` — XOR bit ``bit`` of element ``index`` of param leaf
+  ``leaf`` at the ``numerics`` seam (host-side, before the step's
+  dispatch): the silent-data-corruption case — a flipped exponent bit in
+  HBM weights — that the guardian's sentinels must catch (the gradients
+  computed from the corrupted weights spike or go non-finite).
+- ``loss_spike`` — multiply param leaf ``leaf`` by ``factor`` at the same
+  seam: a finite but violent divergence (the loss blows up without any
+  non-finite value), exercising the gnorm/loss spike sentinel rather
+  than the overflow bit.
+
+The ``numerics`` seam passes a *mutator* callback (the engine's
+``_inject_numerics_fault``) instead of a path — the plan stays host-side
+and engine-agnostic; only the engine knows how to flip a bit in a sharded
+device array. Both kinds are attempt-scoped like ``crash``: a corruption
+injected into attempt 0 does not re-fire after the guardian's rollback
+restarts the world, which is what lets the chaos harness assert the
+rolled-back trajectory matches an uninterrupted run.
 
 Zero overhead when off — the same contract as telemetry: with no plan
 installed, :func:`fault_point` is one global ``None`` check, and nothing
@@ -61,8 +78,14 @@ STALL_EXIT_CODE = 97
 #: exit code of an injected crash when SIGKILL is unavailable.
 CRASH_EXIT_CODE = 137
 
-_SITES = ("step_begin", "step_end", "ckpt_io", "ckpt_tmp")
-_KINDS = ("crash", "stall", "io_error", "torn_write")
+#: exit code of a guardian-initiated rollback (resilience/guardian.py):
+#: distinct from stalls and crashes so the elastic agent's logs attribute
+#: the restart to a NUMERICS event, not a process failure.
+GUARDIAN_EXIT_CODE = 96
+
+_SITES = ("step_begin", "step_end", "ckpt_io", "ckpt_tmp", "numerics")
+_KINDS = ("crash", "stall", "io_error", "torn_write",
+          "grad_bitflip", "loss_spike")
 
 
 @dataclass
@@ -83,6 +106,19 @@ class FaultEvent:
     skip: int = 0
     delay_s: float = 0.0
     exit_code: int = CRASH_EXIT_CODE
+    # numerics-kind knobs (grad_bitflip / loss_spike): which param leaf —
+    # ``leaf_match`` is an fnmatch glob over the flattened path key
+    # (e.g. ``wte*`` targets the embedding, whose corruption reaches the
+    # logits un-normalized; a flip inside a pre-LN block is silently
+    # absorbed by the next LayerNorm — the textbook silent corruption),
+    # else ``leaf`` indexes flatten order (-1 = largest leaf, or the
+    # whole tree for loss_spike); which flat element; which bit (30 =
+    # fp32 high exponent bit — small weights become huge); multiplier
+    leaf: int = 0
+    leaf_match: str = ""
+    index: int = 0
+    bit: int = 30
+    factor: float = 1024.0
     fired: int = field(default=0, compare=False)
     seen: int = field(default=0, compare=False)
 
@@ -94,7 +130,9 @@ class FaultEvent:
     @property
     def site(self) -> str:
         return {"crash": "step_end", "stall": "step_begin",
-                "io_error": "ckpt_io", "torn_write": "ckpt_tmp"}[self.kind]
+                "io_error": "ckpt_io", "torn_write": "ckpt_tmp",
+                "grad_bitflip": "numerics",
+                "loss_spike": "numerics"}[self.kind]
 
 
 class FaultPlan:
@@ -147,7 +185,8 @@ class FaultPlan:
 
     # -- firing ----------------------------------------------------------
     def fire(self, site: str, step: Optional[int] = None,
-             path: Optional[str] = None, tmp: Optional[str] = None) -> None:
+             path: Optional[str] = None, tmp: Optional[str] = None,
+             payload=None) -> None:
         attempt, rank = _current_attempt_rank()
         for e in self.events:
             if e.site != site or e.attempt != attempt or \
@@ -164,9 +203,11 @@ class FaultPlan:
             if e.seen <= e.skip or e.fired >= e.count:
                 continue
             e.fired += 1
-            self._execute(e, site, step=step, path=path, tmp=tmp)
+            self._execute(e, site, step=step, path=path, tmp=tmp,
+                          payload=payload)
 
-    def _execute(self, e: FaultEvent, site: str, step, path, tmp) -> None:
+    def _execute(self, e: FaultEvent, site: str, step, path, tmp,
+                 payload=None) -> None:
         where = f"site={site} step={step} path={path}"
         if e.kind == "crash":
             logger.error(f"fault-injection: CRASH ({where})")
@@ -185,6 +226,15 @@ class FaultPlan:
                 with open(tmp, "r+b") as f:
                     f.truncate(max(1, size // 2))
             _die(e.exit_code)
+        elif e.kind in ("grad_bitflip", "loss_spike"):
+            logger.error(f"fault-injection: {e.kind.upper()} "
+                         f"leaf={e.leaf} ({where})")
+            if payload is None:
+                logger.warning(
+                    f"numerics fault {e.kind} fired at a seam without a "
+                    "mutator payload — nothing corrupted")
+            else:
+                payload(e)
 
 
 def _die(exit_code: int) -> None:
@@ -264,11 +314,14 @@ def maybe_install_from_env() -> None:
 
 
 def fault_point(site: str, step: Optional[int] = None,
-                path: Optional[str] = None, tmp: Optional[str] = None) -> None:
+                path: Optional[str] = None, tmp: Optional[str] = None,
+                payload=None) -> None:
     """The seam call. One ``None`` check when no plan is installed —
-    host-side code only; never reachable from traced functions."""
+    host-side code only; never reachable from traced functions.
+    ``payload`` is the numerics-seam mutator callback (engine-provided);
+    every other seam ignores it."""
     if _PLAN is not None:
-        _PLAN.fire(site, step=step, path=path, tmp=tmp)
+        _PLAN.fire(site, step=step, path=path, tmp=tmp, payload=payload)
 
 
 def fault_descriptor() -> Dict[str, Any]:

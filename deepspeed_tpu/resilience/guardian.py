@@ -1,0 +1,512 @@
+"""dstpu-guardian: in-graph numerics sentinels + host-side escalation.
+
+PR 12 made *process* failure a first-class input; this module does the
+same for *numerical* failure — the loss spikes, gradient blowups and
+silent data corruption that no crash handler sees. Reference DeepSpeed's
+counterpart is the dynamic loss scaler's overflow skip-step
+(``runtime/fp16/loss_scaler.py``); the guardian generalizes that binary
+check into a detect → skip → rollback ladder:
+
+**In-graph sentinels** (:func:`pack_anomaly_word`): the step program's
+existing overflow scalar extends into a packed int32 *anomaly word* —
+non-finite loss, non-finite grads, all-zero grads, and a gradient-norm
+spike against a threshold fed in as a HOST scalar (the rolling-stat
+side stays on the host; the traced side is one compare). Every bit is
+derived from reductions the step already computes (``has_overflow``,
+the global grad norm), so the guardian-ON program launches **zero new
+collectives** and the guardian-OFF program is **jaxpr-identical** to the
+pre-guardian step — machine-checked by the ``guardian-step-parity`` lint
+entry (the ``telemetry-off-parity`` mold).
+
+**Host-side policy** (:class:`GuardianPolicy`): consumes the anomaly
+word plus rolling loss/gnorm reservoirs and escalates deterministically
+(same observations → same verdicts):
+
+1. *skip* — the non-finite case keeps the existing in-graph overflow
+   skip (and the fp16 loss-scale backoff, now with the
+   ``consecutive_hysteresis`` + ``min_loss_scale`` floor); the
+   ``skip_on_anomaly`` knob extends the skip to every anomaly bit
+   (host-side on the offload boundary; opt-in on the traced paths —
+   see its docstring for the GSPMD coupling it buys into).
+2. *rollback* — ``max_anomalies_in_window`` anomalies inside a sliding
+   step window roll the run back to the last-known-good checkpoint tag
+   (``checkpoint/store.py`` ``known_good`` pin, committed only after a
+   verified-clean window and never retired by ``keep_last_n``). Under an
+   elastic agent the engine repoints ``latest`` at the pin and exits
+   with :data:`~.fault_plan.GUARDIAN_EXIT_CODE` — rollback *is* a
+   resumed attempt (the PR 12 restart path). Without an agent the
+   engine reloads the pin in-process and continues.
+3. *skip-ahead* — a step that rolls back **twice** (the replayed attempt
+   is anomalous again, so the anomaly is data-deterministic, not
+   transient corruption) is marked *poisoned* in the persisted ledger;
+   the data pipeline consults :meth:`GuardianPolicy.should_skip_data`
+   to route past the offending span instead of looping forever.
+
+**SDC defense**: ``FaultPlan`` gained ``grad_bitflip`` / ``loss_spike``
+events (host-seam param corruption, attempt-scoped), and a periodic
+deterministic *replay probe* (engine ``_maybe_replay_probe``) re-runs
+one recent step from its saved inputs and compares the outputs bitwise
+— XLA is deterministic on fixed inputs, so ANY drift is silent data
+corruption, reported as :data:`ANOMALY_SDC_REPLAY` and escalated like
+any other anomaly rather than left to poison the run.
+
+Env gate ``DSTPU_GUARDIAN``: ``1``/``0`` force the subsystem on/off over
+the engine config block ``guardian``; a JSON object value supplies the
+full config (the ``DSTPU_ELASTIC`` convention). Zero overhead when off:
+a disabled engine holds no policy object and traces the exact
+pre-guardian step functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import statistics
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+from ..utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# anomaly word layout (docs/RESILIENCE.md)
+# ---------------------------------------------------------------------------
+#: the step's loss is not finite (in-graph on the fused path, host-side
+#: from the cached loss on the split/offload paths — the OR is idempotent)
+ANOMALY_LOSS_NONFINITE = 1 << 0
+#: a gradient leaf is not finite — the classic fp16 overflow bit
+ANOMALY_GRAD_NONFINITE = 1 << 1
+#: the raw (pre-unscale) gradient norm is exactly zero: a dead backward
+#: (or SDC in the grads) while the loss is live
+ANOMALY_GRAD_ZERO = 1 << 2
+#: unscaled gnorm exceeded the host-fed rolling spike threshold
+ANOMALY_GNORM_SPIKE = 1 << 3
+#: deterministic replay probe mismatch (host-side only): silent data
+#: corruption — same program + same inputs produced different bits
+ANOMALY_SDC_REPLAY = 1 << 4
+#: host-side loss spike against the rolling loss reservoir (the in-graph
+#: word carries gnorm spikes; loss magnitude is judged on the host where
+#: the reservoir lives)
+ANOMALY_LOSS_SPIKE = 1 << 5
+
+ANOMALY_NAMES: Tuple[Tuple[int, str], ...] = (
+    (ANOMALY_LOSS_NONFINITE, "loss_nonfinite"),
+    (ANOMALY_GRAD_NONFINITE, "grad_nonfinite"),
+    (ANOMALY_GRAD_ZERO, "grad_zero"),
+    (ANOMALY_GNORM_SPIKE, "gnorm_spike"),
+    (ANOMALY_SDC_REPLAY, "sdc_replay"),
+    (ANOMALY_LOSS_SPIKE, "loss_spike"),
+)
+
+
+def decode_anomaly(word: int) -> Tuple[str, ...]:
+    """Human-readable bit names of an anomaly word (telemetry/ledger)."""
+    return tuple(name for bit, name in ANOMALY_NAMES if word & bit)
+
+
+def pack_anomaly_word(*, overflow, raw_norm, gnorm, spike_thresh, loss=None):
+    """TRACED: fold the sentinels into one int32 word. Every operand is a
+    scalar the step already computed (the overflow flag, the grad-norm
+    reduction) or a host-fed input (``spike_thresh``; ``jnp.inf``
+    disables the spike bit during warmup) — no new reductions, no new
+    collectives ride this. The grad-nonfinite bit ALSO derives from the
+    norm reduction itself: with fp16 off (the bf16 TPU default) the
+    engine pins ``overflow=False`` and never runs ``has_overflow``, but
+    a NaN/inf gradient still poisons the sum-of-squares — without this
+    fold, SDC in a bf16 run would score as a clean step."""
+    import jax.numpy as jnp
+
+    nonfinite = jnp.logical_or(overflow,
+                               jnp.logical_not(jnp.isfinite(raw_norm)))
+    word = jnp.where(nonfinite, ANOMALY_GRAD_NONFINITE, 0).astype(jnp.int32)
+    word = word | jnp.where(raw_norm == 0.0, ANOMALY_GRAD_ZERO, 0)
+    word = word | jnp.where(gnorm > spike_thresh, ANOMALY_GNORM_SPIKE, 0)
+    if loss is not None:
+        word = word | jnp.where(jnp.logical_not(jnp.isfinite(loss)),
+                                ANOMALY_LOSS_NONFINITE, 0)
+    return word
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+class GuardianConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    #: rolling reservoir length for the loss/gnorm stats
+    window: int = 32
+    #: clean observations required before the spike thresholds arm —
+    #: until then the traced threshold input is +inf (bit never fires)
+    warmup_steps: int = 2
+    #: gnorm spike threshold = spike_factor * rolling clean-gnorm median
+    spike_factor: float = 8.0
+    #: host-side loss spike threshold = loss_spike_factor * rolling median
+    loss_spike_factor: float = 8.0
+    #: skip the optimizer update on ANY anomaly bit (the fp16 overflow
+    #: skip generalized). Default OFF on the traced paths: blending the
+    #: pre/post-update state couples every param to the global gnorm
+    #: reduction, which makes GSPMD re-partition the step (measured: the
+    #: grad all-reduces re-decompose and activation-shaped gathers
+    #: appear) — violating the zero-delta collective contract. The
+    #: overflow cond predates those decisions; rollback undoes what a
+    #: skip would have prevented. The host-side offload boundary honors
+    #: this at zero cost either way.
+    skip_on_anomaly: bool = False
+    #: sliding window (in optimizer steps) for escalation counting
+    anomaly_window: int = 8
+    #: anomalies inside the window before the policy escalates to rollback
+    max_anomalies_in_window: int = 2
+    #: consecutive clean steps before a freshly-committed tag may be
+    #: pinned as last-known-good (the rollback target)
+    clean_window_for_pin: int = 1
+    #: every N fused steps, re-run one step from saved inputs and compare
+    #: bitwise (0 = off) — the SDC replay probe
+    replay_probe_interval: int = 0
+    #: escalate to checkpoint rollback at all (False = detect/skip only)
+    rollback: bool = True
+    #: after an in-process rollback, ignore the first N post-resume
+    #: observations. Default 0: the cleared anomaly window already
+    #: prevents stale re-triggering, and a REPLAYED data-deterministic
+    #: anomaly must be observed for the rollback-twice → poisoned-span
+    #: ladder to ever fire. Setting N>0 trades that ladder's latency for
+    #: damping (each cooldown defers the second rollback by N steps).
+    cooldown_steps: int = 0
+
+
+def resolve_guardian_config(config: Optional[GuardianConfig]
+                            ) -> Optional[GuardianConfig]:
+    """Config block + ``DSTPU_GUARDIAN`` env override (both ways, the
+    ``DSTPU_TELEMETRY`` convention; a JSON-object value supplies the full
+    config). Returns the effective config, or ``None`` when disabled."""
+    env = os.environ.get("DSTPU_GUARDIAN", "").strip()
+    if env:
+        low = env.lower()
+        if low in ("0", "off", "false"):
+            return None
+        if low in ("1", "on", "true"):
+            base = config.model_dump() if config is not None else {}
+            base["enabled"] = True
+            return GuardianConfig(**base)
+        doc = json.loads(env)
+        if not isinstance(doc, dict):
+            raise ValueError("DSTPU_GUARDIAN must be 0/1 or a JSON object")
+        doc.setdefault("enabled", True)
+        return GuardianConfig(**doc)
+    if config is not None and config.enabled:
+        return config
+    return None
+
+
+# ---------------------------------------------------------------------------
+# verdicts + policy
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class GuardianVerdict:
+    step: int
+    word: int
+    kinds: Tuple[str, ...]
+    #: "ok" | "anomaly" (tolerated/skipped) | "rollback"
+    action: str
+    detail: str = ""
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"step": self.step, "word": self.word,
+                "kinds": list(self.kinds), "action": self.action,
+                "detail": self.detail}
+
+
+LEDGER_FILE = "guardian.json"
+
+
+class GuardianLedger:
+    """The persisted half of the policy: rollback history and poisoned
+    steps, written atomically next to the checkpoints so a restarted
+    attempt (rollback IS a restart) knows what already happened. A step
+    that appears in ``rollback_steps`` twice is data-deterministic —
+    mark it poisoned so the data pipeline can skip ahead."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self.directory = directory
+        self.rollbacks: List[Dict[str, Any]] = []
+        self.poisoned_steps: List[int] = []
+        self.pinned_tag: Optional[str] = None
+        self.pinned_step: Optional[int] = None
+        # the rolling clean-stat reservoirs persist too: a restarted
+        # attempt (rollback IS a restart) must inherit the healthy-regime
+        # thresholds, or every resume re-opens a warmup window the next
+        # anomaly sails through
+        self.stats: Dict[str, List[float]] = {"losses": [], "gnorms": []}
+        if directory is not None:
+            self._load()
+
+    def _path(self) -> Optional[str]:
+        if self.directory is None:
+            return None
+        return os.path.join(self.directory, LEDGER_FILE)
+
+    def _load(self) -> None:
+        path = self._path()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (ValueError, OSError) as e:
+            logger.warning(f"guardian ledger unreadable ({e}); starting "
+                           "a fresh one")
+            return
+        self.rollbacks = list(doc.get("rollbacks", []))
+        self.poisoned_steps = [int(s) for s in doc.get("poisoned_steps", [])]
+        self.pinned_tag = doc.get("pinned_tag")
+        self.pinned_step = doc.get("pinned_step")
+        stats = doc.get("stats") or {}
+        self.stats = {"losses": [float(x) for x in stats.get("losses", [])],
+                      "gnorms": [float(x) for x in stats.get("gnorms", [])]}
+
+    def save(self) -> None:
+        path = self._path()
+        if path is None:
+            return
+        # deliberately NOT store._atomic_write: the ledger is a tiny
+        # advisory file — plain tmp+rename atomicity suffices, and the
+        # store's write path runs the ckpt_io/ckpt_tmp fault seams, which
+        # a chaos plan with match='*' would then fire from inside
+        # _post_step instead of on a checkpoint file
+        try:
+            os.makedirs(self.directory, exist_ok=True)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({
+                    "rollbacks": self.rollbacks,
+                    "poisoned_steps": sorted(set(self.poisoned_steps)),
+                    "pinned_tag": self.pinned_tag,
+                    "pinned_step": self.pinned_step,
+                    "stats": self.stats,
+                }, f, indent=2)
+            os.replace(tmp, path)
+        except OSError as e:  # ledger IO must never fail the run
+            logger.warning(f"guardian ledger write failed: {e}")
+
+    def note_pinned(self, tag: str, step: int) -> None:
+        self.pinned_tag, self.pinned_step = tag, int(step)
+        self.save()
+
+    def note_rollback(self, step: int, verdict: GuardianVerdict,
+                      tag: Optional[str]) -> None:
+        prior = sum(1 for r in self.rollbacks if r.get("step") == step)
+        self.rollbacks.append({"step": int(step), "tag": tag,
+                               "word": verdict.word,
+                               "kinds": list(verdict.kinds)})
+        if prior >= 1 and step not in self.poisoned_steps:
+            # second rollback for the SAME step: the replayed attempt hit
+            # the anomaly again — data-deterministic, skip ahead
+            self.poisoned_steps.append(int(step))
+            logger.error(f"guardian: step {step} rolled back twice — "
+                         "marking its data span poisoned (skip-ahead)")
+        self.save()
+
+
+class GuardianPolicy:
+    """Deterministic host-side escalation: same observation sequence →
+    same verdicts. Rolling stats feed the spike thresholds; only CLEAN
+    steps feed the stats (an anomaly must not poison its own yardstick).
+    The policy is engine-agnostic — the engine owns the jits, the
+    checkpoint dirs and the exit; the policy owns the decisions."""
+
+    def __init__(self, config: GuardianConfig,
+                 telemetry=None, ledger_dir: Optional[str] = None,
+                 scaler_owns_overflow: bool = False):
+        self.config = config
+        self.telemetry = telemetry  # None or the engine's facade
+        #: True when fp16 DYNAMIC loss scaling is active: overflow-only
+        #: anomalies are then the scaler's routine calibration (skip +
+        #: backoff walk the scale down from 2^initial_scale_power) and
+        #: must not feed the rollback window — a healthy fp16 startup
+        #: would otherwise escalate before any checkpoint exists. With
+        #: the scaler off (bf16/fp32), grad-nonfinite IS the divergence
+        #: signal and escalates like any other bit.
+        self.scaler_owns_overflow = scaler_owns_overflow
+        self.ledger = GuardianLedger(ledger_dir)
+        self._gnorms: deque = deque(self.ledger.stats["gnorms"],
+                                    maxlen=max(2, config.window))
+        self._losses: deque = deque(self.ledger.stats["losses"],
+                                    maxlen=max(2, config.window))
+        self._anomaly_steps: deque = deque()
+        self.consecutive_clean = 0
+        self.anomaly_steps_total = 0
+        self.rollbacks = 0
+        self._cooldown_until = -1
+        self.verdicts: deque = deque(maxlen=256)
+
+    # -- traced-side input ----------------------------------------------
+    def spike_threshold(self) -> float:
+        """The host scalar the jitted step consumes: +inf (bit disarmed)
+        until ``warmup_steps`` clean observations exist, then
+        ``spike_factor`` x the rolling clean-gnorm median."""
+        if len(self._gnorms) < max(1, self.config.warmup_steps):
+            return math.inf
+        return self.config.spike_factor * max(_median(self._gnorms), 1e-12)
+
+    def _loss_threshold(self) -> float:
+        if len(self._losses) < max(1, self.config.warmup_steps):
+            return math.inf
+        return self.config.loss_spike_factor * max(_median(self._losses),
+                                                   1e-12)
+
+    # -- observation ------------------------------------------------------
+    def observe(self, step: int, loss: Optional[float], gnorm: float,
+                word: int) -> GuardianVerdict:
+        """One optimizer step's verdict. ``word`` is the traced anomaly
+        word (0 when the engine path computes none in-graph); host-only
+        bits (loss non-finite on split paths, loss spike, SDC) fold in
+        here."""
+        word = int(word)
+        if loss is not None:
+            if not math.isfinite(loss):
+                word |= ANOMALY_LOSS_NONFINITE
+            elif abs(loss) > self._loss_threshold():
+                word |= ANOMALY_LOSS_SPIKE
+        if step <= self._cooldown_until:
+            verdict = GuardianVerdict(step, word, decode_anomaly(word),
+                                      "ok", detail="cooldown")
+            self.verdicts.append(verdict)
+            return verdict
+        if word == 0:
+            self.consecutive_clean += 1
+            if loss is not None and math.isfinite(loss):
+                self._losses.append(abs(float(loss)))
+            if math.isfinite(gnorm) and gnorm > 0.0:
+                self._gnorms.append(float(gnorm))
+            verdict = GuardianVerdict(step, 0, (), "ok")
+        else:
+            self.consecutive_clean = 0
+            self.anomaly_steps_total += 1
+            # an overflow-ONLY word under active fp16 dynamic scaling is
+            # the loss scaler's routine calibration (it already skipped
+            # the update and backed the scale off) — log it, keep it out
+            # of the rollback window
+            scaler_routine = (self.scaler_owns_overflow
+                              and word == ANOMALY_GRAD_NONFINITE)
+            if not scaler_routine:
+                self._anomaly_steps.append(step)
+            floor = step - max(1, self.config.anomaly_window)
+            while self._anomaly_steps and self._anomaly_steps[0] <= floor:
+                self._anomaly_steps.popleft()
+            escalate = (self.config.rollback and
+                        len(self._anomaly_steps)
+                        >= max(1, self.config.max_anomalies_in_window))
+            kinds = decode_anomaly(word)
+            verdict = GuardianVerdict(
+                step, word, kinds,
+                "rollback" if escalate else "anomaly",
+                detail="scaler-owned overflow" if scaler_routine
+                else f"{len(self._anomaly_steps)} anomalies in window")
+            logger.warning(
+                f"guardian: step {step} anomaly {kinds} "
+                f"({verdict.detail}) -> {verdict.action}")
+            if self.telemetry is not None:
+                self.telemetry.record_anomaly(step, word, kinds)
+        self.verdicts.append(verdict)
+        return verdict
+
+    # -- pin / rollback bookkeeping ---------------------------------------
+    def pin_ready(self) -> bool:
+        """May the tag being committed right now become the rollback
+        target? Only after a verified-clean window."""
+        return self.consecutive_clean >= max(1, self.config.clean_window_for_pin)
+
+    def bind_ledger_dir(self, directory: str) -> None:
+        """Late-bind the ledger next to the checkpoints: agentless runs
+        have no DSTPU_ELASTIC checkpoint dir at build time — the first
+        save (or rollback) tells the guardian where history lives."""
+        if self.ledger.directory is None:
+            self.ledger.directory = directory
+
+    def stats_snapshot(self) -> Dict[str, List[float]]:
+        """A copy of the clean-stat reservoirs, taken on the TRAINING
+        thread — the async-save worker must not iterate live deques the
+        next observe() is appending to."""
+        return {"losses": list(self._losses), "gnorms": list(self._gnorms)}
+
+    def note_pinned(self, tag: str, step: int,
+                    stats: Optional[Dict[str, List[float]]] = None) -> None:
+        # the clean-stat reservoirs persist at PIN cadence (checkpoint
+        # cadence, not step cadence — one tiny write per save): a
+        # restarted attempt resumes with warm spike thresholds, or the
+        # very anomaly that caused the rollback sails through its replay
+        self.ledger.stats = stats if stats is not None \
+            else self.stats_snapshot()
+        self.ledger.note_pinned(tag, step)
+
+    def note_rollback(self, step: int, verdict: GuardianVerdict,
+                      tag: Optional[str]) -> None:
+        self.rollbacks += 1
+        self.ledger.stats = self.stats_snapshot()
+        self.ledger.note_rollback(step, verdict, tag)
+        if self.telemetry is not None:
+            self.telemetry.record_rollback(step, tag)
+
+    def reset_after_rollback(self, resumed_step: int) -> None:
+        """In-process rollback epilogue: the anomaly window describes a
+        trajectory that no longer exists — drop it, and ignore
+        observations for ``cooldown_steps`` so the replayed step cannot
+        re-trigger off stale bookkeeping. The clean-stat reservoirs
+        SURVIVE: they hold only healthy observations, which stay valid
+        for the replayed span — clearing them would re-open a warmup
+        window the next anomaly sails through (the same reason the
+        ledger persists them across restarts)."""
+        self._anomaly_steps.clear()
+        self.consecutive_clean = 0
+        self._cooldown_until = resumed_step + max(0, self.config.cooldown_steps)
+
+    def should_skip_data(self, step: int) -> bool:
+        """Data pipeline hook: True when ``step``'s span is marked
+        poisoned (rolled back twice — the anomaly is in the data, not in
+        transient corruption). The caller substitutes/advances its
+        source for that step."""
+        return step in self.ledger.poisoned_steps
+
+    def descriptor(self) -> Dict[str, Any]:
+        """Debug/report summary (tools/chaos_run.py --numerics)."""
+        return {
+            "anomaly_steps_total": self.anomaly_steps_total,
+            "rollbacks": self.rollbacks,
+            "consecutive_clean": self.consecutive_clean,
+            "spike_threshold": self.spike_threshold(),
+            "poisoned_steps": sorted(set(self.ledger.poisoned_steps)),
+            "pinned_tag": self.ledger.pinned_tag,
+            "verdicts": [v.to_json() for v in self.verdicts],
+        }
+
+
+def _median(values) -> float:
+    return float(statistics.median(values)) if values else 0.0
+
+
+def build_guardian(config: Optional[GuardianConfig], telemetry=None,
+                   ledger_dir: Optional[str] = None,
+                   scaler_owns_overflow: bool = False
+                   ) -> Optional[GuardianPolicy]:
+    """Engine front door: ``None`` when disabled (config block +
+    ``DSTPU_GUARDIAN`` env), else a live policy. The ledger dir defaults
+    to the elastic checkpoint dir when an agent supervises the run, so a
+    rollback-restarted attempt reads its own history;
+    ``scaler_owns_overflow`` is True when fp16 dynamic loss scaling is
+    active (see :class:`GuardianPolicy`)."""
+    effective = resolve_guardian_config(config)
+    if effective is None:
+        return None
+    if ledger_dir is None:
+        from .fault_plan import parse_elastic_env
+        ledger_dir = parse_elastic_env().get("checkpoint_dir") or None
+    policy = GuardianPolicy(effective, telemetry=telemetry,
+                            ledger_dir=ledger_dir,
+                            scaler_owns_overflow=scaler_owns_overflow)
+    logger.info(
+        f"dstpu-guardian armed: spike_factor={effective.spike_factor}, "
+        f"window={effective.anomaly_window}, "
+        f"max_anomalies={effective.max_anomalies_in_window}, "
+        f"rollback={'on' if effective.rollback else 'off'}, "
+        f"replay_probe={effective.replay_probe_interval or 'off'}")
+    return policy

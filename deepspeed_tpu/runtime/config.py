@@ -207,6 +207,11 @@ class DeepSpeedConfig:
         # DSTPU_TELEMETRY env var overrides either way at build time
         from ..telemetry.config import TelemetryConfig
         self.telemetry_config = TelemetryConfig(**pd.get("telemetry", {}))
+        # numerics guardian (resilience/guardian.py, docs/RESILIENCE.md):
+        # off by default; DSTPU_GUARDIAN overrides either way at build
+        # time (a JSON-object env value supplies the full config)
+        from ..resilience.guardian import GuardianConfig
+        self.guardian_config = GuardianConfig(**pd.get("guardian", {}))
         self.activation_checkpointing_config = ActivationCheckpointingConfig(
             **pd.get("activation_checkpointing", {}))
         self.flops_profiler_config = FlopsProfilerConfig(**pd.get("flops_profiler", {}))

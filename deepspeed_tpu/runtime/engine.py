@@ -40,8 +40,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..resilience.fault_plan import (STALL_EXIT_CODE, fault_point,
-                                     maybe_install_from_env)
+from ..resilience.fault_plan import (GUARDIAN_EXIT_CODE, STALL_EXIT_CODE,
+                                     fault_point, maybe_install_from_env,
+                                     parse_elastic_env)
+from ..resilience.guardian import build_guardian, pack_anomaly_word
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER,
                            NoopTimer, SynchronizedWallClockTimer, ThroughputTimer)
@@ -403,6 +405,22 @@ class DeepSpeedEngine:
         self.telemetry = self._build_telemetry()
         self._step_tokens = 0       # host-counted tokens of the open step
 
+        # -- numerics guardian (resilience/guardian.py, ISSUE 13): None
+        #    when off — the step functions then trace the exact
+        #    pre-guardian program (machine-checked by the
+        #    guardian-step-parity lint entry). When armed, the traced
+        #    step packs the anomaly word beside the overflow scalar and
+        #    the host policy escalates deterministically. --------------
+        self._guardian = build_guardian(
+            config.guardian_config, telemetry=self.telemetry,
+            # fp16 DYNAMIC scaling: overflow-only anomalies are the
+            # scaler's routine calibration (skip + backoff), not a
+            # rollback signal — see GuardianPolicy.scaler_owns_overflow
+            scaler_owns_overflow=(config.fp16.enabled
+                                  and config.fp16.loss_scale == 0))
+        #: outputs of the last guardian-armed step (host bookkeeping)
+        self._last_anomaly_word = 0
+
         # -- resilience: a DSTPU_FAULT_PLAN env installs the deterministic
         #    chaos schedule (resilience/fault_plan.py) — host-side seams
         #    only, one None-check per step when absent -------------------
@@ -555,6 +573,10 @@ class DeepSpeedEngine:
             self._build_fused_jit()
             args = (self.state, self._last_prepared_batch,
                     jax.ShapeDtypeStruct((), jnp.float32))
+            if self._guardian is not None:
+                # the guardian-armed fused jit takes the spike threshold
+                # as a 4th (host-scalar) argument
+                args = args + (jax.ShapeDtypeStruct((), jnp.float32),)
             abstract = jax.tree.map(
                 lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), args)
             cost = self._jit_train_step.lower(
@@ -987,11 +1009,29 @@ class DeepSpeedEngine:
         """Optimizer boundary: unscale, clip, update, recast, scale bookkeeping."""
         return self._apply_from_grads(state, state["grad_acc"], lr)
 
-    def _apply_from_grads(self, state, grads, lr):
+    def _apply_step_fn_guardian(self, state, lr, spike_thresh):
+        """The guardian-armed apply boundary (split + pipelined ZeRO micro
+        paths): same program plus the packed anomaly word as a 4th
+        output. The loss bit folds in host-side (the split apply never
+        sees the loss in-graph)."""
+        return self._apply_from_grads(state, state["grad_acc"], lr,
+                                      spike_thresh=spike_thresh)
+
+    def _apply_from_grads(self, state, grads, lr, spike_thresh=None,
+                          loss=None):
         """The apply boundary with the gradient source explicit: the split
         path passes the persistent ``grad_acc`` buffer; the fused gas==1
         path passes the backward's output directly — those gradients are
-        program-internal temporaries, so no persistent buffer exists."""
+        program-internal temporaries, so no persistent buffer exists.
+
+        ``spike_thresh`` arms the guardian sentinels: the anomaly word
+        packs from scalars this body already computes (overflow flag,
+        raw/unscaled grad norms) plus the host-fed threshold — zero new
+        reductions/collectives — and returns as an extra output; the
+        in-graph skip generalizes from the fp16 overflow to any anomaly
+        bit (``skip_on_anomaly``). ``spike_thresh=None`` (guardian off)
+        traces the exact pre-guardian program — the
+        ``guardian-step-parity`` lint entry machine-checks that."""
         scale = state["loss_scale"]["cur_scale"]
         overflow = has_overflow(grads) if self.config.fp16.enabled else jnp.asarray(False)
 
@@ -1028,12 +1068,30 @@ class DeepSpeedEngine:
 
         new_params, new_opt = jax.lax.cond(overflow, skip_update, do_update, None)
 
+        if spike_thresh is not None:
+            word = pack_anomaly_word(overflow=overflow, raw_norm=raw_norm,
+                                     gnorm=gnorm, spike_thresh=spike_thresh,
+                                     loss=loss)
+            if self._guardian.config.skip_on_anomaly:
+                # the anomaly skip beyond overflow is an ELEMENTWISE
+                # select against the pre-update state — NOT a widened
+                # cond predicate: the overflow cond keeps its exact
+                # pre-guardian provenance, so GSPMD partitions the
+                # program identically (the committed guardian map must
+                # stay zero-delta vs engine-train-step; a predicate
+                # change measurably re-decomposed the grad reductions)
+                extra_skip = (word != 0) & jnp.logical_not(overflow)
+                keep = lambda new, old: jnp.where(extra_skip, old, new)
+                new_params = jax.tree.map(keep, new_params, state["params"])
+                new_opt = jax.tree.map(keep, new_opt, state["opt"])
+
         fp16c = self.config.fp16
         new_scale_state = update_scale(
             state["loss_scale"], overflow,
             scale_window=fp16c.loss_scale_window,
             min_scale=fp16c.min_loss_scale,
-            hysteresis=fp16c.hysteresis)
+            hysteresis=fp16c.hysteresis,
+            consecutive_hysteresis=fp16c.consecutive_hysteresis)
 
         new_state = {
             "params": new_params,
@@ -1041,9 +1099,11 @@ class DeepSpeedEngine:
             "opt": new_opt,
             "loss_scale": new_scale_state,
         }
+        if spike_thresh is not None:
+            return new_state, overflow, gnorm, word
         return new_state, overflow, gnorm
 
-    def _train_step_fn(self, state, batch, lr):
+    def _train_step_fn(self, state, batch, lr, spike_thresh=None):
         """Fused micro + apply: ONE XLA program per optimizer step when
         gradient_accumulation_steps == 1. The gradients flow straight from
         the backward into the optimizer update without a grad_acc
@@ -1056,13 +1116,22 @@ class DeepSpeedEngine:
         temporaries and no persistent gradient buffer occupies HBM at all —
         2.2 GiB back at 1.1B params, the margin that lifts the full-depth
         TinyLlama bench from micro 8 to 12 on one chip. (The split
-        forward/backward path lazily allocates the buffer on first use.)"""
+        forward/backward path lazily allocates the buffer on first use.)
+
+        ``spike_thresh`` arms the guardian sentinels (the
+        ``_apply_from_grads`` convention): the loss is in-graph here, so
+        its non-finite bit packs in the same program, and the anomaly
+        word returns as a 5th output. ONE body serves both modes —
+        guardian-off and the armed program cannot drift apart."""
+        guardian = spike_thresh is not None
         if jax.tree.leaves(state["grad_acc"]):
             # a live buffer exists (split path was used on this engine):
             # keep accumulate-then-zero semantics
             state, loss = self._micro_step_fn(state, batch)
-            state, overflow, gnorm = self._apply_step_fn(state, lr)
-            return state, loss, overflow, gnorm
+            res = self._apply_from_grads(
+                state, state["grad_acc"], lr, spike_thresh=spike_thresh,
+                loss=loss if guardian else None)
+            return (res[0], loss) + res[1:]
         scale = state["loss_scale"]["cur_scale"]
 
         def scaled_loss(params):
@@ -1071,8 +1140,16 @@ class DeepSpeedEngine:
 
         grads, loss = jax.grad(scaled_loss, has_aux=True)(state["params"])
         grads = jax.tree.map(lambda g: g.astype(self.grad_dtype), grads)
-        state, overflow, gnorm = self._apply_from_grads(state, grads, lr)
-        return state, loss, overflow, gnorm
+        res = self._apply_from_grads(state, grads, lr,
+                                     spike_thresh=spike_thresh,
+                                     loss=loss if guardian else None)
+        return (res[0], loss) + res[1:]
+
+    def _train_step_fn_guardian(self, state, batch, lr, spike_thresh):
+        """The guardian-armed fused step: ``_train_step_fn`` with the
+        threshold REQUIRED — a distinct callable so the jit cache, the
+        lint entry and stack traces name the armed program explicitly."""
+        return self._train_step_fn(state, batch, lr, spike_thresh)
 
     # ------------------------------------------------------------------
     # 1-bit step functions: explicit shard_map over the data axis so each
@@ -1163,7 +1240,8 @@ class DeepSpeedEngine:
             new_scale = update_scale(state["loss_scale"], overflow,
                                      scale_window=fp16c.loss_scale_window,
                                      min_scale=fp16c.min_loss_scale,
-                                     hysteresis=fp16c.hysteresis)
+                                     hysteresis=fp16c.hysteresis,
+                                     consecutive_hysteresis=fp16c.consecutive_hysteresis)
             return ({"params": new_params, "grad_acc": new_gacc,
                      "opt": new_opt, "loss_scale": new_scale}, overflow, gnorm)
 
@@ -1799,10 +1877,7 @@ class DeepSpeedEngine:
                         in_shardings=(shardings["grad_acc"], rep, None, None),
                         out_shardings=(shardings["grad_acc"], rep))
             if self._jit_apply_step is None:
-                self._jit_apply_step = jax.jit(
-                    self._apply_step_fn, donate_argnums=(0,),
-                    in_shardings=(shardings, rep),
-                    out_shardings=(shardings, rep, rep))
+                self._jit_apply_step = self._make_apply_jit(shardings, rep)
             return
         if self._jit_micro_step is None:
             # batch in_shardings None: inherit _device_batch placement (data
@@ -1821,12 +1896,24 @@ class DeepSpeedEngine:
                 out_shardings=(micro_out, rep),
             )
         if self._jit_apply_step is None:
-            self._jit_apply_step = jax.jit(
-                self._apply_step_fn,
-                donate_argnums=(0,),
-                in_shardings=(shardings, rep),
-                out_shardings=(shardings, rep, rep),
-            )
+            self._jit_apply_step = self._make_apply_jit(shardings, rep)
+
+    def _make_apply_jit(self, shardings, rep):
+        """The split/pipelined-micro apply-step jit — guardian-armed when
+        the policy is live (extra replicated spike-threshold input, the
+        anomaly word as a 4th output), the exact pre-guardian program
+        otherwise. One builder so both _build_jits branches agree."""
+        if self._guardian is not None:
+            return jax.jit(
+                self._apply_step_fn_guardian, donate_argnums=(0,),
+                in_shardings=(shardings, rep, None),
+                out_shardings=(shardings, rep, rep, rep))
+        return jax.jit(
+            self._apply_step_fn,
+            donate_argnums=(0,),
+            in_shardings=(shardings, rep),
+            out_shardings=(shardings, rep, rep),
+        )
 
     def _fused_step_eligible(self) -> bool:
         """The fused one-program step covers the common jitted path; the
@@ -1845,6 +1932,16 @@ class DeepSpeedEngine:
             self._cached_shardings = self._state_shardings()
         shardings = self._cached_shardings
         rep = NamedSharding(self.mesh, P())
+        if self._guardian is not None:
+            # guardian-armed program: +1 replicated host-scalar input
+            # (spike threshold) and the anomaly word as a 5th output
+            self._jit_train_step = jax.jit(
+                self._train_step_fn_guardian,
+                donate_argnums=(0,),
+                in_shardings=(shardings, None, None, None),
+                out_shardings=(shardings, rep, rep, rep, rep),
+            )
+            return
         self._jit_train_step = jax.jit(
             self._train_step_fn,
             donate_argnums=(0,),
@@ -1896,16 +1993,32 @@ class DeepSpeedEngine:
         # (host side) so the watchdog sees exactly what a wedged dispatch
         # looks like; `step` is the step this dispatch will complete
         fault_point("step_begin", step=self.global_steps + 1)
+        # SDC-injection seam (grad_bitflip / loss_spike): host-side param
+        # corruption BEFORE the dispatch — what a flipped HBM bit looks
+        # like to the step the sentinels watch
+        fault_point("numerics", step=self.global_steps + 1,
+                    payload=self._inject_numerics_fault)
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = jnp.asarray(self.lr_scheduler.get_lr(), jnp.float32)
+        anomaly = None
         with self.telemetry.phase("fused_dispatch", phase="step",
                                   step=self.global_steps):
             with self.mesh:
-                self.state, loss, overflow, gnorm = self._jit_train_step(
-                    self.state, batch, lr)
+                if self._guardian is not None:
+                    thresh = jnp.asarray(self._guardian.spike_threshold(),
+                                         jnp.float32)
+                    probe_in = self._stage_replay_inputs(batch, lr, thresh)
+                    self.state, loss, overflow, gnorm, anomaly = \
+                        self._jit_train_step(self.state, batch, lr, thresh)
+                    if probe_in is not None:
+                        anomaly = self._run_replay_probe(
+                            probe_in, (loss, gnorm, anomaly))
+                else:
+                    self.state, loss, overflow, gnorm = self._jit_train_step(
+                        self.state, batch, lr)
         self._cached_loss = loss
         self.micro_steps += 1
-        self._post_step(overflow, gnorm)
+        self._post_step(overflow, gnorm, anomaly=anomaly, loss=loss)
         return loss
 
     # ------------------------------------------------------------------
@@ -2012,6 +2125,8 @@ class DeepSpeedEngine:
         batch = self._prepare_batch(batch)
         self.telemetry.step_begin(self.global_steps)
         fault_point("step_begin", step=self.global_steps + 1)
+        fault_point("numerics", step=self.global_steps + 1,
+                    payload=self._inject_numerics_fault)
         self.timers(FORWARD_GLOBAL_TIMER).start()
         with self.telemetry.phase("micro_dispatch", phase="fwd",
                                   step=self.global_steps):
@@ -2060,19 +2175,36 @@ class DeepSpeedEngine:
         self._build_jits()
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = jnp.asarray(self.lr_scheduler.get_lr(), jnp.float32)
+        anomaly = None
         with self.telemetry.phase("apply_step", phase="optimizer",
                                   step=self.global_steps):
             if self._offload is not None:
                 overflow, gnorm = self._apply_step_offload(float(lr))
+                if self._guardian is not None:
+                    # the offload boundary already resolved everything on
+                    # the host — the word is pure host arithmetic there
+                    anomaly = self._last_anomaly_word
             else:
                 with self.mesh:
-                    self.state, overflow, gnorm = self._jit_apply_step(
-                        self.state, lr)
-        self._post_step(overflow, gnorm)
+                    if self._guardian is not None and \
+                            self._onebit_opt is None:
+                        thresh = jnp.asarray(
+                            self._guardian.spike_threshold(), jnp.float32)
+                        self.state, overflow, gnorm, anomaly = \
+                            self._jit_apply_step(self.state, lr, thresh)
+                    else:
+                        self.state, overflow, gnorm = self._jit_apply_step(
+                            self.state, lr)
+        self._post_step(overflow, gnorm, anomaly=anomaly)
 
-    def _post_step(self, overflow, gnorm) -> None:
+    def _post_step(self, overflow, gnorm, anomaly=None, loss=None) -> None:
         """Host-side bookkeeping after the optimizer update (shared by the
-        split and fused step paths)."""
+        split and fused step paths). ``anomaly`` is the traced anomaly
+        word when the guardian armed this path (None otherwise); the
+        guardian's verdict — observe, maybe roll back — runs at the end,
+        after the step's accounting is consistent."""
+        word = int(anomaly) if anomaly is not None else 0
+        self._last_anomaly_word = word
         self.global_steps += 1
         if self.quantizer is not None:
             # MUST run before _refresh_secondary: quantize() donates the
@@ -2096,11 +2228,19 @@ class DeepSpeedEngine:
                     self.state["params"], bool(overflow), eigenvalues)
         if self._explicit_micro:
             self._refresh_secondary()
+        guardian_skip = (word != 0 and self._guardian is not None
+                         and self._guardian.config.skip_on_anomaly)
         if self.config.fp16.enabled and bool(overflow):
             # skipped update does not consume schedule (reference engine.py:2053)
             self.skipped_steps += 1
             log_dist(f"step {self.global_steps}: fp16 overflow, skipping update "
                      f"(new scale {float(self.state['loss_scale']['cur_scale'])})", ranks=[0])
+        elif guardian_skip:
+            # the in-graph anomaly skip generalizes the overflow skip:
+            # the update did not apply, so the schedule is not consumed
+            self.skipped_steps += 1
+            log_dist(f"step {self.global_steps}: guardian anomaly "
+                     f"(word={word}), update skipped", ranks=[0])
         else:
             self.lr_scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
@@ -2118,6 +2258,19 @@ class DeepSpeedEngine:
             self.monitor.write_events([
                 ("Train/lr", self.lr_scheduler.get_lr(), self.global_steps),
             ])
+        if self._guardian is not None:
+            # the guardian verdict: loss/gnorm are tiny scalars the caller
+            # fetches anyway; the policy ladder is pure host arithmetic
+            lossf = None
+            src = loss if loss is not None else self._cached_loss
+            if src is not None:
+                lossf = float(src)
+            gn = float(gnorm)
+            self.telemetry.record_numerics(self.global_steps, lossf, gn)
+            verdict = self._guardian.observe(self.global_steps, lossf, gn,
+                                             word)
+            if verdict.action == "rollback":
+                self._guardian_rollback(verdict)
         # chaos seam: a crash injected "at step k" kills the process HERE,
         # after step k's bookkeeping and before any checkpoint the caller
         # would write for it — the preemption the elastic agent recovers
@@ -2239,7 +2392,27 @@ class DeepSpeedEngine:
         mult = inv
         if self.gradient_clipping > 0:
             mult = inv * min(1.0, self.gradient_clipping / (gnorm + 1e-6))
-        if not overflow:
+        skip = overflow
+        if self._guardian is not None:
+            # the offload boundary resolves every scalar on the host
+            # already — the anomaly word here is plain Python arithmetic
+            # over the same fetched stats (zero extra device work)
+            from ..resilience.guardian import (ANOMALY_GNORM_SPIKE,
+                                               ANOMALY_GRAD_NONFINITE,
+                                               ANOMALY_GRAD_ZERO)
+            # like pack_anomaly_word: non-finiteness also derives from
+            # the norm itself, so bf16/fp32 runs (overflow pinned False)
+            # still catch NaN/inf grads
+            word = (ANOMALY_GRAD_NONFINITE
+                    if (overflow or not np.isfinite(sq)) else 0)
+            if sq == 0.0:
+                word |= ANOMALY_GRAD_ZERO
+            if gnorm > self._guardian.spike_threshold():
+                word |= ANOMALY_GNORM_SPIKE
+            self._last_anomaly_word = word
+            if word and self._guardian.config.skip_on_anomaly:
+                skip = True
+        if not skip:
             dev_params = {}
             if dev_idx:
                 # Twin-Flow device partition: dispatch the jitted optimizer
@@ -2399,7 +2572,8 @@ class DeepSpeedEngine:
                 new_scale = update_scale(scale_state, ovf,
                                          scale_window=fp16c.loss_scale_window,
                                          min_scale=fp16c.min_loss_scale,
-                                         hysteresis=fp16c.hysteresis)
+                                         hysteresis=fp16c.hysteresis,
+                                         consecutive_hysteresis=fp16c.consecutive_hysteresis)
                 return new_acc, new_scale
 
             self._jit_offload_epilogue = jax.jit(
@@ -2776,6 +2950,18 @@ class DeepSpeedEngine:
                 sidecar = (self._offload_sidecar_arrays()
                            if self._offload is not None else None)
 
+            # guardian pin decision AND its inputs are captured
+            # SYNCHRONOUSLY: the clean window, the step number and the
+            # stat snapshot all describe the state being staged right
+            # now — the worker thread must neither read a counter the
+            # training thread has advanced nor iterate deques the next
+            # observe() is appending to
+            pin_clean = (save_latest and self._guardian is not None
+                         and self._guardian.pin_ready())
+            pin_step = self.global_steps
+            pin_stats = (self._guardian.stats_snapshot()
+                         if pin_clean else None)
+
             def _write():
                 # sidecar FIRST: meta.json (inside write_staged) is the
                 # commit record — a tag whose meta verifies must have
@@ -2790,6 +2976,9 @@ class DeepSpeedEngine:
                              save_latest=False)
                 if save_latest:
                     write_latest(save_dir, tag)
+                if pin_clean:
+                    self._pin_known_good(save_dir, tag, step=pin_step,
+                                         stats=pin_stats)
                 self._retire_old_checkpoints(save_dir, tag)
 
             self.checkpoint_engine.submit(tag, _write)
@@ -2814,8 +3003,25 @@ class DeepSpeedEngine:
             _save(save_dir, tag, self.state, client_state,
                   save_latest=save_latest)
             if jax.process_index() == 0:
+                if save_latest and self._guardian is not None and \
+                        self._guardian.pin_ready():
+                    self._pin_known_good(save_dir, tag)
                 self._retire_old_checkpoints(save_dir, tag)
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+
+    def _pin_known_good(self, save_dir: str, tag: str, step=None,
+                        stats=None) -> None:
+        """Commit ``tag`` as the guardian's rollback target — only
+        reached after a verified-clean window (``pin_ready``), so a tag
+        written mid-anomaly-streak can never become the target
+        ``keep_last_n`` retention must preserve. The async-save worker
+        passes ``step``/``stats`` captured at staging time; the sync
+        path reads them live (same thread)."""
+        from ..checkpoint.store import pin_known_good
+        pin_known_good(save_dir, tag)
+        self._guardian.bind_ledger_dir(save_dir)
+        self._guardian.note_pinned(
+            tag, self.global_steps if step is None else step, stats=stats)
 
     def _retire_old_checkpoints(self, save_dir: str, tag: str) -> None:
         """keep-last-N retention (checkpoint: {keep_last_n: N}); 0 (the
@@ -2878,6 +3084,176 @@ class DeepSpeedEngine:
         except Exception:  # noqa: BLE001
             pass
         self._escalation_exit(STALL_EXIT_CODE)
+
+    # ------------------------------------------------------------------
+    # numerics guardian plumbing (resilience/guardian.py, ISSUE 13)
+    # ------------------------------------------------------------------
+    def _inject_numerics_fault(self, e) -> None:
+        """Mutator for the ``numerics`` fault seam (grad_bitflip /
+        loss_spike events): corrupt ONE param leaf host-side before the
+        step dispatch — exactly what a flipped bit in HBM weights looks
+        like to the sentinels. Deterministic in the event's
+        (leaf_match | leaf, index, bit | factor): ``leaf_match`` is a
+        glob over the flattened param path (``wte*`` reaches the logits
+        un-normalized — a flip inside a pre-LN block is absorbed by the
+        next LayerNorm, the textbook SILENT corruption only the replay
+        probe would see); ``leaf == -1`` selects the largest leaf (or,
+        for loss_spike, scales the whole tree)."""
+        import fnmatch
+        with_paths = jax.tree_util.tree_flatten_with_path(
+            self.state["params"])[0]
+        keys = ["/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                         for p in path) for path, _ in with_paths]
+        leaves, treedef = jax.tree_util.tree_flatten(self.state["params"])
+        if not leaves:
+            logger.warning("numerics fault: no param leaves to corrupt")
+            return
+        matched = None
+        if e.leaf_match:
+            hits = [j for j, k in enumerate(keys)
+                    if fnmatch.fnmatch(k, e.leaf_match)]
+            if not hits:
+                logger.warning(f"numerics fault: no param leaf matches "
+                               f"{e.leaf_match!r}; falling back to leaf "
+                               f"selection by index")
+            else:
+                matched = hits[0]
+        if matched is None and e.kind == "loss_spike" and e.leaf == -1:
+            # the divergence case: EVERY weight scaled — pre-LN blocks
+            # normalize a single scaled leaf away, but a whole-tree scale
+            # blows the logits (and the gradients) up finitely, which is
+            # exactly the loss-spike signature the sentinels watch
+            def scale(x):
+                a = np.array(jax.device_get(x))
+                a = (a.astype(np.float32) * np.float32(e.factor)).astype(
+                    a.dtype)
+                return jax.device_put(a, x.sharding)
+            leaves = [scale(x) for x in leaves]
+            i = "ALL"
+        else:
+            if matched is not None:
+                i = matched
+            elif e.leaf == -1:
+                i = max(range(len(leaves)), key=lambda j: leaves[j].size)
+            else:
+                i = e.leaf % len(leaves)
+            src = leaves[i]
+            arr = np.array(jax.device_get(src))  # writable host copy
+            if e.kind == "grad_bitflip":
+                flat = arr.reshape(-1)
+                iview = flat.view({2: np.int16, 4: np.int32,
+                                   8: np.int64}[arr.dtype.itemsize])
+                bit = min(int(e.bit), 8 * arr.dtype.itemsize - 2)
+                idx = int(e.index) % flat.size
+                iview[idx] ^= iview.dtype.type(1) << bit
+            else:  # loss_spike on one explicit leaf
+                arr = (arr.astype(np.float32) * np.float32(e.factor)).astype(
+                    arr.dtype)
+            leaves[i] = jax.device_put(arr, src.sharding)
+        self.state["params"] = jax.tree_util.tree_unflatten(treedef, leaves)
+        if self._explicit_micro:
+            # the ZeRO++ secondary caches (a resharding of) the params —
+            # the corruption must be visible to the very next micro step
+            self._refresh_secondary()
+        name = keys[i] if isinstance(i, int) else i
+        logger.error(f"numerics fault injected: {e.kind} on param leaf "
+                     f"{name} (step {self.global_steps + 1})")
+
+    def _stage_replay_inputs(self, batch, lr, thresh):
+        """SDC replay probe, stage half: when a probe is due this step,
+        pull a host copy of the full pre-step state (the step donates its
+        device buffers, so the copy must exist BEFORE the dispatch).
+        Returns ``None`` on non-probe steps — the common case costs one
+        modulo."""
+        g = self._guardian
+        interval = g.config.replay_probe_interval if g is not None else 0
+        if not interval or (self.global_steps + 1) % interval:
+            return None
+        host_state = jax.tree.map(lambda x: np.array(jax.device_get(x)),
+                                  self.state)
+        return (host_state, batch, lr, thresh)
+
+    def _run_replay_probe(self, probe_in, outputs):
+        """SDC replay probe, compare half: re-run the SAME compiled step
+        on the staged inputs and compare the (loss, gnorm, anomaly-word)
+        outputs BITWISE. XLA is deterministic on fixed inputs, so any
+        drift means the hardware corrupted data somewhere between the two
+        executions — reported as ANOMALY_SDC_REPLAY on the step's word
+        (escalating through the normal policy ladder) instead of
+        silently poisoning the run. Costs one extra step per probe
+        interval, by design."""
+        from ..resilience.guardian import ANOMALY_SDC_REPLAY
+        host_state, batch, lr, thresh = probe_in
+        shardings = self._cached_shardings
+        replay_state = jax.tree.map(
+            lambda h, s: jax.device_put(h, s), host_state, shardings)
+        _, r_loss, _, r_gnorm, r_word = self._jit_train_step(
+            replay_state, batch, lr, thresh)
+        loss, gnorm, word = outputs
+        mismatch = (
+            np.asarray(r_loss).tobytes() != np.asarray(loss).tobytes()
+            or np.asarray(r_gnorm).tobytes() != np.asarray(gnorm).tobytes()
+            or int(r_word) != int(word))
+        if mismatch:
+            logger.error(
+                f"guardian replay probe MISMATCH at step "
+                f"{self.global_steps + 1}: loss {float(loss)!r} vs replay "
+                f"{float(r_loss)!r}, gnorm {float(gnorm)!r} vs "
+                f"{float(r_gnorm)!r} — silent data corruption")
+            return jnp.asarray(int(word) | ANOMALY_SDC_REPLAY, jnp.int32)
+        return word
+
+    def _guardian_rollback(self, verdict) -> None:
+        """Escalation rung 3: roll the run back to the last-known-good
+        checkpoint. Under an elastic agent this RIDES the PR 12 restart
+        path — repoint ``latest`` at the pinned tag, exit with
+        GUARDIAN_EXIT_CODE, and the restarted attempt auto-resumes from
+        the pin (rollback IS a resumed attempt; injected numerics faults
+        are attempt-scoped, so the replay runs clean). Without an agent
+        the engine reloads the pin in-process and continues — the
+        training loop keyed on ``engine.global_steps`` replays the span
+        naturally."""
+        target = self.config.checkpoint_config.get("escalation_dir") \
+            or self._last_save_dir
+        if target is None:
+            # nothing to roll back to: degrade LOUDLY but keep training —
+            # killing a run over an anomaly it has no checkpoint for
+            # would convert detection into destruction. The cooldown
+            # stops the window from re-escalating every step.
+            logger.error(
+                f"guardian rollback requested at step {self.global_steps} "
+                f"({', '.join(verdict.kinds) or 'anomaly window'}) but no "
+                "checkpoint was ever saved and no "
+                "checkpoint.escalation_dir is configured — continuing "
+                "WITHOUT rollback; save checkpoints (or set "
+                "checkpoint.escalation_dir) to arm recovery")
+            self._guardian.reset_after_rollback(self.global_steps)
+            return
+        from ..checkpoint.store import rollback_to_known_good
+        self._guardian.bind_ledger_dir(target)
+        # repoint `latest` at the pin (no-op when nothing was pinned yet:
+        # resume then loads plain `latest`, which still precedes the
+        # anomalous step whenever the anomaly fired before its save)
+        tag = rollback_to_known_good(target)
+        self._guardian.note_rollback(self.global_steps, verdict, tag)
+        logger.error(
+            f"guardian ROLLBACK at step {self.global_steps} "
+            f"({', '.join(verdict.kinds) or 'anomaly window'}): target "
+            f"{target}/{tag or '<latest>'}")
+        if parse_elastic_env():
+            try:
+                self.telemetry.close()
+            except Exception:  # noqa: BLE001 - the exit is the guarantee
+                pass
+            self._escalation_exit(GUARDIAN_EXIT_CODE)
+            return  # tests stub the exit; fall through like a restart
+        loaded, _ = self.load_checkpoint(target, tag=tag)
+        if loaded is None:
+            raise RuntimeError(
+                f"guardian rollback: no loadable checkpoint under {target}")
+        self._guardian.reset_after_rollback(self.global_steps)
+        log_dist(f"guardian rollback complete: resumed tag {loaded} at "
+                 f"step {self.global_steps}", ranks=[0])
 
     def _offload_sidecar_arrays(self) -> Dict[str, Any]:
         """Host arrays of the offload optimizer sidecar file. Name-keyed

@@ -45,11 +45,17 @@ def has_overflow(grads) -> jax.Array:
 
 def update_scale(state: LossScaleState, overflow: jax.Array, *,
                  scale_window: int = 1000, min_scale: float = 1.0,
-                 hysteresis: int = 2, scale_factor: float = 2.0) -> LossScaleState:
+                 hysteresis: int = 2, scale_factor: float = 2.0,
+                 consecutive_hysteresis: bool = False) -> LossScaleState:
     """One DynamicLossScaler.update_scale step (reference loss_scaler.py:91).
 
-    On overflow: consume hysteresis; once exhausted, halve the scale.
-    After ``scale_window`` clean iters: double the scale.
+    On overflow: consume hysteresis; once exhausted, halve the scale —
+    never below the ``min_scale`` floor. After ``scale_window`` clean
+    iters: double the scale. With ``consecutive_hysteresis`` (reference
+    loss_scaler.py ``consecutive_hysteresis``), every CLEAN step restores
+    the hysteresis budget to full, so only ``hysteresis`` *consecutive*
+    overflows drop the scale — a flapping overflow (every other step)
+    can no longer walk the scale down to the floor one window at a time.
     Static scaling (dynamic=False) passes through unchanged.
     """
     it = state["iter"]
@@ -64,7 +70,10 @@ def update_scale(state: LossScaleState, overflow: jax.Array, *,
 
     def on_clean(_):
         grow = (it - state["last_overflow_iter"]) % scale_window == scale_window - 1
-        return jnp.where(grow, cur * scale_factor, cur), hyst, state["last_overflow_iter"]
+        clean_hyst = (jnp.asarray(hysteresis, jnp.int32)
+                      if consecutive_hysteresis else hyst)
+        return jnp.where(grow, cur * scale_factor, cur), clean_hyst, \
+            state["last_overflow_iter"]
 
     new_scale, new_hyst, last_of = jax.lax.cond(overflow, on_overflow, on_clean, None)
     out = dict(state)

@@ -126,6 +126,14 @@ class MetricsEngine:
         self.ttft_latency = LatencyHistogram()
         self.queue_wait = LatencyHistogram()
         self.ttft_execute = LatencyHistogram()
+        # numerics anomaly reservoirs (dstpu-guardian, ISSUE 13): rolling
+        # loss/gnorm samples — the observability twin of the guardian's
+        # own spike-threshold stats — plus escalation counters
+        self.loss_values = LatencyHistogram()
+        self.gnorm_values = LatencyHistogram()
+        self.anomaly_steps = 0
+        self.anomaly_word_union = 0
+        self.guardian_rollbacks = 0
 
     # -- feeding ---------------------------------------------------------
     def record_step(self, duration_s: float, tokens: int = 0,
@@ -141,6 +149,24 @@ class MetricsEngine:
 
     def record_checkpoint_pause(self, seconds: float) -> None:
         self.checkpoint_lost_s += max(0.0, float(seconds))
+
+    def record_numerics(self, loss: Optional[float],
+                        gnorm: Optional[float]) -> None:
+        """Per-step loss/gnorm samples into the anomaly reservoirs (only
+        finite values — the reservoirs describe the healthy regime the
+        spike thresholds are judged against)."""
+        import math
+        if loss is not None and math.isfinite(loss):
+            self.loss_values.record(abs(float(loss)))
+        if gnorm is not None and math.isfinite(gnorm) and gnorm > 0.0:
+            self.gnorm_values.record(float(gnorm))
+
+    def record_anomaly(self, word: int) -> None:
+        self.anomaly_steps += 1
+        self.anomaly_word_union |= int(word)
+
+    def record_guardian_rollback(self) -> None:
+        self.guardian_rollbacks += 1
 
     def record_comm(self, nbytes: int, overlapped: Optional[bool],
                     count: int = 1,
@@ -221,4 +247,13 @@ class MetricsEngine:
                         self.ttft_latency.percentiles().items()})
             out.update({f"queue_wait_{k}_s": v for k, v in
                         self.queue_wait.percentiles().items()})
+        if self.anomaly_steps or self.guardian_rollbacks:
+            out["anomaly_steps"] = float(self.anomaly_steps)
+            out["guardian_rollbacks"] = float(self.guardian_rollbacks)
+        if len(self.gnorm_values):
+            out.update({f"gnorm_{k}": v for k, v in
+                        self.gnorm_values.percentiles().items()})
+        if len(self.loss_values):
+            out.update({f"loss_{k}": v for k, v in
+                        self.loss_values.percentiles().items()})
         return out

@@ -138,6 +138,26 @@ class Telemetry:
         self.metrics.record_comm(nbytes, overlapped, count,
                                  wire_bytes=wire_bytes)
 
+    # -- numerics guardian (resilience/guardian.py, ISSUE 13) ------------
+    def record_numerics(self, step: int, loss, gnorm) -> None:
+        """Per-step loss/gnorm into the anomaly reservoirs — host scalars
+        the step fetched anyway; nothing here touches the device."""
+        self.metrics.record_numerics(loss, gnorm)
+
+    def record_anomaly(self, step: int, word: int, kinds) -> None:
+        """A guardian sentinel fired: trace instant + anomaly counter
+        (the watchdog-stall convention — instants mark the autopsy
+        timeline, counters feed the flush summary)."""
+        self.trace.instant("guardian:anomaly", phase=PHASE_STEP, step=step,
+                           word=int(word), kinds=list(kinds))
+        self.metrics.record_anomaly(word)
+
+    def record_rollback(self, step: int, tag) -> None:
+        """Guardian escalation: the run is rolling back to ``tag``."""
+        self.trace.instant("guardian:rollback", phase=PHASE_STEP, step=step,
+                           tag=tag)
+        self.metrics.record_guardian_rollback()
+
     # -- serving ---------------------------------------------------------
     def record_wave(self, kind: str, tokens: int, duration_s: float,
                     queue_depth: int = 0, running: int = 0,
@@ -294,6 +314,15 @@ class NullTelemetry:
         pass
 
     def record_request(self, *a, **k):
+        pass
+
+    def record_numerics(self, *a, **k):
+        pass
+
+    def record_anomaly(self, *a, **k):
+        pass
+
+    def record_rollback(self, *a, **k):
         pass
 
     def set_flops_fn(self, fn):

@@ -187,6 +187,26 @@ def test_every_entry_point_has_an_exposure_budget():
         assert entry.get("exposed_bytes", -1) >= 0, name
 
 
+def test_guardian_map_zero_delta_vs_engine_step():
+    """ISSUE 13 zero-overhead contract, Layer-D half: the guardian-ARMED
+    step may launch no collective the plain engine step doesn't — the
+    anomaly word rides reductions the program already runs. Compared as
+    (kind, operand bytes) multisets over the committed maps; byte-level
+    drift here means the sentinels (or the skip blend) made GSPMD
+    re-partition the step."""
+    guardian = load_collective_map(default_maps_dir(), "guardian-step-parity")
+    engine = load_collective_map(default_maps_dir(), "engine-train-step")
+    assert guardian is not None and engine is not None
+
+    def sig(m):
+        return sorted((r["kind"], r["operand_bytes"])
+                      for r in m["collectives"])
+
+    assert sig(guardian) == sig(engine), (
+        "guardian-armed step's collectives differ from engine-train-step "
+        "— the sentinel path launched new collectives")
+
+
 def test_every_entry_point_has_a_committed_collective_map(spmd_gate_run):
     # the maps are the artifact ROADMAP item 2's planner consumes: one
     # per registered entry, refreshed by `dstpu lint --schedule`; for the

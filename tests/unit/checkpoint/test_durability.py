@@ -189,3 +189,74 @@ def test_async_kill_before_commit_leaves_latest_on_previous_tag(tmp_path,
 def test_resolve_tag_fresh_when_nothing_committed(tmp_path):
     assert store.resolve_tag(str(tmp_path), None) == (None, True)
     assert store.resolve_tag(str(tmp_path), "nope") == (None, True)
+
+
+# ---------------------------------------------------------------------------
+# last-known-good pinning (dstpu-guardian)
+# ---------------------------------------------------------------------------
+
+def test_pin_roundtrip_and_absent(tmp_path):
+    assert store.read_known_good(str(tmp_path)) is None
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    store.pin_known_good(str(tmp_path), "t1")
+    assert store.read_known_good(str(tmp_path)) == "t1"
+
+
+def test_corrupt_latest_prefers_pinned_over_newest_verified(tmp_path):
+    """ISSUE 13 satellite: `latest` names a corrupt tag while BOTH a
+    pinned known-good tag and a NEWER verifying tag exist — the fallback
+    must pick the pin (the guardian vouched for those bytes; the newer
+    tag merely has intact bytes and may hold a poisoned state)."""
+    _write_tag(tmp_path, "t1", 1.0, 1)   # pinned
+    _write_tag(tmp_path, "t2", 2.0, 2)   # newer, verifies
+    _write_tag(tmp_path, "t3", 3.0, 3)   # latest -> t3, then corrupted
+    store.pin_known_good(str(tmp_path), "t1")
+    _flip_byte(tmp_path / "t3" / "state.npz")
+    tag, fresh = store.resolve_tag(str(tmp_path), None)
+    assert (tag, fresh) == ("t1", False)
+    # without the pin the same layout falls back to newest verified
+    os.remove(tmp_path / store.KNOWN_GOOD_FILE)
+    assert store.resolve_tag(str(tmp_path), None) == ("t2", False)
+
+
+def test_corrupt_pin_falls_back_to_newest_verified(tmp_path):
+    """A pinned tag whose bytes rot must not wedge the fallback."""
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    _write_tag(tmp_path, "t2", 2.0, 2)
+    _write_tag(tmp_path, "t3", 3.0, 3)
+    store.pin_known_good(str(tmp_path), "t1")
+    _flip_byte(tmp_path / "t1" / "state.npz")
+    _flip_byte(tmp_path / "t3" / "state.npz")
+    assert store.resolve_tag(str(tmp_path), None) == ("t2", False)
+
+
+def test_retention_never_retires_the_pinned_tag(tmp_path):
+    """`keep_last_n` retention may never retire the rollback target,
+    however old it gets."""
+    for i in range(1, 6):
+        _write_tag(tmp_path, f"t{i}", float(i), i)
+    store.pin_known_good(str(tmp_path), "t1")
+    removed = store.retire_old_tags(str(tmp_path), keep_last=2)
+    assert "t1" not in removed and (tmp_path / "t1").exists()
+    assert removed == ["t2", "t3", "t4"]
+
+
+def test_rollback_repoints_latest_to_pin(tmp_path):
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    _write_tag(tmp_path, "t2", 2.0, 2)   # latest -> t2
+    store.pin_known_good(str(tmp_path), "t1")
+    assert store.rollback_to_known_good(str(tmp_path)) == "t1"
+    assert (tmp_path / "latest").read_text() == "t1"
+    # resume now loads the pinned state
+    state, client, tag = store.load_checkpoint(
+        str(tmp_path), None, {"w": np.zeros(16, np.float32)}, {"w": None})
+    assert tag == "t1" and client["global_steps"] == 1
+
+
+def test_rollback_without_pin_or_with_rotten_pin_is_none(tmp_path):
+    _write_tag(tmp_path, "t1", 1.0, 1)
+    assert store.rollback_to_known_good(str(tmp_path)) is None
+    store.pin_known_good(str(tmp_path), "t1")
+    _flip_byte(tmp_path / "t1" / "state.npz")
+    assert store.rollback_to_known_good(str(tmp_path)) is None
+    assert (tmp_path / "latest").read_text() == "t1"  # untouched

@@ -47,6 +47,28 @@ def test_unknown_kind_rejected():
         FaultEvent("meteor")
 
 
+def test_numerics_kinds_round_trip_and_fire_payload():
+    """grad_bitflip/loss_spike (ISSUE 13): serialize with their targeting
+    knobs and fire at the `numerics` seam by calling the engine-provided
+    mutator payload; without a payload they warn instead of raising."""
+    plan = FaultPlan([FaultEvent("grad_bitflip", step=2, leaf_match="wte*",
+                                 bit=30),
+                      FaultEvent("loss_spike", step=3, leaf=-1,
+                                 factor=64.0)])
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.events == plan.events
+    assert back.events[0].site == "numerics"
+    fired = []
+    install_plan(back)
+    fault_point("numerics", step=2, payload=fired.append)
+    assert len(fired) == 1 and fired[0].kind == "grad_bitflip"
+    fault_point("numerics", step=2, payload=fired.append)  # count spent
+    assert len(fired) == 1
+    fault_point("numerics", step=3, payload=None)  # payload-less: warn only
+    fault_point("numerics", step=3, payload=fired.append)
+    assert len(fired) == 1  # ...and the warn consumed the firing budget
+
+
 def test_fault_point_no_plan_is_noop():
     assert active_plan() is None
     fault_point("step_end", step=1)  # must not raise

@@ -1,0 +1,288 @@
+"""dstpu-guardian policy units (ISSUE 13): anomaly-word packing, the
+deterministic escalation ladder, rolling-stat spike thresholds, the
+clean-window pin gate, and the persisted ledger's repeat-rollback →
+poisoned-span promotion. Host-level — no engine builds; the one traced
+piece (pack_anomaly_word) runs as a plain jit on the host platform."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.resilience.guardian import (
+    ANOMALY_GNORM_SPIKE, ANOMALY_GRAD_NONFINITE, ANOMALY_GRAD_ZERO,
+    ANOMALY_LOSS_NONFINITE, ANOMALY_LOSS_SPIKE, GuardianConfig,
+    GuardianLedger, GuardianPolicy, decode_anomaly, pack_anomaly_word,
+    resolve_guardian_config)
+
+
+def _word(overflow=False, raw_norm=1.0, gnorm=1.0, thresh=math.inf,
+          loss=None):
+    return int(pack_anomaly_word(
+        overflow=jnp.asarray(overflow), raw_norm=jnp.asarray(raw_norm),
+        gnorm=jnp.asarray(gnorm), spike_thresh=jnp.asarray(thresh),
+        loss=None if loss is None else jnp.asarray(loss)))
+
+
+class TestAnomalyWord:
+
+    def test_clean_step_packs_zero(self):
+        assert _word() == 0
+        assert _word(loss=2.5) == 0
+
+    def test_each_bit(self):
+        assert _word(overflow=True) & ANOMALY_GRAD_NONFINITE
+        assert _word(raw_norm=0.0) & ANOMALY_GRAD_ZERO
+        assert _word(gnorm=100.0, thresh=10.0) & ANOMALY_GNORM_SPIKE
+        assert _word(loss=float("nan")) & ANOMALY_LOSS_NONFINITE
+        assert _word(loss=float("inf")) & ANOMALY_LOSS_NONFINITE
+
+    def test_nonfinite_grads_caught_without_fp16_overflow_flag(self):
+        """bf16/fp32 engines pin overflow=False (has_overflow never
+        runs); NaN/inf grads must still trip the nonfinite bit through
+        the norm reduction the step already computes."""
+        assert _word(overflow=False, raw_norm=float("nan"),
+                     gnorm=float("nan")) & ANOMALY_GRAD_NONFINITE
+        assert _word(overflow=False, raw_norm=float("inf"),
+                     gnorm=float("inf")) & ANOMALY_GRAD_NONFINITE
+
+    def test_inf_threshold_disarms_spike(self):
+        assert _word(gnorm=1e30) == 0  # warmup: thresh = +inf
+
+    def test_decode_names(self):
+        word = ANOMALY_GRAD_NONFINITE | ANOMALY_GNORM_SPIKE
+        assert decode_anomaly(word) == ("grad_nonfinite", "gnorm_spike")
+        assert decode_anomaly(0) == ()
+
+
+class TestConfigResolution:
+
+    def test_config_block(self):
+        assert resolve_guardian_config(GuardianConfig(enabled=False)) is None
+        cfg = resolve_guardian_config(GuardianConfig(enabled=True,
+                                                     spike_factor=4.0))
+        assert cfg is not None and cfg.spike_factor == 4.0
+
+    def test_env_forces_off(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_GUARDIAN", "0")
+        assert resolve_guardian_config(GuardianConfig(enabled=True)) is None
+
+    def test_env_forces_on_with_defaults(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_GUARDIAN", "1")
+        cfg = resolve_guardian_config(None)
+        assert cfg is not None and cfg.enabled
+
+    def test_env_json_supplies_full_config(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_GUARDIAN", json.dumps(
+            {"max_anomalies_in_window": 1, "warmup_steps": 5}))
+        cfg = resolve_guardian_config(None)
+        assert cfg.enabled and cfg.max_anomalies_in_window == 1
+        assert cfg.warmup_steps == 5
+
+
+def _policy(**kw):
+    base = dict(enabled=True, warmup_steps=2, spike_factor=8.0,
+                anomaly_window=8, max_anomalies_in_window=2,
+                clean_window_for_pin=2)
+    base.update(kw)
+    return GuardianPolicy(GuardianConfig(**base))
+
+
+class TestPolicyLadder:
+
+    def test_threshold_warms_up_from_clean_medians(self):
+        p = _policy()
+        assert p.spike_threshold() == math.inf
+        p.observe(1, 2.0, 1.0, 0)
+        assert p.spike_threshold() == math.inf  # 1 < warmup 2
+        p.observe(2, 2.0, 3.0, 0)
+        assert p.spike_threshold() == pytest.approx(8.0 * 2.0)  # median(1,3)
+
+    def test_anomalous_steps_do_not_feed_stats(self):
+        p = _policy()
+        for s in (1, 2):
+            p.observe(s, 2.0, 1.0, 0)
+        thresh = p.spike_threshold()
+        p.observe(3, 1e9, 1e9, ANOMALY_GNORM_SPIKE)
+        assert p.spike_threshold() == thresh  # poisoned values excluded
+
+    def test_escalation_window(self):
+        p = _policy(max_anomalies_in_window=2, anomaly_window=4)
+        for s in (1, 2):
+            assert p.observe(s, 2.0, 1.0, 0).action == "ok"
+        v1 = p.observe(3, 2.0, 1.0, ANOMALY_GRAD_ZERO)
+        assert v1.action == "anomaly"           # 1 of 2 in window
+        v2 = p.observe(4, 2.0, 1.0, ANOMALY_GRAD_ZERO)
+        assert v2.action == "rollback"          # 2 of 2
+        assert v2.kinds == ("grad_zero",)
+
+    def test_window_slides_old_anomalies_out(self):
+        p = _policy(max_anomalies_in_window=2, anomaly_window=3)
+        p.observe(1, 2.0, 1.0, ANOMALY_GRAD_ZERO)
+        for s in range(2, 6):
+            p.observe(s, 2.0, 1.0, 0)
+        # the step-1 anomaly fell out of the window: no escalation
+        assert p.observe(6, 2.0, 1.0, ANOMALY_GRAD_ZERO).action == "anomaly"
+
+    def test_rollback_disabled_never_escalates(self):
+        p = _policy(rollback=False, max_anomalies_in_window=1)
+        assert p.observe(1, 2.0, 1.0, ANOMALY_GRAD_ZERO).action == "anomaly"
+
+    def test_host_loss_bits_fold_in(self):
+        p = _policy(max_anomalies_in_window=1, loss_spike_factor=8.0)
+        v = p.observe(1, float("nan"), 1.0, 0)
+        assert v.word & ANOMALY_LOSS_NONFINITE and v.action == "rollback"
+        p2 = _policy(max_anomalies_in_window=1)
+        p2.observe(1, 2.0, 1.0, 0)
+        p2.observe(2, 2.0, 1.0, 0)
+        v = p2.observe(3, 1e6, 1.0, 0)
+        assert v.word & ANOMALY_LOSS_SPIKE and v.action == "rollback"
+
+    def test_deterministic_same_sequence_same_verdicts(self):
+        seq = [(1, 2.0, 1.0, 0), (2, 2.0, 1.5, 0),
+               (3, 5e6, 1e4, ANOMALY_GNORM_SPIKE), (4, 2.0, 1.0, 0),
+               (5, 1e9, 1e9, ANOMALY_GNORM_SPIKE)]
+        a = [_policy().observe(*o).to_json() for o in []]  # noqa: F841
+        pa, pb = _policy(), _policy()
+        va = [pa.observe(*o).to_json() for o in seq]
+        vb = [pb.observe(*o).to_json() for o in seq]
+        assert va == vb
+        assert va[-1]["action"] == "rollback"
+
+    def test_pin_gate_needs_clean_window(self):
+        p = _policy(clean_window_for_pin=2)
+        p.observe(1, 2.0, 1.0, 0)
+        assert not p.pin_ready()
+        p.observe(2, 2.0, 1.0, 0)
+        assert p.pin_ready()
+        p.observe(3, 2.0, 1.0, ANOMALY_GRAD_ZERO)
+        assert not p.pin_ready()  # the streak reset
+
+    def test_cooldown_ignores_observations(self):
+        # cooldown_steps=1 ignores exactly the FIRST post-resume step
+        p = _policy(max_anomalies_in_window=1, cooldown_steps=1)
+        p.reset_after_rollback(resumed_step=2)
+        v = p.observe(3, 2.0, 1.0, ANOMALY_GRAD_ZERO)
+        assert v.action == "ok" and v.detail == "cooldown"
+        v = p.observe(4, 2.0, 1.0, ANOMALY_GRAD_ZERO)
+        assert v.action == "rollback"
+
+    def test_scaler_owned_overflow_never_escalates(self):
+        """fp16 dynamic scaling walking the scale down is ROUTINE: pure
+        overflow words are logged but stay out of the rollback window —
+        a healthy fp16 startup must not escalate. Mixed words (overflow
+        + spike) still count."""
+        p = GuardianPolicy(GuardianConfig(enabled=True, warmup_steps=2,
+                                          max_anomalies_in_window=2,
+                                          anomaly_window=8),
+                           scaler_owns_overflow=True)
+        for s in range(1, 6):
+            v = p.observe(s, 2.0, 1.0, ANOMALY_GRAD_NONFINITE)
+            assert v.action == "anomaly", (s, v)
+            assert v.detail == "scaler-owned overflow"
+        assert p.anomaly_steps_total == 5
+        # a non-overflow bit alongside still escalates normally
+        p.observe(6, 2.0, 1.0,
+                  ANOMALY_GRAD_NONFINITE | ANOMALY_GNORM_SPIKE)
+        v = p.observe(7, 2.0, 1.0, ANOMALY_GNORM_SPIKE)
+        assert v.action == "rollback"
+
+
+class TestLedger:
+
+    def test_roundtrip_and_corrupt_tolerance(self, tmp_path):
+        led = GuardianLedger(str(tmp_path))
+        led.note_pinned("global_step2", 2)
+        led.note_rollback(3, _policy().observe(3, 1e9, 1e9,
+                                               ANOMALY_GNORM_SPIKE),
+                          "global_step2")
+        fresh = GuardianLedger(str(tmp_path))
+        assert fresh.pinned_tag == "global_step2"
+        assert fresh.rollbacks[0]["step"] == 3
+        # corrupt ledger starts fresh instead of failing the run
+        (tmp_path / "guardian.json").write_text("{not json")
+        assert GuardianLedger(str(tmp_path)).rollbacks == []
+
+    def test_second_rollback_same_step_marks_poisoned(self, tmp_path):
+        p = GuardianPolicy(GuardianConfig(enabled=True),
+                           ledger_dir=str(tmp_path))
+        v = p.observe(3, 1e9, 1e9, ANOMALY_GNORM_SPIKE)
+        p.note_rollback(3, v, "global_step2")
+        assert not p.should_skip_data(3)  # transient until proven otherwise
+        p.note_rollback(3, v, "global_step2")
+        assert p.should_skip_data(3)      # data-deterministic: skip ahead
+        # the promotion persisted
+        assert 3 in GuardianLedger(str(tmp_path)).poisoned_steps
+
+    def test_replayed_deterministic_anomaly_reaches_poison_ladder(self):
+        """Default cooldown (0) must let the in-process REPLAY of a
+        data-deterministic anomaly be observed: rollback at step N,
+        resume, step N anomalous again → second rollback → poisoned —
+        the documented skip-ahead ladder end to end."""
+        p = _policy(max_anomalies_in_window=1)
+        for s in (1, 2):
+            p.observe(s, 2.0, 1.0, 0)
+        v1 = p.observe(3, 2.0, 1e9, ANOMALY_GNORM_SPIKE)
+        assert v1.action == "rollback"
+        p.note_rollback(3, v1, "global_step2")
+        p.reset_after_rollback(resumed_step=2)
+        v2 = p.observe(3, 2.0, 1e9, ANOMALY_GNORM_SPIKE)  # the replay
+        assert v2.action == "rollback", v2
+        p.note_rollback(3, v2, "global_step2")
+        assert p.should_skip_data(3)
+
+    def test_memoryless_ledger_without_dir(self):
+        led = GuardianLedger(None)
+        led.note_pinned("t", 1)  # save() is a no-op, not an error
+        assert led.pinned_tag == "t"
+
+    def test_clean_stats_persist_across_restart(self, tmp_path):
+        """A restarted attempt (rollback IS a restart) must inherit the
+        healthy-regime reservoirs — a cold warmup window would let the
+        very anomaly that caused the rollback sail through on replay."""
+        cfg = GuardianConfig(enabled=True, warmup_steps=2,
+                             max_anomalies_in_window=1)
+        p = GuardianPolicy(cfg, ledger_dir=str(tmp_path))
+        p.observe(1, 2.0, 1.0, 0)
+        p.observe(2, 2.0, 3.0, 0)
+        thresh = p.spike_threshold()
+        assert math.isfinite(thresh)
+        # reservoirs persist at PIN cadence (checkpoint cadence)
+        p.note_pinned("global_step2", 2)
+        # "restart": a fresh policy over the same ledger dir is warm
+        p2 = GuardianPolicy(cfg, ledger_dir=str(tmp_path))
+        assert p2.spike_threshold() == thresh
+        v = p2.observe(3, 2.0, thresh * 2, ANOMALY_GNORM_SPIKE)
+        assert v.action == "rollback"
+
+    def test_reservoirs_survive_in_process_rollback(self):
+        p = _policy(max_anomalies_in_window=1, warmup_steps=2)
+        p.observe(1, 2.0, 1.0, 0)
+        p.observe(2, 2.0, 3.0, 0)
+        thresh = p.spike_threshold()
+        p.reset_after_rollback(resumed_step=2)
+        assert p.spike_threshold() == thresh  # no re-opened warmup
+
+
+def test_descriptor_shape():
+    p = _policy()
+    p.observe(1, 2.0, 1.0, 0)
+    d = p.descriptor()
+    assert d["anomaly_steps_total"] == 0 and d["rollbacks"] == 0
+    assert isinstance(d["verdicts"], list) and d["verdicts"][0]["step"] == 1
+
+
+def test_numerics_reservoirs_in_telemetry_summary():
+    from deepspeed_tpu.telemetry.metrics import MetricsEngine
+    m = MetricsEngine()
+    m.record_numerics(2.0, 1.5)
+    m.record_numerics(float("nan"), -1.0)  # non-finite/non-positive dropped
+    m.record_anomaly(ANOMALY_GNORM_SPIKE)
+    m.record_guardian_rollback()
+    s = m.summary()
+    assert s["anomaly_steps"] == 1.0 and s["guardian_rollbacks"] == 1.0
+    assert s["gnorm_p50"] == 1.5 and s["loss_p50"] == 2.0
+    assert np.isfinite(s["loss_p99"])

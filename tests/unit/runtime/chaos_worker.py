@@ -57,11 +57,15 @@ GLOBAL_BATCH = 8
 SEQ_LEN = 8
 
 
-def step_batch(step: int):
+def step_batch(step: int, skip_span: bool = False):
     """The global batch of optimizer step ``step`` — a pure function of
     the step index, so an uninterrupted run, a killed-and-resumed run,
-    and a shrunk-world resume all consume identical data."""
-    rng = np.random.default_rng(1000 + step)
+    and a shrunk-world resume all consume identical data.
+    ``skip_span`` is the guardian's skip-ahead hook: a step whose data
+    span was marked poisoned (rolled back twice — data-deterministic
+    anomaly) draws from a disjoint seed range instead of looping on the
+    same poison forever."""
+    rng = np.random.default_rng((10_000_000 if skip_span else 1000) + step)
     return {"input_ids": rng.integers(0, 128, size=(GLOBAL_BATCH, SEQ_LEN))}
 
 
@@ -72,17 +76,26 @@ def main(out_dir: str, total_steps: int = 4) -> int:
     model = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128,
                        remat=False)
     # initialize() resumes from DSTPU_ELASTIC's checkpoint_dir last
-    # committed tag (fresh start when nothing committed yet)
+    # committed tag (fresh start when nothing committed yet); the
+    # guardian (numerics chaos arm) arms via the DSTPU_GUARDIAN env
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": GLOBAL_BATCH // _DEVICES,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": 2},
     }, seed=3)
+    guardian = engine._guardian
 
     while engine.global_steps < total_steps:
         step = engine.global_steps + 1
-        loss = float(engine.train_batch(step_batch(step)))
-        assert np.isfinite(loss), (step, loss)
+        skip = guardian is not None and guardian.should_skip_data(step)
+        loss = float(engine.train_batch(step_batch(step, skip_span=skip)))
+        if engine.global_steps < step:
+            # the guardian rolled this step back (in-process form) or an
+            # anomalous step must not pollute the trajectory: re-run
+            continue
+        # with the guardian armed an anomalous-but-tolerated step may
+        # carry a non-finite loss; without it that is a hard failure
+        assert guardian is not None or np.isfinite(loss), (step, loss)
         # an injected crash at step k dies inside train_batch (step_end
         # seam) — before this step's loss is logged or its tag commits,
         # so the resumed attempt replays it from tag k-1

@@ -1,0 +1,90 @@
+"""Dynamic loss scaler decay/recovery sequences (ISSUE 13 satellite):
+the ``min_loss_scale`` floor must hold under sustained overflow, and
+``consecutive_hysteresis`` (reference-DeepSpeed parity) must make a
+flapping overflow — one every other step — unable to decay the scale,
+because every clean step restores the hysteresis budget. Host-level
+loops over ``update_scale``; no engine builds."""
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (dynamic_loss_scale_state,
+                                                    has_overflow,
+                                                    static_loss_scale_state,
+                                                    update_scale)
+
+
+def _run(state, overflows, **kw):
+    for ovf in overflows:
+        state = update_scale(state, jnp.asarray(bool(ovf)), **kw)
+    return state
+
+
+def _scale(state) -> float:
+    return float(state["cur_scale"])
+
+
+class TestDecay:
+
+    def test_hysteresis_absorbs_first_overflows(self):
+        st = dynamic_loss_scale_state(initial_scale_power=4, hysteresis=2)
+        st = _run(st, [1], hysteresis=2)
+        assert _scale(st) == 16.0  # first overflow only consumes hysteresis
+        st = _run(st, [1], hysteresis=2)
+        assert _scale(st) == 8.0   # second drops
+
+    def test_min_scale_floor_holds_under_sustained_overflow(self):
+        st = dynamic_loss_scale_state(initial_scale_power=3, hysteresis=1)
+        st = _run(st, [1] * 64, hysteresis=1, min_scale=1.0)
+        assert _scale(st) == 1.0
+
+    def test_min_scale_floor_is_configurable(self):
+        st = dynamic_loss_scale_state(initial_scale_power=8, hysteresis=1)
+        st = _run(st, [1] * 64, hysteresis=1, min_scale=4.0)
+        assert _scale(st) == 4.0
+
+    def test_flapping_overflow_decays_without_consecutive_hysteresis(self):
+        # overflow every other step: clean steps do NOT restore hysteresis,
+        # so every second overflow drops the scale (legacy behavior)
+        st = dynamic_loss_scale_state(initial_scale_power=6, hysteresis=2)
+        st = _run(st, [1, 0] * 4, hysteresis=2, scale_window=1000)
+        assert _scale(st) == 16.0  # 64 -> 32 -> 16 over 4 flap cycles
+
+    def test_flapping_overflow_cannot_decay_with_consecutive_hysteresis(self):
+        # every clean step restores the budget: only `hysteresis`
+        # CONSECUTIVE overflows can drop the scale, so the flap holds flat
+        st = dynamic_loss_scale_state(initial_scale_power=6, hysteresis=2)
+        st = _run(st, [1, 0] * 16, hysteresis=2, scale_window=1000,
+                  consecutive_hysteresis=True)
+        assert _scale(st) == 64.0
+
+    def test_consecutive_overflows_still_drop_with_consecutive_hysteresis(self):
+        st = dynamic_loss_scale_state(initial_scale_power=6, hysteresis=2)
+        st = _run(st, [1, 1], hysteresis=2, consecutive_hysteresis=True)
+        assert _scale(st) == 32.0
+
+
+class TestRecovery:
+
+    def test_scale_doubles_after_clean_window(self):
+        st = dynamic_loss_scale_state(initial_scale_power=4, hysteresis=2)
+        st = _run(st, [0] * 4, scale_window=4)
+        assert _scale(st) == 32.0
+
+    def test_recovery_after_drop_sequence(self):
+        st = dynamic_loss_scale_state(initial_scale_power=4, hysteresis=1)
+        st = _run(st, [1], hysteresis=1)              # 16 -> 8
+        assert _scale(st) == 8.0
+        st = _run(st, [0] * 4, hysteresis=1, scale_window=4)
+        assert _scale(st) == 16.0                     # window of clean: regrow
+
+    def test_static_scale_never_moves(self):
+        st = static_loss_scale_state(128.0)
+        st = _run(st, [1, 1, 1, 0, 0, 0], hysteresis=1, scale_window=2)
+        assert _scale(st) == 128.0
+
+
+def test_has_overflow_detects_nonfinite_leaf():
+    clean = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    assert not bool(has_overflow(clean))
+    dirty = dict(clean, b=jnp.asarray([[1.0, jnp.inf], [0.0, 0.0]]))
+    assert bool(has_overflow(dirty))

@@ -59,7 +59,7 @@ def _free_port() -> int:
 
 
 def _run_agent(tmp_path, name, slots=2, shrink=False, plan=None,
-               max_restarts=2):
+               max_restarts=2, guardian=False):
     """Drive chaos_worker under a DSElasticAgent in a subprocess; returns
     (world_history, rank-0 trajectory)."""
     out = tmp_path / name
@@ -70,6 +70,12 @@ def _run_agent(tmp_path, name, slots=2, shrink=False, plan=None,
     worker_env = {}
     if plan is not None:
         worker_env["DSTPU_FAULT_PLAN"] = plan.to_json()
+    if guardian:
+        # arm the numerics guardian: single-anomaly escalation so the
+        # injected corruption rolls back at the step it fires
+        worker_env["DSTPU_GUARDIAN"] = json.dumps({
+            "enabled": True, "max_anomalies_in_window": 1,
+            "warmup_steps": 2})
     spec = {"script": WORKER, "args": [str(out), str(TOTAL_STEPS)],
             "slots": slots, "max_restarts": max_restarts, "shrink": shrink,
             "port": _free_port(), "env": worker_env,
@@ -146,3 +152,57 @@ def test_chaos_torn_write_falls_back(tmp_path, reference):
     assert report["ok"], report
     assert (out / "ckpt" / "latest").read_text() == \
         f"global_step{TOTAL_STEPS}"
+
+
+# ---------------------------------------------------------------------------
+# dstpu-guardian numerics chaos (ISSUE 13 acceptance)
+# ---------------------------------------------------------------------------
+
+def _assert_guardian_rolled_back(out, reference, traj, history, kind):
+    """Shared acceptance: the agent restarted once (rollback IS a
+    resumed attempt), the guardian ledger attributes it to the injected
+    step, and the merged trajectory — replayed step included — matches
+    the uninterrupted (guardian-less) run at the global-scale atol
+    floor."""
+    assert history == [2, 2], history
+    ledger_path = out / "ckpt" / "guardian.json"
+    assert ledger_path.exists(), "guardian ledger never written"
+    ledger = json.loads(ledger_path.read_text())
+    rollbacks = ledger.get("rollbacks", [])
+    assert len(rollbacks) == 1, ledger
+    assert rollbacks[0]["step"] == 3, ledger
+    assert rollbacks[0]["kinds"], ledger
+    report = compare_trajectories(reference, traj, atol_frac=ATOL_FRAC)
+    assert report["ok"], (kind, report)
+    # the run recovered and kept committing to the final step
+    assert (out / "ckpt" / "latest").read_text() == \
+        f"global_step{TOTAL_STEPS}"
+    # the rolled-back tags never won the pin: known_good is a CLEAN tag
+    pin = (out / "ckpt" / "known_good").read_text()
+    assert pin.startswith("global_step"), pin
+
+
+def test_chaos_grad_bitflip_guardian_rolls_back(tmp_path, reference):
+    """SDC: a bit flipped in the embedding weights (HBM corruption) at
+    step 3. The sentinels catch the blown-up loss, the guardian repoints
+    `latest` at the pinned known-good tag and exits for the agent to
+    restart; the injected flip is attempt-scoped, so the resumed attempt
+    replays step 3 clean — full trajectory parity."""
+    plan = FaultPlan([FaultEvent("grad_bitflip", step=3, rank=0,
+                                 leaf_match="wte*")])
+    history, traj, out = _run_agent(tmp_path, "bitflip", slots=2,
+                                    shrink=False, plan=plan, guardian=True)
+    _assert_guardian_rolled_back(out, reference, traj, history,
+                                 "grad_bitflip")
+
+
+def test_chaos_loss_spike_guardian_rolls_back(tmp_path, reference):
+    """Divergence: every weight scaled 1024x at step 3 — finite but
+    violent. The gnorm/loss spike sentinels fire against the rolling
+    stats warmed on steps 1-2, the update is skipped in-graph, and the
+    guardian rolls back through the same restart path."""
+    plan = FaultPlan([FaultEvent("loss_spike", step=3, rank=0, leaf=-1)])
+    history, traj, out = _run_agent(tmp_path, "spike", slots=2,
+                                    shrink=False, plan=plan, guardian=True)
+    _assert_guardian_rolled_back(out, reference, traj, history,
+                                 "loss_spike")

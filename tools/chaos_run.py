@@ -80,6 +80,12 @@ def main(argv=None) -> int:
     ap.add_argument("--plan", default="",
                     help="fault-plan JSON file overriding the default "
                          "single-crash plan")
+    ap.add_argument("--numerics", action="store_true",
+                    help="numerics chaos arm (dstpu-guardian): inject a "
+                         "grad_bitflip at --crash-step (attempt 0) and a "
+                         "loss_spike one step later on the restarted "
+                         "attempt; workers run with the guardian armed "
+                         "and the report carries its verdicts")
     ap.add_argument("--seed", type=int, default=0,
                     help="with --random, seed for FaultPlan.sample")
     ap.add_argument("--random", action="store_true",
@@ -99,6 +105,17 @@ def main(argv=None) -> int:
     if args.plan:
         with open(args.plan) as f:
             plan = FaultPlan.from_json(f.read())
+    elif args.numerics:
+        # both SDC kinds in one supervised run: the bitflip rolls attempt
+        # 0 back (restart), then the spike hits the RESTARTED attempt one
+        # step later — each is attempt-scoped, so its replay runs clean
+        plan = FaultPlan([
+            FaultEvent("grad_bitflip", step=args.crash_step, rank=0,
+                       leaf_match="wte*"),
+            FaultEvent("loss_spike", step=min(args.crash_step + 1,
+                                              args.steps), rank=0,
+                       attempt=1, leaf=-1),
+        ])
     elif args.random:
         plan = FaultPlan.sample(seed=args.seed,
                                 max_step=max(1, args.steps - 1))
@@ -106,6 +123,11 @@ def main(argv=None) -> int:
         plan = FaultPlan([FaultEvent("crash", step=args.crash_step, rank=0)])
 
     base_env = {}
+    chaos_env = {}
+    if args.numerics:
+        chaos_env["DSTPU_GUARDIAN"] = json.dumps({
+            "enabled": True, "max_anomalies_in_window": 1,
+            "warmup_steps": 2})
     script_args = [str(args.steps)] + args.script_args
 
     ref_dir = os.path.join(args.out, "reference")
@@ -126,7 +148,7 @@ def main(argv=None) -> int:
           f"shrink={args.shrink})...")
     rc, history = _run_world(args.script, script_args, chaos_dir,
                              args.slots, args.shrink, args.max_restarts,
-                             plan.to_json(), base_env)
+                             plan.to_json(), {**base_env, **chaos_env})
     if rc != 0:
         print(f"chaos_run: chaos run did not recover rc={rc}",
               file=sys.stderr)
@@ -137,6 +159,20 @@ def main(argv=None) -> int:
                                   atol_frac=args.atol_frac)
     report["world_history"] = history
     report["plan"] = json.loads(plan.to_json())
+    if args.numerics:
+        # the guardian ledger (rollbacks, pins, poisoned spans) persists
+        # next to the checkpoints — the verdict record of the run
+        ledger_path = os.path.join(chaos_dir, "ckpt", "guardian.json")
+        if os.path.exists(ledger_path):
+            with open(ledger_path) as f:
+                report["guardian"] = json.load(f)
+            rbs = report["guardian"].get("rollbacks", [])
+            print(f"chaos_run: guardian verdicts — {len(rbs)} rollback(s): "
+                  + ", ".join(f"step {r['step']} ({'+'.join(r['kinds'])})"
+                              for r in rbs))
+        else:
+            report["guardian"] = {"rollbacks": [],
+                                  "note": "no ledger written"}
     path = os.path.join(args.out, "chaos_report.json")
     with open(path, "w") as f:
         json.dump(report, f, indent=2)

@@ -231,6 +231,11 @@ def bench_train(label, model, ds_config, batch_size, seq, steps, ref_mfu,
         line["offload_stall_frac"] = round(
             engine.last_offload_stall_s
             / max(engine.last_offload_compute_s, 1e-9), 3)
+        # ISSUE 15 stall decomposition: where the offload boundary's wall
+        # actually went (h2d_prefetch / bucket_compute / d2h_writeback /
+        # nvme_io seconds of the LAST step — docs/OBSERVABILITY.md)
+        for k, v in getattr(engine, "last_offload_phase_s", {}).items():
+            line[f"offload_{k}_s"] = round(v, 4)
     if mfu is not None:
         factor = _forced_remat_factor(model.config, seq)
         if factor > 1.0:
@@ -570,35 +575,83 @@ def _serving_subprocess(env_extra, timeout, diags):
     return None
 
 
+def _offload_bench_model():
+    """THE offload bench model — one definition shared by the main NVMe
+    line and both denominator arms, so an A/B can never silently compare
+    two different shapes. Sized to ~20M params: this environment reaches
+    its chip through a remote-device tunnel moving ~13 MB/s device->host
+    (measured), so the grad fetch — PCIe-speed on a real TPU VM — bounds
+    every offload step here."""
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.models import llama_model
+
+    return llama_model("llama2-7b", dtype=jnp.bfloat16, remat=True,
+                       num_layers=2, hidden_size=768, intermediate_size=2048,
+                       num_heads=12, num_kv_heads=4, vocab_size=4096,
+                       max_seq_len=512)
+
+
+def _offload_bench_cfg(device: str, nvme_dir=None):
+    """THE offload bench config (stage-3 bf16, grad bf16, clip 1.0) with
+    the optimizer offloaded to ``device`` — shared across the line and
+    its denominators for the same no-drift reason as the model."""
+    oc = {"device": device}
+    if device == "nvme":
+        # pipelined swapper: chunk i+1's read overlaps chunk i's CPU step
+        # (tools/offload_ab.py; the r4 committed line forgot these knobs
+        # and shipped the unpipelined number)
+        oc.update({"nvme_path": nvme_dir, "pipeline_read": True,
+                   "pipeline_write": True})
+    return {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "adamw",
+                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
+        "zero_optimization": {"stage": 3, "offload_optimizer": oc},
+        "bf16": {"enabled": True},
+        "gradient_clipping": 1.0,
+        "data_types": {"grad_accum_dtype": "bf16"},
+    }
+
+
 def _offload_denominator():
     """Child mode for the NVMe line's denominator: the SAME model with the
     optimizer resident in host RAM, in a fresh process (HBM isolation)."""
     import jax
-    import jax.numpy as jnp
-
-    from deepspeed_tpu.models import llama_model
 
     on_tpu = jax.default_backend() not in ("cpu",)
     if not on_tpu:
         os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
     peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
     steps = 30 if on_tpu else 3
-    cfg = {
-        "train_micro_batch_size_per_gpu": 4,
-        "optimizer": {"type": "adamw",
-                      "params": {"lr": 1e-4, "weight_decay": 0.01}},
-        "zero_optimization": {"stage": 3,
-                              "offload_optimizer": {"device": "cpu"}},
-        "bf16": {"enabled": True},
-        "gradient_clipping": 1.0,
-        "data_types": {"grad_accum_dtype": "bf16"},
-    }
-    model = llama_model("llama2-7b", dtype=jnp.bfloat16, remat=True,
-                        num_layers=2, hidden_size=768, intermediate_size=2048,
-                        num_heads=12, num_kv_heads=4, vocab_size=4096,
-                        max_seq_len=512)
-    _emit(bench_train("llama-arch ZeRO-3 cpu-offload (denominator)", model,
-                      cfg, 4, 512, max(6, steps // 5), REF_MFU_ZERO3, peak))
+    _emit(bench_train("llama-arch ZeRO-3 cpu-offload (denominator)",
+                      _offload_bench_model(), _offload_bench_cfg("cpu"),
+                      4, 512, max(6, steps // 5), REF_MFU_ZERO3, peak))
+
+
+def _offload_pipeline_denominator():
+    """Child mode for the NVMe line's SCHEDULE denominator (ISSUE 15):
+    the SAME model, SAME NVMe paging, with the serial
+    fetch→compute→writeback schedule (DSTPU_OFFLOAD_PIPELINE=0 — bitwise
+    the pre-pipeline program), in a fresh process (HBM isolation). The
+    ratio isolates what the double-buffered schedule buys with the
+    tunnel/NVMe constant in both arms."""
+    os.environ["DSTPU_OFFLOAD_PIPELINE"] = "0"
+    import tempfile
+
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    if not on_tpu:
+        os.environ.setdefault("DSTPU_ACCELERATOR", "cpu")
+    peak = PEAK_TFLOPS.get(jax.devices()[0].device_kind) if on_tpu else None
+    steps = 30 if on_tpu else 3
+    with tempfile.TemporaryDirectory(prefix="dstpu_nvme_den_",
+                                     ignore_cleanup_errors=True) as nvme:
+        _emit(bench_train(
+            "llama-arch ZeRO-3 NVMe-offload serial-schedule (denominator)",
+            _offload_bench_model(), _offload_bench_cfg("nvme", nvme),
+            4, 512, max(6, steps // 5), REF_MFU_ZERO3, peak))
 
 
 def _zero_overlap_cfg(overlap: bool = True):
@@ -762,6 +815,8 @@ def _moe_kernel_denominator():
 def main():
     if "--offload-denominator" in sys.argv:
         return _offload_denominator()
+    if "--offload-pipeline-denominator" in sys.argv:
+        return _offload_pipeline_denominator()
     if "--opt-kernel-denominator" in sys.argv:
         return _opt_kernel_denominator()
     if "--moe-kernel-denominator" in sys.argv:
@@ -912,36 +967,21 @@ def _run_configs():
         def offload_run():
             import tempfile
 
-            def offload_model():
-                # Sized to ~20M params: this environment reaches its chip
-                # through a remote-device tunnel moving ~13 MB/s
-                # device->host (measured), so the grad fetch - PCIe-speed
-                # on a real TPU VM - bounds every offload step here. The
-                # line demonstrates the full path (host-partitioned
-                # optimizer, fp32 masters + moments paged through
-                # dstpu_aio per step).
-                return llama_model("llama2-7b", dtype=jnp.bfloat16, remat=True,
-                                   num_layers=2, hidden_size=768,
-                                   intermediate_size=2048, num_heads=12,
-                                   num_kv_heads=4, vocab_size=4096,
-                                   max_seq_len=512)
-
+            # model/config are THE shared offload bench definitions
+            # (_offload_bench_model/_offload_bench_cfg) so the cpu and
+            # serial-schedule denominator arms can never drift from this
+            # line's shape. The line demonstrates the full path
+            # (host-partitioned optimizer, fp32 masters + moments paged
+            # through dstpu_aio per step, pipelined offload schedule).
             # ignore_cleanup_errors: if a step raises while async AIO writes
             # are in flight, rmtree during unwinding can race the worker
             # threads and mask the real error with ENOTEMPTY
             with tempfile.TemporaryDirectory(prefix="dstpu_nvme_",
                                              ignore_cleanup_errors=True) as nvme:
-                cfg = zero_cfg(3, 4)
-                cfg["zero_optimization"]["offload_optimizer"] = {
-                    "device": "nvme", "nvme_path": nvme,
-                    # pipelined swapper: chunk i+1's read overlaps chunk
-                    # i's CPU step (tools/offload_ab.py: fence-stall 0.29
-                    # unpipelined -> 0.05); the r4 committed line forgot
-                    # these knobs and shipped the unpipelined number
-                    "pipeline_read": True, "pipeline_write": True}
                 line = bench_train(
                     "llama-arch ZeRO-3 NVMe-offload bf16",
-                    offload_model(), cfg, 4, 512,
+                    _offload_bench_model(), _offload_bench_cfg("nvme", nvme),
+                    4, 512,
                     max(6, steps // 5), REF_MFU_ZERO3, peak,
                     note=", optimizer state paged via dstpu_aio")
             # REAL denominator (r3 verdict missing #3): the same model with
@@ -956,6 +996,16 @@ def _run_configs():
                 line["vs_cpu_offload"] = round(
                     line["value"] / cpu_line["value"], 3)
                 line["cpu_offload_tokens_per_sec"] = cpu_line["value"]
+            # ISSUE 15 schedule denominator: the SAME NVMe engine under
+            # DSTPU_OFFLOAD_PIPELINE=0 (serial fetch→compute→writeback,
+            # bitwise the pre-pipeline program) in its own subprocess —
+            # the ratio isolates the double-buffered SCHEDULE
+            pipe_line = _denominator_line("--offload-pipeline-denominator")
+            if pipe_line and pipe_line.get("value"):
+                line["vs_offload_pipeline_off"] = round(
+                    line["value"] / pipe_line["value"], 3)
+                line["offload_pipeline_off_tokens_per_sec"] = \
+                    pipe_line["value"]
             return line
         runs.append(offload_run)
         def moe_kernel_run():

@@ -608,6 +608,75 @@ def build_fused_moe_dispatch() -> EntrySpec:
         gate_cheap=True)
 
 
+def build_offload_step_pipeline() -> EntrySpec:
+    """The per-bucket traced compute of the double-buffered offload
+    pipeline (ISSUE 15, ``engine._apply_step_offload``): the D2H fetch
+    side's 2-D flatten (``DeepSpeedEngine._to_flat`` — dp dim first, any
+    model dim major of the second, a LOCAL transpose by design) and the
+    H2D push side's unflatten (``_from_flat`` — the engine's push jit
+    traces the SAME function, so the audited program cannot drift).
+
+    Contracts under machine check:
+
+    - **Donated swap-in buffer** (``dead-donation``): the pushed flat
+      master segment is dead once the param leaf is rebuilt; for an
+      identity-order dp-sharded leaf the unflatten is a pure bitcast and
+      the donated buffer MUST alias the output — a pad/concat/reshard
+      creeping into the push path surfaces as a hard finding (the
+      fused-optimizer-step discipline).
+    - **Zero-collective data path** (``expected_spmd`` empty, zero-byte
+      committed map): the whole point of the 2-D flat layout is that the
+      SPMD partitioner never rematerializes — a GSPMD-inserted collective
+      here means the layout contract regressed. (The per-leaf sq-norm
+      stat programs are scalar reductions outside this contract; they
+      all-reduce ~4 bytes by construction and run once per leaf.)
+    - **No host-sync prims in the traced bucket compute** (Layer B's
+      callback/sync walk): every fence in the pipeline is host-side
+      BETWEEN programs, never inside one."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
+
+    topo = topo_mod.initialize(TopologyConfig(data=-1), force=True)
+    mesh = topo.mesh
+    d = DATA_AXIS
+    # one dp-sharded matrix leaf + one replicated bias leaf — the two
+    # layout classes the offload flat machinery handles (a tp-sharded
+    # leaf adds an mp dim on the flat's second axis, same local-transpose
+    # argument); identity flat order for the matrix, so the push-side
+    # donation contract is checkable
+    lay_w = (0, (d,), None, ())
+    lay_b = (None, (), None, ())
+    shape_w, shape_b = (2048, 128), (128,)
+    wire = jnp.bfloat16
+
+    def bucket_step(grads, push_flat):
+        gw, gb = grads
+        flats = [DeepSpeedEngine._to_flat(gw, lay_w),
+                 DeepSpeedEngine._to_flat(gb, lay_b)]
+        new_w = DeepSpeedEngine._from_flat(push_flat, lay_w, shape_w, wire)
+        return flats, new_w
+
+    put = lambda x, *spec: jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    grads = (put(jnp.zeros(shape_w, wire), d),
+             put(jnp.zeros(shape_b, wire)))
+    push_flat = put(jnp.zeros(shape_w, wire), d)
+    args = (grads, push_flat)
+    w_sh = NamedSharding(mesh, P(d, None))
+    b_flat_sh = NamedSharding(mesh, P(None, None))
+    return EntrySpec(
+        name="offload-step-pipeline", fn=bucket_step, args=args,
+        donate_argnums=(1,), mesh=mesh, retrace_args=[args, args],
+        jit_kwargs=dict(
+            in_shardings=((grads[0].sharding, grads[1].sharding),
+                          push_flat.sharding),
+            out_shardings=([w_sh, b_flat_sh], w_sh)),
+        gate_cheap=True)
+
+
 def build_telemetry_off_parity() -> EntrySpec:
     """The telemetry zero-overhead contract (docs/OBSERVABILITY.md): the
     engine step entry point's jaxpr must be IDENTICAL with telemetry off
@@ -759,6 +828,7 @@ SPEC_BUILDERS: Dict[str, Callable[[], EntrySpec]] = {
     "quantized-transport": build_quantized_transport,
     "ragged-paged-attention": build_ragged_paged_attention,
     "fused-optimizer-step": build_fused_optimizer_step,
+    "offload-step-pipeline": build_offload_step_pipeline,
     "telemetry-off-parity": build_telemetry_off_parity,
     "guardian-step-parity": build_guardian_step_parity,
 }
@@ -804,8 +874,8 @@ ENTRY_POINTS: Dict[str, Callable[[], List[Finding]]] = {
 #: gate_cheap flag would boot engines; a test asserts the two agree.
 GATE_SPMD_ENTRY_POINTS: Tuple[str, ...] = (
     "fused-moe-dispatch", "fused-optimizer-step", "moe-dispatch",
-    "paged-decode", "quantized-transport", "ragged-paged-attention",
-    "ring-attention", "ulysses-attention")
+    "offload-step-pipeline", "paged-decode", "quantized-transport",
+    "ragged-paged-attention", "ring-attention", "ulysses-attention")
 
 
 def audit_entry_points(names=None) -> List[Finding]:

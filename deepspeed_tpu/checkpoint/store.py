@@ -243,11 +243,17 @@ def rollback_to_known_good(save_dir: str) -> Optional[str]:
 
 
 def write_staged(save_dir: str, tag: str, keys, host: Dict[str, np.ndarray],
-                 client_state: Dict[str, Any], save_latest: bool = True) -> None:
+                 client_state: Dict[str, Any], save_latest: bool = True,
+                 extra_checksums: Optional[Dict[str, int]] = None) -> None:
     """Write an already-staged (host-resident) single-process checkpoint:
     data, then meta.json (the commit record, carrying the data files'
     checksums), then — optionally — the ``latest`` repoint. The IO half
-    of a write-behind save; runs on the async engine's worker thread."""
+    of a write-behind save; runs on the async engine's worker thread.
+
+    ``extra_checksums`` folds sidecar data files written BEFORE this call
+    (the offload optimizer sidecar) into the commit record, so
+    ``verify_tag`` covers them: a tag whose sidecar was torn after commit
+    fails verification instead of loading half a master state."""
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
     # npz keys cannot contain some chars; index them
@@ -264,7 +270,7 @@ def write_staged(save_dir: str, tag: str, keys, host: Dict[str, np.ndarray],
         "dtypes": {k: str(host[k].dtype) for k in keys},
         "shapes": {k: list(host[k].shape) for k in keys},
         "num_shard_files": 0,
-        "checksums": {"state.npz": crc},
+        "checksums": {"state.npz": crc, **(extra_checksums or {})},
         "client_state": client_state,
     }
     _atomic_json(os.path.join(path, "meta.json"), meta)
@@ -273,12 +279,14 @@ def write_staged(save_dir: str, tag: str, keys, host: Dict[str, np.ndarray],
 
 
 def save_checkpoint(save_dir: str, tag: str, state, client_state: Dict[str, Any],
-                    save_latest: bool = True) -> None:
+                    save_latest: bool = True,
+                    extra_checksums: Optional[Dict[str, int]] = None) -> None:
     pcount = jax.process_count()
     if pcount == 1:
         keys, host = stage_state(state)
         write_staged(save_dir, tag, keys, host, client_state,
-                     save_latest=save_latest)
+                     save_latest=save_latest,
+                     extra_checksums=extra_checksums)
         return
     path = os.path.join(save_dir, tag)
     os.makedirs(path, exist_ok=True)
@@ -322,7 +330,7 @@ def save_checkpoint(save_dir: str, tag: str, state, client_state: Dict[str, Any]
             "dtypes": {k: str(np.dtype(flat[k].dtype)) for k in keys},
             "shapes": {k: list(np.shape(flat[k])) for k in keys},
             "num_shard_files": pcount,
-            "checksums": checksums,
+            "checksums": {**checksums, **(extra_checksums or {})},
             "client_state": client_state,
         }
         _atomic_json(os.path.join(path, "meta.json"), meta)
@@ -358,7 +366,10 @@ def verify_tag(path: str) -> Tuple[bool, str]:
     checksums = meta.get("checksums") or {}
     scan = os.environ.get("DSTPU_CKPT_VERIFY", "1").strip().lower() \
         not in ("0", "off", "false")
-    for fn in files:
+    # sidecar data files (offload optimizer state) committed through
+    # extra_checksums are part of the contract too: a load needs them
+    sidecars = [fn for fn in checksums if fn not in files]
+    for fn in files + sidecars:
         fp = os.path.join(path, fn)
         if not os.path.exists(fp):
             return False, f"missing data file {fn}"

@@ -755,8 +755,24 @@ class DeepSpeedEngine:
     # overlaps the previous chunk's CPU step — double-buffered)
     _OFFLOAD_CHUNK_ELEMS = 4 << 20
 
+    def _offload_bucket_elems(self) -> int:
+        """Effective offload bucket/chunk size in ELEMENTS: the fused-buffer
+        planner's ``reduce_bucket_size`` discipline (overlap.py binds the
+        same knob for collective launches) bounded by the streaming default
+        — an explicit smaller ``reduce_bucket_size`` shrinks the offload
+        buckets with it, so one knob governs both tiers. Chunk boundaries
+        are a CHECKPOINT LAYOUT contract (m/v state is chunked), so this is
+        resolved once and recorded in the sidecar."""
+        zc = self.config.zero_config
+        rb = int(getattr(zc, "reduce_bucket_size", 0) or 0)
+        eff = self._OFFLOAD_CHUNK_ELEMS
+        if rb > 0:
+            eff = min(eff, rb)
+        return max(1, eff)
+
     def _chunked(self, a: np.ndarray):
-        c = self._OFFLOAD_CHUNK_ELEMS
+        c = getattr(self, "_offload_chunk_elems", None) \
+            or self._offload_bucket_elems()
         return [a[i:i + c] for i in range(0, max(a.size, 1), c)]
 
     def _offload_ckpt_path(self, dirname: str) -> str:
@@ -939,8 +955,48 @@ class DeepSpeedEngine:
         local_master = (np.concatenate(pieces) if pieces
                         else np.zeros(0, np.float32))
         # chunk the local segment so NVMe paging streams fixed-size blocks
-        # (chunk i+1's read overlaps chunk i's CPU step)
+        # (chunk i+1's read overlaps chunk i's CPU step); resolved ONCE —
+        # the chunk layout is a checkpoint contract
+        self._offload_chunk_elems = self._offload_bucket_elems()
         chunks = self._chunked(local_master)
+        # -- pipelined-schedule metadata (ISSUE 15): per-leaf span ranges
+        # and leaf-bucket fetch groups. Spans are recorded per leaf in
+        # order, so a bucket (a contiguous leaf run) is a contiguous span
+        # run — the prefix property the chunk feed relies on. Grouping
+        # rides the overlap.py fused-buffer planner: small leaves pack
+        # greedily under the bucket, at-cap leaves stand alone.
+        from .zero.partition import plan_comm_buckets
+        self._offload_leaf_spans = []
+        s = 0
+        for k in range(len(host_idx)):
+            e = s
+            while e < len(self._offload_spans) and \
+                    self._offload_spans[e][0] == k:
+                e += 1
+            self._offload_leaf_spans.append((s, e))
+            s = e
+        local_sizes = [sum(int(np.prod(self._offload_spans[j][2]))
+                           for j in range(a, b))
+                       for a, b in self._offload_leaf_spans]
+        entries, _ = plan_comm_buckets(
+            local_sizes, ["offload"] * len(local_sizes),
+            [1] * len(local_sizes), self._offload_chunk_elems)
+        # the planner may pack around a standalone at-cap leaf; the feed
+        # needs CONTIGUOUS leaf runs (runner chunks consume a prefix), so
+        # split each bucket at discontinuities and order by first leaf
+        runs = []
+        for e in entries:
+            ls = sorted(e.leaves)
+            run = [ls[0]]
+            for x in ls[1:]:
+                if x == run[-1] + 1:
+                    run.append(x)
+                else:
+                    runs.append(run)
+                    run = [x]
+            runs.append(run)
+        runs.sort(key=lambda r: r[0])
+        self._offload_fetch_buckets = runs
 
         opt_cfg = self.config.optimizer
         self._offload = OffloadedOptimizerRunner(
@@ -2335,22 +2391,120 @@ class DeepSpeedEngine:
             return jax.jit(stat)
         return self._offload_jit("stat", (shape, str(dtype), fp16), build)
 
+    @staticmethod
+    def _from_flat(f, lay, shape, dtype):
+        """Inverse of :meth:`_to_flat`: 2-D flat → the leaf's own shape,
+        cast to the param dtype. The single statement of the unflatten
+        math — the push jit and the ``offload-step-pipeline`` lint entry
+        both trace THIS function, so the audited program cannot drift
+        from production."""
+        if len(shape) == 0:
+            a = f.reshape(())
+        else:
+            dp_dim, _, mp_dim, _ = lay
+            order = DeepSpeedEngine._flat_order(len(shape), dp_dim, mp_dim)
+            a = f.reshape(tuple(shape[d] for d in order))
+            a = a.transpose([order.index(d) for d in range(len(shape))])
+        return a.astype(dtype)
+
     def _unflat_leaf_jit(self, lay, shape, sharding):
         dtype = self.param_dtype
 
         def build():
-            def unflat(f):
-                if len(shape) == 0:
-                    a = f.reshape(())
-                else:
-                    dp_dim, _, mp_dim, _ = lay
-                    order = self._flat_order(len(shape), dp_dim, mp_dim)
-                    a = f.reshape(tuple(shape[d] for d in order))
-                    a = a.transpose([order.index(d)
-                                     for d in range(len(shape))])
-                return a.astype(dtype)
-            return jax.jit(unflat, out_shardings=sharding)
+            # DONATE the pushed flat buffer when the unflatten is a pure
+            # reshape (identity order) and the push dtype matches: the
+            # swap-in buffer is dead after this program, and the alias
+            # lets XLA build the new param leaf in place (machine-checked
+            # dead-donation in the offload-step-pipeline lint entry). A
+            # transposing layout cannot alias — no donation there.
+            dp_dim, _, mp_dim, mp_axes = lay
+            order = self._flat_order(max(len(shape), 1), dp_dim, mp_dim)
+            # inputs arrive pre-cast to the param dtype (push_dt), so the
+            # unflat is a pure bitcast when the order is identity AND the
+            # in/out shardings agree (a ZeRO-3 dp-sharded matrix leaf —
+            # the out-of-core production case). Replicated-param stages
+            # reshard on the way out and cannot alias; donating there
+            # only buys a 'donation unusable' warning per leaf.
+            donate = False
+            if (order == list(range(max(len(shape), 1))) and not mp_axes
+                    and len(shape) == 2):
+                fsh = NamedSharding(self.mesh, self._flat2_sharding_spec(lay))
+                try:
+                    donate = sharding.is_equivalent_to(fsh, 2)
+                except (TypeError, ValueError):
+                    donate = False
+            return jax.jit(lambda f: self._from_flat(f, lay, shape, dtype),
+                           out_shardings=sharding,
+                           donate_argnums=(0,) if donate else ())
         return self._offload_jit("unflat", (lay, shape, str(sharding)), build)
+
+    def _offload_grad_feed(self, leaves, mult, ph, grad_buf, span_offs,
+                           span_lens, chunk_bounds):
+        """Lazily yield runner grad chunks as their D2H transfers land —
+        the fetch half of the double-buffered offload pipeline (ISSUE 15).
+
+        Bucket k+1's flatten programs and async host copies are ISSUED
+        before the blocking landing of bucket k (``copy_to_host_async``
+        starts the wire transfer; the later ``device_get`` merely
+        completes it), so at most two buckets of flat grad copies are
+        device-resident and the landing wait — charged to the
+        ``h2d_prefetch`` phase — shrinks toward transfer-minus-compute.
+        The runner pulls chunks between bucket computes, which is what
+        puts bucket k's host step under bucket k+1's wire time."""
+        import time as _time
+        host_idx = self._offload_host_idx
+        layouts = self._offload_layouts
+        buckets = self._offload_fetch_buckets
+        staged: Dict[int, list] = {}
+
+        def issue(bk):
+            for k in buckets[bk]:
+                i = host_idx[k]
+                if self._offload_direct[k]:
+                    datas = [leaves[i]]
+                else:
+                    flat = self._flat_leaf_jit(
+                        leaves[i].shape, leaves[i].dtype, layouts[k],
+                        self._offload_flat_shardings[k])(leaves[i])
+                    datas = [d for _, _, d in self._leaf_local_groups(flat)]
+                for d in datas:
+                    try:
+                        d.copy_to_host_async()
+                    except AttributeError:
+                        pass  # older jaxlib: device_get still lands it
+                staged[k] = datas
+
+        filled = 0
+        next_chunk = 0
+        if buckets:
+            issue(0)
+        for bk in range(len(buckets)):
+            if bk + 1 < len(buckets):
+                issue(bk + 1)  # next bucket's wire time under this landing
+            t0 = _time.perf_counter()
+            for k in buckets[bk]:
+                datas = staged.pop(k)
+                got = jax.device_get(datas)
+                s0, s1 = self._offload_leaf_spans[k]
+                for j, p in zip(range(s0, s1), got):
+                    seg = grad_buf[span_offs[j]:span_offs[j] + span_lens[j]]
+                    seg[...] = np.asarray(p, np.float32).reshape(-1)
+                    if mult != 1.0:
+                        np.multiply(seg, np.float32(mult), out=seg)
+                filled = span_offs[s1 - 1] + span_lens[s1 - 1] \
+                    if s1 > s0 else filled
+                del got, datas
+            ph["h2d_prefetch"] += _time.perf_counter() - t0
+            while next_chunk < len(chunk_bounds) \
+                    and chunk_bounds[next_chunk][1] <= filled:
+                a, b = chunk_bounds[next_chunk]
+                next_chunk += 1
+                yield grad_buf[a:b]
+        # tail: everything has landed (zero-size locals land here too)
+        while next_chunk < len(chunk_bounds):
+            a, b = chunk_bounds[next_chunk]
+            next_chunk += 1
+            yield grad_buf[a:b]
 
     def _apply_step_offload(self, lr: float):
         """Optimizer boundary on the host (ZeRO-Offload): fetch the LOCAL
@@ -2359,7 +2513,14 @@ class DeepSpeedEngine:
         happen on the host), native CPU optimizer on the local master
         segment (NVMe chunks stream through the pipelined swapper), then
         scatter the updated master back into the sharded param tree, one
-        small program per leaf (see _offload_jit)."""
+        small program per leaf (see _offload_jit).
+
+        Since ISSUE 15 the three streams run as a double-buffered
+        leaf-bucket pipeline (fetch of bucket k+1 under host compute of
+        bucket k, pushes async behind both — docs/OFFLOAD.md);
+        ``DSTPU_OFFLOAD_PIPELINE=0`` restores the serial barrier
+        schedule bitwise. Either way the step records the 4-way stall
+        decomposition in ``last_offload_phase_s``."""
         host_idx = self._offload_host_idx
         dev_idx = self._offload_device_idx
         dev_names = [self._offload_leaf_names[i] for i in dev_idx]
@@ -2448,34 +2609,68 @@ class DeepSpeedEngine:
                             dev_grads, self.state["opt"],
                             jnp.asarray(lr, jnp.float32),
                             jnp.asarray(mult, jnp.float32))
-            # flatten -> pull -> RELEASE one leaf at a time (same memory
-            # argument as the init fetch: all flat grad copies at once is a
-            # third model-size on a chip already holding two; direct leaves
-            # move raw with no device transient at all); widen to fp32 and
-            # apply unscale x clip HOST-side
-            pieces = []
-            with self.mesh:
-                for k, (i, lay, sh) in enumerate(zip(
-                        host_idx, layouts, self._offload_flat_shardings)):
-                    if self._offload_direct[k]:
-                        pieces.append(np.asarray(
-                            jax.device_get(leaves[i]),
-                            np.float32).reshape(-1))
-                        continue
-                    flat = self._flat_leaf_jit(
-                        leaves[i].shape, leaves[i].dtype, lay, sh)(leaves[i])
-                    datas = [d for _, _, d in self._leaf_local_groups(flat)]
-                    pieces.extend(np.asarray(p, np.float32).reshape(-1)
-                                  for p in jax.device_get(datas))
-                    del flat, datas
-            if mult != 1.0:
-                for j, pc in enumerate(pieces):
-                    if pc.flags.writeable:
-                        np.multiply(pc, np.float32(mult), out=pc)
-                    else:  # zero-copy device_get views are read-only
-                        pieces[j] = pc * np.float32(mult)
-            local_grad = (np.concatenate(pieces) if pieces
-                          else np.zeros(0, np.float32))
+            # Grad fetch (device → host). Two schedules (ISSUE 15):
+            #
+            # - PIPELINED (default): the chunk feed below issues bucket
+            #   k+1's flatten programs + async host copies before blocking
+            #   on bucket k, so the landing wait overlaps the host step of
+            #   the previous bucket. At most two buckets of flat copies
+            #   are device-resident (double buffer) — the per-leaf memory
+            #   argument still holds, bounded by the bucket size.
+            # - SERIAL (DSTPU_OFFLOAD_PIPELINE=0): flatten → pull →
+            #   RELEASE one leaf at a time, every leaf fetched before any
+            #   host compute (the pre-ISSUE-15 schedule, kept BITWISE —
+            #   same chunk boundaries, same arithmetic order). Direct
+            #   leaves move raw with no device transient at all. fp32
+            #   widening and unscale × clip happen HOST-side either way.
+            from .zero.offload_optimizer import offload_pipeline_enabled
+            import time as _time
+            pipelined = offload_pipeline_enabled()
+            ph = {"h2d_prefetch": 0.0, "bucket_compute": 0.0,
+                  "d2h_writeback": 0.0, "nvme_io": 0.0}
+            span_lens = [int(np.prod(sh))
+                         for _, _, sh, _ in self._offload_spans]
+            span_offs = []
+            off = 0
+            for ln in span_lens:
+                span_offs.append(off)
+                off += ln
+            total_local = off
+            if pipelined:
+                grad_buf = np.empty(total_local, np.float32)
+                c = self._offload_chunk_elems
+                chunk_bounds = [(a, min(a + c, total_local))
+                                for a in range(0, max(total_local, 1), c)]
+                grad_feed = self._offload_grad_feed(
+                    leaves, mult, ph, grad_buf, span_offs, span_lens,
+                    chunk_bounds)
+            else:
+                _t0 = _time.perf_counter()
+                pieces = []
+                with self.mesh:
+                    for k, (i, lay, sh) in enumerate(zip(
+                            host_idx, layouts, self._offload_flat_shardings)):
+                        if self._offload_direct[k]:
+                            pieces.append(np.asarray(
+                                jax.device_get(leaves[i]),
+                                np.float32).reshape(-1))
+                            continue
+                        flat = self._flat_leaf_jit(
+                            leaves[i].shape, leaves[i].dtype, lay, sh)(leaves[i])
+                        datas = [d for _, _, d in self._leaf_local_groups(flat)]
+                        pieces.extend(np.asarray(p, np.float32).reshape(-1)
+                                      for p in jax.device_get(datas))
+                        del flat, datas
+                if mult != 1.0:
+                    for j, pc in enumerate(pieces):
+                        if pc.flags.writeable:
+                            np.multiply(pc, np.float32(mult), out=pc)
+                        else:  # zero-copy device_get views are read-only
+                            pieces[j] = pc * np.float32(mult)
+                local_grad = (np.concatenate(pieces) if pieces
+                              else np.zeros(0, np.float32))
+                grad_feed = self._chunked(local_grad)
+                ph["h2d_prefetch"] = _time.perf_counter() - _t0
             # the OLD params are dead from here on (their gradients are
             # consumed, their replacement is rebuilt from the host master
             # and dev_params): drop the tree BEFORE the first push so the
@@ -2498,22 +2693,18 @@ class DeepSpeedEngine:
             push_dt = np.dtype(self.param_dtype)
             param_sh_leaves = jax.tree.leaves(self._param_shardings)
             outs = [None] * len(self._offload_full_shapes)
-            span_offs = []
-            off = 0
-            for _, _, pshape, _ in self._offload_spans:
-                span_offs.append(off)
-                off += int(np.prod(pshape))
-            master_buf = np.empty(off, np.float32)
+            master_buf = np.empty(total_local, np.float32)
             done = 0
             next_span = 0
 
             def _flush_spans(limit):
                 nonlocal next_span
+                t0 = _time.perf_counter()
                 while next_span < len(self._offload_spans):
                     leaf_idx, _, pshape, devices = \
                         self._offload_spans[next_span]
                     o = span_offs[next_span]
-                    length = int(np.prod(pshape))
+                    length = span_lens[next_span]
                     if o + length > limit:
                         break
                     seg = master_buf[o:o + length]
@@ -2529,15 +2720,19 @@ class DeepSpeedEngine:
                                            d)
                             for d in devices)
                     next_span += 1
+                # dispatch wall of the async H2D pushes (device_put returns
+                # before the copy completes — the transfer itself rides
+                # under the next bucket's paging + CPU step)
+                ph["d2h_writeback"] += _time.perf_counter() - t0
 
             with self.mesh:
-                for _, mchunk in self._offload.step_iter(
-                        self._chunked(local_grad), lr=lr):
+                for _, mchunk in self._offload.step_iter(grad_feed, lr=lr):
                     flat = np.asarray(mchunk).reshape(-1)
                     master_buf[done:done + flat.size] = flat
                     done += flat.size
                     _flush_spans(done)
                 _flush_spans(done)
+                t0 = _time.perf_counter()
                 for leaf_idx, arrs in per_leaf.items():
                     flat = jax.make_array_from_single_device_arrays(
                         self._offload_flat_shapes[leaf_idx],
@@ -2547,11 +2742,23 @@ class DeepSpeedEngine:
                         layouts[leaf_idx], self._offload_shapes[leaf_idx],
                         param_sh_leaves[i])(flat)
                     del flat
+                ph["d2h_writeback"] += _time.perf_counter() - t0
             # paging-stall visibility: seconds the host step spent BLOCKED
             # on NVMe fences (0 for device=cpu), and its total wall time —
-            # the bench reports stall_frac from these
+            # the bench reports stall_frac from these. The 4-way phase
+            # split (docs/OBSERVABILITY.md "Offload stall decomposition")
+            # is the honest decomposition the pipeline is judged by.
+            if pipelined:
+                # the feed charged its landing waits as it ran; fold in
+                # any residual pull-wait the runner saw on top of them
+                ph["h2d_prefetch"] = max(ph["h2d_prefetch"],
+                                         self._offload.last_fetch_s)
+            ph["bucket_compute"] = self._offload.last_compute_s
+            ph["nvme_io"] = self._offload.last_stall_s
             self.last_offload_stall_s = self._offload.last_stall_s
             self.last_offload_compute_s = self._offload.last_compute_s
+            self.last_offload_phase_s = dict(ph)
+            self.telemetry.record_offload_phases(self.global_steps, ph)
             for n, i in zip(dev_names, dev_idx):
                 outs[i] = dev_params[n]
             self.state["params"] = jax.tree.unflatten(
@@ -2617,6 +2824,13 @@ class DeepSpeedEngine:
         with self.telemetry.phase("paged_step", phase="step",
                                   step=self.global_steps):
             loss = self._param_stream.train_step(dev, lr)
+        # paged-path stall decomposition (ISSUE 15): device-side waits on
+        # host futures (the pipeline interlock) and main-thread waits on
+        # NVMe read futures — both already accumulated by the runner
+        self.telemetry.record_offload_phases(self.global_steps, {
+            "h2d_prefetch": self._param_stream.last_fetch_wait_s,
+            "nvme_io": getattr(self._param_stream, "last_nvme_wait_s", 0.0),
+        })
         self.micro_steps += gas
         self.global_steps += 1
         fault_point("step_end", step=self.global_steps)
@@ -2827,7 +3041,13 @@ class DeepSpeedEngine:
             return
         nvme = self._param_offload_device == "nvme"
         swapper = self._param_swapper
-        if nvme:  # prefetch everything; reads overlap the rebuild below
+        if nvme:
+            # prefetch everything. Pipelined (ISSUE 15) the swapper lands
+            # the bulk read in byte-bounded GROUPS on its worker queue, so
+            # each get() below blocks only on its own group and the H2D
+            # device_put dispatch of group k overlaps group k+1's disk
+            # reads; serial mode keeps the single-queue prefetch (the
+            # first get drains it whole — one handle, one wait).
             swapper.swap_in([n for m in self._pcache["meta"]
                              for n, _ in m["pieces"]], async_op=True)
         leaves = []
@@ -2966,14 +3186,14 @@ class DeepSpeedEngine:
                 # sidecar FIRST: meta.json (inside write_staged) is the
                 # commit record — a tag whose meta verifies must have
                 # every file a load needs, or the corrupt-`latest`
-                # fallback could select a half-written tag
-                if sidecar is not None:
-                    from ..checkpoint.store import _atomic_savez
-                    os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
-                    _atomic_savez(self._offload_ckpt_path(
-                        os.path.join(save_dir, tag)), sidecar)
+                # fallback could select a half-written tag. Its crc32
+                # rides the commit record (extra_checksums) so the
+                # CRC-verified-load contract covers the offload master
+                # state, not just the device tree.
+                extra = (self._write_offload_sidecar(save_dir, tag, sidecar)
+                         if sidecar is not None else None)
                 write_staged(save_dir, tag, keys, host, client_state,
-                             save_latest=False)
+                             save_latest=False, extra_checksums=extra)
                 if save_latest:
                     write_latest(save_dir, tag)
                 if pin_clean:
@@ -2991,23 +3211,48 @@ class DeepSpeedEngine:
             # any instruction leaves either an uncommitted tag or a
             # complete one, never a committed tag missing its sidecar
             # (the corrupt-`latest` fallback trusts committed tags)
-            if self._offload is not None:
-                from ..checkpoint.store import _atomic_savez
-                os.makedirs(os.path.join(save_dir, tag), exist_ok=True)
-                _atomic_savez(self._offload_ckpt_path(
-                    os.path.join(save_dir, tag)),
-                    self._offload_sidecar_arrays())
-                if jax.process_count() > 1:
-                    from .. import comm as dist
-                    dist.barrier()  # every rank's sidecar before commit
+            extra = (self._write_offload_sidecar(
+                         save_dir, tag, self._offload_sidecar_arrays())
+                     if self._offload is not None else None)
             _save(save_dir, tag, self.state, client_state,
-                  save_latest=save_latest)
+                  save_latest=save_latest, extra_checksums=extra)
             if jax.process_index() == 0:
                 if save_latest and self._guardian is not None and \
                         self._guardian.pin_ready():
                     self._pin_known_good(save_dir, tag)
                 self._retire_old_checkpoints(save_dir, tag)
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+
+    def _write_offload_sidecar(self, save_dir: str, tag: str,
+                               arrays) -> Optional[Dict[str, int]]:
+        """Write this process's offload sidecar atomically and return the
+        checksums to fold into the commit record — ONE definition for the
+        sync and async-staged save paths, so their durability contracts
+        cannot drift. Multi-host: every rank drops a ``.crc`` sidecar
+        next to its file and rank 0 folds them post-barrier (the
+        ``state.rank*.npz`` precedent in checkpoint/store.py), so
+        ``verify_tag`` covers every rank's master state, not just this
+        host's."""
+        from ..checkpoint.store import _atomic_savez, _atomic_text
+        tag_dir = os.path.join(save_dir, tag)
+        os.makedirs(tag_dir, exist_ok=True)
+        spath = self._offload_ckpt_path(tag_dir)
+        crc = _atomic_savez(spath, arrays)
+        if jax.process_count() == 1:
+            return {os.path.basename(spath): crc}
+        from .. import comm as dist
+        _atomic_text(spath + ".crc", str(crc))
+        dist.barrier()  # every rank's sidecar + crc before the commit
+        if jax.process_index() != 0:
+            return None
+        extra = {}
+        for p in range(jax.process_count()):
+            fn = f"offload_optimizer.rank{p}.npz"
+            cp = os.path.join(tag_dir, fn + ".crc")
+            with open(cp) as f:
+                extra[fn] = int(f.read().strip())
+            os.remove(cp)
+        return extra
 
     def _pin_known_good(self, save_dir: str, tag: str, step=None,
                         stats=None) -> None:
@@ -3271,7 +3516,7 @@ class DeepSpeedEngine:
             names=np.array(self._offload_names),
             sizes=np.array(lay["sizes"], np.int64),
             total=lay["total"],
-            chunk_elems=self._OFFLOAD_CHUNK_ELEMS,
+            chunk_elems=self._offload_chunk_elems,
             # per-leaf 2-D flat form: dp dim first, model dim (if
             # any) major of the second (-1 = absent)
             shard_dims=np.array(
@@ -3340,11 +3585,11 @@ class DeepSpeedEngine:
                     "load_optimizer_states=False, or extract fp32 weights "
                     "with the version that wrote it")
             saved_chunk = int(z["chunk_elems"]) if "chunk_elems" in z else None
-            if saved_chunk != self._OFFLOAD_CHUNK_ELEMS:
+            if saved_chunk is None:
                 raise ValueError(
-                    f"offload checkpoint chunk size {saved_chunk} != "
-                    f"current {self._OFFLOAD_CHUNK_ELEMS}; the m/v state "
-                    "layout is chunked — load with the same chunk size")
+                    "offload checkpoint records no chunk_elems — the m/v "
+                    "state layout is chunked and cannot be parsed; re-save "
+                    "with a current version")
             starts = np.asarray(z["span_starts"])
             if starts.ndim == 1:
                 # legacy 1-D flat layout (pure-dp): element offset ->
@@ -3370,12 +3615,38 @@ class DeepSpeedEngine:
                     f"host/device layout (spans {saved[:3]}... vs "
                     f"{cur[:3]}...); per-host segments must match")
             master, state = z["master_flat"], z["state_flat"]
-            masters = self._chunked(master)
-            states, off = [], 0
             slots = self._offload._slots
-            for m in masters:
-                states.append(state[off:off + m.size * slots])
-                off += m.size * slots
+            if saved_chunk != self._offload_chunk_elems:
+                # RE-CHUNK a tag written at a different chunk size (e.g. a
+                # pre-reduce_bucket_size-binding checkpoint, or the knob
+                # changed): state_flat is per-SAVED-chunk [m|v] blocks, so
+                # rebuild the full per-slot vectors and re-split at the
+                # current boundaries — the master itself is one flat concat
+                # either way
+                log_dist(
+                    f"offload checkpoint chunk size {saved_chunk} != "
+                    f"current {self._offload_chunk_elems}; re-chunking the "
+                    "m/v state (docs/OFFLOAD.md)", ranks=[0])
+                full = [np.empty(master.size, state.dtype)
+                        for _ in range(slots)]
+                off = 0
+                for a in range(0, max(master.size, 1), saved_chunk):
+                    ln = min(saved_chunk, master.size - a)
+                    for s in range(slots):
+                        full[s][a:a + ln] = state[off:off + ln]
+                        off += ln
+                masters = self._chunked(np.asarray(master))
+                states, a = [], 0
+                for m in masters:
+                    states.append(np.concatenate(
+                        [full[s][a:a + m.size] for s in range(slots)]))
+                    a += m.size
+            else:
+                masters = self._chunked(master)
+                states, off = [], 0
+                for m in masters:
+                    states.append(state[off:off + m.size * slots])
+                    off += m.size * slots
             self._offload.load_state_dict({
                 "step": int(z["step"]), "master": masters, "state": states,
             })

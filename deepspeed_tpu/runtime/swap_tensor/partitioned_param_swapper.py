@@ -14,6 +14,7 @@ the engine's ``offload_param_cache``/``reload_param_cache`` phase flips
 from __future__ import annotations
 
 import os
+from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
 import numpy as np
@@ -24,7 +25,8 @@ from ...ops.aio import AsyncIOHandle
 class AsyncPartitionedParameterSwapper:
 
     def __init__(self, swap_dir: str, block_size: int = 1 << 20,
-                 num_threads: int = 2, pool_bytes: int = 1 << 30):
+                 num_threads: int = 2, pool_bytes: int = 1 << 30,
+                 read_group_bytes: int = 16 << 20):
         os.makedirs(swap_dir, exist_ok=True)
         self.swap_dir = swap_dir
         self.aio = AsyncIOHandle(block_size=block_size, num_threads=num_threads)
@@ -43,6 +45,29 @@ class AsyncPartitionedParameterSwapper:
         self._free: Dict[int, List[np.ndarray]] = {}
         self._free_bytes = 0
         self._pool_owned: Set[str] = set()
+        # ISSUE 15 worker queue: in pipelined mode ONE worker thread owns
+        # the AIO handle and swap_in splits its name list into byte-bounded
+        # GROUPS, one read task each — ``get(name)`` then waits only on
+        # that name's group future, so a bulk prefetch
+        # (engine.reload_param_cache) lands incrementally: the H2D
+        # dispatch of group k overlaps group k+1's disk reads instead of
+        # the first ``get`` draining the whole queue (one handle: a plain
+        # ``wait()`` is all-or-nothing). DSTPU_OFFLOAD_PIPELINE=0 keeps
+        # every AIO call on the caller's thread — the pre-ISSUE-15
+        # schedule.
+        self.read_group_bytes = int(read_group_bytes)
+        self._read_futs: Dict[str, Future] = {}
+        self._exec: Optional[ThreadPoolExecutor] = None
+        try:
+            # lazy import: swap_tensor/__init__ imports this module while
+            # zero.offload_optimizer (the gate's home) imports swap_tensor
+            from ..zero.offload_optimizer import offload_pipeline_enabled
+            pipelined = offload_pipeline_enabled()
+        except ImportError:  # partial-init corner during package import
+            pipelined = False
+        if pipelined:
+            self._exec = ThreadPoolExecutor(max_workers=1,
+                                            thread_name_prefix="pswap-io")
 
     def _path(self, name: str) -> str:
         return os.path.join(self.swap_dir, f"param_{name}.swp")
@@ -58,7 +83,13 @@ class AsyncPartitionedParameterSwapper:
         any read of ``name`` (or ``synchronize_writes``) fences first."""
         value = np.ascontiguousarray(value)
         self._meta[name] = (value.shape, value.dtype)
-        self.aio.async_pwrite(value.reshape(-1), self._path(name))
+        if self._exec is not None:
+            # worker owns the handle; async_pwrite only queues, so the
+            # result() here is a sub-ms hop, not an IO wait
+            self._exec.submit(self.aio.async_pwrite, value.reshape(-1),
+                              self._path(name)).result()
+        else:
+            self.aio.async_pwrite(value.reshape(-1), self._path(name))
         self._pending_writes.add(name)
         # the caller's array replaces (or evicts) any pooled buffer under
         # this name; ownership ends here — the old buffer may still back a
@@ -72,9 +103,12 @@ class AsyncPartitionedParameterSwapper:
     def synchronize_writes(self) -> None:
         """Fence every queued write (reference ``synchronize_writes``)."""
         if self._pending_writes:
-            self.aio.wait()
+            if self._exec is not None:
+                self._exec.submit(self.aio.wait).result()
+            else:
+                self.aio.wait()
+                self._inflight.clear()  # wait() drains reads too (one handle)
             self._pending_writes.clear()
-            self._inflight.clear()  # wait() drains reads too (one handle)
 
     def _take_buffer(self, count: int, dtype) -> np.ndarray:
         """Flat typed buffer, reusing a pooled one of the exact byte size."""
@@ -86,10 +120,52 @@ class AsyncPartitionedParameterSwapper:
             return raw.view(dtype)
         return np.empty(count, dtype=dtype)
 
+    def _read_group(self, bufs: Dict[str, np.ndarray]) -> None:
+        """Worker task: land one group's reads. The leading ``wait()``
+        fences every previously-queued write (FIFO worker: a swap_out
+        task queued earlier has already submitted its pwrite), so a read
+        can never observe its own shard's torn write-back."""
+        self.aio.wait()
+        for name, buf in bufs.items():
+            self.aio.async_pread(buf, self._path(name))
+        self.aio.wait()
+
     def swap_in(self, names: List[str], async_op: bool = True) -> None:
         """Begin paging shards in (reference ``swap_in`` with prefetch).
         Buffers come from the bounded pool — a shard released after use
-        donates its buffer to the next swap_in of the same size."""
+        donates its buffer to the next swap_in of the same size.
+
+        Pipelined mode splits ``names`` into ``read_group_bytes``-bounded
+        groups, one worker task each, so a bulk prefetch completes
+        INCREMENTALLY: consumers calling :meth:`get` in order overlap
+        their own work with the later groups' disk reads."""
+        if self._exec is not None:
+            group: Dict[str, np.ndarray] = {}
+            gbytes = 0
+
+            def flush():
+                nonlocal group, gbytes
+                if group:
+                    fut = self._exec.submit(self._read_group, group)
+                    for n in group:
+                        self._read_futs[n] = fut
+                    group, gbytes = {}, 0
+
+            for name in names:
+                if name in self._resident:
+                    continue
+                shape, dtype = self._meta[name]
+                buf = self._take_buffer(int(np.prod(shape)), dtype)
+                self._resident[name] = buf.reshape(shape)
+                self._pool_owned.add(name)
+                group[name] = buf
+                gbytes += buf.nbytes
+                if gbytes >= self.read_group_bytes:
+                    flush()
+            flush()
+            if not async_op:
+                self.synchronize_reads()
+            return
         if self._pending_writes.intersection(names):
             self.synchronize_writes()
         for name in names:
@@ -105,13 +181,28 @@ class AsyncPartitionedParameterSwapper:
             self.synchronize_reads()
 
     def synchronize_reads(self) -> None:
+        if self._exec is not None:
+            futs, self._read_futs = set(self._read_futs.values()), {}
+            for fut in futs:
+                fut.result()
+            return
         if self._inflight:
             self.aio.wait()
             self._inflight.clear()
             self._pending_writes.clear()  # one handle: wait() drains all
 
     def get(self, name: str) -> np.ndarray:
-        """Resident view of a shard; fetches synchronously if paged out."""
+        """Resident view of a shard; fetches synchronously if paged out.
+        Pipelined mode blocks only on the shard's OWN group future."""
+        if self._exec is not None:
+            fut = self._read_futs.pop(name, None)
+            if fut is not None:
+                fut.result()
+            if name not in self._resident:
+                self.swap_in([name], async_op=False)
+            elif name in self._pending_writes:
+                self.synchronize_writes()
+            return self._resident[name]
         if name not in self._resident:
             self.swap_in([name], async_op=False)
         elif name in self._inflight or name in self._pending_writes:
@@ -133,11 +224,17 @@ class AsyncPartitionedParameterSwapper:
             return
         self._pool_owned.discard(name)
         if not donate:
+            self._read_futs.pop(name, None)
             return
         if name in self._inflight:
             # the AIO worker is still writing into this buffer — recycling
             # it now would hand the next swap_in a buffer being mutated
             self.synchronize_reads()
+        fut = self._read_futs.pop(name, None)
+        if fut is not None:
+            # same hazard, worker-queue form: the group's pread may still
+            # be landing into this buffer
+            fut.result()
         raw = np.ascontiguousarray(arr).reshape(-1).view(np.uint8)
         if self._free_bytes + raw.nbytes <= self.pool_bytes:
             self._free.setdefault(raw.nbytes, []).append(raw)
@@ -151,5 +248,8 @@ class AsyncPartitionedParameterSwapper:
         return sum(len(v) for v in self._free.values())
 
     def close(self) -> None:
+        self.synchronize_reads()
         self.synchronize_writes()
+        if self._exec is not None:
+            self._exec.shutdown(wait=True)
         self.aio.close()

@@ -27,6 +27,17 @@ from ...ops.adam.cpu_adam import DeepSpeedCPUAdagrad, DeepSpeedCPUAdam, DeepSpee
 from ..swap_tensor.optimizer_swapper import OptimizerStateSwapper
 
 
+def offload_pipeline_enabled() -> bool:
+    """The ISSUE-15 double-buffered offload pipeline's kill switch:
+    ``DSTPU_OFFLOAD_PIPELINE=0`` restores the serial
+    fetch→compute→writeback schedule BITWISE (the pipeline only reorders
+    independent transfers — same chunk boundaries, same arithmetic order
+    — so the hatch is a schedule A/B, not a numerics A/B; a CPU-mesh
+    parity test pins the bitwise claim)."""
+    return os.environ.get("DSTPU_OFFLOAD_PIPELINE", "").strip() not in (
+        "0", "off", "false")
+
+
 class OffloadedOptimizerRunner:
 
     def __init__(self, opt_type: str, opt_params: Dict, leaves: List[np.ndarray],
@@ -39,6 +50,9 @@ class OffloadedOptimizerRunner:
         self.step_count = 0
         self.last_stall_s = 0.0    # NVMe fence-blocked time of the last step
         self.last_compute_s = 0.0  # host optimizer wall time of the last step
+        self.last_fetch_s = 0.0    # time blocked pulling grads from a LAZY
+        # feed (engine pipeline: the D2H landing of the next bucket) — kept
+        # out of last_compute_s so the stall decomposition stays honest
 
         lr = opt_params.get("lr", 1e-3)
         wd = opt_params.get("weight_decay", 0.0)
@@ -107,14 +121,22 @@ class OffloadedOptimizerRunner:
             pass
         return self.master
 
-    def step_iter(self, grads: List[np.ndarray], lr: Optional[float] = None):
+    def step_iter(self, grads, lr: Optional[float] = None):
         """Generator form of :meth:`step`: yields ``(i, master_i)`` as each
         chunk's update lands, so the caller can begin the H2D param push of
         completed chunks WHILE later chunks are still paging/stepping (the
         reference's overlap of optimizer work with adjacent phases,
-        stage_1_and_2.py:1005 — here host compute overlaps device upload)."""
+        stage_1_and_2.py:1005 — here host compute overlaps device upload).
+
+        ``grads`` may be a list OR a lazy iterable (the engine's pipelined
+        schedule feeds chunks as their D2H transfers land, so chunk i's
+        host step runs while chunk i+1 is still on the wire). Each chunk
+        is pulled only when its update is about to run; time blocked
+        inside the feed accumulates in ``last_fetch_s``, never in
+        ``last_compute_s``."""
         import time
-        assert len(grads) == len(self.master)
+        if hasattr(grads, "__len__"):
+            assert len(grads) == len(self.master)
         self.step_count += 1
         # last_compute_s accumulates ONLY this generator's own work
         # segments — consumer time between yields (the engine's H2D pushes)
@@ -122,10 +144,25 @@ class OffloadedOptimizerRunner:
         # stall/compute deflates in the flattering direction
         self.last_compute_s = 0.0
         self.last_stall_s = 0.0
+        self.last_fetch_s = 0.0
+        grad_it = iter(grads)
+
+        def pull(i: int) -> np.ndarray:
+            t0 = time.perf_counter()
+            try:
+                g = next(grad_it)
+            except StopIteration:
+                raise ValueError(
+                    f"grad feed exhausted at chunk {i} of "
+                    f"{len(self.master)}") from None
+            self.last_fetch_s += time.perf_counter() - t0
+            return np.ascontiguousarray(g, np.float32).reshape(-1)
+
         seg = time.perf_counter()
-        flat_grads = [np.ascontiguousarray(g, np.float32).reshape(-1) for g in grads]
         if self._swapper is None:
-            for i, g in enumerate(flat_grads):
+            for i in range(len(self.master)):
+                g = pull(i)
+                seg = time.perf_counter()  # fetch wait is not compute
                 self._apply(i, g, self._state[i], lr, self.step_count)
                 self.last_compute_s += time.perf_counter() - seg
                 yield i, self.master[i]
@@ -145,8 +182,10 @@ class OffloadedOptimizerRunner:
                     self.last_stall_s += self._swapper.take_stall()
                     self.last_compute_s += time.perf_counter() - seg
                     break
+                g = pull(i)
+                seg = time.perf_counter()
                 n = self._slots * self.master[i].size
-                self._apply(i, flat_grads[i], buf[:n], lr, self.step_count)
+                self._apply(i, g, buf[:n], lr, self.step_count)
                 self.last_stall_s += self._swapper.take_stall()
                 self.last_compute_s += time.perf_counter() - seg
                 yield i, self.master[i]

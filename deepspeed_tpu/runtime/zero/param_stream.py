@@ -106,6 +106,7 @@ class ParamStreamRunner:
         self.total_param_bytes = 0     # full host tree, for the ratio
         self.last_fetch_wait_s = 0.0   # device-side stall on host futures
         self.last_host_step_s = 0.0    # host optimizer wall (overlapped)
+        self.last_nvme_wait_s = 0.0    # main-thread stall on NVMe futures
         self._lock = threading.Lock()
 
         # -- host parameter store (wire dtype) --------------------------
@@ -237,6 +238,17 @@ class ParamStreamRunner:
         self._aio = None               # non-None IS the nvme-mode flag
         self._nvme_pending = None  # (unit_index, buffer) of in-flight read
         self._nvme_last = None
+        # NVMe worker queue (ISSUE 15): in pipelined mode ONE worker
+        # thread owns the AIO handle during steady state and every
+        # read/write runs as a queued task, so `_nvme_take` /
+        # `_flush_nvme_dirty` never block the device dispatch loop on an
+        # `aio.wait()` — the main thread only ever waits on a FUTURE,
+        # and only when the prefetch genuinely has not landed (the
+        # honest `nvme_io` stall). DSTPU_OFFLOAD_PIPELINE=0 restores the
+        # main-thread-fenced schedule bitwise.
+        self._nvme_exec = None
+        self._nvme_futs: Dict[int, Future] = {}
+        self._nvme_flush_fut: Optional[Future] = None
         # write-behind cache: optimizer-pool threads STAGE updated blobs
         # here (the AIO handle is not thread-safe — wait()'s pin-drop
         # would free a buffer a pool thread just queued); ONLY the main
@@ -245,6 +257,10 @@ class ParamStreamRunner:
         if device == "nvme":
             import tempfile
             from ...ops.aio import AsyncIOHandle
+            from .offload_optimizer import offload_pipeline_enabled
+            if offload_pipeline_enabled():
+                self._nvme_exec = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="pstream-nvme")
             base = nvme_path or tempfile.gettempdir()
             # per-instance subdir: two runners sharing an nvme_path must
             # not clobber each other's store (same convention as
@@ -292,13 +308,93 @@ class ParamStreamRunner:
         return blob
 
     def _flush_nvme_dirty(self) -> None:
-        """MAIN THREAD ONLY: queue the staged write-backs. Called at step
-        start and at fence — pool threads never touch the AIO handle."""
+        """Queue the staged write-backs. Called at step start and at
+        fence. Pipelined: the flush is a TASK on the NVMe worker queue —
+        the main thread returns immediately instead of sitting on the
+        AIO submit path — and any prefetch futures from the previous
+        step are invalidated first (their units are about to be
+        re-stepped, so a held read would serve one-step-old params).
+        Serial (DSTPU_OFFLOAD_PIPELINE=0): main-thread submit, the
+        pre-ISSUE-15 schedule."""
+        if self._nvme_exec is not None:
+            self._check_nvme_flush()
+            for fut in self._nvme_futs.values():
+                fut.cancel() or fut.result()  # drain; buffers are dropped
+            self._nvme_futs.clear()
+            self._nvme_flush_fut = self._nvme_exec.submit(
+                self._flush_nvme_dirty_task)
+            return
+        self._flush_nvme_dirty_task()
+
+    def _check_nvme_flush(self, wait: bool = False) -> None:
+        """Surface a failed async write-back LOUDLY: the flush task pops
+        blobs from the dirty cache before writing, so an exception inside
+        it (ENOSPC, dead handle) would otherwise vanish in a dropped
+        Future while training continues against one-step-old disk state —
+        the serial path raised on the main thread, and so must this
+        one."""
+        fut = self._nvme_flush_fut
+        if fut is not None and (wait or fut.done()):
+            self._nvme_flush_fut = None
+            fut.result()
+
+    def _flush_nvme_dirty_task(self) -> None:
+        """AIO-owner context (worker task in pipelined mode, main thread
+        in serial mode): pop every staged blob and queue its write."""
         with self._lock:
             items = list(self._nvme_dirty.items())
             self._nvme_dirty.clear()
         for k, blob in items:
             self._aio.async_pwrite(blob, self._unit_path(k))
+
+    def _nvme_read_task(self, k: int) -> np.ndarray:
+        """Worker task: the blob for unit ``k``. A staged dirty blob
+        serves from RAM (its disk write is queued here — two readers of
+        the buffer are safe, same argument as the serial path);
+        otherwise the handle's ``wait()`` fences every previously-queued
+        write before the disk read, so a read can never race its own
+        unit's write-back. Only the worker thread runs this, so the AIO
+        handle has exactly one driver during steady state."""
+        with self._lock:
+            dirty = self._nvme_dirty.pop(k, None)
+        if dirty is not None:
+            self._aio.async_pwrite(dirty, self._unit_path(k))
+            return dirty
+        self._aio.wait()
+        buf = np.empty(self._block_bytes, np.uint8)
+        self._aio.async_pread(buf, self._unit_path(k))
+        self._aio.wait()
+        return buf
+
+    def _nvme_take_pipelined(self, k: int) -> np.ndarray:
+        """Pipelined `_nvme_take`: consume the prefetch future (blocking
+        only if the read genuinely has not landed — the honest
+        ``nvme_io`` stall, accumulated in ``last_nvme_wait_s``), then
+        queue the next unit's read on the worker. The prefetch guard is
+        the serial path's: only units whose host optimizer step is fully
+        done may be read ahead (an in-flight step is about to stage a
+        dirty blob; the read task's own dirty check closes the
+        staged-after-submit window because the worker runs strictly
+        after the flush task that would carry it)."""
+        self._check_nvme_flush()
+        L = self.num_layers
+        d = -1 if (self._nvme_last is not None and k < self._nvme_last) else 1
+        self._nvme_last = k
+        fut = self._nvme_futs.pop(k, None)
+        if fut is None:
+            fut = self._nvme_exec.submit(self._nvme_read_task, k)
+        t0 = time.perf_counter()
+        buf = fut.result()
+        self.last_nvme_wait_s += time.perf_counter() - t0
+        nxt = k + d
+        if 0 <= nxt < L and nxt != k and nxt not in self._nvme_futs:
+            hostfut = self._unit_futs.get(1 + nxt)
+            with self._lock:
+                nxt_dirty = nxt in self._nvme_dirty
+            if not nxt_dirty and (hostfut is None or hostfut.done()):
+                self._nvme_futs[nxt] = self._nvme_exec.submit(
+                    self._nvme_read_task, nxt)
+        return buf
 
     def _nvme_take(self, k: int) -> np.ndarray:
         """Blob for layer k (MAIN THREAD ONLY): a staged dirty blob serves
@@ -308,6 +404,8 @@ class ParamStreamRunner:
         fetch — the device_put may still be reading the previous one
         asynchronously. The aio.wait() fences every previously-queued
         write, so a read can never race its own unit's write-back."""
+        if self._nvme_exec is not None:
+            return self._nvme_take_pipelined(k)
         L = self.num_layers
         d = 1
         if self._nvme_last is not None and k < self._nvme_last:
@@ -634,6 +732,7 @@ class ParamStreamRunner:
         lr = self.lr_default if lr is None else float(lr)
         L = self.num_layers
         self.last_fetch_wait_s = 0.0
+        self.last_nvme_wait_s = 0.0
         windows = getattr(self.model, "_windows", None)
         wkey = windows is not None
         if self._aio is not None:
@@ -739,7 +838,15 @@ class ParamStreamRunner:
             self._wait_unit(unit)
         if self._aio is not None:
             self._flush_nvme_dirty()
-            self._aio.wait()
+            if self._nvme_exec is not None:
+                # the flush ran as a worker task; the wait must too — the
+                # worker owns the handle, and FIFO ordering makes this a
+                # full drain of everything queued before it. A failed
+                # flush re-raises HERE, not silently in its Future.
+                self._check_nvme_flush(wait=True)
+                self._nvme_exec.submit(self._aio.wait).result()
+            else:
+                self._aio.wait()
 
     def params_host_tree(self):
         """Full parameter tree (host numpy, wire dtype) — state_dict/save.
@@ -756,12 +863,26 @@ class ParamStreamRunner:
         return tree
 
     def _rewrite_nvme_store(self) -> None:
-        """Regenerate every unit blob from the masters (checkpoint load)."""
+        """Regenerate every unit blob from the masters (checkpoint load).
+        Prefetched reads are invalidated first — they hold pre-load
+        params."""
         with self._lock:
             self._nvme_dirty.clear()
-        for k in range(self.num_layers):
-            self._aio.async_pwrite(self._pack_unit(k), self._unit_path(k))
-        self._aio.wait()
+        self._nvme_pending = None
+
+        def rewrite():
+            for k in range(self.num_layers):
+                self._aio.async_pwrite(self._pack_unit(k),
+                                       self._unit_path(k))
+            self._aio.wait()
+
+        if self._nvme_exec is not None:
+            for fut in self._nvme_futs.values():
+                fut.cancel() or fut.result()
+            self._nvme_futs.clear()
+            self._nvme_exec.submit(rewrite).result()
+        else:
+            rewrite()
 
     def _save_arr(self, a: np.ndarray) -> np.ndarray:
         # npz has no bf16: persist the raw 2-byte payload as uint16 (same
@@ -816,6 +937,8 @@ class ParamStreamRunner:
         self.fence()
         self._io.shutdown(wait=True)
         self._cpu.shutdown(wait=True)
+        if self._nvme_exec is not None:
+            self._nvme_exec.shutdown(wait=True)
         if self._aio is not None:
             self._aio.wait()
             self._aio.close()

@@ -134,6 +134,10 @@ class MetricsEngine:
         self.anomaly_steps = 0
         self.anomaly_word_union = 0
         self.guardian_rollbacks = 0
+        # out-of-core offload phase accounting (ISSUE 15): cumulative
+        # seconds per pipeline phase — the decomposition of the old
+        # scalar offload_stall_frac (docs/OBSERVABILITY.md)
+        self.offload_phase_s: Dict[str, float] = {}
 
     # -- feeding ---------------------------------------------------------
     def record_step(self, duration_s: float, tokens: int = 0,
@@ -167,6 +171,13 @@ class MetricsEngine:
 
     def record_guardian_rollback(self) -> None:
         self.guardian_rollbacks += 1
+
+    def record_offload_phases(self, phases: Dict[str, float]) -> None:
+        """Per-step offload pipeline phase seconds (h2d_prefetch /
+        bucket_compute / d2h_writeback / nvme_io)."""
+        for k, v in phases.items():
+            self.offload_phase_s[k] = \
+                self.offload_phase_s.get(k, 0.0) + max(0.0, float(v))
 
     def record_comm(self, nbytes: int, overlapped: Optional[bool],
                     count: int = 1,
@@ -247,6 +258,17 @@ class MetricsEngine:
                         self.ttft_latency.percentiles().items()})
             out.update({f"queue_wait_{k}_s": v for k, v in
                         self.queue_wait.percentiles().items()})
+        if self.offload_phase_s:
+            # the stall-decomposition keys (ISSUE 15): per-phase seconds
+            # plus the blocked fraction of the offload boundary — what
+            # the double-buffered pipeline exists to shrink
+            for k, v in self.offload_phase_s.items():
+                out[f"offload_{k}_s"] = v
+            compute = self.offload_phase_s.get("bucket_compute", 0.0)
+            blocked = sum(v for k, v in self.offload_phase_s.items()
+                          if k != "bucket_compute")
+            if compute + blocked > 0:
+                out["offload_stall_frac"] = blocked / (compute + blocked)
         if self.anomaly_steps or self.guardian_rollbacks:
             out["anomaly_steps"] = float(self.anomaly_steps)
             out["guardian_rollbacks"] = float(self.guardian_rollbacks)

@@ -158,6 +158,22 @@ class Telemetry:
                            tag=tag)
         self.metrics.record_guardian_rollback()
 
+    # -- out-of-core offload pipeline (ISSUE 15) -------------------------
+    def record_offload_phases(self, step: int,
+                              phases: Dict[str, float]) -> None:
+        """One offload optimizer boundary's phase decomposition
+        (h2d_prefetch / bucket_compute / d2h_writeback / nvme_io seconds,
+        accumulated host-side — nothing here touches the device). Each
+        phase lands as a completed span under the ``offload`` phase track
+        plus a summary accumulator (``offload_*_s`` / the derived
+        ``offload_stall_frac``)."""
+        from .trace import PHASE_OFFLOAD
+        for name, dur in phases.items():
+            if dur > 0.0:
+                self.trace.complete_span(f"offload/{name}", PHASE_OFFLOAD,
+                                         dur, step=step)
+        self.metrics.record_offload_phases(phases)
+
     # -- serving ---------------------------------------------------------
     def record_wave(self, kind: str, tokens: int, duration_s: float,
                     queue_depth: int = 0, running: int = 0,
@@ -323,6 +339,9 @@ class NullTelemetry:
         pass
 
     def record_rollback(self, *a, **k):
+        pass
+
+    def record_offload_phases(self, *a, **k):
         pass
 
     def set_flops_fn(self, fn):

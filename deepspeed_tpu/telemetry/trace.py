@@ -39,6 +39,7 @@ PHASE_STEP = "step"              # fused train-step dispatch
 PHASE_OPTIMIZER = "optimizer"    # apply/optimizer dispatch
 PHASE_CHECKPOINT = "checkpoint"  # save/load, incl. async write-behind
 PHASE_SERVING = "serving"        # inference wave/dispatch
+PHASE_OFFLOAD = "offload"        # out-of-core optimizer step pipeline
 PHASE_OTHER = "other"
 
 # collective op -> phase attribution for comm records
@@ -125,6 +126,21 @@ class TraceRecorder:
                 "ts": span.t0 - self._epoch, "dur": span.t1 - span.t0,
                 "step": span.step, "tid": span._tid,
                 **({"args": span.args} if span.args else {}),
+            })
+
+    def complete_span(self, name: str, phase: str, dur: float,
+                      step: Optional[int] = None, **args) -> None:
+        """Record an already-measured interval as a span (duration events
+        accumulated across a step — the offload pipeline's per-phase
+        seconds land here post-hoc rather than as hundreds of per-bucket
+        live spans). ``ts`` is backdated so the span ends 'now'."""
+        t = clock.now()
+        with self._lock:
+            self._push({
+                "kind": "span", "name": name, "phase": phase,
+                "ts": max(0.0, t - self._epoch - dur), "dur": float(dur),
+                "step": step, "tid": threading.get_ident(),
+                **({"args": args} if args else {}),
             })
 
     def instant(self, name: str, phase: str = PHASE_OTHER,

@@ -260,3 +260,112 @@ def test_rollback_without_pin_or_with_rotten_pin_is_none(tmp_path):
     _flip_byte(tmp_path / "t1" / "state.npz")
     assert store.rollback_to_known_good(str(tmp_path)) is None
     assert (tmp_path / "latest").read_text() == "t1"  # untouched
+
+
+# ---------------------------------------------------------------------------
+# offload sidecar durability under the async writeback pipeline (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def _write_tag_with_sidecar(d, tag, value, steps, sc_value=0.5):
+    """A committed tag carrying an offload sidecar whose crc32 rides the
+    commit record (the engine's single-process save path)."""
+    path = os.path.join(str(d), tag)
+    os.makedirs(path, exist_ok=True)
+    crc = store._atomic_savez(
+        os.path.join(path, "offload_optimizer.npz"),
+        {"master_flat": np.full(64, sc_value, np.float32)})
+    store.write_staged(str(d), tag, ["w"],
+                       {"w": np.full(16, value, np.float32)},
+                       {"global_steps": steps},
+                       extra_checksums={"offload_optimizer.npz": crc})
+
+
+def test_sidecar_checksum_in_commit_record(tmp_path):
+    _write_tag_with_sidecar(tmp_path, "t1", 1.0, 1)
+    with open(tmp_path / "t1" / "meta.json") as f:
+        meta = json.load(f)
+    assert set(meta["checksums"]) == {"state.npz", "offload_optimizer.npz"}
+    assert store.verify_tag(str(tmp_path / "t1")) == (True, "ok")
+
+
+def test_corrupt_sidecar_detected_and_falls_back(tmp_path):
+    """A torn/flipped offload sidecar AFTER commit fails verification —
+    the corrupt-`latest` fallback refuses the tag instead of loading a
+    device tree whose master state is garbage (the failure mode the
+    CRC-verified-load contract exists for)."""
+    _write_tag_with_sidecar(tmp_path, "t1", 1.0, 1)
+    _write_tag_with_sidecar(tmp_path, "t2", 2.0, 2)
+    _flip_byte(tmp_path / "t2" / "offload_optimizer.npz")
+    ok, reason = store.verify_tag(str(tmp_path / "t2"))
+    assert not ok and "offload_optimizer.npz" in reason, reason
+    state, client, tag = store.load_checkpoint(
+        str(tmp_path), None, {"w": np.zeros(16, np.float32)}, {"w": None})
+    assert tag == "t1"
+    assert client["global_steps"] == 1
+
+
+def test_missing_sidecar_after_commit_is_detected(tmp_path):
+    _write_tag_with_sidecar(tmp_path, "t1", 1.0, 1)
+    os.remove(tmp_path / "t1" / "offload_optimizer.npz")
+    ok, reason = store.verify_tag(str(tmp_path / "t1"))
+    assert not ok and "missing data file" in reason, reason
+
+
+def test_io_error_on_sidecar_write_is_retried_then_loud(tmp_path,
+                                                        monkeypatch):
+    """The PR 12 ckpt_io seam covers the sidecar write: a transient
+    injected OSError retries (the save succeeds, crc still valid); a
+    persistent one raises after the retry budget with no temp litter and
+    `latest` untouched — never a half-committed tag."""
+    from deepspeed_tpu.resilience import FaultEvent, FaultPlan
+    from deepspeed_tpu.resilience.fault_plan import install_plan
+
+    monkeypatch.setenv("DSTPU_CKPT_RETRIES", "2")
+    monkeypatch.setenv("DSTPU_CKPT_BACKOFF_S", "0.001")
+    _write_tag_with_sidecar(tmp_path, "t1", 1.0, 1)
+    try:
+        # transient: fires once, first retry lands the write
+        install_plan(FaultPlan([FaultEvent(
+            "io_error", match="offload_optimizer*", count=1)]))
+        _write_tag_with_sidecar(tmp_path, "t2", 2.0, 2)
+        assert store.verify_tag(str(tmp_path / "t2")) == (True, "ok")
+        # persistent: exhausts the retry budget and raises BEFORE any
+        # commit-record write for t3
+        install_plan(FaultPlan([FaultEvent(
+            "io_error", match="offload_optimizer*", count=99)]))
+        with pytest.raises(OSError):
+            _write_tag_with_sidecar(tmp_path, "t3", 3.0, 3)
+    finally:
+        install_plan(None)
+    assert (tmp_path / "latest").read_text() == "t2"
+    names = os.listdir(tmp_path / "t3")
+    assert not [n for n in names if ".tmp" in n], names
+    assert not os.path.exists(tmp_path / "t3" / "meta.json")
+
+
+def test_offload_runner_async_writeback_state_dict_ordering(tmp_path):
+    """NVMe dirty-flush ordering: immediately after a step whose
+    write-backs were issued ASYNC (the pipelined swapper), state_dict's
+    reads must observe every completed write — the nvme state equals the
+    RAM-resident (device=cpu) runner's after identical steps."""
+    from deepspeed_tpu.runtime.zero.offload_optimizer import (
+        OffloadedOptimizerRunner)
+    rng = np.random.default_rng(3)
+    leaves = [rng.standard_normal(129).astype(np.float32)
+              for _ in range(4)]
+    grads = [rng.standard_normal(129).astype(np.float32) * 1e-2
+             for _ in range(4)]
+    nv = OffloadedOptimizerRunner(
+        "adamw", {"lr": 1e-3}, [l.copy() for l in leaves],
+        device="nvme", nvme_path=str(tmp_path), pipeline=True)
+    ram = OffloadedOptimizerRunner(
+        "adamw", {"lr": 1e-3}, [l.copy() for l in leaves], device="cpu")
+    for _ in range(2):
+        nv.step(list(grads))
+        ram.step(list(grads))
+    sd_nv, sd_ram = nv.state_dict(), ram.state_dict()
+    assert sd_nv["step"] == sd_ram["step"]
+    for a, b in zip(sd_nv["master"], sd_ram["master"]):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(sd_nv["state"], sd_ram["state"]):
+        np.testing.assert_array_equal(a, b)

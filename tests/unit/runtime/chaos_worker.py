@@ -77,11 +77,19 @@ def main(out_dir: str, total_steps: int = 4) -> int:
                        remat=False)
     # initialize() resumes from DSTPU_ELASTIC's checkpoint_dir last
     # committed tag (fresh start when nothing committed yet); the
-    # guardian (numerics chaos arm) arms via the DSTPU_GUARDIAN env
+    # guardian (numerics chaos arm) arms via the DSTPU_GUARDIAN env.
+    # DSTPU_CHAOS_OFFLOAD ("cpu" | "nvme:<dir>") adds an offloaded
+    # optimizer — the ISSUE 15 sidecar-durability chaos arm.
+    zero = {"stage": 2}
+    offload = os.environ.get("DSTPU_CHAOS_OFFLOAD", "")
+    if offload:
+        dev, _, nvme = offload.partition(":")
+        zero["offload_optimizer"] = {"device": dev,
+                                     **({"nvme_path": nvme} if nvme else {})}
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
         "train_micro_batch_size_per_gpu": GLOBAL_BATCH // _DEVICES,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
-        "zero_optimization": {"stage": 2},
+        "zero_optimization": zero,
     }, seed=3)
     guardian = engine._guardian
 

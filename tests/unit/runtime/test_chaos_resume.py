@@ -59,7 +59,7 @@ def _free_port() -> int:
 
 
 def _run_agent(tmp_path, name, slots=2, shrink=False, plan=None,
-               max_restarts=2, guardian=False):
+               max_restarts=2, guardian=False, worker_extra=None):
     """Drive chaos_worker under a DSElasticAgent in a subprocess; returns
     (world_history, rank-0 trajectory)."""
     out = tmp_path / name
@@ -67,7 +67,7 @@ def _run_agent(tmp_path, name, slots=2, shrink=False, plan=None,
     env_clean = {k: v for k, v in os.environ.items()
                  if not k.startswith(("JAX_", "XLA_", "DSTPU_"))}
     env_clean["PYTHONPATH"] = REPO + os.pathsep + env_clean.get("PYTHONPATH", "")
-    worker_env = {}
+    worker_env = dict(worker_extra or {})
     if plan is not None:
         worker_env["DSTPU_FAULT_PLAN"] = plan.to_json()
     if guardian:
@@ -206,3 +206,42 @@ def test_chaos_loss_spike_guardian_rolls_back(tmp_path, reference):
                                     shrink=False, plan=plan, guardian=True)
     _assert_guardian_rolled_back(out, reference, traj, history,
                                  "loss_spike")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 15: offload sidecar durability under the pipelined offload step
+# ---------------------------------------------------------------------------
+
+def test_chaos_offload_torn_sidecar_falls_back(tmp_path):
+    """With the NVMe-offloaded optimizer (double-buffered pipeline ON —
+    async bucket writebacks in flight every step), a torn write of the
+    offload sidecar at step 3's save kills the process between the
+    bucket writeback and the checkpoint commit. `latest` must still name
+    step 2's tag — whose sidecar crc32 rides the commit record — and the
+    restarted world reloads the CRC-verified master state and replays to
+    the SAME trajectory as an uninterrupted offload run."""
+    nvme_ref = tmp_path / "nvme_ref"
+    nvme_torn = tmp_path / "nvme_torn"
+    nvme_ref.mkdir()
+    nvme_torn.mkdir()
+    # offload reference: host cpu-Adam math differs from the device path
+    # beyond ATOL_FRAC, so the parity baseline must itself be offloaded
+    ref_hist, ref_traj, _ = _run_agent(
+        tmp_path, "offref", slots=2, shrink=False,
+        worker_extra={"DSTPU_CHAOS_OFFLOAD": f"nvme:{nvme_ref}"})
+    assert ref_hist == [2]
+    plan = FaultPlan([FaultEvent("torn_write", match="offload_optimizer*",
+                                 rank=0, skip=2)])
+    history, traj, out = _run_agent(
+        tmp_path, "offtorn", slots=2, shrink=False, plan=plan,
+        worker_extra={"DSTPU_CHAOS_OFFLOAD": f"nvme:{nvme_torn}"})
+    assert history == [2, 2]
+    report = compare_trajectories(ref_traj, traj, atol_frac=ATOL_FRAC)
+    assert report["ok"], report
+    assert (out / "ckpt" / "latest").read_text() == \
+        f"global_step{TOTAL_STEPS}"
+    # the commit record carries the sidecar checksum (CRC-verified loads
+    # cover the master state, not just the device tree)
+    meta = json.loads((out / "ckpt" / f"global_step{TOTAL_STEPS}"
+                       / "meta.json").read_text())
+    assert "offload_optimizer.npz" in meta["checksums"], meta["checksums"]

@@ -556,3 +556,209 @@ class TestDirectLeafOffload:
             l_off = float(off.train_batch(batch))
             l_ref = float(ref.train_batch(batch))
         np.testing.assert_allclose(l_off, l_ref, rtol=2e-5)
+
+
+class TestOffloadPipeline:
+    """ISSUE 15: the double-buffered offload pipeline (default) against
+    the serial fetch→compute→writeback schedule (DSTPU_OFFLOAD_PIPELINE=0
+    kill switch). The pipeline only reorders INDEPENDENT transfers — same
+    chunk boundaries, same arithmetic order — so the two schedules must
+    be BITWISE identical; the kill switch is a schedule A/B, never a
+    numerics A/B."""
+
+    def _run(self, monkeypatch, pipeline, device="cpu", nvme_path=None,
+             steps=3, chunk_elems=None):
+        import jax
+        monkeypatch.setenv("DSTPU_OFFLOAD_PIPELINE",
+                           "1" if pipeline else "0")
+        if chunk_elems is not None:
+            from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+            monkeypatch.setattr(DeepSpeedEngine, "_OFFLOAD_CHUNK_ELEMS",
+                                chunk_elems)
+        eng = _make_engine(device, nvme_path=nvme_path)
+        b = {"input_ids":
+             np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+        losses = [float(eng.train_batch(b)) for _ in range(steps)]
+        params = [np.asarray(jax.device_get(l))
+                  for l in jax.tree.leaves(eng.state["params"])]
+        return eng, losses, params
+
+    def test_kill_switch_bitwise_cpu(self, monkeypatch):
+        _, l_on, p_on = self._run(monkeypatch, True)
+        _, l_off, p_off = self._run(monkeypatch, False)
+        assert l_on == l_off, (l_on, l_off)
+        for a, b in zip(p_on, p_off):
+            np.testing.assert_array_equal(a, b)
+
+    def test_kill_switch_bitwise_nvme_chunked(self, monkeypatch, tmp_path):
+        """Multi-chunk NVMe paging under the pipelined feed: the lazy
+        chunk consumption must not change a single bit vs the serial
+        eager list."""
+        e_on, l_on, p_on = self._run(
+            monkeypatch, True, "nvme", str(tmp_path / "a"),
+            chunk_elems=8192)
+        assert len(e_on._offload.master) > 2, "must span several chunks"
+        assert len(e_on._offload_fetch_buckets) > 1, \
+            "model must span several fetch buckets"
+        _, l_off, p_off = self._run(
+            monkeypatch, False, "nvme", str(tmp_path / "b"),
+            chunk_elems=8192)
+        assert l_on == l_off, (l_on, l_off)
+        for a, b in zip(p_on, p_off):
+            np.testing.assert_array_equal(a, b)
+
+    def test_phase_split_recorded(self, monkeypatch, tmp_path):
+        """The stall decomposition (docs/OBSERVABILITY.md): every offload
+        step records the four pipeline phases, with real host compute."""
+        eng, _, _ = self._run(monkeypatch, True, "nvme",
+                              str(tmp_path / "p"))
+        ph = eng.last_offload_phase_s
+        assert set(ph) == {"h2d_prefetch", "bucket_compute",
+                           "d2h_writeback", "nvme_io"}, ph
+        assert all(v >= 0.0 for v in ph.values()), ph
+        assert ph["bucket_compute"] > 0.0, ph
+        # bench continuity: the legacy pair still reports
+        assert eng.last_offload_compute_s == ph["bucket_compute"]
+        assert eng.last_offload_stall_s == ph["nvme_io"]
+
+    def test_fetch_buckets_tile_leaves(self, monkeypatch):
+        """Bucket plan sanity: the fetch buckets are contiguous leaf runs
+        tiling 0..n-1 exactly once (the prefix property the chunk feed
+        relies on), and the bucket size binds through reduce_bucket_size
+        (the overlap.py fused-buffer discipline)."""
+        monkeypatch.setenv("DSTPU_OFFLOAD_PIPELINE", "1")
+        m = gpt2_model("gpt2-tiny", max_seq_len=16, vocab_size=128,
+                       remat=False)
+        eng, _, _, _ = deepspeed_tpu.initialize(model=m, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1, "reduce_bucket_size": 8192,
+                                  "offload_optimizer": {"device": "cpu"}},
+        }, seed=7)
+        eng.train_batch({"input_ids":
+                         np.random.default_rng(0).integers(
+                             0, 128, size=(8, 8))})
+        assert eng._offload_chunk_elems == 8192  # the knob bound
+        flat = [k for run in eng._offload_fetch_buckets for k in run]
+        assert flat == list(range(len(eng._offload_host_idx)))
+        for run in eng._offload_fetch_buckets:
+            assert run == list(range(run[0], run[-1] + 1))
+        # several buckets at this cap — the pipeline has something to
+        # double-buffer
+        assert len(eng._offload_fetch_buckets) > 1
+
+    def test_runner_lazy_feed_matches_list(self, tmp_path):
+        """OffloadedOptimizerRunner.step_iter with a lazy generator feed
+        (the engine pipeline's form) is bitwise the eager-list form, and
+        fetch-wait time lands in last_fetch_s, not last_compute_s."""
+        from deepspeed_tpu.runtime.zero.offload_optimizer import (
+            OffloadedOptimizerRunner)
+        rng = np.random.default_rng(0)
+        leaves = [rng.standard_normal(257).astype(np.float32)
+                  for _ in range(5)]
+        grads = [rng.standard_normal(257).astype(np.float32) * 1e-2
+                 for _ in range(5)]
+
+        def make():
+            return OffloadedOptimizerRunner(
+                "adamw", {"lr": 1e-3, "weight_decay": 0.01},
+                [l.copy() for l in leaves], device="nvme",
+                nvme_path=str(tmp_path), pipeline=True)
+
+        a, b = make(), make()
+        for _ in range(2):
+            for _ in a.step_iter(list(grads)):
+                pass
+            for _ in b.step_iter(iter(list(grads))):
+                pass
+        for ma, mb in zip(a.master, b.master):
+            np.testing.assert_array_equal(ma, mb)
+        assert b.last_fetch_s >= 0.0
+        # a short feed is a hard error, not a silent partial step
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="exhausted"):
+            for _ in a.step_iter(iter(grads[:2])):
+                pass
+
+
+class TestParamSwapperWorkerQueue:
+    """ISSUE 15: grouped read futures on the swapper's worker queue —
+    bulk swap_in lands incrementally (get blocks per group, not on the
+    whole queue) and the kill switch restores the single-queue form."""
+
+    def _roundtrip(self, tmp_path, monkeypatch, pipelined):
+        from deepspeed_tpu.runtime.swap_tensor import (
+            AsyncPartitionedParameterSwapper)
+        monkeypatch.setenv("DSTPU_OFFLOAD_PIPELINE",
+                           "1" if pipelined else "0")
+        sw = AsyncPartitionedParameterSwapper(str(tmp_path),
+                                              read_group_bytes=256)
+        assert (sw._exec is not None) == pipelined
+        rng = np.random.default_rng(5)
+        data = {f"p{i}": rng.standard_normal(64).astype(np.float32)
+                for i in range(6)}
+        for k, v in data.items():
+            sw.swap_out(k, v)
+        sw.synchronize_writes()
+        sw.swap_in(list(data), async_op=True)
+        if pipelined:
+            # 64 fp32 = 256 B per shard -> one group per shard: a bulk
+            # prefetch is SEVERAL futures, not one all-or-nothing wait
+            assert len(set(sw._read_futs.values())) == len(data)
+        out = {k: sw.get(k).copy() for k in data}
+        for k, v in data.items():
+            np.testing.assert_array_equal(out[k], v)
+        # write-after-read ordering: overwrite and read back through the
+        # same queue
+        sw.swap_out("p0", data["p0"] + 1)
+        sw.swap_in(["p0"], async_op=False)
+        np.testing.assert_array_equal(sw.get("p0"), data["p0"] + 1)
+        sw.close()
+
+    def test_pipelined_grouped_futures(self, tmp_path, monkeypatch):
+        self._roundtrip(tmp_path, monkeypatch, True)
+
+    def test_kill_switch_serial(self, tmp_path, monkeypatch):
+        self._roundtrip(tmp_path, monkeypatch, False)
+
+
+class TestOffloadChunkRechunk:
+    def test_checkpoint_loads_across_chunk_size_change(self, monkeypatch,
+                                                       tmp_path):
+        """A tag written at one chunk size loads at another (the
+        reduce_bucket_size binding must not strand pre-existing offload
+        checkpoints): the loader re-chunks the flat m/v state, and the
+        resumed trajectory matches."""
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+
+        def full_state(runner):
+            n = sum(m.size for m in runner.master)
+            slots = runner._slots
+            full = [np.empty(n, np.float32) for _ in range(slots)]
+            a = 0
+            for m, st in zip(runner.master, runner._state):
+                for s in range(slots):
+                    full[s][a:a + m.size] = st[s * m.size:(s + 1) * m.size]
+                a += m.size
+            return np.concatenate([m.reshape(-1) for m in runner.master]), \
+                full
+
+        b = {"input_ids":
+             np.random.default_rng(0).integers(0, 128, size=(8, 8))}
+        monkeypatch.setattr(DeepSpeedEngine, "_OFFLOAD_CHUNK_ELEMS", 8192)
+        eng = _make_engine("cpu")
+        eng.train_batch(b)
+        eng.save_checkpoint(str(tmp_path / "ck"))
+        m_ref, s_ref = full_state(eng._offload)
+
+        monkeypatch.setattr(DeepSpeedEngine, "_OFFLOAD_CHUNK_ELEMS", 2048)
+        eng2 = _make_engine("cpu", seed=99)
+        eng2.load_checkpoint(str(tmp_path / "ck"))
+        assert len(eng2._offload.master) > len(eng._offload.master)
+        m2, s2 = full_state(eng2._offload)
+        np.testing.assert_array_equal(m_ref, m2)
+        for a, c in zip(s_ref, s2):
+            np.testing.assert_array_equal(a, c)
+        l1 = float(eng.train_batch(b))
+        l2 = float(eng2.train_batch(b))
+        assert abs(l1 - l2) < 1e-5, (l1, l2)

@@ -342,3 +342,77 @@ class TestRejections:
         dense.train_batch(iter(batches))
         np.testing.assert_allclose(paged.get_global_grad_norm(),
                                    dense.get_global_grad_norm(), rtol=1e-3)
+
+
+class TestNVMeWorkerQueue:
+    """ISSUE 15: the pipelined NVMe worker queue (one thread owns the
+    AIO handle; `_nvme_take`/`_flush_nvme_dirty` never fence on the main
+    thread) against the serial main-thread schedule
+    (DSTPU_OFFLOAD_PIPELINE=0) — a schedule change only, trajectories
+    identical."""
+
+    def _nvme_cfg(self, tmp_path):
+        cfg = _cfg(True)
+        cfg["zero_optimization"]["offload_param"] = {
+            "device": "nvme", "nvme_path": str(tmp_path),
+            "paged_training": True}
+        return cfg
+
+    def _run(self, monkeypatch, tmp_path, pipelined, steps=3):
+        monkeypatch.setenv("DSTPU_OFFLOAD_PIPELINE",
+                           "1" if pipelined else "0")
+        m = _model()
+        init = _shared_init(m)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=self._nvme_cfg(tmp_path),
+            model_parameters=init)
+        rs = eng._param_stream
+        assert (rs._nvme_exec is not None) == pipelined
+        b = _batch(seed=0)
+        losses = [float(eng.train_batch(b)) for _ in range(steps)]
+        rs.fence()
+        tree = rs.params_host_tree()
+        leaves = [np.asarray(l) for l in jax.tree.leaves(tree)]
+        rs.close()
+        return losses, leaves
+
+    def test_worker_queue_matches_serial(self, eight_devices, monkeypatch,
+                                         tmp_path):
+        l_on, p_on = self._run(monkeypatch, tmp_path / "on", True)
+        l_off, p_off = self._run(monkeypatch, tmp_path / "off", False)
+        np.testing.assert_allclose(l_on, l_off, rtol=0, atol=0)
+        for a, b in zip(p_on, p_off):
+            np.testing.assert_array_equal(a, b)
+
+    def test_nvme_wait_accounted(self, eight_devices, monkeypatch,
+                                 tmp_path):
+        monkeypatch.setenv("DSTPU_OFFLOAD_PIPELINE", "1")
+        m = _model()
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=self._nvme_cfg(tmp_path))
+        eng.train_batch(_batch(seed=0))
+        rs = eng._param_stream
+        assert rs.last_nvme_wait_s >= 0.0
+        rs.close()
+
+    def test_failed_flush_surfaces_loudly(self, eight_devices, monkeypatch,
+                                          tmp_path):
+        """A write-back that dies on the worker queue must raise at the
+        next fence/take — never train on silently-stale disk state."""
+        monkeypatch.setenv("DSTPU_OFFLOAD_PIPELINE", "1")
+        m = _model()
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=m, config=self._nvme_cfg(tmp_path))
+        rs = eng._param_stream
+        eng.train_batch(_batch(seed=0))
+        def boom():
+            raise OSError("injected ENOSPC")
+        monkeypatch.setattr(rs, "_flush_nvme_dirty_task", boom)
+        import pytest as _pytest
+        with _pytest.raises(OSError, match="ENOSPC"):
+            # the step submits the poisoned flush; the very next NVMe
+            # take (or, at the latest, fence) surfaces it
+            eng.train_batch(_batch(seed=0))
+            rs.fence()
+        monkeypatch.undo()
+        rs.close()

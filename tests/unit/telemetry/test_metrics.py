@@ -93,3 +93,56 @@ def test_latency_histogram_percentiles():
     for _ in range(20):
         h.record(0.001)
     assert h.percentiles()["p99"] == pytest.approx(0.001)
+
+
+def test_offload_phase_split_summary_keys():
+    """ISSUE 15: the offload stall decomposition accumulates per-phase
+    seconds and derives offload_stall_frac = blocked / total (blocked =
+    everything but bucket_compute)."""
+    m = MetricsEngine()
+    assert "offload_stall_frac" not in m.summary()  # absent when unused
+    m.record_offload_phases({"h2d_prefetch": 0.2, "bucket_compute": 0.6,
+                             "d2h_writeback": 0.1, "nvme_io": 0.1})
+    m.record_offload_phases({"h2d_prefetch": 0.2, "bucket_compute": 0.6,
+                             "d2h_writeback": 0.1, "nvme_io": 0.1})
+    s = m.summary()
+    assert s["offload_h2d_prefetch_s"] == pytest.approx(0.4)
+    assert s["offload_bucket_compute_s"] == pytest.approx(1.2)
+    assert s["offload_d2h_writeback_s"] == pytest.approx(0.2)
+    assert s["offload_nvme_io_s"] == pytest.approx(0.2)
+    assert s["offload_stall_frac"] == pytest.approx(0.8 / 2.0)
+
+
+def test_offload_phase_spans_reach_trace_and_view():
+    """record_offload_phases lands completed spans the trace export (and
+    tools/trace_view.py's breakdown line) can see."""
+    import os
+    import sys
+
+    from deepspeed_tpu.telemetry.config import TelemetryConfig
+    from deepspeed_tpu.telemetry.telemetry import Telemetry
+
+    tele = Telemetry(TelemetryConfig(enabled=True,
+                                     watchdog={"enabled": False}))
+    tele.record_offload_phases(3, {"h2d_prefetch": 0.02,
+                                   "bucket_compute": 0.05,
+                                   "d2h_writeback": 0.01,
+                                   "nvme_io": 0.0})
+    spans = [r for r in tele.trace.events()
+             if r.get("kind") == "span"
+             and r["name"].startswith("offload/")]
+    names = {s["name"] for s in spans}
+    # zero-duration phases are elided; the rest land with their duration
+    assert names == {"offload/h2d_prefetch", "offload/bucket_compute",
+                     "offload/d2h_writeback"}, names
+    assert all(s["phase"] == "offload" for s in spans)
+    by = {s["name"]: s["dur"] for s in spans}
+    assert by["offload/bucket_compute"] == pytest.approx(0.05)
+    # the trace_view breakdown line renders from these records
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "..", "..", "..", "tools"))
+    import trace_view
+    out = trace_view.summarize([dict(r) for r in tele.trace.events()])
+    assert "offload stall decomposition" in out
+    assert "blocked fraction" in out
+    tele.close()

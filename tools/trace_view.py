@@ -100,6 +100,27 @@ def summarize(records, top=15, phase=None):
                          f"docs/KERNELS.md)")
             lines.append("")
 
+        # offload stall decomposition (ISSUE 15): the four pipeline phases
+        # of the out-of-core optimizer boundary — everything except
+        # bucket_compute is time the pipeline exists to hide
+        # (docs/OBSERVABILITY.md "Offload stall decomposition")
+        off = {name[len("offload/"):]: sum(durs)
+               for name, durs in by_name.items()
+               if name.startswith("offload/")}
+        if phase is None and off:
+            total_off = sum(off.values())
+            blocked = total_off - off.get("bucket_compute", 0.0)
+            parts = "  ".join(
+                f"{k} {v * 1e3:.2f} ms"
+                for k, v in sorted(off.items(), key=lambda kv: -kv[1]))
+            lines.append(f"offload stall decomposition: {parts}")
+            lines.append(
+                f"  blocked fraction "
+                f"{blocked / max(total_off, 1e-12):.3f} "
+                f"(everything but bucket_compute; the double-buffered "
+                f"pipeline drives this toward 0, docs/OFFLOAD.md)")
+            lines.append("")
+
     ov = ex = 0
     for r in records:
         if r.get("kind") != "comm":

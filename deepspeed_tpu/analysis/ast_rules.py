@@ -478,6 +478,138 @@ def check_telemetry_hot_sync(ctx: ModuleContext):
 
 
 # ---------------------------------------------------------------------------
+# unguarded-worker-state
+# ---------------------------------------------------------------------------
+UNGUARDED_WORKER_STATE = Rule(
+    rule_id="unguarded-worker-state", layer=LAYER_AST,
+    severity=SEVERITY_WARNING,
+    description="A host-side worker thread (Thread(target=...), "
+                "executor.submit(fn)) mutating shared object/module state "
+                "outside a lock or queue handoff races the main thread — "
+                "async checkpoint workers, NVMe queues, watchdogs and "
+                "elastic agents must publish through a Lock/Condition or a "
+                "Queue.put",
+    fix_hint="hold the owning object's lock (`with self._lock:`) around the "
+             "mutation, or hand the value to the consumer through a "
+             "queue.Queue instead of assigning shared attributes",
+)
+
+# context-manager names that count as a lock guard; matched against the
+# last dotted segment of the `with` expression (self._lock, cls.mutex,
+# threading.Lock(), cond, semaphore ...)
+_LOCK_NAME_RE = re.compile(r"(lock|mutex|cond|cv|sem)", re.IGNORECASE)
+
+
+def _is_lock_guard(item: ast.withitem) -> bool:
+    expr = item.context_expr
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr)
+    seg = _last_segment(name)
+    return bool(seg and _LOCK_NAME_RE.search(seg))
+
+
+def _worker_fn_names(tree: ast.AST) -> Set[str]:
+    """Function names handed to Thread(target=...) or executor.submit(fn)
+    anywhere in the module."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = _last_segment(_callee(node))
+        if seg == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = _last_segment(dotted_name(kw.value))
+                    if target:
+                        names.add(target)
+        elif seg in ("submit", "apply_async"):
+            if node.args:
+                target = _last_segment(dotted_name(node.args[0]))
+                if target:
+                    names.add(target)
+    return names
+
+
+def _shared_mutation_target(node: ast.AST, local_names: Set[str],
+                            global_names: Set[str]) -> Optional[str]:
+    """Dotted name of the shared state a statement mutates, or None.
+
+    Shared = an attribute chain (self.x, module.flag, self.d[k]) or a
+    module-global the worker declared ``global``. Plain locals are private
+    to the worker and never flagged."""
+    targets: List[ast.AST] = []
+    if isinstance(node, (ast.Assign,)):
+        targets = list(node.targets)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    else:
+        return None
+    flat: List[ast.AST] = []
+    for t in targets:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            flat.extend(t.elts)
+        else:
+            flat.append(t)
+    for t in flat:
+        while isinstance(t, (ast.Subscript, ast.Starred)):
+            t = t.value
+        if isinstance(t, ast.Attribute):
+            return dotted_name(t) or t.attr
+        if isinstance(t, ast.Name) and t.id in global_names \
+                and t.id not in local_names:
+            return t.id
+    return None
+
+
+@ast_rule(UNGUARDED_WORKER_STATE)
+def check_unguarded_worker_state(ctx: ModuleContext):
+    workers = _worker_fn_names(ctx.tree)
+    if not workers:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in workers:
+            continue
+        global_names: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Global):
+                global_names.update(n.names)
+        local_names = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                       + node.args.kwonlyargs)}
+
+        def scan(body, guarded):
+            for stmt in body:
+                if isinstance(stmt, ast.With):
+                    yield from scan(stmt.body,
+                                    guarded or any(_is_lock_guard(i)
+                                                   for i in stmt.items))
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue  # nested defs get their own worker analysis
+                if not guarded:
+                    shared = _shared_mutation_target(stmt, local_names,
+                                                    global_names)
+                    if shared is not None:
+                        yield stmt, shared
+                for child_body in (getattr(stmt, "body", []),
+                                   getattr(stmt, "orelse", []),
+                                   getattr(stmt, "finalbody", [])):
+                    if child_body:
+                        yield from scan(child_body, guarded)
+                for handler in getattr(stmt, "handlers", []):
+                    yield from scan(handler.body, guarded)
+
+        for stmt, shared in scan(node.body, False):
+            yield _finding(
+                UNGUARDED_WORKER_STATE, ctx, stmt,
+                f"worker {node.name}() mutates shared state {shared!r} "
+                "outside a lock — racing the thread that reads it")
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 

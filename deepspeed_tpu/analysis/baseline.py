@@ -24,20 +24,21 @@ from .findings import Finding, sort_findings
 TRACE_PREFIX = "<trace:"
 SPMD_PREFIX = "<spmd:"
 SCHED_PREFIX = "<sched:"
+PLAN_PREFIX = "<plan:"
 
-#: the four layers a finding can come from, keyed by its path marker.
+#: the five layers a finding can come from, keyed by its path marker.
 #: Layers don't always run together (the jaxpr audit needs a working JAX,
-#: the SPMD/schedule audits additionally compile), so baseline diffs must
-#: only cover the layers that actually ran — otherwise an AST-only run
-#: reports grandfathered jaxpr/spmd/schedule entries as stale, and
-#: ``--write-baseline`` silently drops them.
-LAYER_KEYS = ("ast", "jaxpr", "spmd", "schedule")
+#: the SPMD/schedule/feasibility audits additionally compile), so baseline
+#: diffs must only cover the layers that actually ran — otherwise an
+#: AST-only run reports grandfathered jaxpr/spmd/schedule/feasibility
+#: entries as stale, and ``--write-baseline`` silently drops them.
+LAYER_KEYS = ("ast", "jaxpr", "spmd", "schedule", "feasibility")
 
 #: path markers of the entry-point layers (everything except "ast") — the
 #: layers whose baseline entries are keyed by a registered entry-point
 #: name rather than a source file.
 ENTRY_PREFIXES = {"jaxpr": TRACE_PREFIX, "spmd": SPMD_PREFIX,
-                  "schedule": SCHED_PREFIX}
+                  "schedule": SCHED_PREFIX, "feasibility": PLAN_PREFIX}
 
 
 def finding_layer(f: Finding) -> str:
@@ -47,6 +48,8 @@ def finding_layer(f: Finding) -> str:
         return "spmd"
     if f.path.startswith(SCHED_PREFIX):
         return "schedule"
+    if f.path.startswith(PLAN_PREFIX):
+        return "feasibility"
     return "ast"
 
 
@@ -82,7 +85,8 @@ def by_layer(findings: List[Finding]) -> Dict[str, List[Finding]]:
 
 
 def split_layers(findings: List[Finding]) -> Tuple[List[Finding], ...]:
-    """-> (ast, jaxpr, spmd, schedule) findings, by path marker."""
+    """-> (ast, jaxpr, spmd, schedule, feasibility) findings, by path
+    marker."""
     layers = by_layer(findings)
     return tuple(layers[k] for k in LAYER_KEYS)
 

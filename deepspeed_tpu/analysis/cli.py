@@ -126,6 +126,22 @@ def run_schedule_layer(entry_names=None, exposure_path=None, entries=None):
     return findings, reports, checked
 
 
+def run_feasibility_layer(entry_names=None, exposure_path=None, entries=None):
+    """Layer E (``--feasibility``): the static config-feasibility oracle
+    over the HEAD default configs -> (findings, verdicts). Exposure
+    rejections use the committed budgets under the same mesh-match
+    semantics as Layer D; ``entries`` is the shared compile pass."""
+    from .budgets import env_matches
+    from .feasibility import evaluate_entries
+    from .schedule_audit import default_exposure_path, load_exposure_budgets
+
+    path = exposure_path or default_exposure_path()
+    exposure = load_exposure_budgets(path)
+    if exposure is not None and not env_matches(exposure):
+        exposure = None
+    return evaluate_entries(entry_names, entries=entries, exposure=exposure)
+
+
 def render(findings: List[Finding], fix_hints: bool) -> str:
     lines = []
     for f in findings:
@@ -157,6 +173,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "overlapped/exposed/serialized, checks "
                              "tools/exposure_budgets.json, and refreshes "
                              "tools/collective_maps/<entry>.json)")
+    parser.add_argument("--feasibility", action="store_true",
+                        help="also run the Layer-E config-feasibility "
+                             "audits (the `dstpu plan` oracle over the "
+                             "HEAD default configs: HBM fit, compile, "
+                             "exposure, donation)")
+    parser.add_argument("--all", action="store_true", dest="all_layers",
+                        help="run every layer (A-E: AST + --jaxpr + --spmd "
+                             "+ --schedule + --feasibility) off one shared "
+                             "compile per entry")
     parser.add_argument("--maps-dir", default=None,
                         help="directory for the per-entry collective maps "
                              "a --schedule run emits (default: "
@@ -229,6 +254,7 @@ def _main(args) -> int:
         from . import trace_harness  # noqa: F401 — registers Layer-B rules
         from . import spmd_audit  # noqa: F401 — registers Layer-C rules
         from . import schedule_audit  # noqa: F401 — registers Layer-D rules
+        from . import feasibility  # noqa: F401 — registers Layer-E rules
         for rule in all_rules():
             print(f"{rule.rule_id:26} [{rule.layer}/{rule.severity}] "
                   f"{rule.description}")
@@ -240,8 +266,14 @@ def _main(args) -> int:
             print(f"dstpu lint: no such path: {p}", file=sys.stderr)
             return 2
 
+    if args.all_layers:
+        args.jaxpr = True
+        args.spmd = True
+        args.schedule = True
+        args.feasibility = True
     run_spmd = args.spmd or args.update_budgets
     run_sched = args.schedule
+    run_feas = args.feasibility
     if run_spmd or run_sched:
         # fail fast on budget-file problems BEFORE the ~40s compile audit:
         # a typo'd explicit --budgets path must not silently disable the
@@ -280,14 +312,15 @@ def _main(args) -> int:
     findings = run_ast_layer(paths)
     spmd_reports = {}
     sched_reports = {}
+    feas_verdicts = {}
     budgets_checked = False
     exposure_checked = False
     try:
         if args.jaxpr:
             findings += run_jaxpr_layer(args.entry)
         shared_entries = None
-        if run_spmd and run_sched:
-            # one lower+compile pass feeds both compiled layers
+        if sum((run_spmd, run_sched, run_feas)) >= 2:
+            # one lower+compile pass feeds every compiled layer (C, D, E)
             from .spmd_audit import iter_compiled_entries
             shared_entries = list(iter_compiled_entries(args.entry))
         if run_spmd:
@@ -299,6 +332,10 @@ def _main(args) -> int:
                 run_schedule_layer(args.entry, args.exposure_budgets,
                                    entries=shared_entries)
             findings += sched_findings
+        if run_feas:
+            feas_findings, feas_verdicts = run_feasibility_layer(
+                args.entry, args.exposure_budgets, entries=shared_entries)
+            findings += feas_findings
     except ValueError as e:
         print(f"dstpu lint: {e}", file=sys.stderr)
         return 2
@@ -365,7 +402,8 @@ def _main(args) -> int:
 
     ran_layers = {"ast"} | ({"jaxpr"} if args.jaxpr else set()) \
         | ({"spmd"} if run_spmd else set()) \
-        | ({"schedule"} if run_sched else set())
+        | ({"schedule"} if run_sched else set()) \
+        | ({"feasibility"} if run_feas else set())
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
         # A partial run must not erase grandfathered entries for the
@@ -423,6 +461,9 @@ def _main(args) -> int:
                                            for k, r in sched_reports.items()}
             payload["collective_maps"] = collective_maps
             payload["exposure_checked"] = exposure_checked
+        if run_feas:
+            payload["feasibility_verdicts"] = {
+                k: v.to_dict() for k, v in feas_verdicts.items()}
         print(json.dumps(payload, indent=2))
     else:
         report = new if not args.no_baseline else findings

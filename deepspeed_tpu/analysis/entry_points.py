@@ -38,6 +38,7 @@ Layer-C expectations on a spec:
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 from typing import Any, Callable, Dict, FrozenSet, List, Optional, Sequence, Tuple
 
@@ -79,23 +80,65 @@ class EntrySpec:
         return self.mesh if self.mesh is not None else contextlib.nullcontext()
 
 
+#: the active candidate overrides (installed by :func:`candidate_overrides`,
+#: consulted by ``_tiny_engine`` / ``_batch``): ``{"config": nested config
+#: overrides, "model": gpt2_model kwargs, "batch": {"size", "seq"}}``.
+#: Empty = HEAD defaults, which is every path except `dstpu plan`.
+_CANDIDATE: Dict[str, Dict[str, Any]] = {}
+
+#: the entries whose spec builders synthesize an engine from a config dict
+#: — the only ones a candidate config can re-parameterize. The rest build
+#: fixed toy programs; `dstpu plan` rejects candidates targeting them
+#: rather than silently auditing the default program.
+CANDIDATE_ENTRY_POINTS: Tuple[str, ...] = (
+    "engine-train-step", "zero-gather-partition", "zeropp-micro-overlap",
+    "telemetry-off-parity", "guardian-step-parity")
+
+
+@contextlib.contextmanager
+def candidate_overrides(config=None, model=None, batch=None):
+    """Install candidate overrides for the duration of a spec build:
+    ``config`` deep-merges over the builder's engine config (the same
+    :func:`~deepspeed_tpu.runtime.config.deep_update` semantics the
+    engine build validates under), ``model`` overrides the tiny-model
+    kwargs (e.g. ``remat``), ``batch`` overrides the representative batch
+    shape (``size``/``seq``). This is how `dstpu plan` re-parameterizes
+    the EXISTING registry builders instead of growing a parallel set."""
+    global _CANDIDATE
+    old = _CANDIDATE
+    _CANDIDATE = {"config": config or {}, "model": model or {},
+                  "batch": batch or {}}
+    try:
+        yield
+    finally:
+        _CANDIDATE = old
+
+
 def _tiny_engine(config_extra=None, **model_kw):
     import deepspeed_tpu
     from deepspeed_tpu.models import gpt2_model
+    from deepspeed_tpu.runtime.config import deep_update
 
     config = {
         "train_micro_batch_size_per_gpu": 1,
         "optimizer": {"type": "adamw", "params": {"lr": 1e-3}},
         "zero_optimization": {"stage": 1},
     }
-    config.update(config_extra or {})
-    model = gpt2_model("gpt2-tiny", **dict(_TINY, **model_kw))
+    deep_update(config, config_extra)
+    deep_update(config, _CANDIDATE.get("config"))
+    model_args = dict(_TINY)
+    model_args.update(model_kw)
+    model_args.update(_CANDIDATE.get("model", {}))
+    model = gpt2_model("gpt2-tiny", **model_args)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config=config)
     return engine
 
 
 def _batch(engine, batch=8, seq=16):
     import numpy as np
+    over = _CANDIDATE.get("batch", {})
+    batch = int(over.get("size", batch))
+    seq = int(over.get("seq", seq))
     ids = np.zeros((batch, seq), dtype=np.int32)
     return engine._prepare_batch({"input_ids": ids})
 

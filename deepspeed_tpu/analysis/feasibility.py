@@ -1,0 +1,814 @@
+"""Layer E — the static config-feasibility oracle (``dstpu plan``).
+
+The missing piece under ROADMAP item 3 (the autotuner "brain"): take a
+*candidate* config — mesh/bucket/remat/moment-dtype/transport/batch knobs
+layered over a base engine config — and decide **feasible / infeasible**
+plus a predicted static cost WITHOUT running a step. The reference
+DeepSpeed's ``autotuning/`` layer answers the same question dynamically
+with trial runs; here everything the trial would reveal is already in the
+compiled artifact the other lint layers audit:
+
+- **HBM fit** — XLA's ``memory_analysis`` of the partitioned program
+  (the Layer-C budget quantity) against the per-device HBM of the
+  accelerator table below (``DSTPU_HBM_BYTES`` overrides).
+- **Partitionability** — the compile itself: a candidate whose shapes
+  don't partition on the declared mesh dies in ``lower().compile()``,
+  which is the ``spmd-lower-failed`` rejection.
+- **Exposure** — the Layer-D schedule walk's exposed collective bytes
+  against the committed shrink-only budget: a candidate that un-hides
+  communication the repo already proved hideable is rejected statically.
+- **Donation** — the Layer-C ``dead-donation`` alias check: a candidate
+  that makes XLA drop a donated buffer pays double-residency at peak,
+  which on a full-size model IS an OOM the memory analysis of the tiny
+  audit program can't see.
+
+One compile serves Layers C, D and E (``iter_compiled_entries`` /
+``analysis/lowering.py``); candidate synthesis re-parameterizes the
+EXISTING registry builders via
+:func:`~.entry_points.candidate_overrides`, and candidate validation is
+the SAME :class:`~deepspeed_tpu.runtime.config.DeepSpeedConfig` pass the
+engine build runs (``validate_candidate_config``), so `plan` can never
+accept a config the engine would reject (or vice versa).
+
+Cost-model semantics (and their audit-mesh limits): ``cost`` is
+*flop-equivalents* — ``predicted_step_flops`` (the Layer-D
+:class:`~.schedule_audit.FlopModel` over the entry computation, the same
+dot/conv costing MFU keys on) **plus** ``exposed_bytes /
+bytes_per_flop`` (the Layer-D roofline ratio converting exposed
+communication into the compute a device could have done while moving
+those bytes). It ranks candidates; it is NOT a wall-clock claim —
+numbers taken on the 8-device CPU audit mesh rank *schedule structure*,
+and transfer to a real pod only insofar as the partitioning transfers
+(the same caveat the committed budgets carry; docs/STATIC_ANALYSIS.md).
+
+Artifacts: ``tools/feasibility/<entry>.json`` — the HEAD default
+config's verdict per entry, deterministic (no wall times, no
+trace-cache-dependent transport summary), refreshed by
+``dstpu plan --update-artifacts`` and drift-checked by the tier-1
+artifact-freshness gate. The future autotuner controller consumes these
+as its warm-start priors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .findings import Finding, SEVERITY_ERROR
+from .registry import LAYER_FEASIBILITY, Rule, register
+
+PLAN_PREFIX = "<plan:"
+
+CONFIG_INFEASIBLE = register(Rule(
+    rule_id="config-infeasible", layer=LAYER_FEASIBILITY,
+    severity=SEVERITY_ERROR,
+    description="The entry point's config is statically infeasible: HBM "
+                "overflow vs the device budget, unpartitionable shapes "
+                "(compile failure), exposed collective bytes over the "
+                "committed budget, or a dead donation on a donated buffer",
+    fix_hint="run `dstpu plan --entry <name>` for the full verdict; shrink "
+             "the candidate (batch/remat/moment dtypes), fix the sharding, "
+             "or re-overlap the exposed collective"))
+
+FEASIBILITY_AUDIT_FAILED = register(Rule(
+    rule_id="feasibility-audit-failed", layer=LAYER_FEASIBILITY,
+    severity=SEVERITY_ERROR,
+    description="The feasibility oracle itself could not produce a verdict "
+                "for the entry point (spec build crashed before lowering)",
+    fix_hint="run the audit under JAX_PLATFORMS=cpu with "
+             "xla_force_host_platform_device_count>=8 and fix the build "
+             "error"))
+
+#: per-device HBM by accelerator (marketing capacities, same stated-
+#: convention contract as telemetry's ``PEAK_FLOPS_BY_KIND`` and Layer D's
+#: ``BYTES_PER_FLOP_BY_KIND``). Keyed by substrings of
+#: ``jax.devices()[0].device_kind`` lowercased. The "cpu" row is the
+#: audit-mesh stand-in: generous enough that HEAD's tiny audit programs
+#: always fit — real rejections on the audit mesh come from
+#: ``DSTPU_HBM_BYTES`` pinning a deliberate ceiling.
+HBM_BYTES_BY_KIND = (
+    ("v6e", int(32e9)),
+    ("v5p", int(95e9)),
+    ("v5e", int(16e9)),
+    ("v5 lite", int(16e9)),
+    ("v4", int(32e9)),
+    ("v3", int(16e9)),
+    ("v2", int(8e9)),
+    ("cpu", int(16e9)),
+)
+
+
+def hbm_bytes_per_device(device_kind: Optional[str] = None) -> int:
+    """Per-device HBM budget from the accelerator table;
+    ``DSTPU_HBM_BYTES`` (per-device, in bytes) overrides."""
+    env = os.environ.get("DSTPU_HBM_BYTES")
+    if env:
+        return int(float(env))
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # pragma: no cover - no backend
+            return int(16e9)
+    kind = (device_kind or "").lower()
+    for key, nbytes in HBM_BYTES_BY_KIND:
+        if key in kind:
+            return nbytes
+    return int(16e9)
+
+
+# ---------------------------------------------------------------------------
+# candidates
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the search space: overrides layered on a registry
+    builder's HEAD defaults. ``config`` deep-merges into the engine
+    config (nested dict form), ``model`` overrides tiny-model kwargs
+    (e.g. ``remat``), ``batch`` the representative batch shape
+    (``size``/``seq``). ``label`` is display-only."""
+    label: str = "candidate"
+    config: Tuple[Tuple[str, Any], ...] = ()     # frozen as sorted items
+    model: Tuple[Tuple[str, Any], ...] = ()
+    batch: Tuple[Tuple[str, Any], ...] = ()
+
+    @staticmethod
+    def from_overrides(overrides: Dict[str, Any],
+                       label: Optional[str] = None) -> "Candidate":
+        """Build from FLAT dotted overrides: ``model.*`` keys go to the
+        model namespace, ``batch.*`` to the batch shape, everything else
+        is a (dotted) engine-config path."""
+        from deepspeed_tpu.runtime.config import expand_dotted
+
+        config: Dict[str, Any] = {}
+        model: Dict[str, Any] = {}
+        batch: Dict[str, Any] = {}
+        for key, value in overrides.items():
+            if key.startswith("model."):
+                model[key[len("model."):]] = value
+            elif key.startswith("batch."):
+                batch[key[len("batch."):]] = value
+            else:
+                config[key] = value
+        lbl = label if label is not None else ",".join(
+            f"{k}={json.dumps(v)}" for k, v in sorted(overrides.items()))
+        return Candidate(
+            label=lbl or "candidate",
+            config=_freeze(expand_dotted(config)),
+            model=_freeze(model), batch=_freeze(batch))
+
+    def namespaces(self) -> Tuple[Dict, Dict, Dict]:
+        return _thaw(self.config), _thaw(self.model), _thaw(self.batch)
+
+    def to_dict(self) -> Dict[str, Any]:
+        config, model, batch = self.namespaces()
+        return {"label": self.label, "config": config, "model": model,
+                "batch": batch}
+
+
+def _freeze(d: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+    return tuple(sorted(
+        (k, _freeze(v) if isinstance(v, dict) else v) for k, v in d.items()))
+
+
+def _thaw(items) -> Dict[str, Any]:
+    return {k: _thaw(v) if isinstance(v, tuple) else v for k, v in items}
+
+
+# ---------------------------------------------------------------------------
+# the verdict
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FeasibilityVerdict:
+    """What `dstpu plan` answers for one (entry, candidate): go / no-go
+    with every rejection named, plus the static numbers the cost model
+    and the autotuner controller rank on."""
+    entry: str
+    feasible: bool
+    reasons: List[str]                     # empty iff feasible
+    mesh_devices: int
+    device_kind: str
+    candidate: Optional[Dict[str, Any]]    # None = HEAD defaults
+    hbm_bytes: int                         # peak per-device program bytes
+    hbm_budget_bytes: int
+    memory: Dict[str, int]                 # raw memory_analysis fields
+    collective_bytes: int
+    collective_bytes_by_kind: Dict[str, int]
+    exposed_bytes: int
+    overlapped_bytes: int
+    exposure_budget_bytes: Optional[int]   # None = no committed budget
+    predicted_step_flops: int
+    bytes_per_flop: float
+    cost: float                            # flop-equivalents (see module doc)
+    tokens_per_step: Optional[int]
+    cost_per_token: Optional[float]
+    transport_plan_summary: Optional[Dict[str, int]]
+    compile_wall: Optional[float]          # seconds; NOT in the artifact
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_artifact(self) -> Dict[str, Any]:
+        """The deterministic committed form: everything except wall
+        times (compile_wall varies run to run) and the transport
+        summary (the ledger records NOTHING on a trace-cache hit, so
+        its numbers depend on process history — see
+        ``trace_runtime_ledger``). The artifact must diff clean when
+        nothing changed."""
+        out = self.to_dict()
+        out.pop("compile_wall")
+        out.pop("transport_plan_summary")
+        return out
+
+
+def _infeasible(entry: str, reasons: List[str], *, mesh_devices: int,
+                device_kind: str, candidate: Optional[Candidate],
+                compile_wall: Optional[float] = None) -> FeasibilityVerdict:
+    """A verdict for a candidate that never produced an artifact (compile
+    failure, invalid config, or statically pruned)."""
+    return FeasibilityVerdict(
+        entry=entry, feasible=False, reasons=list(reasons),
+        mesh_devices=mesh_devices, device_kind=device_kind,
+        candidate=candidate.to_dict() if candidate else None,
+        hbm_bytes=0, hbm_budget_bytes=hbm_bytes_per_device(device_kind),
+        memory={}, collective_bytes=0, collective_bytes_by_kind={},
+        exposed_bytes=0, overlapped_bytes=0, exposure_budget_bytes=None,
+        predicted_step_flops=0, bytes_per_flop=0.0, cost=float("inf"),
+        tokens_per_step=None, cost_per_token=None,
+        transport_plan_summary=None, compile_wall=compile_wall)
+
+
+def _device_env() -> Tuple[int, str]:
+    import jax
+    return jax.device_count(), jax.devices()[0].device_kind
+
+
+def transport_summary(spec) -> Optional[Dict[str, int]]:
+    """Trace the transport-planner ledger for ``spec`` and summarize it
+    (overlapped/exposed split plus logical-vs-wire bytes). MUST run
+    BEFORE the spec is lowered — jax caches traces, so tracing after a
+    compile records nothing; for the same reason the summary depends on
+    process history (an entry whose fn was already traced records
+    empty), which is why it is advisory display output and excluded
+    from the committed artifact. None when the trace itself fails."""
+    from .schedule_audit import trace_runtime_ledger
+
+    try:
+        ledger = trace_runtime_ledger(spec)
+        transport = dict(ledger.split(wire=True))
+        transport["logical_bytes"] = sum(
+            r["bytes"] * r["count"] for r in ledger.records)
+        transport["wire_bytes"] = sum(
+            r["wire_bytes"] * r["count"] for r in ledger.records)
+        transport["records"] = len(ledger.records)
+        return transport
+    except Exception:  # noqa: BLE001 — advisory
+        return None
+
+
+def evaluate_compiled(spec, artifact, *, exposure: Optional[Dict] = None,
+                      candidate: Optional[Candidate] = None,
+                      compile_wall: Optional[float] = None,
+                      transport: Optional[Dict[str, int]] = None,
+                      tokens_per_step: Optional[int] = None,
+                      ) -> FeasibilityVerdict:
+    """The Layer-E verdict over an already-compiled artifact — the shared
+    half ``dstpu lint --feasibility`` reuses off the one compile pass
+    Layers C and D consume."""
+    from .schedule_audit import (ScheduleReport, bytes_per_flop,
+                                 entry_computation, FlopModel,
+                                 parse_hlo_computations, walk_schedule)
+    from .spmd_audit import audit_artifact
+
+    mesh_devices, device_kind = _device_env()
+    reasons: List[str] = []
+
+    # Layer C's machinery: collectives by kind + the dead-donation check
+    spmd_findings, spmd_report = audit_artifact(spec, artifact)
+    dead = [f for f in spmd_findings if f.rule_id == "dead-donation"]
+    if dead:
+        reasons.append(
+            f"dead-donation: {len(dead)} donated buffer(s) not aliased by "
+            "XLA — double residency at peak on the full-size model")
+
+    # HBM fit: peak per-device program bytes vs the accelerator budget.
+    # arguments + outputs + temps, minus the donated bytes XLA aliased
+    # (an aliased output shares its argument's buffer).
+    mem = {k: int(v) for k, v in (spmd_report.memory or {}).items()}
+    hbm_bytes = (mem.get("argument_size_in_bytes", 0)
+                 + mem.get("output_size_in_bytes", 0)
+                 + mem.get("temp_size_in_bytes", 0)
+                 - mem.get("alias_size_in_bytes", 0))
+    hbm_budget = hbm_bytes_per_device(device_kind)
+    if hbm_bytes > hbm_budget:
+        reasons.append(
+            f"hbm-overflow: {hbm_bytes} B/device > {hbm_budget} B "
+            f"({device_kind} budget)")
+
+    # Layer D's machinery: schedule walk -> exposed split + the FLOP model
+    ratio = bytes_per_flop(device_kind)
+    comps = parse_hlo_computations(artifact.hlo_text)
+    records, _ = walk_schedule(comps, ratio)
+    sched = ScheduleReport(name=spec.name, records=records,
+                           bytes_per_flop=ratio)
+    exposed = int(sched.exposed_bytes)
+    exposure_budget: Optional[int] = None
+    if exposure is not None:
+        entry_budget = exposure.get("budgets", {}).get(spec.name)
+        if entry_budget is not None:
+            exposure_budget = int(entry_budget.get("exposed_bytes", 0))
+            if exposed > exposure_budget:
+                reasons.append(
+                    f"exposure-over-budget: {exposed} B exposed > committed "
+                    f"{exposure_budget} B — the candidate un-hides "
+                    "communication the committed schedule overlaps")
+
+    entry_comp = entry_computation(comps)
+    flops = (FlopModel(comps).computation_flops(entry_comp.name)
+             if entry_comp is not None else 0)
+    cost = float(flops) + (exposed / ratio if ratio > 0 else 0.0)
+
+    return FeasibilityVerdict(
+        entry=spec.name, feasible=not reasons, reasons=reasons,
+        mesh_devices=mesh_devices, device_kind=device_kind,
+        candidate=candidate.to_dict() if candidate else None,
+        hbm_bytes=int(hbm_bytes), hbm_budget_bytes=int(hbm_budget),
+        memory=mem, collective_bytes=int(spmd_report.collective_bytes),
+        collective_bytes_by_kind=dict(
+            sorted(spmd_report.collective_bytes_by_kind.items())),
+        exposed_bytes=exposed,
+        overlapped_bytes=int(sched.overlapped_bytes),
+        exposure_budget_bytes=exposure_budget,
+        predicted_step_flops=int(flops), bytes_per_flop=ratio, cost=cost,
+        tokens_per_step=tokens_per_step,
+        cost_per_token=(cost / tokens_per_step
+                        if tokens_per_step else None),
+        transport_plan_summary=transport, compile_wall=compile_wall)
+
+
+def _candidate_tokens(name: str, candidate: Optional[Candidate]
+                      ) -> Optional[int]:
+    """tokens/step for the entries whose representative batch the
+    candidate controls (the ``_batch`` defaults otherwise); None for the
+    fixed toy programs where tokens/step is not a meaningful unit."""
+    from .entry_points import CANDIDATE_ENTRY_POINTS
+
+    if name not in CANDIDATE_ENTRY_POINTS:
+        return None
+    batch = dict(candidate.namespaces()[2]) if candidate else {}
+    return int(batch.get("size", 8)) * int(batch.get("seq", 16))
+
+
+def evaluate_entry(name: str, candidate: Optional[Candidate] = None,
+                   exposure: Optional[Dict] = None) -> FeasibilityVerdict:
+    """Build, lower and compile one entry (optionally re-parameterized by
+    ``candidate``) and return its verdict. This is the standalone
+    `dstpu plan` path: it additionally traces the transport-planner
+    ledger (BEFORE compiling — jax caches traces, so tracing after the
+    compile would record nothing) for the wire-vs-logical byte summary."""
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                              validate_candidate_config)
+
+    from .entry_points import (CANDIDATE_ENTRY_POINTS, build_spec,
+                               candidate_overrides)
+    from .lowering import lower_entry
+
+    mesh_devices, device_kind = _device_env()
+    config, model, batch = (candidate.namespaces() if candidate
+                            else ({}, {}, {}))
+    if candidate and name not in CANDIDATE_ENTRY_POINTS:
+        return _infeasible(
+            name, [f"candidate-unsupported: {name!r} builds a fixed toy "
+                   f"program; candidates re-parameterize "
+                   f"{', '.join(CANDIDATE_ENTRY_POINTS)}"],
+            mesh_devices=mesh_devices, device_kind=device_kind,
+            candidate=candidate)
+    if config:
+        # the engine-build validation pass, paid BEFORE any compile
+        try:
+            validate_candidate_config({}, config)
+        except DeepSpeedConfigError as e:
+            return _infeasible(
+                name, [f"config-invalid: {e}"], mesh_devices=mesh_devices,
+                device_kind=device_kind, candidate=candidate)
+
+    tokens = _candidate_tokens(name, candidate)
+    start = time.monotonic()
+    with candidate_overrides(config=config, model=model, batch=batch):
+        try:
+            spec = build_spec(name)
+        except DeepSpeedConfigError as e:
+            # the engine-build validation (mesh-aware batch math etc.)
+            # rejecting the merged config — a config error, not a
+            # partitioning one
+            return _infeasible(
+                name, [f"config-invalid: {e}"], mesh_devices=mesh_devices,
+                device_kind=device_kind, candidate=candidate,
+                compile_wall=time.monotonic() - start)
+        except Exception as e:  # noqa: BLE001 — any build failure rejects
+            return _infeasible(
+                name, [f"spmd-lower-failed: entry point failed to build: "
+                       f"{type(e).__name__}: {e}"],
+                mesh_devices=mesh_devices, device_kind=device_kind,
+                candidate=candidate,
+                compile_wall=time.monotonic() - start)
+        transport = transport_summary(spec)
+        try:
+            with spec.mesh_ctx():
+                artifact = lower_entry(
+                    spec.fn, spec.args, donate_argnums=spec.donate_argnums,
+                    jit_kwargs=spec.jit_kwargs, name=spec.name)
+        except Exception as e:  # noqa: BLE001 — unpartitionable = rejected
+            return _infeasible(
+                name, [f"spmd-lower-failed: {type(e).__name__}: {e}"],
+                mesh_devices=mesh_devices, device_kind=device_kind,
+                candidate=candidate,
+                compile_wall=time.monotonic() - start)
+    wall = time.monotonic() - start
+    return evaluate_compiled(spec, artifact, exposure=exposure,
+                             candidate=candidate, compile_wall=wall,
+                             transport=transport, tokens_per_step=tokens)
+
+
+def evaluate_entries(names=None, entries=None, exposure: Optional[Dict] = None
+                     ) -> Tuple[List[Finding], Dict[str, FeasibilityVerdict]]:
+    """Layer E over the registered entry points at HEAD defaults — the
+    ``dstpu lint --feasibility`` integration. ``entries`` is an optional
+    pre-materialized :func:`~.spmd_audit.iter_compiled_entries` result
+    (the shared compile pass); verdicts taken this way omit the
+    transport summary (the specs were already traced, so a ledger trace
+    would record nothing — `dstpu plan` owns the full artifact)."""
+    from .spmd_audit import iter_compiled_entries
+
+    findings: List[Finding] = []
+    verdicts: Dict[str, FeasibilityVerdict] = {}
+    mesh_devices, device_kind = _device_env()
+    for name, spec, artifact, error in (
+            entries if entries is not None else iter_compiled_entries(names)):
+        if error is not None:
+            verdict = _infeasible(
+                name, [f"spmd-lower-failed: {error}"],
+                mesh_devices=mesh_devices, device_kind=device_kind,
+                candidate=None)
+        else:
+            verdict = evaluate_compiled(
+                spec, artifact, exposure=exposure,
+                tokens_per_step=_candidate_tokens(name, None))
+        verdicts[name] = verdict
+        if not verdict.feasible:
+            findings.append(Finding(
+                rule_id=CONFIG_INFEASIBLE.rule_id,
+                path=f"{PLAN_PREFIX}{name}>", line=0,
+                severity=CONFIG_INFEASIBLE.severity,
+                message="HEAD config statically infeasible: "
+                        + "; ".join(verdict.reasons),
+                fix_hint=CONFIG_INFEASIBLE.fix_hint))
+    return findings, verdicts
+
+
+# ---------------------------------------------------------------------------
+# committed artifacts
+# ---------------------------------------------------------------------------
+
+def default_plans_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "feasibility")
+
+
+def write_verdict_artifact(plans_dir: str, verdict: FeasibilityVerdict
+                           ) -> str:
+    os.makedirs(plans_dir, exist_ok=True)
+    path = os.path.join(plans_dir, f"{verdict.entry}.json")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(verdict.to_artifact(), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return path
+
+
+def load_verdict_artifact(plans_dir: str, name: str) -> Optional[Dict]:
+    path = os.path.join(plans_dir, f"{name}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+# ---------------------------------------------------------------------------
+# grid sweeps
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SweepResult:
+    """One grid point's outcome. ``compiled`` False = statically pruned
+    (the verdict's infeasibility is implied by a dominated axis value, no
+    compile paid)."""
+    candidate: Candidate
+    verdict: FeasibilityVerdict
+    compiled: bool
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"candidate": self.candidate.to_dict(),
+                "verdict": self.verdict.to_dict(),
+                "compiled": self.compiled}
+
+
+def load_grid(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        grid = json.load(fh)
+    if "axes" not in grid or not isinstance(grid["axes"], dict):
+        raise ValueError(f"grid file {path} has no 'axes' object")
+    for axis in grid.get("monotone", []):
+        if axis not in grid["axes"]:
+            raise ValueError(f"monotone axis {axis!r} not in 'axes'")
+    return grid
+
+
+def expand_grid(grid: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The cartesian product of ``axes`` (flat dotted-override keys ->
+    value lists), merged over the optional flat ``base`` overrides.
+    Deterministic order: axes sorted by name, values in listed order."""
+    axes = grid["axes"]
+    names = sorted(axes)
+    base = grid.get("base", {})
+    points = []
+    for combo in itertools.product(*(range(len(axes[n])) for n in names)):
+        overrides = dict(base)
+        overrides.update({n: axes[n][i] for n, i in zip(names, combo)})
+        points.append(overrides)
+    return points
+
+
+def sweep(grid: Dict[str, Any], exposure: Optional[Dict] = None,
+          log=None) -> List[SweepResult]:
+    """Evaluate every grid point, pruning statically: when a point is
+    rejected for **hbm-overflow**, every point identical on the other
+    axes with a LATER value on a declared ``monotone`` axis (value lists
+    are ordered by increasing memory) is infeasible by domination and is
+    never compiled. Only the overflow rejection prunes — a compile
+    failure or exposure regression at one point says nothing about its
+    neighbors."""
+    entry = grid.get("entry", "engine-train-step")
+    axes = grid["axes"]
+    names = sorted(axes)
+    monotone = [a for a in grid.get("monotone", []) if a in axes]
+    # per monotone axis: {values-of-the-other-axes -> smallest index that
+    # overflowed}
+    dominated: Dict[str, Dict[Tuple, int]] = {a: {} for a in monotone}
+    results: List[SweepResult] = []
+    for overrides in expand_grid(grid):
+        candidate = Candidate.from_overrides(overrides)
+        pruned_by = None
+        for axis in monotone:
+            rest = tuple((n, json.dumps(overrides[n], sort_keys=True))
+                         for n in names if n != axis)
+            floor = dominated[axis].get(rest)
+            if floor is not None and axes[axis].index(overrides[axis]) >= floor:
+                pruned_by = (axis, axes[axis][floor])
+                break
+        if pruned_by is not None:
+            axis, value = pruned_by
+            verdict = _infeasible(
+                entry, [f"hbm-overflow: pruned without compiling — "
+                        f"dominated by {axis}={json.dumps(value)}, which "
+                        f"already overflowed with the same remaining axes"],
+                mesh_devices=_device_env()[0], device_kind=_device_env()[1],
+                candidate=candidate)
+            results.append(SweepResult(candidate, verdict, compiled=False))
+            continue
+        verdict = evaluate_entry(entry, candidate, exposure=exposure)
+        results.append(SweepResult(candidate, verdict, compiled=True))
+        if any(r.startswith("hbm-overflow") for r in verdict.reasons):
+            for axis in monotone:
+                rest = tuple((n, json.dumps(overrides[n], sort_keys=True))
+                             for n in names if n != axis)
+                idx = axes[axis].index(overrides[axis])
+                prev = dominated[axis].get(rest)
+                if prev is None or idx < prev:
+                    dominated[axis][rest] = idx
+    compiled = sum(1 for r in results if r.compiled)
+    if log is not None:
+        log(f"dstpu plan: compiled {compiled} of {len(results)} grid "
+            f"point(s) ({len(results) - compiled} pruned statically)")
+    return results
+
+
+def rank_survivors(results: List[SweepResult]) -> List[SweepResult]:
+    """Feasible points, cheapest first (cost-per-token when defined, raw
+    flop-equivalent cost otherwise; candidate label breaks ties so the
+    order is total and deterministic)."""
+    survivors = [r for r in results if r.verdict.feasible]
+    key = lambda r: (r.verdict.cost_per_token
+                     if r.verdict.cost_per_token is not None
+                     else r.verdict.cost, r.candidate.label)
+    return sorted(survivors, key=key)
+
+
+# ---------------------------------------------------------------------------
+# CLI — `dstpu plan`
+# ---------------------------------------------------------------------------
+
+def build_parser():
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dstpu plan",
+        description="Layer E: static config-feasibility oracle — compile "
+                    "and audit candidate configs without running a step "
+                    "(docs/STATIC_ANALYSIS.md)")
+    parser.add_argument("--entry", action="append", default=None,
+                        help="entry point(s) to evaluate (default: all "
+                             "registered; candidate/grid mode defaults to "
+                             "engine-train-step)")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="KEY=VALUE", dest="overrides",
+                        help="candidate override (dotted config path, or "
+                             "model.*/batch.* — JSON-parsed value), e.g. "
+                             "--set batch.size=64 "
+                             "--set model.remat=false --set "
+                             "data_types.optimizer_moment_dtype='\"float32\"'")
+    parser.add_argument("--candidate", default=None,
+                        help="candidate JSON file (flat dotted overrides, "
+                             "or {config/model/batch} namespaces)")
+    parser.add_argument("--grid", default=None,
+                        help="grid JSON file: {entry, base?, axes: {key: "
+                             "[values...]}, monotone?: [keys...]} — sweeps "
+                             "the cartesian product with static pruning")
+    parser.add_argument("--plans-dir", default=None,
+                        help="artifact directory (default: "
+                             "tools/feasibility)")
+    parser.add_argument("--update-artifacts", action="store_true",
+                        help="write tools/feasibility/<entry>.json for "
+                             "HEAD-default verdicts (deterministic; the "
+                             "tier-1 freshness gate diffs them)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit verdicts as JSON")
+    parser.add_argument("--list-entries", action="store_true",
+                        help="print the registered entry points and exit")
+    return parser
+
+
+def _parse_set(items: List[str]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for item in items:
+        if "=" not in item:
+            raise ValueError(f"--set expects KEY=VALUE, got {item!r}")
+        key, _, raw = item.partition("=")
+        try:
+            out[key] = json.loads(raw)
+        except json.JSONDecodeError:
+            out[key] = raw
+    return out
+
+
+def _load_candidate_file(path: str) -> Candidate:
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    if set(data) <= {"label", "config", "model", "batch"}:
+        from deepspeed_tpu.runtime.config import expand_dotted
+        return Candidate(
+            label=data.get("label", os.path.basename(path)),
+            config=_freeze(expand_dotted(data.get("config", {}))),
+            model=_freeze(data.get("model", {})),
+            batch=_freeze(data.get("batch", {})))
+    return Candidate.from_overrides(data, label=os.path.basename(path))
+
+
+def _render_verdict(v: FeasibilityVerdict) -> str:
+    head = "FEASIBLE" if v.feasible else "INFEASIBLE"
+    lines = [f"{v.entry}: {head}"
+             + (f" [{v.candidate['label']}]" if v.candidate else "")]
+    for reason in v.reasons:
+        lines.append(f"    reject: {reason}")
+    if v.memory:
+        lines.append(
+            f"    hbm {v.hbm_bytes} / {v.hbm_budget_bytes} B/device, "
+            f"collectives {v.collective_bytes} B, exposed "
+            f"{v.exposed_bytes} B"
+            + (f" (budget {v.exposure_budget_bytes} B)"
+               if v.exposure_budget_bytes is not None else "")
+            + f", flops {v.predicted_step_flops}, cost {v.cost:.3e}")
+    if v.compile_wall is not None:
+        lines.append(f"    compile {v.compile_wall:.2f}s")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import sys
+
+    try:
+        args = build_parser().parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    from .entry_points import SPEC_BUILDERS
+
+    if args.list_entries:
+        from .entry_points import CANDIDATE_ENTRY_POINTS
+        for name in sorted(SPEC_BUILDERS):
+            tag = " [candidate-capable]" if name in CANDIDATE_ENTRY_POINTS \
+                else ""
+            print(f"{name}{tag}")
+        return 0
+
+    try:
+        overrides = _parse_set(args.overrides)
+    except ValueError as e:
+        print(f"dstpu plan: {e}", file=sys.stderr)
+        return 2
+    if args.grid and (overrides or args.candidate):
+        print("dstpu plan: --grid is exclusive with --set/--candidate",
+              file=sys.stderr)
+        return 2
+
+    from .budgets import env_matches
+    from .schedule_audit import default_exposure_path, load_exposure_budgets
+    exposure = load_exposure_budgets(default_exposure_path())
+    if exposure is not None and not env_matches(exposure):
+        print("dstpu plan: exposure budgets committed for "
+              f"{exposure['mesh_devices']} devices — exposure rejections "
+              "skipped on this mesh", file=sys.stderr)
+        exposure = None
+
+    if args.grid:
+        try:
+            grid = load_grid(args.grid)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"dstpu plan: bad grid file: {e}", file=sys.stderr)
+            return 2
+        if args.entry:
+            grid["entry"] = args.entry[0]
+        results = sweep(grid, exposure=exposure,
+                        log=lambda m: print(m, file=sys.stderr))
+        ranked = rank_survivors(results)
+        if args.as_json:
+            print(json.dumps({
+                "entry": grid.get("entry", "engine-train-step"),
+                "grid_points": len(results),
+                "compiled": sum(1 for r in results if r.compiled),
+                "pruned": sum(1 for r in results if not r.compiled),
+                "results": [r.to_dict() for r in results],
+                "ranked": [r.candidate.label for r in ranked],
+            }, indent=2))
+        else:
+            for r in results:
+                print(_render_verdict(r.verdict)
+                      + ("" if r.compiled else "    (pruned, not compiled)"))
+            print(f"\n{len(ranked)} feasible of {len(results)} point(s); "
+                  "ranked cheapest first:")
+            for i, r in enumerate(ranked):
+                v = r.verdict
+                per_tok = (f", {v.cost_per_token:.3e}/token"
+                           if v.cost_per_token is not None else "")
+                print(f"  {i + 1}. {r.candidate.label} "
+                      f"(cost {v.cost:.3e}{per_tok})")
+        return 0 if ranked else 1
+
+    candidate: Optional[Candidate] = None
+    if args.candidate and overrides:
+        print("dstpu plan: --candidate is exclusive with --set",
+              file=sys.stderr)
+        return 2
+    if args.candidate:
+        try:
+            candidate = _load_candidate_file(args.candidate)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"dstpu plan: bad candidate file: {e}", file=sys.stderr)
+            return 2
+    if overrides:
+        candidate = Candidate.from_overrides(overrides)
+
+    if candidate is not None:
+        names = args.entry or ["engine-train-step"]
+    else:
+        names = args.entry or sorted(SPEC_BUILDERS)
+    unknown = sorted(set(names) - set(SPEC_BUILDERS))
+    if unknown:
+        print(f"dstpu plan: unknown entry point(s): {', '.join(unknown)}",
+              file=sys.stderr)
+        return 2
+
+    verdicts = []
+    for name in names:
+        verdict = evaluate_entry(name, candidate, exposure=exposure)
+        verdicts.append(verdict)
+        if not args.as_json:
+            print(_render_verdict(verdict))
+        if candidate is None and args.update_artifacts:
+            path = write_verdict_artifact(
+                args.plans_dir or default_plans_dir(), verdict)
+            print(f"wrote {path}", file=sys.stderr)
+    if args.as_json:
+        print(json.dumps({"verdicts": [v.to_dict() for v in verdicts]},
+                         indent=2))
+    return 0 if all(v.feasible for v in verdicts) else 1
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -172,7 +172,11 @@ class InferenceEngineV2:
         self._model = RaggedInferenceModel(
             model, block_size, self.max_blocks_per_seq,
             use_pallas=self._impls["decode"].name == "pallas_paged",
-            ragged_block_q=self.config.ragged_block_q)
+            ragged_block_q=self.config.ragged_block_q,
+            # MQA/odd head counts under tp: kv_heads can't shard over the
+            # model axis, and GSPMD mis-sums the rope'd K page scatter over
+            # the data axis (see RaggedInferenceModel.replicate_kv_writes)
+            replicate_kv_writes=(tp > 1 and c.kv_heads % tp != 0))
         self.model = model
 
         specs = model.specs()

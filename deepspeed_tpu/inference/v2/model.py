@@ -41,12 +41,22 @@ Params = Dict[str, Any]
 class RaggedInferenceModel:
 
     def __init__(self, model: TransformerLM, block_size: int, max_blocks_per_seq: int,
-                 use_pallas: bool = None, ragged_block_q: int = 8):
+                 use_pallas: bool = None, ragged_block_q: int = 8,
+                 replicate_kv_writes: bool = False):
         self.model = model
         self.config = model.config
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.use_pallas = use_pallas
+        # MQA under tp>1 (kv_heads % tp != 0): the KV projection's head dim
+        # cannot shard, and GSPMD's partitioning of the rope'd K scatter
+        # over the mesh's DATA axis mis-sums replicated updates (each data
+        # rank contributes the full update set — written K comes out
+        # scaled by the data-axis size). Pinning the pre-scatter operand
+        # replicated keeps the partitioner on the single-scatter path.
+        # Engine-set; never used on the shard_map (data-sharded pool)
+        # dispatch, which requires tp == 1.
+        self.replicate_kv_writes = replicate_kv_writes
         # atom tile of the unified wave program (wave_forward)
         self.ragged_block_q = ragged_block_q
         c = self.config
@@ -141,6 +151,9 @@ class RaggedInferenceModel:
         (ragged_ops.cpp:20-47) — here a scatter XLA turns into an in-place
         dynamic update on the donated cache.
         """
+        if self.replicate_kv_writes:
+            from jax.sharding import PartitionSpec
+            new = jax.lax.with_sharding_constraint(new, PartitionSpec())
         kvH, P, ps, D = pages.shape
         flat = pages.reshape(kvH, P * ps, D)
         flat = flat.at[:, flat_idx, :].set(new.astype(pages.dtype).transpose(1, 0, 2))

@@ -440,6 +440,12 @@ def main(args=None) -> int:
         # tools/memory_budgets.json — see docs/STATIC_ANALYSIS.md).
         from ..analysis.cli import main as lint_main
         return lint_main(argv[1:])
+    if argv and argv[0] == "plan":
+        # `dstpu plan ...` — the Layer-E static config-feasibility oracle
+        # (analysis/feasibility.py): compile-and-audit candidate configs
+        # without running a step — see docs/STATIC_ANALYSIS.md.
+        from ..analysis.feasibility import main as plan_main
+        return plan_main(argv[1:])
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
     if args.elastic_training:

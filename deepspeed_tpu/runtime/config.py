@@ -307,3 +307,63 @@ class DeepSpeedConfig:
         from ..utils.logging import logger
         logger.info(f"{name}:")
         logger.info(json.dumps(self._param_dict, indent=2, sort_keys=True, default=str))
+
+
+# ---------------------------------------------------------------------------
+# candidate-override plumbing (shared by the engine build and `dstpu plan`)
+# ---------------------------------------------------------------------------
+
+def deep_update(base: Dict[str, Any], overrides: Optional[Dict[str, Any]]
+                ) -> Dict[str, Any]:
+    """Recursively merge ``overrides`` into ``base`` IN PLACE (and return
+    it): nested dicts merge key-by-key, anything else replaces. This is
+    the one merge semantics for layering a partial config over a base —
+    the analysis entry-point builders (``_tiny_engine``) and the
+    feasibility oracle's candidate synthesis both use it, so a candidate
+    override lands exactly where the same key in a user config would."""
+    for key, value in (overrides or {}).items():
+        if isinstance(value, dict) and isinstance(base.get(key), dict):
+            deep_update(base[key], value)
+        else:
+            base[key] = value
+    return base
+
+
+def expand_dotted(overrides: Dict[str, Any]) -> Dict[str, Any]:
+    """``{"zero_optimization.stage": 3}`` -> ``{"zero_optimization":
+    {"stage": 3}}`` — the CLI/grid-file override syntax, normalized to
+    the nested form :func:`deep_update` merges."""
+    out: Dict[str, Any] = {}
+    for key, value in overrides.items():
+        parts = key.split(".")
+        node = out
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+            if not isinstance(node, dict):
+                raise DeepSpeedConfigError(
+                    f"override path {key!r} descends through a non-dict")
+        node[parts[-1]] = value
+    return out
+
+
+def validate_candidate_config(base: Optional[Dict[str, Any]],
+                              overrides: Optional[Dict[str, Any]] = None,
+                              mesh_topology=None) -> Dict[str, Any]:
+    """Merge ``overrides`` (nested dict form) over ``base`` and run the
+    SAME validation the engine build runs — :class:`DeepSpeedConfig`
+    construction, including batch-math resolution. Returns the merged
+    dict; raises :class:`DeepSpeedConfigError` on anything the engine
+    would reject, so `dstpu plan` can fail a candidate statically
+    without paying a spec build or a compile."""
+    merged = deep_update(json.loads(json.dumps(base or {})), overrides)
+    try:
+        DeepSpeedConfig(merged, mesh_topology=mesh_topology)
+    except DeepSpeedConfigError:
+        raise
+    except Exception as e:
+        # pydantic section models raise their own ValidationError; a
+        # candidate rejected there is still a config rejection, not an
+        # oracle crash
+        raise DeepSpeedConfigError(
+            f"candidate config rejected: {e}") from e
+    return merged

@@ -218,6 +218,42 @@ class MetricsEngine:
             return 0.0
         return self.model_flops_per_step / (step * self.peak_flops_total)
 
+    def feasibility_cross_check(self, entry: str,
+                                plans_dir: Optional[str] = None,
+                                rel_tol: float = 0.5) -> Optional[Dict]:
+        """Cross-check the MFU numerator against Layer E's committed
+        static prediction (``tools/feasibility/<entry>.json``,
+        ``dstpu plan --update-artifacts``).
+
+        ``model_flops_per_step`` is what the engine measured through the
+        flops profiler; ``predicted_step_flops`` is what the feasibility
+        oracle derived from the compiled HLO without running a step. A
+        ratio drifting outside ``[1 - rel_tol, 1 / (1 - rel_tol)]`` means
+        the committed verdict no longer describes the program that is
+        actually running (stale artifact, diverged config) — the same
+        drift the tier-1 freshness gate catches at commit time, caught
+        here at run time. Advisory only: never called on the hot path,
+        returns None when either side is missing."""
+        if self.model_flops_per_step <= 0:
+            return None
+        from ..analysis.feasibility import (default_plans_dir,
+                                            load_verdict_artifact)
+        artifact = load_verdict_artifact(plans_dir or default_plans_dir(),
+                                         entry)
+        if artifact is None:
+            return None
+        predicted = float(artifact.get("predicted_step_flops") or 0.0)
+        if predicted <= 0.0:
+            return None
+        ratio = self.model_flops_per_step / predicted
+        lo = max(0.0, 1.0 - rel_tol)
+        hi = 1.0 / lo if lo > 0 else float("inf")
+        return {"entry": entry,
+                "predicted_step_flops": predicted,
+                "model_flops_per_step": self.model_flops_per_step,
+                "ratio": ratio,
+                "consistent": lo <= ratio <= hi}
+
     def goodput(self) -> float:
         lost = self.stall_lost_s + self.checkpoint_lost_s
         total = self.productive_s + lost

@@ -1,0 +1,157 @@
+"""Artifact-freshness gate: the committed analysis artifacts must match
+what HEAD regenerates.
+
+Three artifact families chain off the same compile: the Layer-D
+collective maps (``tools/collective_maps/``), the overlap plans the
+runtime planner derives FROM those maps (``tools/overlap_plans/``), and
+the Layer-E feasibility verdicts (``tools/feasibility/``). Each already
+has a producer (`dstpu lint --schedule`, ``overlap_planner --update``,
+`dstpu plan --update-artifacts`); this module is the consumer-side CI
+check: ONE compile pass over the cheap gate subset regenerates all
+three and fails on any drift — a refreshed map without a refreshed
+plan, a hand-edited verdict, or a code change that silently moved the
+numbers all die here, in tier 1, not in production.
+
+The engine-building entries are too expensive for the gate; their
+committed artifacts are covered by existence/lockstep checks and the
+off-gate `dstpu plan --update-artifacts` run.
+"""
+
+import json
+import os
+
+import jax
+import pytest
+
+from deepspeed_tpu.analysis import feasibility as feas
+from deepspeed_tpu.analysis.budgets import env_matches
+from deepspeed_tpu.analysis.entry_points import (GATE_SPMD_ENTRY_POINTS,
+                                                 SPEC_BUILDERS)
+from deepspeed_tpu.analysis.schedule_audit import (audit_artifact_schedule,
+                                                   default_exposure_path,
+                                                   default_maps_dir,
+                                                   load_collective_map,
+                                                   load_exposure_budgets)
+from deepspeed_tpu.runtime import overlap_planner as op
+
+
+@pytest.fixture(scope="module")
+def regenerated():
+    """One compile pass over the gate subset -> per-entry (collective
+    map, feasibility verdict artifact), regenerated exactly the way the
+    committed producers write them (same exposure gating as
+    `dstpu plan`)."""
+    from deepspeed_tpu.analysis.entry_points import build_spec
+    from deepspeed_tpu.analysis.lowering import lower_entry
+    from deepspeed_tpu.runtime import topology as topo_mod
+
+    exposure = load_exposure_budgets(default_exposure_path())
+    if exposure is not None and not env_matches(exposure):
+        exposure = None
+    maps, verdicts = {}, {}
+    for name in GATE_SPMD_ENTRY_POINTS:
+        spec = build_spec(name)
+        with spec.mesh_ctx():
+            artifact = lower_entry(
+                spec.fn, spec.args, donate_argnums=spec.donate_argnums,
+                jit_kwargs=spec.jit_kwargs, name=spec.name)
+        _, report = audit_artifact_schedule(spec, artifact)
+        maps[name] = report.to_map(jax.device_count())
+        # the artifact form excludes the trace-cache-dependent transport
+        # summary, so the compiled artifact alone regenerates it exactly
+        verdict = feas.evaluate_compiled(
+            spec, artifact, exposure=exposure,
+            tokens_per_step=feas._candidate_tokens(name, None))
+        verdicts[name] = verdict.to_artifact()
+    topo_mod.reset()
+    return maps, verdicts
+
+
+def test_committed_collective_maps_fresh(regenerated):
+    maps, _ = regenerated
+    for name in GATE_SPMD_ENTRY_POINTS:
+        committed = load_collective_map(default_maps_dir(), name)
+        assert committed is not None, (
+            f"tools/collective_maps/{name}.json missing — run "
+            "`dstpu lint --schedule` and commit the maps")
+        assert committed == maps[name], (
+            f"committed collective map for {name} is stale — rerun "
+            "`dstpu lint --schedule` and commit the refreshed map (and "
+            "regenerate the overlap plans that derive from it)")
+
+
+def test_committed_overlap_plans_fresh_from_regenerated_maps(regenerated,
+                                                             tmp_path):
+    # the chain check: re-derive each gate entry's overlap plan from the
+    # map THIS run regenerated (not the committed one) — a map refresh
+    # that changes the derivation without a plan refresh fails here even
+    # if both committed files are self-consistent
+    maps, _ = regenerated
+    maps_dir = str(tmp_path / "maps")
+    os.makedirs(maps_dir)
+    for name, payload in maps.items():
+        with open(os.path.join(maps_dir, f"{name}.json"), "w") as fh:
+            json.dump(payload, fh)
+    op.reset_plans()
+    try:
+        for entry in sorted(set(op.PLAN_DERIVATIONS)
+                            & set(GATE_SPMD_ENTRY_POINTS)):
+            committed = op.load_plan_artifact(op.default_plans_dir(), entry)
+            assert committed is not None, (
+                f"tools/overlap_plans/{entry}.json missing — run "
+                "`python -m deepspeed_tpu.runtime.overlap_planner "
+                "--update`")
+            derived = op.plan_entry(entry, maps_dir)
+            assert derived.to_dict() == committed.to_dict(), (
+                f"committed overlap plan for {entry} is stale against the "
+                "regenerated collective map — rerun the planner --update")
+    finally:
+        op.reset_plans()
+
+
+def test_committed_feasibility_verdicts_fresh(regenerated):
+    _, verdicts = regenerated
+    plans_dir = feas.default_plans_dir()
+    for name in GATE_SPMD_ENTRY_POINTS:
+        committed = feas.load_verdict_artifact(plans_dir, name)
+        assert committed is not None, (
+            f"tools/feasibility/{name}.json missing — run "
+            "`dstpu plan --update-artifacts` and commit the verdicts")
+        assert committed == verdicts[name], (
+            f"committed feasibility verdict for {name} is stale — rerun "
+            "`dstpu plan --update-artifacts`")
+
+
+def test_every_entry_point_has_a_committed_verdict():
+    # same lockstep contract as the budgets/exposure files: one verdict
+    # per registered entry (a new entry lands with its verdict in the
+    # same PR), and no verdict names an unregistered entry (no rot)
+    plans_dir = feas.default_plans_dir()
+    committed = {os.path.splitext(f)[0]
+                 for f in os.listdir(plans_dir) if f.endswith(".json")}
+    assert committed == set(SPEC_BUILDERS), (
+        "tools/feasibility/ out of sync with registered entry points — "
+        "run `dstpu plan --update-artifacts` (new entries) or delete the "
+        "stale file by hand")
+
+
+def test_committed_verdicts_all_feasible_on_audit_mesh():
+    # the HEAD default config must be feasible for EVERY registered
+    # entry: an infeasible default is a broken ship, not a lint finding
+    plans_dir = feas.default_plans_dir()
+    for name in SPEC_BUILDERS:
+        verdict = feas.load_verdict_artifact(plans_dir, name)
+        assert verdict is not None, name
+        assert verdict["feasible"], (
+            f"{name}: HEAD default config committed as INFEASIBLE: "
+            f"{verdict['reasons']}")
+        assert verdict["reasons"] == [], name
+        assert verdict["mesh_devices"] == jax.device_count(), (
+            f"{name}: verdict committed for {verdict['mesh_devices']} "
+            f"devices, audit mesh has {jax.device_count()}")
+        assert "compile_wall" not in verdict, (
+            f"{name}: wall time leaked into the committed artifact — "
+            "it can never diff clean")
+        assert "transport_plan_summary" not in verdict, (
+            f"{name}: trace-cache-dependent transport summary leaked "
+            "into the committed artifact — it can never diff clean")

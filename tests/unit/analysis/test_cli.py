@@ -464,3 +464,93 @@ def test_entry_restricted_write_baseline_keeps_other_entries(tmp_path,
     kept = {f.path for f in load_baseline(baseline)}
     # the audited entry's (now-clean) row is dropped; the other survives
     assert kept == {"<sched:engine-train-step>"}
+
+
+def _fake_feas(findings, verdicts):
+    def run(entry_names=None, exposure_path=None, entries=None):
+        return findings, verdicts
+    return run
+
+
+def test_feasibility_verdicts_flow_through_json(tmp_path, monkeypatch,
+                                                capsys):
+    from deepspeed_tpu.analysis.feasibility import _infeasible
+    verdict = _infeasible("e", ["hbm-overflow: 9 B/device > 5 B"],
+                          mesh_devices=8, device_kind="cpu", candidate=None)
+    finding = Finding(rule_id="config-infeasible", path="<plan:e>", line=0,
+                      severity=SEVERITY_ERROR,
+                      message="HEAD config statically infeasible: "
+                              "hbm-overflow")
+    monkeypatch.setattr(cli, "run_feasibility_layer",
+                        _fake_feas([finding], {"e": verdict}))
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--feasibility",
+                   "--json", "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["feasibility_verdicts"]["e"]["feasible"] is False
+    assert payload["feasibility_verdicts"]["e"]["reasons"][0].startswith(
+        "hbm-overflow")
+    assert payload["new"][0]["rule_id"] == "config-infeasible"
+
+
+def test_all_layers_run_off_one_shared_compile_pass(tmp_path, monkeypatch,
+                                                    capsys):
+    # --all = A+B+C+D+E, and the compiled layers (C, D, E) must all see
+    # the SAME materialized iter_compiled_entries result — one compile
+    # per entry, not one per layer
+    from deepspeed_tpu.analysis import spmd_audit
+
+    shared = [("e", None, None, "did not compile in this fake")]
+    calls = {}
+    monkeypatch.setattr(spmd_audit, "iter_compiled_entries",
+                        lambda names=None: iter(shared))
+
+    def fake_jaxpr(entry_names=None):
+        calls["jaxpr"] = True
+        return []
+
+    def fake_spmd(entry_names=None, budgets_path=None, entries=None):
+        calls["spmd"] = entries
+        return [], {}, True
+
+    def fake_sched(entry_names=None, exposure_path=None, entries=None):
+        calls["schedule"] = entries
+        return [], {}, True
+
+    def fake_feas(entry_names=None, exposure_path=None, entries=None):
+        calls["feasibility"] = entries
+        return [], {}
+
+    monkeypatch.setattr(cli, "run_jaxpr_layer", fake_jaxpr)
+    monkeypatch.setattr(cli, "run_spmd_layer", fake_spmd)
+    monkeypatch.setattr(cli, "run_schedule_layer", fake_sched)
+    monkeypatch.setattr(cli, "run_feasibility_layer", fake_feas)
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--all",
+                   "--maps-dir", str(tmp_path / "maps"),
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    assert calls["jaxpr"] is True
+    assert calls["spmd"] == shared
+    assert calls["spmd"] is calls["schedule"] is calls["feasibility"]
+
+
+def test_single_compiled_layer_skips_the_shared_pass(tmp_path, monkeypatch):
+    # one compiled layer alone gets entries=None (it drives its own
+    # compiles); materializing the shared pass would be pure overhead
+    from deepspeed_tpu.analysis import spmd_audit
+
+    def boom(names=None):
+        raise AssertionError("shared pass materialized for a single layer")
+
+    monkeypatch.setattr(spmd_audit, "iter_compiled_entries", boom)
+    seen = {}
+
+    def fake_feas(entry_names=None, exposure_path=None, entries=None):
+        seen["entries"] = entries
+        return [], {}
+
+    monkeypatch.setattr(cli, "run_feasibility_layer", fake_feas)
+    rc = cli.main([_write(tmp_path, "ok.py", CLEAN), "--feasibility",
+                   "--baseline", _empty_baseline(tmp_path)])
+    assert rc == 0
+    assert seen["entries"] is None

@@ -237,6 +237,19 @@ def test_gate_subset_matches_spec_flags():
             "not declare gate_cheap")
 
 
+def test_candidate_entry_pins_are_consistent():
+    # Layer E's pinned lists must stay coherent with the registry: every
+    # candidate-capable entry is registered, and none of them is in the
+    # cheap gate subset — candidates re-parameterize engine builds, which
+    # are exactly what GATE_SPMD_ENTRY_POINTS exists to keep out of tier 1
+    from deepspeed_tpu.analysis.entry_points import CANDIDATE_ENTRY_POINTS
+
+    assert set(CANDIDATE_ENTRY_POINTS) <= set(SPEC_BUILDERS)
+    assert set(CANDIDATE_ENTRY_POINTS).isdisjoint(GATE_SPMD_ENTRY_POINTS), (
+        "an engine-building candidate entry crept into the cheap gate "
+        "subset")
+
+
 def test_every_entry_point_has_a_committed_budget():
     # shrink-only file integrity: every registered entry point is budgeted
     # (a new entry lands with its budget in the same PR) and every budget

@@ -472,6 +472,73 @@ def test_sync_outside_trace_and_hot_modules_quiet():
     assert "telemetry-hot-path-sync" not in rule_ids(src)
 
 
+# ---------------------------------------------------------------------------
+# unguarded-worker-state
+# ---------------------------------------------------------------------------
+
+def test_unguarded_worker_mutation_fires():
+    src = """
+    import threading
+
+    class AsyncSaver:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                task = self.queue.get()
+                task.run()
+                self.completed += 1
+                self.last_task = task
+    """
+    ids = rule_ids(src)
+    assert ids.count("unguarded-worker-state") == 2
+
+
+def test_worker_submit_target_global_fires():
+    src = """
+    _PROGRESS = {}
+
+    def _drain(pool):
+        pool.submit(writeback)
+
+    def writeback():
+        global _PROGRESS
+        _PROGRESS = {"done": True}
+    """
+    assert "unguarded-worker-state" in rule_ids(src)
+
+
+def test_locked_worker_and_queue_handoff_quiet():
+    src = """
+    import threading
+
+    class AsyncSaver:
+        def start(self):
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+        def _loop(self):
+            while True:
+                task = self.queue.get()
+                result = task.run()
+                with self._lock:
+                    self.completed += 1
+                self.out_queue.put(result)
+    """
+    assert "unguarded-worker-state" not in rule_ids(src)
+
+
+def test_non_worker_method_mutation_quiet():
+    src = """
+    class Engine:
+        def step(self):
+            self.global_steps += 1
+    """
+    assert "unguarded-worker-state" not in rule_ids(src)
+
+
 def test_shipped_telemetry_package_is_clean():
     import glob
     import os

@@ -146,3 +146,55 @@ def test_offload_phase_spans_reach_trace_and_view():
     assert "offload stall decomposition" in out
     assert "blocked fraction" in out
     tele.close()
+
+
+def _verdict_dir(tmp_path, entry="engine-train-step", flops=1e9):
+    import json
+    d = tmp_path / "feasibility"
+    d.mkdir(parents=True)
+    (d / f"{entry}.json").write_text(json.dumps(
+        {"entry": entry, "feasible": True,
+         "predicted_step_flops": flops}))
+    return str(d)
+
+
+def test_feasibility_cross_check_consistent(tmp_path):
+    m = MetricsEngine()
+    m.model_flops_per_step = 1.2e9
+    out = m.feasibility_cross_check(
+        "engine-train-step", plans_dir=_verdict_dir(tmp_path))
+    assert out["consistent"] is True
+    assert out["ratio"] == pytest.approx(1.2)
+    assert out["predicted_step_flops"] == pytest.approx(1e9)
+
+
+def test_feasibility_cross_check_flags_drift(tmp_path):
+    # measured flops 4x the committed static prediction: the artifact no
+    # longer describes the running program
+    m = MetricsEngine()
+    m.model_flops_per_step = 4e9
+    out = m.feasibility_cross_check(
+        "engine-train-step", plans_dir=_verdict_dir(tmp_path))
+    assert out["consistent"] is False
+    assert out["ratio"] == pytest.approx(4.0)
+    # a tighter tolerance tightens the band symmetrically (ratio bands:
+    # [1-tol, 1/(1-tol)])
+    out = m.feasibility_cross_check(
+        "engine-train-step", plans_dir=_verdict_dir(tmp_path / "b"),
+        rel_tol=0.9)
+    assert out["consistent"] is True
+
+
+def test_feasibility_cross_check_none_when_either_side_missing(tmp_path):
+    m = MetricsEngine()
+    # no measured flops
+    assert m.feasibility_cross_check(
+        "engine-train-step", plans_dir=_verdict_dir(tmp_path)) is None
+    m.model_flops_per_step = 1e9
+    # no committed artifact for the entry
+    assert m.feasibility_cross_check("no-such-entry",
+                                     plans_dir=str(tmp_path)) is None
+    # artifact with no usable prediction
+    zero = _verdict_dir(tmp_path / "z", flops=0)
+    assert m.feasibility_cross_check("engine-train-step",
+                                     plans_dir=zero) is None

@@ -16,6 +16,7 @@ Front door (reference ``deepspeed/__init__.py:64``):
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, Optional, Tuple
 
 __version__ = "0.1.0"
@@ -26,6 +27,41 @@ from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
 from .runtime.engine import DeepSpeedEngine  # noqa: F401
 from .runtime.topology import MeshTopology, TopologyConfig  # noqa: F401
 from .comm.comm import init_distributed  # noqa: F401
+
+
+def maybe_apply_tuned_config(config: Optional[Any]) -> Optional[Any]:
+    """The ``DSTPU_TUNE`` overlay (docs/AUTOTUNING.md): when the env var
+    is ``1``, deep-merge the pinned tune winner's config overrides
+    (``tools/autotune/best.json``, written by ``dstpu tune --apply``)
+    over the caller's config dict; any other non-empty, non-``0`` value
+    is read as an explicit path to a ``best.json`` or trial ledger.
+
+    Unset or ``0`` returns ``config`` UNCHANGED — the very same object,
+    so opted-out engine construction is byte-identical to a build that
+    never heard of the autotuner."""
+    gate = os.environ.get("DSTPU_TUNE", "")
+    if gate in ("", "0"):
+        return config
+    from .autotuning.cli import default_best_path
+    path = default_best_path() if gate == "1" else gate
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        from .utils.logging import logger
+        logger.warning(f"DSTPU_TUNE={gate}: no usable tuned config at "
+                       f"{path} ({e}) — building untuned")
+        return config
+    best = doc.get("best") if "best" in doc else doc
+    overrides = ((best or {}).get("overrides") or {}).get("config") or {}
+    if not overrides or not isinstance(config, dict):
+        return config
+    from .runtime.config import deep_update
+    from .utils.logging import log_dist
+    merged = deep_update(json.loads(json.dumps(config)), overrides)
+    log_dist(f"DSTPU_TUNE: overlaid tuned config "
+             f"{(best or {}).get('label')} from {path}", ranks=[0])
+    return merged
 
 
 def initialize(args=None,
@@ -54,6 +90,9 @@ def initialize(args=None,
     if isinstance(config, str):  # JSON path (reference-supported form)
         with open(config) as f:
             config = json.load(f)
+    # DSTPU_TUNE overlay: off (unset/"0") this returns `config` itself —
+    # engine construction stays byte-identical to an autotuner-free build
+    config = maybe_apply_tuned_config(config)
 
     init_distributed()
 

@@ -611,6 +611,177 @@ def rank_survivors(results: List[SweepResult]) -> List[SweepResult]:
 
 
 # ---------------------------------------------------------------------------
+# model mode — static prediction off committed artifacts (dstpu tune)
+# ---------------------------------------------------------------------------
+
+#: the representative tokens/step of the HEAD-default audit batch
+#: (``entry_points._batch``: size 8 x seq 16) — the denominator the
+#: static model scales candidate geometry against.
+_HEAD_TOKENS_PER_STEP = 8 * 16
+
+
+def predict_from_artifact(artifact: Dict[str, Any], candidate: Candidate,
+                          entry: Optional[str] = None) -> FeasibilityVerdict:
+    """A verdict WITHOUT a compile: scale the committed HEAD verdict
+    artifact (``tools/feasibility/<entry>.json``) by the candidate's
+    token geometry. The model is deliberately coarse — FLOPs, exposed
+    and collective bytes scale linearly with tokens/step; HBM splits
+    into a constant resident part (arguments: params + optimizer state)
+    and a token-proportional part (outputs + temps, net of aliasing) —
+    and is blind to every non-batch knob. That is exactly the fidelity
+    the tune pipeline needs from its zero-cost stage: rank and prune
+    before paying compiles, then let measured trials (and the
+    calibration record) correct it. Deterministic given (artifact,
+    candidate, DSTPU_HBM_BYTES)."""
+    name = entry or str(artifact.get("entry", "engine-train-step"))
+    batch = dict(candidate.namespaces()[2])
+    tokens = int(batch.get("size", 8)) * int(batch.get("seq", 16))
+    base_tokens = int(artifact.get("tokens_per_step")
+                      or _HEAD_TOKENS_PER_STEP)
+    r = tokens / float(base_tokens)
+
+    mem = {k: int(v) for k, v in (artifact.get("memory") or {}).items()}
+    resident = mem.get("argument_size_in_bytes", 0)
+    activ = (mem.get("output_size_in_bytes", 0)
+             + mem.get("temp_size_in_bytes", 0)
+             - mem.get("alias_size_in_bytes", 0))
+    hbm = int(resident + activ * r)
+    budget = hbm_bytes_per_device(artifact.get("device_kind"))
+
+    flops = int(int(artifact.get("predicted_step_flops") or 0) * r)
+    exposed = int(int(artifact.get("exposed_bytes") or 0) * r)
+    overlapped = int(int(artifact.get("overlapped_bytes") or 0) * r)
+    coll = int(int(artifact.get("collective_bytes") or 0) * r)
+    ratio = float(artifact.get("bytes_per_flop") or 0.0)
+    cost = float(flops) + (exposed / ratio if ratio > 0 else 0.0)
+
+    reasons: List[str] = []
+    if hbm > budget:
+        reasons.append(
+            f"hbm-overflow: predicted {hbm} B/device > {budget} B "
+            f"(static model over the committed {name} artifact)")
+    return FeasibilityVerdict(
+        entry=name, feasible=not reasons, reasons=reasons,
+        mesh_devices=int(artifact.get("mesh_devices") or 0),
+        device_kind=str(artifact.get("device_kind") or ""),
+        candidate=candidate.to_dict(),
+        hbm_bytes=hbm, hbm_budget_bytes=int(budget),
+        memory={}, collective_bytes=coll, collective_bytes_by_kind={},
+        exposed_bytes=exposed, overlapped_bytes=overlapped,
+        exposure_budget_bytes=None, predicted_step_flops=flops,
+        bytes_per_flop=ratio, cost=cost, tokens_per_step=tokens,
+        cost_per_token=(cost / tokens if tokens else None),
+        transport_plan_summary=None, compile_wall=None)
+
+
+def static_sweep(grid: Dict[str, Any], artifact: Optional[Dict] = None,
+                 log=None) -> List[SweepResult]:
+    """:func:`sweep`'s zero-compile sibling: every grid point scored by
+    :func:`predict_from_artifact` over the entry's committed artifact.
+    All results carry ``compiled=False``; infeasibility comes from the
+    static model alone. Raises when no artifact is committed for the
+    entry — model mode has nothing to extrapolate from."""
+    entry = grid.get("entry", "engine-train-step")
+    if artifact is None:
+        artifact = load_verdict_artifact(default_plans_dir(), entry)
+    if artifact is None:
+        raise ValueError(
+            f"no committed verdict artifact for entry {entry!r} "
+            f"(run `dstpu plan --entry {entry} --update-artifacts`)")
+    results = [SweepResult(c, predict_from_artifact(artifact, c, entry),
+                           compiled=False)
+               for c in (Candidate.from_overrides(o)
+                         for o in expand_grid(grid))]
+    if log is not None:
+        pruned = sum(1 for r in results if not r.verdict.feasible)
+        log(f"dstpu plan: statically predicted {len(results)} grid "
+            f"point(s), {pruned} infeasible (model mode, 0 compiled)")
+    return results
+
+
+def export_survivors(results: List[SweepResult]) -> List[Dict[str, Any]]:
+    """The ranked-survivor export the trial ledger commits: candidate (in
+    re-runnable namespace form) + deterministic verdict artifact +
+    whether the verdict came from a compile audit or the static model."""
+    return [{"candidate": r.candidate.to_dict(),
+             "verdict": r.verdict.to_artifact(),
+             "compiled": r.compiled}
+            for r in rank_survivors(results)]
+
+
+# ---------------------------------------------------------------------------
+# calibration — measured trials sharpening the static model
+# ---------------------------------------------------------------------------
+
+def default_calibration_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "autotune", "calibration.json")
+
+
+def load_calibration(path: Optional[str] = None) -> Dict[str, Any]:
+    """The per-entry calibration records ({entry: {seconds_per_cost,
+    flops_ratio, samples}}); {} when none accumulated yet (or torn —
+    calibration is advisory, a bad file must never fail a plan)."""
+    p = path or default_calibration_path()
+    if not os.path.exists(p):
+        return {}
+    try:
+        with open(p, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def update_calibration(entry: str, *, measured_step_s: float, cost: float,
+                       flops_ratio: Optional[float] = None,
+                       path: Optional[str] = None,
+                       alpha: float = 0.5) -> Dict[str, Any]:
+    """Fold one full-budget trial's measurement into the entry's record:
+    EWMA of ``seconds_per_cost`` (wall seconds per flop-equivalent — the
+    factor turning the oracle's unitless cost into a predicted step
+    time) and of the measured/predicted FLOPs ratio from
+    ``feasibility_cross_check``. Crash-consistent via the checkpoint
+    store's atomic-write discipline. Returns the updated record."""
+    from deepspeed_tpu.checkpoint.store import _atomic_json
+
+    p = path or default_calibration_path()
+    if measured_step_s <= 0 or cost <= 0:
+        return load_calibration(p).get(entry, {})
+    doc = load_calibration(p)
+    rec = dict(doc.get(entry) or {})
+    spc = measured_step_s / cost
+    prev = rec.get("seconds_per_cost")
+    rec["seconds_per_cost"] = (spc if prev is None
+                               else alpha * spc + (1 - alpha) * float(prev))
+    if flops_ratio is not None and flops_ratio > 0:
+        prev_fr = rec.get("flops_ratio")
+        rec["flops_ratio"] = (flops_ratio if prev_fr is None
+                              else alpha * flops_ratio
+                              + (1 - alpha) * float(prev_fr))
+    rec["samples"] = int(rec.get("samples") or 0) + 1
+    doc[entry] = rec
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    _atomic_json(p, doc)
+    return rec
+
+
+def predicted_step_seconds(verdict: FeasibilityVerdict,
+                           calibration: Optional[Dict[str, Any]] = None
+                           ) -> Optional[float]:
+    """Wall-clock prediction for a verdict: ``cost x seconds_per_cost``
+    from the entry's calibration record; None before any full trial has
+    calibrated the entry (the oracle alone ranks, it does not clock)."""
+    cal = calibration if calibration is not None else load_calibration()
+    rec = cal.get(verdict.entry) or {}
+    spc = rec.get("seconds_per_cost")
+    if not spc or verdict.cost in (None, float("inf")):
+        return None
+    return float(verdict.cost) * float(spc)
+
+
+# ---------------------------------------------------------------------------
 # CLI — `dstpu plan`
 # ---------------------------------------------------------------------------
 
@@ -694,6 +865,10 @@ def _render_verdict(v: FeasibilityVerdict) -> str:
             + (f" (budget {v.exposure_budget_bytes} B)"
                if v.exposure_budget_bytes is not None else "")
             + f", flops {v.predicted_step_flops}, cost {v.cost:.3e}")
+    pred_s = predicted_step_seconds(v)
+    if pred_s is not None:
+        lines.append(f"    predicted step {pred_s:.4f}s (calibrated by "
+                     "measured trials — tools/autotune/calibration.json)")
     if v.compile_wall is not None:
         lines.append(f"    compile {v.compile_wall:.2f}s")
     return "\n".join(lines)

@@ -1,1 +1,28 @@
-from .autotuner import Autotuner  # noqa: F401
+"""dstpu-tune: the self-driving training service (docs/AUTOTUNING.md).
+
+Three cooperating pieces over the Layer-E feasibility oracle:
+
+- :mod:`.trial` — the measured half: candidate → in-process engine build
+  → warmup + N scored ``train_batch`` steps → ``tuning_objective``
+  (MFU × goodput) from telemetry, with measured-vs-predicted
+  cross-checks feeding the oracle's calibration record;
+- :mod:`.search` — the policy: oracle sweep (static or compile-audited)
+  → cost-per-token-ranked survivors → successive-halving trials,
+  committed per-trial to the crash-consistent :mod:`.ledger`;
+- :mod:`.controller` — the closed loop: elastic resizes and guardian
+  rollbacks trigger scoped re-tunes, sustained regression triggers an
+  A/B of the recorded runner-up.
+
+``dstpu tune`` (:mod:`.cli`) is the operator face; the ``DSTPU_TUNE``
+env gate (``deepspeed_tpu.maybe_apply_tuned_config``) overlays a pinned
+winner at engine construction. The seed-era :class:`.autotuner.Autotuner`
+remains as a deprecated shim routed through :class:`.trial.TrialRunner`.
+"""
+
+from .autotuner import Autotuner  # noqa: F401  (deprecated shim)
+from .controller import EVENT_SCOPES, TuneController  # noqa: F401
+from .ledger import (PHASE_FULL, PHASE_SHORT, TrialLedger,  # noqa: F401
+                     TrialRecord, default_ledger_dir)
+from .search import (KNOB_SCOPES, plan_schedule,  # noqa: F401
+                     remaining_schedule, run_search, scope_grid)
+from .trial import TrialResult, TrialRunner  # noqa: F401

@@ -1,27 +1,39 @@
-"""Configuration autotuning.
+"""DEPRECATED seed-era autotuner — kept as a thin compatibility shim.
 
 Counterpart of the reference ``autotuning/autotuner.py`` (``Autotuner`` :42,
-``tune`` :404, ``model_info_profile_run`` :663) + ``tuner/`` (grid/random/
-model-based): search the ZeRO-stage × micro-batch space by running short
-profiling experiments and keeping the best throughput.
-
-The reference launches each experiment as a separate multi-GPU job through
-the launcher and parses logs; on TPU an experiment is an in-process engine
-construction + a few timed ``train_batch`` calls (compilation cached per
-config). The model-based pruning step estimates per-chip memory from the
-ZeRO stage exactly like the reference's cost model and skips configs that
-cannot fit.
+``tune`` :404, ``model_info_profile_run`` :663). Superseded by the
+dstpu-tune subsystem (docs/AUTOTUNING.md, docs/MIGRATING.md): the
+feasibility oracle replaces the hand-rolled ZeRO memory model, the trial
+ledger replaces ``results_dir`` JSON scatter, and ``dstpu tune`` replaces
+constructing this class. In-process experiments now route through
+:class:`~deepspeed_tpu.autotuning.trial.TrialRunner` (the measured core
+both paths share); launched mode (``model_spec`` + ``results_dir``) is
+unchanged. This shim warns once per process and will be removed.
 """
 
 from __future__ import annotations
 
 import itertools
-import time
+import warnings
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..utils.logging import logger
+
+_WARNED = False
+
+
+def _warn_deprecated() -> None:
+    global _WARNED
+    if _WARNED:
+        return
+    _WARNED = True
+    warnings.warn(
+        "deepspeed_tpu.autotuning.Autotuner is deprecated: use the "
+        "dstpu-tune subsystem (`dstpu tune --grid ...`, "
+        "autotuning.run_search) — see docs/MIGRATING.md and "
+        "docs/AUTOTUNING.md", DeprecationWarning, stacklevel=3)
 
 
 class Autotuner:
@@ -47,6 +59,7 @@ class Autotuner:
         a config that OOMs/crashes is a failed data point, not a dead
         search), results persist under ``results_dir`` and completed
         experiments are skipped on re-run (the reference's resume)."""
+        _warn_deprecated()
         if model_spec is not None:
             from .experiment import build_model_from_spec
             model_fn = lambda: build_model_from_spec(model_spec)  # noqa: E731
@@ -117,35 +130,50 @@ class Autotuner:
         return grid[:self.max_trials]
 
     def run_experiment(self, stage: int, micro_batch: int) -> Dict[str, Any]:
-        """One short profiling run (the reference's launched experiment)."""
-        import jax
+        """One short profiling run, routed through the dstpu-tune
+        measured core (``TrialRunner.measure``) — the shim keeps this
+        class's result-dict shape while the build/warmup/measure/reset
+        mechanics live in one place."""
+        import json as _json
 
         import deepspeed_tpu
+        from ..runtime.config import deep_update
+        from .ledger import PHASE_SHORT
+        from .trial import TRIAL_TELEMETRY_CONFIG, TrialRunner
+
         config = self._experiment_config(stage, micro_batch)
         exp = {"zero_stage": stage, "micro_batch": micro_batch, "config": config}
-        try:
+        # scoring needs the metrics engine; overlay telemetry on a copy so
+        # the recorded experiment config stays the caller's
+        run_config = deep_update(_json.loads(_json.dumps(config)),
+                                 TRIAL_TELEMETRY_CONFIG)
+        holder: Dict[str, Any] = {}
+
+        def make_engine():
             model = self.model_fn()
             engine, _, _, _ = deepspeed_tpu.initialize(model=model,
-                                                       config=config)
+                                                       config=run_config)
+            holder["model"] = model
+            return engine
+
+        def batch_for(engine):
             dp = engine.topology.data_parallel_size
             if self.batch_fn is not None:
-                batch = self.batch_fn(micro_batch * dp)
-            else:
-                from .experiment import synthetic_batch
-                batch = synthetic_batch(model, micro_batch, dp, self.seq_len)
-            for _ in range(self.warmup_steps):
-                jax.block_until_ready(engine.train_batch(batch))
-            t0 = time.perf_counter()
-            for _ in range(self.measure_steps):
-                loss = engine.train_batch(batch)
-            jax.block_until_ready(loss)
-            dt = time.perf_counter() - t0
-            samples = micro_batch * dp * self.measure_steps \
-                * engine.gradient_accumulation_steps
-            exp.update({"status": "ok", "samples_per_sec": samples / dt,
-                        "loss": float(loss)})
-        except Exception as e:
-            exp.update({"status": f"error: {e}", "samples_per_sec": 0.0})
+                return self.batch_fn(micro_batch * dp)
+            from .experiment import synthetic_batch
+            return synthetic_batch(holder["model"], micro_batch, dp,
+                                   self.seq_len)
+
+        runner = TrialRunner(warmup_steps=self.warmup_steps,
+                             measure_steps=self.measure_steps)
+        result = runner.measure(make_engine, batch_for,
+                                label=f"stage{stage}_mb{micro_batch}",
+                                phase=PHASE_SHORT, steps=self.measure_steps)
+        rec = result.record
+        exp.update({"status": rec.status,
+                    "samples_per_sec": rec.samples_per_sec,
+                    "step_time_mean_s": rec.step_time_mean_s,
+                    "tuning_objective": rec.objective})
         return exp
 
     def _experiment_config(self, stage: int, micro_batch: int) -> Dict[str, Any]:

@@ -1,0 +1,212 @@
+"""CLI — ``dstpu tune`` (launcher dispatch, next to ``dstpu plan``).
+
+The operator face of dstpu-tune (docs/AUTOTUNING.md):
+
+    dstpu tune --grid tools/autotune/demo_grid.json --budget-trials 6
+    dstpu tune --resume tools/autotune/engine-train-step-s0.json
+    dstpu tune --smoke            # the tier-1 gate's 2-trial CPU run
+    dstpu tune --update-demo      # regenerate the committed demo ledger
+
+Modes: ``--mode static`` (default) plans off the committed feasibility
+artifact with zero compiles; ``--mode audit`` pays the Layer-E oracle's
+compile audit per non-pruned point. ``--apply`` commits the winner's
+overrides to ``tools/autotune/best.json`` — the file the ``DSTPU_TUNE``
+engine overlay (``deepspeed_tpu.maybe_apply_tuned_config``) reads.
+
+Exit codes: 0 — search completed and pinned a winner; 1 — no winner
+(no survivors, every trial errored, or a budget expired before any
+trial); 2 — usage/ledger errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from .ledger import TrialLedger, default_ledger_dir
+from .search import run_search
+
+#: the HBM budget the committed demo ledger is planned under — small
+#: enough that the demo grid's big corner points are statically pruned
+#: (a demo with zero pruning would not demonstrate the oracle)
+DEMO_HBM_BYTES = 14_000_000
+
+
+def demo_grid_path() -> str:
+    return os.path.join(default_ledger_dir(), "demo_grid.json")
+
+
+def demo_ledger_path() -> str:
+    return os.path.join(default_ledger_dir(), "demo.json")
+
+
+def default_best_path() -> str:
+    return os.path.join(default_ledger_dir(), "best.json")
+
+
+#: the ``--smoke`` grid: two statically-feasible points, short trials
+#: only — the smallest run that exercises plan → measure → pin end to
+#: end on a CPU host (the lint-clean gate's budget)
+SMOKE_GRID: Dict[str, Any] = {
+    "entry": "engine-train-step",
+    "axes": {"batch.size": [8, 16], "batch.seq": [8]},
+    "monotone": ["batch.size"],
+}
+
+
+@contextlib.contextmanager
+def _pinned_env(key: str, value: str):
+    prev = os.environ.get(key)
+    os.environ[key] = value
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = prev
+
+
+def _load_grid_file(path: str) -> Dict[str, Any]:
+    from ..analysis.feasibility import load_grid
+    return load_grid(path)
+
+
+def build_demo_plan(log=None) -> Dict[str, Any]:
+    """The committed demo ledger's content, regenerated: a static-mode
+    plan over the demo grid under the pinned DEMO_HBM_BYTES budget, no
+    measured trials. Deterministic given the committed grid + feasibility
+    artifact — the tier-1 freshness gate regenerates this and diffs it
+    against ``tools/autotune/demo.json``."""
+    import tempfile
+    grid = _load_grid_file(demo_grid_path())
+    with _pinned_env("DSTPU_HBM_BYTES", str(DEMO_HBM_BYTES)):
+        with tempfile.TemporaryDirectory() as td:
+            # budget_seconds=0: plan the full schedule, measure nothing —
+            # budget_trials would truncate the schedule itself
+            ledger = run_search(grid, seed=0, run="demo",
+                                ledger_path=os.path.join(td, "demo.json"),
+                                mode="static", budget_seconds=0.0, log=log)
+    return ledger.plan_artifact()
+
+
+def apply_best(best: Dict[str, Any], path: Optional[str] = None) -> str:
+    """Commit a search winner where the DSTPU_TUNE overlay finds it."""
+    from ..checkpoint.store import _atomic_json
+    path = path or default_best_path()
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    _atomic_json(path, best)
+    return path
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="dstpu tune",
+        description="measured autotuning over the feasibility oracle's "
+                    "survivors (docs/AUTOTUNING.md)")
+    p.add_argument("--grid", help="knob-grid JSON (dstpu plan format)")
+    p.add_argument("--entry", default=None,
+                   help="entry point override (default: grid's entry)")
+    p.add_argument("--run", default=None, help="run name (ledger stem)")
+    p.add_argument("--ledger-dir", default=None,
+                   help=f"ledger directory (default {default_ledger_dir()})")
+    p.add_argument("--resume", metavar="LEDGER", default=None,
+                   help="resume a killed search from its ledger")
+    p.add_argument("--budget-trials", type=int, default=None)
+    p.add_argument("--budget-seconds", type=float, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mode", choices=("static", "audit"), default="static",
+                   help="static: plan off the committed artifact, zero "
+                        "compiles; audit: compile-audit each non-pruned "
+                        "point")
+    p.add_argument("--apply", action="store_true",
+                   help="commit the winner to tools/autotune/best.json "
+                        "(the DSTPU_TUNE overlay source)")
+    p.add_argument("--smoke", action="store_true",
+                   help="built-in 2-point, 2-trial CPU run (tier-1 gate)")
+    p.add_argument("--update-demo", action="store_true",
+                   help="regenerate the committed demo ledger "
+                        "(plan half only, deterministic)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the final ledger doc as JSON on stdout")
+    return p.parse_args(argv)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    say = print if not args.as_json else (lambda m: print(m, file=sys.stderr))
+
+    if args.update_demo:
+        from ..checkpoint.store import _atomic_json
+        artifact = build_demo_plan(log=say)
+        _atomic_json(demo_ledger_path(), artifact)
+        say(f"dstpu tune: demo ledger updated ({demo_ledger_path()})")
+        return 0
+
+    if args.smoke:
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            ledger = run_search(SMOKE_GRID, seed=args.seed, run="smoke",
+                                ledger_path=os.path.join(td, "smoke.json"),
+                                mode="static", budget_trials=2, log=say)
+            doc = ledger.doc
+        if args.as_json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        ok_trials = [t for t in doc["trials"] if t["status"] == "ok"]
+        if len(ok_trials) == 2 and doc["best"]:
+            say(f"dstpu tune: smoke OK — 2/2 trials, winner "
+                f"{doc['best']['label']}")
+            return 0
+        say(f"dstpu tune: smoke FAILED — {len(ok_trials)}/2 trials ok, "
+            f"best={'pinned' if doc['best'] else 'missing'}")
+        return 1
+
+    try:
+        if args.resume:
+            if not os.path.exists(args.resume):
+                say(f"dstpu tune: no ledger at {args.resume}")
+                return 2
+            prior = TrialLedger.load(args.resume)
+            if not prior.plan:
+                say(f"dstpu tune: ledger {args.resume} has no plan half")
+                return 2
+            grid = (_load_grid_file(args.grid) if args.grid
+                    else prior.plan["grid"])
+            ledger = run_search(
+                grid, seed=int(prior.plan["seed"]), run=prior.plan["run"],
+                ledger_path=args.resume, mode=prior.plan["mode"],
+                budget_trials=args.budget_trials,
+                budget_seconds=args.budget_seconds,
+                resume=True, log=say)
+        else:
+            if not args.grid:
+                say("dstpu tune: --grid (or --resume/--smoke/"
+                    "--update-demo) is required")
+                return 2
+            grid = _load_grid_file(args.grid)
+            if args.entry:
+                grid["entry"] = args.entry
+            ledger = run_search(
+                grid, seed=args.seed, run=args.run,
+                ledger_dir=args.ledger_dir, mode=args.mode,
+                budget_trials=args.budget_trials,
+                budget_seconds=args.budget_seconds, log=say)
+    except (ValueError, OSError) as e:
+        say(f"dstpu tune: {e}")
+        return 2
+
+    if args.as_json:
+        print(json.dumps(ledger.doc, indent=2, sort_keys=True))
+    if ledger.best and args.apply:
+        path = apply_best(ledger.best)
+        say(f"dstpu tune: winner applied to {path} "
+            f"(set DSTPU_TUNE=1 to overlay it)")
+    return 0 if ledger.best else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

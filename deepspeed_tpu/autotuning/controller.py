@@ -1,0 +1,237 @@
+"""The closed loop: re-tune when the world the winner was tuned for ends.
+
+A pinned tune winner is a bet on a fixed world — a dp width, a healthy
+numerics regime, a step-time distribution. :class:`TuneController` is the
+host-side daemon (the ``StallWatchdog`` mold: background thread, pure
+host bookkeeping, synchronous ``poll()`` for tests) that watches for that
+world to change and answers with a SCOPED re-tune, never a blind full
+search:
+
+- an **elastic resize** (``resilience.events.EVENT_ELASTIC_RESIZE``, the
+  elastic agent's re-solve) invalidates batch-geometry and transport
+  knobs → re-tune the ``batch`` + ``transport`` scopes;
+- a **guardian rollback** (``EVENT_GUARDIAN_ROLLBACK``) impugns
+  numerics-adjacent knobs → re-tune the ``numerics`` scope;
+- a **sustained MFU regression** — ``regression_patience`` consecutive
+  telemetry summaries whose ``tuning_objective`` undershoots the pinned
+  best by more than ``regression_tolerance`` — triggers a background A/B
+  of the ledger's recorded runner-up (cheapest possible counterfactual:
+  one trial, not a search).
+
+Events are queued (publisher threads never tune inline — a guardian
+rollback must not block on an engine build) and coalesced: N rollbacks
+while a numerics re-tune is pending cost one re-tune. Each re-tune runs
+the normal :func:`~deepspeed_tpu.autotuning.search.run_search` over the
+scoped grid and hands the winner to ``apply_fn`` — in production the
+DSTPU_TUNE overlay for the next engine build; in tests a recorder.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+from .search import KNOB_SCOPES, scope_grid
+
+#: event kind → knob scopes invalidated (docs/AUTOTUNING.md table)
+EVENT_SCOPES: Dict[str, Tuple[str, ...]] = {
+    "elastic_resize": ("batch", "transport"),
+    "guardian_rollback": ("numerics",),
+}
+
+
+class TuneController:
+    """Watches telemetry + resilience events; schedules scoped re-tunes.
+
+    ``tune_fn(scoped_grid, reason)`` must return the re-tune's pinned
+    best dict (or None); the default wires :func:`run_search` over
+    ``grid`` scoped by :data:`EVENT_SCOPES`. ``ab_fn(runner_up)`` runs
+    the regression counterfactual and returns its measured objective
+    (or None to decline)."""
+
+    def __init__(self, grid: Dict[str, Any],
+                 best: Optional[Dict[str, Any]] = None,
+                 *,
+                 tune_fn: Optional[Callable[..., Optional[Dict]]] = None,
+                 apply_fn: Optional[Callable[[Dict, str], None]] = None,
+                 ab_fn: Optional[Callable[[Dict], Optional[float]]] = None,
+                 regression_patience: int = 3,
+                 regression_tolerance: float = 0.2,
+                 poll_s: float = 1.0,
+                 seed: int = 0,
+                 ledger_dir: Optional[str] = None):
+        self.grid = grid
+        self.best = dict(best) if best else None
+        self.tune_fn = tune_fn or self._default_tune
+        self.apply_fn = apply_fn or (lambda best, reason: None)
+        self.ab_fn = ab_fn
+        self.regression_patience = max(1, int(regression_patience))
+        self.regression_tolerance = float(regression_tolerance)
+        self.poll_s = max(0.01, float(poll_s))
+        self.seed = int(seed)
+        self.ledger_dir = ledger_dir
+
+        self._events: deque = deque()
+        self._lock = threading.Lock()
+        self._regressed_streak = 0
+        self._ab_done = False
+        self._unsubscribes: List[Callable[[], None]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # observability for tests and status lines
+        self.retunes: List[Dict[str, Any]] = []
+        self.ab_results: List[Dict[str, Any]] = []
+
+    # -- wiring ----------------------------------------------------------
+    def attach(self, telemetry=None, *, events=True) -> "TuneController":
+        """Subscribe to the live signal sources: the telemetry flush
+        stream (regression tracking) and the resilience event bus."""
+        if telemetry is not None:
+            self._unsubscribes.append(
+                telemetry.subscribe(self.on_summary))
+        if events:
+            from ..resilience import events as ev
+            self._unsubscribes.append(ev.subscribe(self.on_event))
+        return self
+
+    def detach(self) -> None:
+        for unsub in self._unsubscribes:
+            unsub()
+        self._unsubscribes = []
+
+    # -- signal intake (publisher threads; must stay cheap) --------------
+    def on_event(self, kind: str, payload: Dict[str, Any]) -> None:
+        if kind not in EVENT_SCOPES:
+            return
+        with self._lock:
+            self._events.append((kind, dict(payload)))
+
+    def on_summary(self, step: int, summary: Dict[str, float]) -> None:
+        """Telemetry flush hook: track the objective against the pinned
+        best; ``regression_patience`` consecutive misses arm the A/B."""
+        if not self.best:
+            return
+        objective = float(summary.get("tuning_objective") or 0.0)
+        floor = float(self.best.get("objective") or 0.0) \
+            * (1.0 - self.regression_tolerance)
+        with self._lock:
+            if objective < floor:
+                self._regressed_streak += 1
+            else:
+                self._regressed_streak = 0
+                self._ab_done = False
+
+    # -- the loop --------------------------------------------------------
+    def start(self) -> "TuneController":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="dstpu-tune-controller", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.detach()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.poll()
+
+    def poll(self) -> int:
+        """One controller beat, callable synchronously (tests, or hosts
+        that fold the controller into an existing loop). Returns how many
+        actions (re-tunes + A/Bs) it took."""
+        actions = 0
+        # coalesce: all queued events of one kind → one scoped re-tune
+        with self._lock:
+            pending = list(self._events)
+            self._events.clear()
+            regressed = (self._regressed_streak >= self.regression_patience
+                         and not self._ab_done)
+        seen_kinds: List[str] = []
+        for kind, payload in pending:
+            if kind in seen_kinds:
+                continue
+            seen_kinds.append(kind)
+            self._retune(kind, payload)
+            actions += 1
+        if regressed:
+            self._run_ab()
+            actions += 1
+        return actions
+
+    # -- actions ---------------------------------------------------------
+    def _retune(self, kind: str, payload: Dict[str, Any]) -> None:
+        scopes = EVENT_SCOPES[kind]
+        axes = [a for s in scopes for a in KNOB_SCOPES[s]
+                if a in self.grid.get("axes", {})]
+        reason = f"{kind}:{'+'.join(scopes)}"
+        logger.warning(f"dstpu tune controller: {kind} "
+                       f"(payload {payload}) -> scoped re-tune over "
+                       f"{axes or 'full grid'}")
+        scoped = scope_grid(self.grid, axes) if axes else self.grid
+        try:
+            new_best = self.tune_fn(scoped, reason)
+        except Exception as e:  # noqa: BLE001 - the loop must survive
+            logger.warning(f"dstpu tune controller: re-tune for {kind} "
+                           f"failed: {e}")
+            return
+        self.retunes.append({"kind": kind, "reason": reason,
+                             "axes": axes, "best": new_best,
+                             "payload": payload})
+        if new_best:
+            self.best = dict(new_best)
+            with self._lock:
+                self._regressed_streak = 0
+                self._ab_done = False
+            self.apply_fn(new_best, reason)
+
+    def _run_ab(self) -> None:
+        """The regression counterfactual: measure the recorded runner-up
+        once; adopt it only if it beats the (regressed) incumbent."""
+        with self._lock:
+            self._ab_done = True       # once per regression episode
+        runner_up = (self.best or {}).get("runner_up")
+        if not runner_up or self.ab_fn is None:
+            logger.warning(
+                "dstpu tune controller: sustained regression vs pinned "
+                "best but no runner-up/A-B runner available")
+            return
+        try:
+            objective = self.ab_fn(runner_up)
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"dstpu tune controller: A/B failed: {e}")
+            return
+        self.ab_results.append({"runner_up": runner_up["label"],
+                                "objective": objective})
+        if objective is None:
+            return
+        incumbent = float((self.best or {}).get("objective") or 0.0)
+        if objective > incumbent * (1.0 - self.regression_tolerance):
+            new_best = {"label": runner_up["label"],
+                        "overrides": runner_up.get("overrides") or {},
+                        "objective": float(objective),
+                        "runner_up": None}
+            logger.warning(
+                f"dstpu tune controller: A/B adopted runner-up "
+                f"{runner_up['label']} (objective {objective:.3e})")
+            self.best = new_best
+            self.apply_fn(new_best, "regression:ab")
+
+    # -- default re-tune wiring ------------------------------------------
+    def _default_tune(self, scoped_grid: Dict[str, Any],
+                      reason: str) -> Optional[Dict[str, Any]]:
+        from .search import run_search
+        ledger = run_search(
+            scoped_grid, seed=self.seed,
+            run=f"retune-{reason.replace(':', '-').replace('+', '-')}"
+                f"-s{self.seed}",
+            ledger_dir=self.ledger_dir,
+            log=lambda m: logger.warning(m))
+        return ledger.best

@@ -7,6 +7,12 @@ or crash without killing the search. This runner is the experiment body:
 build the model from a declarative spec, construct the engine with the
 candidate config, time a few steps, write ``result.json``.
 
+This launched form remains the isolation hatch for candidates that might
+take the process down. The primary search path is now ``dstpu tune``
+(``search.run_search`` + ``trial.TrialRunner`` — see docs/AUTOTUNING.md):
+the Layer-E oracle rejects the OOM candidates *statically*, which is what
+makes in-process measurement safe enough to be the default.
+
 Usage: ``python -m deepspeed_tpu.autotuning.experiment <exp_dir>`` where
 ``exp_dir/exp.json`` holds::
 

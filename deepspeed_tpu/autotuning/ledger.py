@@ -1,0 +1,175 @@
+"""The trial ledger — dstpu-tune's crash-consistent search state.
+
+One JSON file per tune run (``tools/autotune/<run>.json``) holding two
+halves with different durability/determinism contracts:
+
+- the **plan half** (written once, at search start): run name, seed, entry,
+  the grid, the environment pins that make the oracle deterministic
+  (``DSTPU_HBM_BYTES``), the sweep outcome (point/pruned/compiled counts +
+  ranked survivor artifacts) and the derived trial schedule. Deterministic
+  given (grid, seed, committed artifacts, env) — this is the half a
+  committed demo ledger diffs against in the tier-1 freshness gate.
+- the **trial half** (appended one commit per measured trial): each
+  trial's scores. Measured wall times are machine-dependent by nature, so
+  committed demo ledgers carry an empty trial list.
+
+Every write goes through the checkpoint store's ``_atomic_json`` —
+temp + fsync + rename with the ``ckpt_io``/``ckpt_tmp`` fault-plan seams,
+so the SIGKILL-mid-search durability test drives the SAME torn-write
+windows the checkpoint chaos tests drive: a kill between any two trial
+commits resumes from the last committed trial, never from a torn file.
+
+Resume contract (:meth:`TrialLedger.load` + ``run_search(resume=...)``):
+the remaining schedule is a PURE FUNCTION of (plan half, committed
+trials) — short-budget trials over the ranked survivors in rank order,
+then full-budget trials over the top quartile by committed short scores —
+so a resumed search replays the identical remaining schedule the killed
+search would have run (seed-pinned determinism, proven by the durability
+test).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+#: schema version — bump on layout changes so a resume against a ledger
+#: from another era fails loudly instead of mis-scheduling
+LEDGER_VERSION = 1
+
+#: successive-halving phases
+PHASE_SHORT = "short"
+PHASE_FULL = "full"
+
+
+def default_ledger_dir() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, "tools", "autotune")
+
+
+@dataclasses.dataclass
+class TrialRecord:
+    """One committed measurement. ``status`` is ``"ok"`` or an
+    ``"error: ..."`` string — a failed trial is a data point (objective
+    0.0), not a crash, matching the legacy Autotuner's contract."""
+    label: str
+    phase: str                      # PHASE_SHORT | PHASE_FULL
+    status: str
+    objective: float                # tuning_objective (mfu x goodput)
+    mfu: float = 0.0
+    goodput: float = 0.0
+    tokens_per_sec: float = 0.0
+    samples_per_sec: float = 0.0
+    step_time_mean_s: float = 0.0
+    steps: int = 0
+    cross_check: Optional[Dict[str, Any]] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(doc: Dict[str, Any]) -> "TrialRecord":
+        fields = {f.name for f in dataclasses.fields(TrialRecord)}
+        return TrialRecord(**{k: v for k, v in doc.items() if k in fields})
+
+
+class TrialLedger:
+    """The on-disk search state. Mutations commit immediately and
+    atomically; readers see either the pre- or post-commit file."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.doc: Dict[str, Any] = {"version": LEDGER_VERSION,
+                                    "plan": None, "trials": [],
+                                    "best": None}
+
+    # -- durability ------------------------------------------------------
+    def _commit(self) -> None:
+        from ..checkpoint.store import _atomic_json
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        _atomic_json(self.path, self.doc)
+
+    @staticmethod
+    def load(path: str) -> "TrialLedger":
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        version = int(doc.get("version") or 0)
+        if version != LEDGER_VERSION:
+            raise ValueError(
+                f"ledger {path} has version {version}, expected "
+                f"{LEDGER_VERSION} — refusing to resume a foreign schema")
+        ledger = TrialLedger(path)
+        ledger.doc = doc
+        return ledger
+
+    # -- the plan half ---------------------------------------------------
+    def write_plan(self, *, run: str, entry: str, seed: int,
+                   grid: Dict[str, Any], mode: str,
+                   points: int, pruned: int, compiled: int,
+                   survivors: List[Dict[str, Any]],
+                   schedule: List[Dict[str, Any]],
+                   env: Optional[Dict[str, str]] = None) -> None:
+        self.doc["plan"] = {
+            "run": run, "entry": entry, "seed": int(seed), "grid": grid,
+            "mode": mode,                      # "static" | "audit"
+            "points": int(points), "pruned": int(pruned),
+            "compiled": int(compiled), "survivors": survivors,
+            "schedule": schedule, "env": dict(env or {}),
+        }
+        self._commit()
+
+    @property
+    def plan(self) -> Optional[Dict[str, Any]]:
+        return self.doc.get("plan")
+
+    def plan_matches(self, *, entry: str, seed: int,
+                     grid: Dict[str, Any]) -> bool:
+        """May this ledger resume a search over (entry, seed, grid)? The
+        plan half must agree exactly — resuming under a different grid
+        would mis-map committed trials onto the wrong candidates."""
+        plan = self.plan
+        return bool(plan) and plan["entry"] == entry \
+            and int(plan["seed"]) == int(seed) \
+            and json.loads(json.dumps(plan["grid"])) == \
+            json.loads(json.dumps(grid))
+
+    # -- the trial half --------------------------------------------------
+    @property
+    def trials(self) -> List[TrialRecord]:
+        return [TrialRecord.from_dict(t) for t in self.doc["trials"]]
+
+    def committed(self) -> set:
+        """(label, phase) pairs already measured — what resume skips."""
+        return {(t["label"], t["phase"]) for t in self.doc["trials"]}
+
+    def record_trial(self, record: TrialRecord) -> None:
+        self.doc["trials"].append(record.to_dict())
+        self._commit()
+
+    # -- the verdict -----------------------------------------------------
+    def pin_best(self, label: str, overrides: Dict[str, Any],
+                 objective: float,
+                 runner_up: Optional[Dict[str, Any]] = None) -> None:
+        """Commit the search winner (and the runner-up the controller
+        A/Bs against on a sustained regression)."""
+        self.doc["best"] = {"label": label, "overrides": overrides,
+                            "objective": float(objective),
+                            "runner_up": runner_up}
+        self._commit()
+
+    @property
+    def best(self) -> Optional[Dict[str, Any]]:
+        return self.doc.get("best")
+
+    # -- committed-demo form ---------------------------------------------
+    def plan_artifact(self) -> Dict[str, Any]:
+        """The deterministic committed form: the plan half only, no
+        measured trials, no machine-dependent fields — what
+        ``dstpu tune --update-demo`` writes and the tier-1 freshness
+        gate regenerates and diffs."""
+        return {"version": self.doc["version"], "plan": self.doc["plan"],
+                "trials": [], "best": None}

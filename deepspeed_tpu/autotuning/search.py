@@ -1,0 +1,243 @@
+"""The search policy — oracle survivors to a pinned winner.
+
+Pipeline (docs/AUTOTUNING.md): candidate synthesis over a declared knob
+grid → the Layer-E oracle (``analysis/feasibility.sweep`` with monotone
+pruning, or its zero-compile ``static_sweep`` sibling over the committed
+artifact) → cost-per-token-ranked survivors → **successive halving**
+measured trials: a short budget for every survivor (MFU seeded from the
+oracle's prediction, no cost-analysis pass), then the full budget for the
+top quartile by short objective. Deterministic given ``(grid, seed,
+committed artifacts, DSTPU_HBM_BYTES)``; every measurement commits to the
+crash-consistent trial ledger before the next trial starts, and the
+remaining schedule is recomputed from (plan, committed trials) alone — so
+a search killed anywhere resumes with the identical remaining schedule.
+
+The seed steers exactly one policy point: when ``budget_trials`` is
+smaller than the survivor count, the cheapest half of the budget is kept
+by rank and the rest of the budget explores the remaining survivors by
+seeded sample — exploitation by the oracle's ranking, exploration pinned
+by the seed.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .ledger import (PHASE_FULL, PHASE_SHORT, TrialLedger, TrialRecord,
+                     default_ledger_dir)
+from .trial import TrialRunner
+
+#: knob scopes for event-triggered re-tunes (controller.py): the subset of
+#: the declared knob space an event actually invalidated. Keys are flat
+#: dotted override axes (the grid vocabulary).
+KNOB_SCOPES: Dict[str, List[str]] = {
+    # an elastic resize changed the dp width: batch geometry and the
+    # transport/collective shape move, numerics knobs don't
+    "batch": ["batch.size", "batch.seq",
+              "train_micro_batch_size_per_gpu",
+              "gradient_accumulation_steps"],
+    "transport": ["comm_transport.default.width",
+                  "zero_optimization.allgather_bucket_size",
+                  "zero_optimization.reduce_bucket_size"],
+    # a guardian rollback impugns numerics-adjacent choices
+    "numerics": ["data_types.optimizer_moment_dtype",
+                 "model.remat", "gradient_clipping"],
+}
+
+
+def scope_grid(grid: Dict[str, Any], scope_axes: List[str]
+               ) -> Dict[str, Any]:
+    """Restrict ``grid`` to the axes in ``scope_axes``: kept axes still
+    sweep, dropped axes freeze at their first (committed-default) value
+    via ``base``. The scoped grid stays a valid grid file."""
+    axes = {k: v for k, v in grid["axes"].items() if k in scope_axes}
+    frozen = {k: v[0] for k, v in grid["axes"].items()
+              if k not in scope_axes}
+    scoped = {k: v for k, v in grid.items() if k not in ("axes", "base")}
+    scoped["axes"] = axes
+    scoped["base"] = {**grid.get("base", {}), **frozen}
+    scoped["monotone"] = [a for a in grid.get("monotone", []) if a in axes]
+    return scoped
+
+
+def _candidate_from_dict(doc: Dict[str, Any]):
+    from ..analysis.feasibility import Candidate, _freeze
+    return Candidate(label=doc.get("label", "candidate"),
+                     config=_freeze(doc.get("config") or {}),
+                     model=_freeze(doc.get("model") or {}),
+                     batch=_freeze(doc.get("batch") or {}))
+
+
+def _full_quota(n_shorts: int) -> int:
+    """Successive halving's promotion count: the top quartile, never
+    fewer than one (a search with any survivor must produce a
+    full-budget winner when budget allows)."""
+    return max(1, math.ceil(n_shorts / 4)) if n_shorts else 0
+
+
+def plan_schedule(survivors: List[Dict[str, Any]], *, seed: int,
+                  budget_trials: Optional[int] = None
+                  ) -> List[Dict[str, Any]]:
+    """The short-phase schedule (the deterministic prefix of the search).
+    Full-phase entries are NOT pre-listed — they are a function of the
+    short results (:func:`remaining_schedule`)."""
+    labels = [s["candidate"]["label"] for s in survivors]
+    if budget_trials is not None and budget_trials < len(labels):
+        keep = max(1, budget_trials // 2)
+        head, tail = labels[:keep], labels[keep:]
+        explore = random.Random(seed).sample(
+            tail, min(len(tail), max(0, budget_trials - keep)))
+        labels = head + [t for t in tail if t in set(explore)]
+    return [{"phase": PHASE_SHORT, "label": lbl} for lbl in labels]
+
+
+def remaining_schedule(plan: Dict[str, Any],
+                       trials: List[TrialRecord]) -> List[Dict[str, str]]:
+    """The trials still owed: uncommitted shorts in schedule order; once
+    every short committed, the top ``ceil(shorts/4)`` by short objective
+    (ties broken by schedule rank — a total, deterministic order) minus
+    committed fulls. A pure function of (plan, trials): the resume
+    contract."""
+    committed = {(t.label, t.phase) for t in trials}
+    schedule = plan["schedule"]
+    owed = [s for s in schedule
+            if (s["label"], s["phase"]) not in committed]
+    if owed:
+        return [dict(s) for s in owed]
+    shorts = {t.label: t for t in trials if t.phase == PHASE_SHORT}
+    rank = {s["label"]: i for i, s in enumerate(schedule)}
+    ordered = sorted(
+        (lbl for lbl in rank if lbl in shorts),
+        key=lambda lbl: (-shorts[lbl].objective, rank[lbl]))
+    promote = ordered[:_full_quota(len(rank))]
+    return [{"phase": PHASE_FULL, "label": lbl} for lbl in promote
+            if (lbl, PHASE_FULL) not in committed]
+
+
+def _pin_from_trials(ledger: TrialLedger,
+                     survivors_by_label: Dict[str, Dict[str, Any]]) -> None:
+    """Pick and pin the winner: best full-phase objective (short-phase
+    fallback when no full trial committed — budget exhaustion), ties by
+    label. The runner-up (same phase) rides along for the controller's
+    regression A/B."""
+    trials = ledger.trials
+    pool = [t for t in trials if t.phase == PHASE_FULL and t.status == "ok"]
+    if not pool:
+        pool = [t for t in trials if t.status == "ok"]
+    if not pool:
+        return
+    ranked = sorted(pool, key=lambda t: (-t.objective, t.label))
+    best = ranked[0]
+    runner_up = None
+    if len(ranked) > 1:
+        ru = ranked[1]
+        runner_up = {"label": ru.label, "objective": ru.objective,
+                     "overrides": (survivors_by_label.get(ru.label) or {}
+                                   ).get("candidate")}
+    ledger.pin_best(
+        best.label,
+        (survivors_by_label.get(best.label) or {}).get("candidate") or {},
+        best.objective, runner_up=runner_up)
+
+
+def run_search(grid: Dict[str, Any], *,
+               seed: int = 0,
+               run: Optional[str] = None,
+               ledger_path: Optional[str] = None,
+               ledger_dir: Optional[str] = None,
+               mode: str = "static",
+               budget_trials: Optional[int] = None,
+               budget_seconds: Optional[float] = None,
+               resume: bool = False,
+               runner: Optional[TrialRunner] = None,
+               sweep_fn: Optional[Callable[..., List]] = None,
+               log: Optional[Callable[[str], None]] = None) -> TrialLedger:
+    """The `dstpu tune` engine: plan (oracle sweep + schedule, committed
+    once) then measure (one ledger commit per trial) then pin the winner.
+
+    ``mode="static"`` plans off the committed artifact with zero
+    compiles; ``mode="audit"`` pays the oracle's compile audit per
+    non-pruned point (the closed-loop proof path). ``runner`` and
+    ``sweep_fn`` are injection points for tests and the legacy shim."""
+    from ..analysis.feasibility import (export_survivors, static_sweep,
+                                        sweep)
+
+    entry = grid.get("entry", "engine-train-step")
+    run = run or f"{entry}-s{seed}"
+    if ledger_path is None:
+        ledger_path = os.path.join(ledger_dir or default_ledger_dir(),
+                                   f"{run}.json")
+    say = log or (lambda m: None)
+
+    if resume and os.path.exists(ledger_path):
+        ledger = TrialLedger.load(ledger_path)
+        if not ledger.plan_matches(entry=entry, seed=seed, grid=grid):
+            raise ValueError(
+                f"ledger {ledger_path} was planned for a different "
+                "(entry, seed, grid) — refusing to resume into a "
+                "mismatched schedule")
+        say(f"dstpu tune: resuming {run} with "
+            f"{len(ledger.doc['trials'])} committed trial(s)")
+    else:
+        if sweep_fn is not None:
+            results = sweep_fn(grid, log=say)
+        elif mode == "static":
+            results = static_sweep(grid, log=say)
+        else:
+            results = sweep(grid, log=say)
+        survivors = export_survivors(results)
+        schedule = plan_schedule(survivors, seed=seed,
+                                 budget_trials=budget_trials)
+        ledger = TrialLedger(ledger_path)
+        ledger.write_plan(
+            run=run, entry=entry, seed=seed,
+            grid=json.loads(json.dumps(grid)), mode=mode,
+            points=len(results),
+            pruned=sum(1 for r in results if not r.verdict.feasible),
+            compiled=sum(1 for r in results if r.compiled),
+            survivors=survivors, schedule=schedule,
+            env={k: os.environ[k] for k in ("DSTPU_HBM_BYTES",)
+                 if k in os.environ})
+        say(f"dstpu tune: planned {run}: {len(results)} point(s), "
+            f"{len(survivors)} survivor(s), "
+            f"{len(schedule)} short trial(s) scheduled")
+
+    runner = runner or TrialRunner(entry=entry)
+    survivors_by_label = {s["candidate"]["label"]: s
+                         for s in ledger.plan["survivors"]}
+    started = time.monotonic()
+    done = len(ledger.doc["trials"])
+    while True:
+        owed = remaining_schedule(ledger.plan, ledger.trials)
+        if not owed:
+            break
+        if budget_trials is not None and done >= budget_trials:
+            say(f"dstpu tune: trial budget ({budget_trials}) exhausted "
+                f"with {len(owed)} trial(s) unmeasured")
+            break
+        if budget_seconds is not None \
+                and time.monotonic() - started >= budget_seconds:
+            say(f"dstpu tune: time budget ({budget_seconds:.0f}s) "
+                f"exhausted with {len(owed)} trial(s) unmeasured")
+            break
+        item = owed[0]
+        surv = survivors_by_label[item["label"]]
+        candidate = _candidate_from_dict(surv["candidate"])
+        result = runner.run_candidate(candidate, phase=item["phase"],
+                                      verdict=surv["verdict"])
+        ledger.record_trial(result.record)
+        done += 1
+        say(f"dstpu tune: [{item['phase']}] {item['label']}: "
+            f"{result.record.status}, objective "
+            f"{result.record.objective:.3e}")
+
+    _pin_from_trials(ledger, survivors_by_label)
+    if ledger.best:
+        say(f"dstpu tune: winner {ledger.best['label']} "
+            f"(objective {ledger.best['objective']:.3e})")
+    return ledger

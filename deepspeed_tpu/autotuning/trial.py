@@ -1,0 +1,219 @@
+"""The measured half of dstpu-tune: build, step, score — in-process.
+
+The reference's ``Autotuner`` forks one subprocess per experiment and
+scrapes stdout; here a trial is an ordinary in-process engine build (the
+same :func:`~deepspeed_tpu.analysis.entry_points._tiny_engine` +
+``candidate_overrides`` path the feasibility oracle compiles through, so
+the program a trial MEASURES is the program the oracle AUDITED) followed
+by ``warmup + N`` measured ``train_batch`` steps scored from the
+telemetry summary's ``tuning_objective`` (MFU x goodput).
+
+Successive-halving economics (docs/AUTOTUNING.md): a SHORT trial seeds
+``model_flops_per_step`` from the candidate's verdict
+(``predicted_step_flops``) so MFU needs no XLA cost-analysis pass — the
+dominant per-trial fixed cost after the compile; a FULL trial resolves
+measured FLOPs, runs ``feasibility_cross_check`` against the committed
+artifact, and folds the measured-vs-predicted error into the per-entry
+calibration record (``analysis/feasibility.update_calibration``) — the
+loop that sharpens the static oracle as trials accumulate.
+
+A trial that fails to build or step is a DATA POINT (``status="error:
+..."``, objective 0.0), never a crash of the search.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, Optional
+
+from .ledger import PHASE_FULL, PHASE_SHORT, TrialRecord
+
+#: telemetry overlay every trial engine builds under: scoring needs the
+#: metrics engine, never the watchdog thread (a 1-core audit host under
+#: compile load trips soft deadlines spuriously)
+TRIAL_TELEMETRY_CONFIG = {
+    "telemetry": {"enabled": True, "watchdog": {"enabled": False}},
+}
+
+
+@dataclasses.dataclass
+class TrialResult:
+    """What one measured trial concluded (ledger form + the verdict-linked
+    extras the search policy consumes)."""
+    record: TrialRecord
+    summary: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    @property
+    def objective(self) -> float:
+        return self.record.objective
+
+    @property
+    def ok(self) -> bool:
+        return self.record.status == "ok"
+
+
+def _reset_runtime() -> None:
+    """Between-trial hygiene — the conftest reset block, owned by the
+    runner so searches outside pytest don't leak one candidate's
+    telemetry/transport/topology into the next build."""
+    from ..telemetry import reset_telemetry
+    reset_telemetry()
+    from .. import comm as dist
+    dist.reset_transport()
+    from ..runtime.overlap_planner import configure_planner
+    configure_planner(None)
+    from ..runtime import topology as topo_mod
+    topo_mod.reset()
+
+
+class TrialRunner:
+    """Builds candidate engines and scores measured steps.
+
+    ``make_engine``/``batch_for`` injection points exist for the legacy
+    ``Autotuner`` shim (which supplies its own model/config) and for
+    stub-based tests; ``run_candidate`` is the production path the search
+    policy drives."""
+
+    def __init__(self, entry: str = "engine-train-step",
+                 warmup_steps: int = 1, measure_steps: int = 3,
+                 short_steps: int = 1,
+                 plans_dir: Optional[str] = None,
+                 calibration_path: Optional[str] = None):
+        self.entry = entry
+        self.warmup_steps = max(0, int(warmup_steps))
+        self.measure_steps = max(1, int(measure_steps))
+        self.short_steps = max(1, int(short_steps))
+        self.plans_dir = plans_dir
+        self.calibration_path = calibration_path
+
+    # -- the generic measured core --------------------------------------
+    def measure(self, make_engine: Callable[[], Any],
+                batch_for: Callable[[Any], Any], *,
+                label: str, phase: str = PHASE_FULL,
+                steps: Optional[int] = None,
+                warmup: Optional[int] = None,
+                predicted_flops: Optional[float] = None,
+                predicted_cost: Optional[float] = None,
+                calibrate: bool = False) -> TrialResult:
+        """Build via ``make_engine``, run ``warmup`` + ``steps`` measured
+        ``train_batch`` calls, score from the telemetry summary. Never
+        raises for a candidate's failure — the error string is the
+        result."""
+        import jax
+
+        steps = self.measure_steps if steps is None else max(1, int(steps))
+        warmup = self.warmup_steps if warmup is None else max(0, int(warmup))
+        try:
+            return self._measure_inner(jax, make_engine, batch_for, label,
+                                       phase, steps, warmup, predicted_flops,
+                                       predicted_cost, calibrate)
+        except Exception as e:  # noqa: BLE001 - a failed trial is data
+            return TrialResult(record=TrialRecord(
+                label=label, phase=phase,
+                status=f"error: {type(e).__name__}: {e}",
+                objective=0.0, steps=0))
+        finally:
+            _reset_runtime()
+
+    def _measure_inner(self, jax, make_engine, batch_for, label, phase,
+                       steps, warmup, predicted_flops, predicted_cost,
+                       calibrate) -> TrialResult:
+        from ..telemetry.metrics import MetricsEngine
+        from ..telemetry.telemetry import NullTelemetry
+
+        engine = make_engine()
+        tele = getattr(engine, "telemetry", None)
+        if tele is None or isinstance(tele, NullTelemetry):
+            return TrialResult(record=TrialRecord(
+                label=label, phase=phase,
+                status="error: trial engine built without telemetry "
+                       "(candidate config disabled it?)",
+                objective=0.0, steps=0))
+        batch = batch_for(engine)
+        leaves = jax.tree.leaves(batch)
+        batch_size = int(leaves[0].shape[0]) if leaves else 0
+
+        for _ in range(warmup):
+            engine.train_batch(batch)
+        # drop warmup/compile steps from the scored window: fresh metrics,
+        # same FLOPs plumbing (peak figure + any already-resolved model
+        # FLOPs survive the swap)
+        fresh = MetricsEngine(window=tele.metrics._durations.maxlen
+                              or 128)
+        fresh.peak_flops_total = tele.metrics.peak_flops_total
+        fresh.model_flops_per_step = tele.metrics.model_flops_per_step
+        tele.metrics = fresh
+        if predicted_flops and fresh.model_flops_per_step <= 0 \
+                and phase == PHASE_SHORT:
+            # short-budget trial: the oracle's prediction stands in for
+            # the measured numerator — no cost-analysis pass paid
+            fresh.model_flops_per_step = float(predicted_flops)
+
+        for _ in range(steps):
+            engine.train_batch(batch)
+        if phase == PHASE_FULL:
+            tele.flush(steps)       # resolves measured model FLOPs
+        summary = tele.metrics.summary()
+
+        step_mean = float(summary.get("step_time_mean_s") or 0.0)
+        cross = None
+        if phase == PHASE_FULL:
+            cross = tele.metrics.feasibility_cross_check(
+                self.entry, plans_dir=self.plans_dir)
+            if calibrate and step_mean > 0 and predicted_cost \
+                    and predicted_cost > 0:
+                from ..analysis.feasibility import update_calibration
+                update_calibration(
+                    self.entry, measured_step_s=step_mean,
+                    cost=float(predicted_cost),
+                    flops_ratio=(cross or {}).get("ratio"),
+                    path=self.calibration_path)
+        record = TrialRecord(
+            label=label, phase=phase, status="ok",
+            objective=float(summary.get("tuning_objective") or 0.0),
+            mfu=float(summary.get("mfu") or 0.0),
+            goodput=float(summary.get("goodput") or 0.0),
+            tokens_per_sec=float(summary.get("tokens_per_sec") or 0.0),
+            samples_per_sec=(batch_size / step_mean
+                             if step_mean > 0 else 0.0),
+            step_time_mean_s=step_mean, steps=int(steps),
+            cross_check=cross)
+        return TrialResult(record=record, summary=dict(summary))
+
+    # -- the candidate path the search policy drives ---------------------
+    def run_candidate(self, candidate, *, phase: str = PHASE_FULL,
+                      verdict: Optional[Dict[str, Any]] = None,
+                      steps: Optional[int] = None,
+                      warmup: Optional[int] = None) -> TrialResult:
+        """Measure one oracle survivor: rebuild the engine the oracle
+        audited (same overrides context, telemetry overlaid) and score
+        it. ``verdict`` is the survivor's artifact dict — its
+        ``predicted_step_flops`` seeds short-trial MFU and its ``cost``
+        anchors the calibration record."""
+        from ..analysis.entry_points import (_batch, _tiny_engine,
+                                             candidate_overrides)
+
+        config, model, batch_ns = candidate.namespaces()
+        if steps is None:
+            steps = (self.short_steps if phase == PHASE_SHORT
+                     else self.measure_steps)
+
+        def make_engine():
+            ctx = candidate_overrides(config=config, model=model,
+                                      batch=batch_ns)
+            with ctx:
+                return _tiny_engine(config_extra=TRIAL_TELEMETRY_CONFIG)
+
+        def batch_for(engine):
+            with candidate_overrides(config=config, model=model,
+                                     batch=batch_ns):
+                return _batch(engine)
+
+        v = verdict or {}
+        return self.measure(
+            make_engine, batch_for, label=candidate.label, phase=phase,
+            steps=steps, warmup=warmup,
+            predicted_flops=v.get("predicted_step_flops"),
+            predicted_cost=v.get("cost"),
+            calibrate=(phase == PHASE_FULL))

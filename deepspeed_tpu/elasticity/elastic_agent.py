@@ -203,6 +203,10 @@ class DSElasticAgent:
         while True:
             world = self._solve_world(slots)
             self.world_history.append(world["world_size"])
+            # a (re)solved world is a resize event: the tune controller
+            # re-searches the batch-geometry knobs for the new dp width
+            from ..resilience.events import announce_resize
+            announce_resize(world, attempt=attempt)
             logger.info(
                 f"elastic agent: attempt {attempt}, world {world['world_size']} "
                 f"(batch {world['train_batch']} = {world['micro_batch']} "

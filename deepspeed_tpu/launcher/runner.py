@@ -446,6 +446,12 @@ def main(args=None) -> int:
         # without running a step — see docs/STATIC_ANALYSIS.md.
         from ..analysis.feasibility import main as plan_main
         return plan_main(argv[1:])
+    if argv and argv[0] == "tune":
+        # `dstpu tune ...` — measured autotuning over the oracle's
+        # survivors (autotuning/search.py): successive-halving trials to
+        # a crash-consistent ledger — see docs/AUTOTUNING.md.
+        from ..autotuning.cli import main as tune_main
+        return tune_main(argv[1:])
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
     if args.elastic_training:

@@ -16,9 +16,16 @@ Pieces:
 - :mod:`guardian` — the NUMERICS half (ISSUE 13): in-graph anomaly-word
   sentinels, the deterministic detect → skip → rollback policy, the
   last-known-good pin, and the SDC replay probe (docs/RESILIENCE.md).
+- :mod:`events` — the world-changed pub/sub (ISSUE 19): elastic resizes
+  and guardian rollbacks announce themselves so the tune controller can
+  re-search the knobs the event invalidated (docs/AUTOTUNING.md).
 """
 
 from .chaos import compare_trajectories, read_trajectory  # noqa: F401
+from .events import (EVENT_ELASTIC_RESIZE, EVENT_GUARDIAN_ROLLBACK,  # noqa: F401
+                     announce_resize)
+from .events import publish as publish_event  # noqa: F401
+from .events import subscribe as subscribe_events  # noqa: F401
 from .fault_plan import (CRASH_EXIT_CODE, GUARDIAN_EXIT_CODE,  # noqa: F401
                          STALL_EXIT_CODE, FaultEvent,
                          FaultPlan, active_plan, clear_plan, fault_descriptor,

@@ -1,0 +1,80 @@
+"""Resilience event bus (dstpu-tune, docs/AUTOTUNING.md).
+
+A deliberately tiny host-side pub/sub: the moments the world changes shape
+— an elastic agent re-solves the world after a failure, the numerics
+guardian rolls a run back — are exactly the moments a previously-tuned
+config stops being the right one. The publishers are the existing
+resilience subsystems (``ElasticAgent._run``, ``GuardianPolicy.
+note_rollback``); the one subscriber today is the tune controller
+(``autotuning/controller.py``), which maps each event kind to the scope of
+knobs worth re-searching.
+
+Same discipline as the telemetry sinks: subscribers run synchronously on
+the publishing (host) thread, a raising subscriber is logged and kept, and
+nothing here is reachable from traced code.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List
+
+from ..utils.logging import logger
+
+#: the elastic agent (re)solved a world: payload carries ``world_size``
+#: (the new dp width), ``micro_batch``/``train_batch``/``gas`` when known,
+#: and ``attempt`` (0 = first launch, >0 = a restart/resize).
+EVENT_ELASTIC_RESIZE = "elastic_resize"
+
+#: the guardian rolled the run back to a pinned checkpoint: payload
+#: carries ``step`` and ``tag`` (None when nothing was ever pinned).
+EVENT_GUARDIAN_ROLLBACK = "guardian_rollback"
+
+_LOCK = threading.Lock()
+_SUBSCRIBERS: List[Callable[[str, Dict[str, Any]], None]] = []
+
+
+def subscribe(callback: Callable[[str, Dict[str, Any]], None]
+              ) -> Callable[[], None]:
+    """Register ``callback(kind, payload)`` for every published event.
+    Returns an unsubscribe callable."""
+    with _LOCK:
+        _SUBSCRIBERS.append(callback)
+
+    def unsubscribe() -> None:
+        with _LOCK:
+            try:
+                _SUBSCRIBERS.remove(callback)
+            except ValueError:
+                pass
+    return unsubscribe
+
+
+def publish(kind: str, **payload: Any) -> int:
+    """Deliver ``(kind, payload)`` to every subscriber, synchronously, in
+    registration order. Returns the number of subscribers reached — a
+    publisher never fails because a listener did."""
+    with _LOCK:
+        subs = list(_SUBSCRIBERS)
+    for cb in subs:
+        try:
+            cb(kind, dict(payload))
+        except Exception as e:  # noqa: BLE001 - sink-parity error policy
+            logger.warning(f"resilience event subscriber failed on "
+                           f"{kind!r}: {e}")
+    return len(subs)
+
+
+def announce_resize(world: Dict[str, Any], attempt: int = 0) -> None:
+    """The elastic agent's publish point, shared with tests that drive a
+    resize without spawning worlds: ``world`` is the agent's solved-world
+    dict (``world_size``/``micro_batch``/``train_batch``/``gas``)."""
+    publish(EVENT_ELASTIC_RESIZE, attempt=int(attempt),
+            **{k: world[k] for k in ("world_size", "micro_batch",
+                                     "train_batch", "gas") if k in world})
+
+
+def reset() -> None:
+    """Drop every subscriber — test-harness hygiene."""
+    with _LOCK:
+        _SUBSCRIBERS.clear()

@@ -446,6 +446,11 @@ class GuardianPolicy:
         self.ledger.note_rollback(step, verdict, tag)
         if self.telemetry is not None:
             self.telemetry.record_rollback(step, tag)
+        # announce on the resilience bus: a rollback invalidates whatever
+        # the autotuner concluded about numerics-adjacent knobs
+        from .events import EVENT_GUARDIAN_ROLLBACK, publish
+        publish(EVENT_GUARDIAN_ROLLBACK, step=int(step), tag=tag,
+                kinds=list(verdict.kinds) if verdict is not None else [])
 
     def reset_after_rollback(self, resumed_step: int) -> None:
         """In-process rollback epilogue: the anomaly window describes a

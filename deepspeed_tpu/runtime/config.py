@@ -148,6 +148,27 @@ class CompressionConfig(DeepSpeedConfigModel):
     layer_reduction: Dict[str, Any] = Field(default_factory=dict)
 
 
+class AutotuningConfig(DeepSpeedConfigModel):
+    """The ``autotuning`` block (dstpu-tune, docs/AUTOTUNING.md). The
+    reference's block of the same name steers its launched-experiment
+    ``Autotuner``; here it parameterizes the in-process trial runner and
+    the closed-loop controller. ``enabled`` gates only the CONTROLLER
+    attachment — one-shot ``dstpu tune`` runs ignore it."""
+    enabled: bool = False
+    # composite objective key read from the telemetry flush summary
+    metric: str = "tuning_objective"
+    # per-trial measurement budget
+    warmup_steps: int = 1
+    measure_steps: int = 3
+    # trial-ledger directory; empty -> tools/autotune under the repo root
+    ledger_dir: str = ""
+    # controller policy: consecutive regressed flush summaries (vs the
+    # pinned best) before a background A/B of the runner-up fires
+    regression_patience: int = 3
+    # fractional tuning_objective drop that counts as a regression
+    regression_tolerance: float = 0.2
+
+
 class ElasticityConfigModel(DeepSpeedConfigModel):
     enabled: bool = False
     max_train_batch_size: int = 2000
@@ -219,6 +240,10 @@ class DeepSpeedConfig:
         self.data_efficiency_config = DataEfficiencyConfig(**pd.get("data_efficiency", {}))
         self.compression_config = CompressionConfig(**pd.get("compression_training", {}))
         self.elasticity_config = ElasticityConfigModel(**pd.get("elasticity", {}))
+        # autotuning subsystem (autotuning/, docs/AUTOTUNING.md): the
+        # trial-budget and controller policy; DSTPU_TUNE gates the
+        # config-overlay path in initialize()
+        self.autotuning_config = AutotuningConfig(**pd.get("autotuning", {}))
 
         self.gradient_clipping: float = pd.get("gradient_clipping", 0.0)
         self.steps_per_print: int = pd.get("steps_per_print", 10)

@@ -177,5 +177,12 @@ class PipelineModule:
             logits = self._lm._lm_head(params["lm_head"], x)
         return logits.astype(jnp.float32), aux_total
 
+    # The shared loss ingredients (transformer.py): ``TransformerLM.loss``
+    # calls ``self.derive_labels``/``self.combine_aux``, and both read only
+    # ``self.config`` — borrowing them keeps the pipelined loss math
+    # identical to the dense model's by construction.
+    derive_labels = TransformerLM.derive_labels
+    combine_aux = TransformerLM.combine_aux
+
     def loss(self, params: Dict[str, Any], batch: Dict[str, jax.Array]) -> jax.Array:
         return TransformerLM.loss(self, params, batch)  # same loss math

@@ -259,6 +259,14 @@ class MetricsEngine:
         total = self.productive_s + lost
         return (self.productive_s / total) if total > 0 else 1.0
 
+    def tuning_objective(self) -> float:
+        """The autotuner's composite score: ``mfu() * goodput()`` —
+        hardware efficiency discounted by the fraction of wall time the
+        run actually trained (docs/AUTOTUNING.md). 0.0 until MFU is
+        resolvable (no model-FLOPs source, or no steps yet), so a
+        candidate that never produced a measurable step never wins."""
+        return self.mfu() * self.goodput()
+
     def overlap_efficiency(self) -> Optional[float]:
         total = self.comm_overlapped_bytes + self.comm_exposed_bytes
         if total == 0:
@@ -271,6 +279,9 @@ class MetricsEngine:
             "step_time_mean_s": self.mean_step_s(),
             "tokens_per_sec": self.tokens_per_sec(),
             "goodput": self.goodput(),
+            # always present (0.0 while MFU is unresolved) — the
+            # controller and trial runner key on it unconditionally
+            "tuning_objective": self.tuning_objective(),
             "stalled_steps": float(self.stalled_steps),
         }
         out.update({f"step_time_{k}_s": v

@@ -78,6 +78,25 @@ class Telemetry:
         self._flops_fn: Optional[Callable[[], float]] = None
         self._flops_attempts = 0
         self._closed = False
+        # flush-summary subscribers (the tune controller): host-side
+        # callbacks fed off the flush fence, never from traced code
+        self._subscribers: List[Callable[[int, Dict[str, float]], None]] = []
+
+    # -- flush subscription (dstpu-tune, docs/AUTOTUNING.md) -------------
+    def subscribe(self, callback: Callable[[int, Dict[str, float]], None]
+                  ) -> Callable[[], None]:
+        """Register ``callback(step, summary)`` to run at every flush,
+        after the sinks. Returns an unsubscribe callable. Callbacks run
+        on the flushing thread and must be cheap; a raising callback is
+        logged and kept (parity with the sink contract)."""
+        self._subscribers.append(callback)
+
+        def unsubscribe() -> None:
+            try:
+                self._subscribers.remove(callback)
+            except ValueError:
+                pass
+        return unsubscribe
 
     # -- spans -----------------------------------------------------------
     def phase(self, name: str, phase: Optional[str] = None,
@@ -234,8 +253,8 @@ class Telemetry:
         list (also recorded as trace counter tracks)."""
         clock.fence("telemetry-flush")
         self._resolve_flops()
-        events = [(f"Telemetry/{k}", v, step)
-                  for k, v in self.metrics.summary().items()]
+        summary = self.metrics.summary()
+        events = [(f"Telemetry/{k}", v, step) for k, v in summary.items()]
         if self.memory is not None:
             sample = self.memory.sample(tag=f"step{step}")
             events += [(f"Telemetry/memory/{k}", float(v), step)
@@ -248,6 +267,11 @@ class Telemetry:
             except Exception as e:  # noqa: BLE001 - a broken sink must not
                 logger.warning(f"telemetry sink {type(sink).__name__} "
                                f"failed: {e}")          # kill the training loop
+        for cb in list(self._subscribers):
+            try:
+                cb(step, summary)
+            except Exception as e:  # noqa: BLE001 - subscriber parity with
+                logger.warning(f"telemetry subscriber failed: {e}")  # sinks
         return events
 
     def export(self) -> Dict[str, str]:
@@ -346,6 +370,9 @@ class NullTelemetry:
 
     def set_flops_fn(self, fn):
         pass
+
+    def subscribe(self, callback):
+        return lambda: None
 
     def flush(self, step):
         return []

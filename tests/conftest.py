@@ -28,6 +28,11 @@ import pytest  # noqa: E402
 from deepspeed_tpu.runtime import topology as topo_mod  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_topology():
     topo_mod.reset()

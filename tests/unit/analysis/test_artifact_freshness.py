@@ -135,6 +135,35 @@ def test_every_entry_point_has_a_committed_verdict():
         "stale file by hand")
 
 
+def test_committed_demo_tune_ledger_fresh():
+    """dstpu-tune's committed demo ledger (tools/autotune/demo.json) is
+    the plan half of a static-mode search over the committed demo grid
+    under the pinned DEMO_HBM_BYTES budget — deterministic off the
+    committed engine-train-step verdict artifact, so regenerating it
+    here is sub-second (model mode, zero compiles) and any drift in the
+    static model, the ranking, or the schedule derivation dies in
+    tier 1."""
+    from deepspeed_tpu.autotuning.cli import build_demo_plan, demo_ledger_path
+
+    assert os.path.exists(demo_ledger_path()), (
+        "tools/autotune/demo.json missing — run `dstpu tune --update-demo` "
+        "and commit the ledger")
+    with open(demo_ledger_path()) as fh:
+        committed = json.load(fh)
+    regenerated = build_demo_plan()
+    assert committed == regenerated, (
+        "committed demo tune ledger is stale against the static oracle — "
+        "rerun `dstpu tune --update-demo` and commit the result")
+    # and the demo must actually demonstrate: a real grid, real pruning,
+    # zero compiles paid, a full short-trial schedule, no measured state
+    plan = committed["plan"]
+    assert plan["mode"] == "static" and plan["compiled"] == 0
+    assert plan["points"] >= 12 and plan["pruned"] > 0
+    assert len(plan["schedule"]) == len(plan["survivors"]) \
+        == plan["points"] - plan["pruned"]
+    assert committed["trials"] == [] and committed["best"] is None
+
+
 def test_committed_verdicts_all_feasible_on_audit_mesh():
     # the HEAD default config must be feasible for EVERY registered
     # entry: an infeasible default is a broken ship, not a lint finding

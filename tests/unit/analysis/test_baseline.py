@@ -40,18 +40,19 @@ def test_finding_layer_markers():
     assert finding_layer(_f(path="<spmd:engine-train-step>")) == "spmd"
 
 
-def test_split_layers_four_way():
-    ast, jaxpr, spmd, sched = split_layers([
+def test_split_layers_five_way():
+    ast, jaxpr, spmd, sched, feas = split_layers([
         _f(path="a.py"), _f(path="<trace:e>"), _f(path="<spmd:e>"),
-        _f(path="<sched:e>")])
+        _f(path="<sched:e>"), _f(path="<plan:e>")])
     assert [f.path for f in ast] == ["a.py"]
     assert [f.path for f in jaxpr] == ["<trace:e>"]
     assert [f.path for f in spmd] == ["<spmd:e>"]
     assert [f.path for f in sched] == ["<sched:e>"]
+    assert [f.path for f in feas] == ["<plan:e>"]
     layers = by_layer([_f(path="<spmd:e>")])
     assert [f.path for f in layers["spmd"]] == ["<spmd:e>"]
     assert layers["ast"] == [] and layers["jaxpr"] == []
-    assert layers["schedule"] == []
+    assert layers["schedule"] == [] and layers["feasibility"] == []
 
 
 def test_entry_name_and_prune_unknown():

@@ -250,6 +250,18 @@ def test_candidate_entry_pins_are_consistent():
         "subset")
 
 
+def test_tune_smoke_two_trial_run():
+    """`dstpu tune --smoke` joins the gate: the smallest end-to-end pass
+    through the NEW autotuning pipeline — static plan over the built-in
+    2-point grid, two short measured trials on REAL in-process engine
+    builds, a pinned winner. ~15 s on the CPU mesh (two tiny engine
+    compiles); anything structural that breaks plan→measure→pin breaks
+    here, in tier 1, without waiting for the slow closed-loop test."""
+    from deepspeed_tpu.autotuning.cli import main as tune_main
+
+    assert tune_main(["--smoke"]) == 0
+
+
 def test_every_entry_point_has_a_committed_budget():
     # shrink-only file integrity: every registered entry point is budgeted
     # (a new entry lands with its budget in the same PR) and every budget

@@ -1,0 +1,12 @@
+import pytest
+
+from deepspeed_tpu.resilience import events
+
+
+@pytest.fixture(autouse=True)
+def _reset_event_bus():
+    """The resilience event bus is module-global; a subscriber leaked by
+    one test must not see the next test's publishes."""
+    events.reset()
+    yield
+    events.reset()

@@ -1,0 +1,196 @@
+"""TuneController: the closed loop (autotuning/controller.py). Re-tunes
+are stubbed (tune_fn records + returns a winner); the SIGNALS are the
+real ones — the elastic agent's ``announce_resize`` and the guardian's
+``note_rollback`` publish on the real resilience event bus, and the
+regression stream arrives through the real telemetry ``subscribe``
+flush hook."""
+
+import pytest
+
+from deepspeed_tpu.autotuning.controller import EVENT_SCOPES, TuneController
+from deepspeed_tpu.resilience import announce_resize
+from deepspeed_tpu.resilience.guardian import (GuardianConfig,
+                                               GuardianPolicy,
+                                               GuardianVerdict)
+
+GRID = {"entry": "engine-train-step",
+        "axes": {"batch.size": [8, 16, 32], "batch.seq": [8, 16],
+                 "model.remat": [False, True]},
+        "monotone": ["batch.size", "batch.seq"]}
+
+
+def _controller(**kw):
+    tuned = []
+    applied = []
+
+    def tune_fn(scoped_grid, reason):
+        tuned.append((scoped_grid, reason))
+        return {"label": f"retuned-{len(tuned)}", "overrides": {},
+                "objective": 2.0, "runner_up": None}
+
+    ctl = TuneController(GRID,
+                         best=kw.pop("best", {"label": "orig",
+                                              "objective": 1.0,
+                                              "overrides": {}}),
+                         tune_fn=tune_fn,
+                         apply_fn=lambda best, reason:
+                             applied.append((best["label"], reason)),
+                         **kw)
+    return ctl, tuned, applied
+
+
+class TestEventRetunes:
+
+    def test_elastic_resize_triggers_one_batch_transport_retune(self):
+        ctl, tuned, applied = _controller()
+        ctl.attach()
+        try:
+            # the REAL publisher the elastic agent calls on a re-solve:
+            # the 8-device world shrank to dp=4
+            announce_resize({"world_size": 4, "micro_batch": 1,
+                             "train_batch": 4, "gas": 1}, attempt=1)
+        finally:
+            ctl.detach()
+        assert ctl.poll() == 1
+        assert len(tuned) == 1
+        scoped, reason = tuned[0]
+        assert reason.startswith("elastic_resize:")
+        # scoped to batch+transport knobs present in the grid; the
+        # numerics axis is frozen at its default, not swept
+        assert sorted(scoped["axes"]) == ["batch.seq", "batch.size"]
+        assert scoped["base"]["model.remat"] is False
+        assert applied == [("retuned-1", reason)]
+        assert ctl.best["label"] == "retuned-1"
+
+    def test_guardian_rollback_triggers_one_numerics_retune(self, tmp_path):
+        ctl, tuned, applied = _controller()
+        ctl.attach()
+        try:
+            # the REAL publisher: a guardian policy recording a rollback
+            policy = GuardianPolicy(GuardianConfig(enabled=True),
+                                    ledger_dir=str(tmp_path))
+            verdict = GuardianVerdict(step=7, word=1,
+                                      kinds=("grad_nonfinite",),
+                                      action="rollback")
+            policy.note_rollback(7, verdict, "tag3")
+        finally:
+            ctl.detach()
+        assert ctl.poll() == 1
+        scoped, reason = tuned[0]
+        assert reason == "guardian_rollback:numerics"
+        assert sorted(scoped["axes"]) == ["model.remat"]
+        assert len(applied) == 1
+        assert ctl.retunes[0]["payload"]["kinds"] == ["grad_nonfinite"]
+
+    def test_events_coalesce_one_retune_per_kind(self, tmp_path):
+        ctl, tuned, _ = _controller()
+        ctl.attach()
+        try:
+            policy = GuardianPolicy(GuardianConfig(enabled=True),
+                                    ledger_dir=str(tmp_path))
+            v = GuardianVerdict(step=1, word=1, kinds=("loss_spike",),
+                                action="rollback")
+            for step in (1, 2, 3):
+                policy.note_rollback(step, v, None)
+        finally:
+            ctl.detach()
+        assert ctl.poll() == 1
+        assert len(tuned) == 1
+        assert ctl.poll() == 0  # queue drained, nothing re-fires
+
+    def test_unknown_event_kinds_are_ignored(self):
+        ctl, tuned, _ = _controller()
+        ctl.on_event("zeropp_phase_change", {"step": 1})
+        assert ctl.poll() == 0 and tuned == []
+
+    def test_event_scope_table_matches_knob_scopes(self):
+        from deepspeed_tpu.autotuning.search import KNOB_SCOPES
+        for kind, scopes in EVENT_SCOPES.items():
+            for s in scopes:
+                assert s in KNOB_SCOPES, (kind, s)
+
+
+class TestRegressionAB:
+
+    def _regressing(self, ab_objective):
+        abs_run = []
+
+        def ab_fn(runner_up):
+            abs_run.append(runner_up["label"])
+            return ab_objective
+
+        ctl, tuned, applied = _controller(
+            best={"label": "orig", "objective": 1.0, "overrides": {},
+                  "runner_up": {"label": "ru", "objective": 0.9,
+                                "overrides": {"config": {}}}},
+            ab_fn=ab_fn, regression_patience=3,
+            regression_tolerance=0.2)
+        return ctl, abs_run, applied
+
+    def test_sustained_regression_runs_one_ab(self):
+        ctl, abs_run, applied = self._regressing(ab_objective=0.95)
+        for step in (10, 20, 30):
+            ctl.on_summary(step, {"tuning_objective": 0.5})  # < 0.8 floor
+        assert ctl.poll() == 1
+        assert abs_run == ["ru"]
+        # 0.95 beats the regressed incumbent's floor: runner-up adopted
+        assert ctl.best["label"] == "ru"
+        assert applied[-1] == ("ru", "regression:ab")
+        # the episode ran once; another poll does not re-A/B
+        assert ctl.poll() == 0
+
+    def test_ab_not_adopted_when_runner_up_no_better(self):
+        ctl, abs_run, applied = self._regressing(ab_objective=0.1)
+        for step in (10, 20, 30):
+            ctl.on_summary(step, {"tuning_objective": 0.5})
+        ctl.poll()
+        assert abs_run == ["ru"]
+        assert ctl.best["label"] == "orig" and applied == []
+
+    def test_recovery_resets_the_streak(self):
+        ctl, abs_run, _ = self._regressing(ab_objective=0.95)
+        ctl.on_summary(1, {"tuning_objective": 0.5})
+        ctl.on_summary(2, {"tuning_objective": 0.5})
+        ctl.on_summary(3, {"tuning_objective": 0.99})  # recovered
+        ctl.on_summary(4, {"tuning_objective": 0.5})
+        assert ctl.poll() == 0 and abs_run == []
+
+    def test_regression_stream_arrives_via_telemetry_subscribe(self):
+        """The real wiring: controller.attach(telemetry) registers the
+        flush hook; three flushes of a (flops-unresolved → objective 0)
+        window trip the A/B."""
+        from deepspeed_tpu.telemetry.config import TelemetryConfig
+        from deepspeed_tpu.telemetry.telemetry import Telemetry
+
+        tele = Telemetry(TelemetryConfig(**{"enabled": True,
+                                            "watchdog": {"enabled": False}}))
+        ctl, abs_run, _ = self._regressing(ab_objective=0.95)
+        ctl.attach(telemetry=tele, events=False)
+        try:
+            for step in (1, 2, 3):
+                tele.step_begin(step)
+                tele.step_end(step, tokens=128)
+                tele.flush(step)
+            assert ctl.poll() == 1
+            assert abs_run == ["ru"]
+        finally:
+            ctl.detach()
+            tele.close()
+
+
+class TestDaemonThread:
+
+    def test_background_thread_services_events(self):
+        import time
+        ctl, tuned, _ = _controller(poll_s=0.02)
+        ctl.attach()
+        ctl.start()
+        try:
+            announce_resize({"world_size": 4, "micro_batch": 1,
+                             "train_batch": 4, "gas": 1})
+            deadline = time.monotonic() + 5.0
+            while not tuned and time.monotonic() < deadline:
+                time.sleep(0.02)
+        finally:
+            ctl.stop()
+        assert len(tuned) == 1

@@ -1,0 +1,116 @@
+"""Ledger durability: SIGKILL mid-search via the fault plan (the same
+``ckpt_tmp`` torn-write seam the checkpoint chaos tests drive), then
+resume from the last committed trial with the IDENTICAL remaining
+schedule an uninterrupted search would have run."""
+
+import json
+import os
+import subprocess
+import sys
+
+from deepspeed_tpu.autotuning.ledger import TrialLedger
+from deepspeed_tpu.autotuning.search import remaining_schedule
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+#: worker: a full run_search over a 6-point static grid with a
+#: deterministic stub runner. `resume=True` makes the same invocation
+#: serve both the initial (to-be-killed) run and the resumed run.
+WORKER = r"""
+import json, sys
+from deepspeed_tpu.resilience.fault_plan import maybe_install_from_env
+maybe_install_from_env()
+from deepspeed_tpu.analysis.feasibility import static_sweep
+from deepspeed_tpu.autotuning.ledger import TrialRecord
+from deepspeed_tpu.autotuning.search import run_search
+from deepspeed_tpu.autotuning.trial import TrialResult
+
+ARTIFACT = {
+    "entry": "engine-train-step", "device_kind": "cpu",
+    "memory": {"argument_size_in_bytes": 1000,
+               "output_size_in_bytes": 600, "temp_size_in_bytes": 500,
+               "alias_size_in_bytes": 100},
+    "predicted_step_flops": 1000, "exposed_bytes": 100,
+    "overlapped_bytes": 0, "collective_bytes": 50,
+    "collective_bytes_by_kind": {}, "bytes_per_flop": 0.05,
+    "tokens_per_step": 128,
+}
+GRID = {"entry": "engine-train-step",
+        "axes": {"batch.size": [8, 16, 32], "batch.seq": [8, 16]},
+        "monotone": ["batch.size", "batch.seq"]}
+
+
+def objective(label):
+    return (sum(ord(c) for c in label) % 97) / 97.0
+
+
+class StubRunner:
+    def run_candidate(self, candidate, *, phase, verdict=None, steps=None,
+                      warmup=None):
+        print(json.dumps({"call": [candidate.label, phase]}), flush=True)
+        return TrialResult(record=TrialRecord(
+            label=candidate.label, phase=phase, status="ok",
+            objective=objective(candidate.label)))
+
+
+ledger = run_search(
+    GRID, seed=0, ledger_path=sys.argv[1], resume=True,
+    sweep_fn=lambda grid, log=None: static_sweep(grid, artifact=ARTIFACT,
+                                                 log=log),
+    runner=StubRunner())
+print(json.dumps({"done": True, "best": ledger.best["label"],
+                  "trials": [[t.label, t.phase] for t in ledger.trials]}),
+      flush=True)
+"""
+
+
+def _spawn(ledger_path, fault_plan=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH",
+                                                             ""))
+    env.pop("DSTPU_HBM_BYTES", None)
+    env.pop("DSTPU_FAULT_PLAN", None)
+    if fault_plan is not None:
+        env["DSTPU_FAULT_PLAN"] = json.dumps(fault_plan)
+    return subprocess.run([sys.executable, "-c", WORKER, ledger_path],
+                          capture_output=True, text=True, env=env,
+                          timeout=300, cwd=REPO)
+
+
+def test_sigkill_mid_search_resumes_identical_schedule(tmp_path):
+    ledger_path = str(tmp_path / "search.json")
+
+    # -- run 1: torn-write SIGKILL at the 3rd ledger commit (plan and
+    # trial #1 pass; the commit of trial #2 tears its temp file and dies)
+    plan = {"events": [{"kind": "torn_write", "match": "search.json",
+                        "skip": 2}]}
+    proc = _spawn(ledger_path, fault_plan=plan)
+    assert proc.returncode in (-9, 137), (proc.returncode, proc.stderr[-800:])
+    assert '"done"' not in proc.stdout
+
+    # the torn temp never replaced the committed file: the ledger reads
+    # back clean, with the plan and exactly the one committed trial
+    killed = TrialLedger.load(ledger_path)
+    assert len(killed.plan["schedule"]) == 6
+    assert len(killed.trials) == 1
+    expected = remaining_schedule(killed.plan, killed.trials)
+    assert len(expected) == 5           # the 5 uncommitted shorts
+
+    # -- run 2: resume. Replays exactly the owed schedule, no repeats.
+    proc2 = _spawn(ledger_path)
+    assert proc2.returncode == 0, proc2.stderr[-800:]
+    lines = [json.loads(l) for l in proc2.stdout.splitlines()
+             if l.startswith("{")]
+    calls = [tuple(l["call"]) for l in lines if "call" in l]
+    final = next(l for l in lines if l.get("done"))
+    assert calls[:5] == [(s["label"], s["phase"]) for s in expected]
+
+    # -- reference: an uninterrupted search must agree trial-for-trial
+    ref_path = str(tmp_path / "ref.json")
+    ref = _spawn(ref_path)
+    assert ref.returncode == 0, ref.stderr[-800:]
+    ref_final = next(json.loads(l) for l in ref.stdout.splitlines()
+                     if l.startswith("{") and "done" in l)
+    assert final["trials"] == ref_final["trials"]
+    assert final["best"] == ref_final["best"]

@@ -1,0 +1,99 @@
+"""Trial ledger: the crash-consistent search state (autotuning/ledger.py)."""
+
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.autotuning.ledger import (LEDGER_VERSION, PHASE_FULL,
+                                             PHASE_SHORT, TrialLedger,
+                                             TrialRecord)
+
+
+def _plan_kwargs(**over):
+    kw = dict(run="r", entry="engine-train-step", seed=0,
+              grid={"axes": {"batch.size": [8, 16]}}, mode="static",
+              points=2, pruned=0, compiled=0,
+              survivors=[{"candidate": {"label": "a"}, "verdict": {},
+                          "compiled": False}],
+              schedule=[{"phase": PHASE_SHORT, "label": "a"}])
+    kw.update(over)
+    return kw
+
+
+class TestTrialLedger:
+
+    def test_plan_commit_and_load_roundtrip(self, tmp_path):
+        path = str(tmp_path / "r.json")
+        ledger = TrialLedger(path)
+        ledger.write_plan(**_plan_kwargs())
+        loaded = TrialLedger.load(path)
+        assert loaded.plan["run"] == "r"
+        assert loaded.plan["schedule"] == [{"phase": "short", "label": "a"}]
+
+    def test_load_rejects_foreign_version(self, tmp_path):
+        path = tmp_path / "old.json"
+        path.write_text(json.dumps({"version": LEDGER_VERSION + 1,
+                                    "plan": None, "trials": []}))
+        with pytest.raises(ValueError, match="version"):
+            TrialLedger.load(str(path))
+
+    def test_record_trial_appends_and_commits(self, tmp_path):
+        path = str(tmp_path / "r.json")
+        ledger = TrialLedger(path)
+        ledger.write_plan(**_plan_kwargs())
+        ledger.record_trial(TrialRecord(label="a", phase=PHASE_SHORT,
+                                        status="ok", objective=0.5))
+        # durability: a fresh reader sees the committed trial
+        assert TrialLedger.load(path).committed() == {("a", "short")}
+
+    def test_trials_roundtrip_through_records(self, tmp_path):
+        ledger = TrialLedger(str(tmp_path / "r.json"))
+        ledger.write_plan(**_plan_kwargs())
+        rec = TrialRecord(label="a", phase=PHASE_FULL, status="ok",
+                          objective=0.25, mfu=0.1, goodput=0.9, steps=3,
+                          cross_check={"ratio": 1.1})
+        ledger.record_trial(rec)
+        got = ledger.trials[0]
+        assert got == rec
+
+    def test_from_dict_ignores_unknown_keys(self):
+        rec = TrialRecord.from_dict({"label": "a", "phase": "short",
+                                     "status": "ok", "objective": 1.0,
+                                     "some_future_field": 42})
+        assert rec.label == "a"
+
+    def test_plan_matches_requires_exact_grid(self, tmp_path):
+        ledger = TrialLedger(str(tmp_path / "r.json"))
+        ledger.write_plan(**_plan_kwargs())
+        good = {"axes": {"batch.size": [8, 16]}}
+        assert ledger.plan_matches(entry="engine-train-step", seed=0,
+                                   grid=good)
+        assert not ledger.plan_matches(entry="engine-train-step", seed=1,
+                                       grid=good)
+        assert not ledger.plan_matches(
+            entry="engine-train-step", seed=0,
+            grid={"axes": {"batch.size": [8, 32]}})
+
+    def test_pin_best_and_artifact_form(self, tmp_path):
+        ledger = TrialLedger(str(tmp_path / "r.json"))
+        ledger.write_plan(**_plan_kwargs())
+        ledger.record_trial(TrialRecord(label="a", phase=PHASE_SHORT,
+                                        status="ok", objective=0.5))
+        ledger.pin_best("a", {"batch": {"size": 8}}, 0.5,
+                        runner_up={"label": "b", "objective": 0.4})
+        assert ledger.best["runner_up"]["label"] == "b"
+        # the committed-demo form drops everything machine-dependent
+        art = ledger.plan_artifact()
+        assert art["trials"] == [] and art["best"] is None
+        assert art["plan"]["run"] == "r"
+
+    def test_commit_is_atomic_no_temp_litter(self, tmp_path):
+        path = str(tmp_path / "r.json")
+        ledger = TrialLedger(path)
+        ledger.write_plan(**_plan_kwargs())
+        for i in range(3):
+            ledger.record_trial(TrialRecord(label=f"t{i}",
+                                            phase=PHASE_SHORT,
+                                            status="ok", objective=float(i)))
+        assert sorted(os.listdir(tmp_path)) == ["r.json"]

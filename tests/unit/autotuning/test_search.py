@@ -1,0 +1,220 @@
+"""Search policy: schedule determinism, successive halving, resume
+identity, budgets (autotuning/search.py). All trials are stubs — the
+measured half has its own tests."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.analysis.feasibility import static_sweep
+from deepspeed_tpu.autotuning.ledger import (PHASE_FULL, PHASE_SHORT,
+                                             TrialLedger, TrialRecord)
+from deepspeed_tpu.autotuning.search import (KNOB_SCOPES, plan_schedule,
+                                             remaining_schedule, run_search,
+                                             scope_grid)
+from deepspeed_tpu.autotuning.trial import TrialResult
+
+#: a synthetic committed artifact the static oracle extrapolates from —
+#: tiny resident part, token-linear activations (tests pin their own
+#: HBM budget via DSTPU_HBM_BYTES to choose how much gets pruned)
+FAKE_ARTIFACT = {
+    "entry": "engine-train-step", "device_kind": "cpu",
+    "memory": {"argument_size_in_bytes": 1000,
+               "output_size_in_bytes": 600, "temp_size_in_bytes": 500,
+               "alias_size_in_bytes": 100},
+    "predicted_step_flops": 1000, "exposed_bytes": 100,
+    "overlapped_bytes": 0, "collective_bytes": 50,
+    "collective_bytes_by_kind": {}, "bytes_per_flop": 0.05,
+    "tokens_per_step": 128,
+}
+
+GRID = {"entry": "engine-train-step",
+        "axes": {"batch.size": [8, 16, 32], "batch.seq": [8, 16]},
+        "monotone": ["batch.size", "batch.seq"]}
+
+
+def fake_sweep(grid, log=None):
+    return static_sweep(grid, artifact=FAKE_ARTIFACT, log=log)
+
+
+class StubRunner:
+    """Deterministic objectives keyed by label; records every call."""
+
+    def __init__(self, objectives=None, fail=()):
+        self.objectives = objectives or {}
+        self.fail = set(fail)
+        self.calls = []
+
+    def run_candidate(self, candidate, *, phase, verdict=None, steps=None,
+                      warmup=None):
+        self.calls.append((candidate.label, phase))
+        if candidate.label in self.fail:
+            rec = TrialRecord(label=candidate.label, phase=phase,
+                              status="error: boom", objective=0.0)
+        else:
+            obj = self.objectives.get(
+                candidate.label, 1.0 / (1 + len(candidate.label)))
+            rec = TrialRecord(label=candidate.label, phase=phase,
+                              status="ok", objective=obj)
+        return TrialResult(record=rec)
+
+
+def _search(tmp_path, name="run", **kw):
+    kw.setdefault("sweep_fn", fake_sweep)
+    kw.setdefault("runner", StubRunner(kw.pop("objectives", None),
+                                       kw.pop("fail", ())))
+    return run_search(GRID, ledger_path=str(tmp_path / f"{name}.json"), **kw)
+
+
+class TestSchedule:
+
+    def test_plan_schedule_is_rank_order(self):
+        survivors = [{"candidate": {"label": l}} for l in "abcde"]
+        sched = plan_schedule(survivors, seed=0)
+        assert [s["label"] for s in sched] == list("abcde")
+        assert {s["phase"] for s in sched} == {PHASE_SHORT}
+
+    def test_budget_subsample_is_seed_deterministic(self):
+        survivors = [{"candidate": {"label": f"c{i}"}} for i in range(10)]
+        a = plan_schedule(survivors, seed=7, budget_trials=5)
+        b = plan_schedule(survivors, seed=7, budget_trials=5)
+        c = plan_schedule(survivors, seed=8, budget_trials=5)
+        assert a == b
+        assert len(a) == 5
+        # the cheapest half of the budget is always kept by rank
+        assert [s["label"] for s in a[:2]] == ["c0", "c1"]
+        assert a != c  # a different seed explores a different tail
+
+    def test_remaining_schedule_promotes_top_quartile(self):
+        plan = {"schedule": [{"phase": PHASE_SHORT, "label": l}
+                             for l in "abcdefgh"]}
+        trials = [TrialRecord(label=l, phase=PHASE_SHORT, status="ok",
+                              objective=obj)
+                  for l, obj in zip("abcdefgh", [1, 5, 3, 5, 2, 0, 4, 1])]
+        owed = remaining_schedule(plan, trials)
+        # ceil(8/4)=2 fulls; ties (b,d at 5) break by schedule rank
+        assert owed == [{"phase": PHASE_FULL, "label": "b"},
+                        {"phase": PHASE_FULL, "label": "d"}]
+
+    def test_remaining_schedule_shorts_first(self):
+        plan = {"schedule": [{"phase": PHASE_SHORT, "label": l}
+                             for l in "abc"]}
+        trials = [TrialRecord(label="a", phase=PHASE_SHORT, status="ok",
+                              objective=1.0)]
+        owed = remaining_schedule(plan, trials)
+        assert owed == [{"phase": PHASE_SHORT, "label": "b"},
+                        {"phase": PHASE_SHORT, "label": "c"}]
+
+
+class TestRunSearch:
+
+    def test_full_run_pins_winner_from_fulls(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_HBM_BYTES", raising=False)
+        objectives = {}  # default objective: shorter label scores higher
+        ledger = _search(tmp_path, objectives=objectives)
+        plan = ledger.plan
+        assert plan["points"] == 6 and plan["pruned"] == 0
+        shorts = [t for t in ledger.trials if t.phase == PHASE_SHORT]
+        fulls = [t for t in ledger.trials if t.phase == PHASE_FULL]
+        assert len(shorts) == 6
+        assert len(fulls) == 2          # ceil(6/4)
+        assert ledger.best is not None
+        best_full = max(fulls, key=lambda t: t.objective)
+        assert ledger.best["label"] == best_full.label
+        assert ledger.best["runner_up"] is not None
+
+    def test_static_pruning_excludes_infeasible(self, tmp_path, monkeypatch):
+        # activations = 1000 * tokens/128; budget 1300 - resident 1000
+        # leaves the biggest geometries out
+        monkeypatch.setenv("DSTPU_HBM_BYTES", "1300")
+        ledger = _search(tmp_path)
+        plan = ledger.plan
+        assert plan["pruned"] > 0
+        assert plan["points"] == 6
+        assert len(plan["survivors"]) == 6 - plan["pruned"]
+        assert plan["env"] == {"DSTPU_HBM_BYTES": "1300"}
+        labels = {s["candidate"]["label"] for s in plan["survivors"]}
+        assert "batch.seq=16,batch.size=32" not in labels
+
+    def test_search_is_deterministic(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_HBM_BYTES", raising=False)
+        a = _search(tmp_path, name="a", seed=3).doc
+        b = _search(tmp_path, name="b", seed=3).doc
+        assert a["plan"]["schedule"] == b["plan"]["schedule"]
+        assert [t["label"] for t in a["trials"]] == \
+            [t["label"] for t in b["trials"]]
+        assert a["best"]["label"] == b["best"]["label"]
+
+    def test_budget_trials_stops_search(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_HBM_BYTES", raising=False)
+        runner = StubRunner()
+        ledger = run_search(GRID, sweep_fn=fake_sweep, runner=runner,
+                            budget_trials=2,
+                            ledger_path=str(tmp_path / "b.json"))
+        assert len(runner.calls) == 2
+        # budget exhaustion still pins a winner from what was measured
+        assert ledger.best is not None
+
+    def test_failed_trial_is_data_point(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_HBM_BYTES", raising=False)
+        bad = "batch.seq=8,batch.size=8"
+        ledger = _search(tmp_path, fail=(bad,))
+        rec = next(t for t in ledger.trials if t.label == bad)
+        assert rec.status.startswith("error:") and rec.objective == 0.0
+        assert ledger.best is not None and ledger.best["label"] != bad
+
+    def test_resume_refuses_mismatched_plan(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_HBM_BYTES", raising=False)
+        path = str(tmp_path / "r.json")
+        run_search(GRID, sweep_fn=fake_sweep, runner=StubRunner(),
+                   ledger_path=path, budget_trials=1)
+        other = json.loads(json.dumps(GRID))
+        other["axes"]["batch.size"] = [64]
+        with pytest.raises(ValueError, match="refusing to resume"):
+            run_search(other, sweep_fn=fake_sweep, runner=StubRunner(),
+                       ledger_path=path, resume=True)
+
+    def test_resume_replays_identical_remaining_schedule(
+            self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DSTPU_HBM_BYTES", raising=False)
+        path = str(tmp_path / "r.json")
+
+        class DyingRunner(StubRunner):
+            def run_candidate(self, candidate, **kw):
+                if len(self.calls) == 3:
+                    raise RuntimeError("killed")
+                return super().run_candidate(candidate, **kw)
+
+        # killed search: dies after committing 3 of 6 shorts
+        with pytest.raises(RuntimeError, match="killed"):
+            run_search(GRID, sweep_fn=fake_sweep, runner=DyingRunner(),
+                       ledger_path=path, seed=5)
+        partial = TrialLedger.load(path)
+        assert len(partial.trials) == 3
+        expected = remaining_schedule(partial.plan, partial.trials)
+        # an uninterrupted run with the same seed defines the reference
+        ref = run_search(GRID, sweep_fn=fake_sweep, runner=StubRunner(),
+                         ledger_path=str(tmp_path / "ref.json"), seed=5)
+        resumed_runner = StubRunner()
+        ledger = run_search(GRID, sweep_fn=fake_sweep, runner=resumed_runner,
+                            ledger_path=path, seed=5, resume=True)
+        replayed = [(lbl, ph) for lbl, ph in resumed_runner.calls]
+        assert replayed[:len(expected)] == \
+            [(s["label"], s["phase"]) for s in expected]
+        assert [(t.label, t.phase) for t in ledger.trials] == \
+            [(t.label, t.phase) for t in ref.trials]
+        assert ledger.best["label"] == ref.best["label"]
+
+
+class TestScopeGrid:
+
+    def test_scope_freezes_dropped_axes_at_default(self):
+        scoped = scope_grid(GRID, ["batch.size"])
+        assert list(scoped["axes"]) == ["batch.size"]
+        assert scoped["base"]["batch.seq"] == 8
+        assert scoped["monotone"] == ["batch.size"]
+
+    def test_knob_scopes_cover_distinct_namespaces(self):
+        assert set(KNOB_SCOPES) == {"batch", "transport", "numerics"}
+        flat = [a for axes in KNOB_SCOPES.values() for a in axes]
+        assert len(flat) == len(set(flat))

@@ -1,0 +1,120 @@
+"""TrialRunner's scoring/cross-check plumbing over FAKE engines (the
+real-engine path is exercised by `dstpu tune --smoke` in the lint gate
+and by the slow closed-loop test). A fake engine carries a REAL
+Telemetry facade and drives its step hooks, so the scored summary is the
+production one."""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.autotuning.ledger import PHASE_FULL, PHASE_SHORT
+from deepspeed_tpu.autotuning.trial import TrialRunner
+from deepspeed_tpu.telemetry.config import TelemetryConfig
+from deepspeed_tpu.telemetry.telemetry import NullTelemetry, Telemetry
+
+
+class FakeEngine:
+    """Steps are real wall-clock spans through the real telemetry step
+    hooks; ``flops_fn`` mimics the engine's deferred XLA cost-analysis
+    registration."""
+
+    def __init__(self, flops_fn=None):
+        self.telemetry = Telemetry(TelemetryConfig(
+            **{"enabled": True, "watchdog": {"enabled": False}}))
+        if flops_fn is not None:
+            self.telemetry.set_flops_fn(flops_fn)
+        self._step = 0
+
+    def train_batch(self, batch):
+        self._step += 1
+        self.telemetry.step_begin(self._step)
+        self.telemetry.step_end(self._step, tokens=128)
+        return 0.0
+
+
+def _batch_for(engine):
+    import numpy as np
+    return {"input_ids": np.zeros((8, 16), dtype=np.int32)}
+
+
+class TestMeasure:
+
+    def test_short_trial_scores_from_predicted_flops(self):
+        result = TrialRunner().measure(
+            FakeEngine, _batch_for, label="c", phase=PHASE_SHORT,
+            steps=2, predicted_flops=1e6)
+        rec = result.record
+        assert rec.status == "ok" and rec.steps == 2
+        # MFU seeded from the oracle's prediction — no flush, no
+        # cost-analysis pass — so the composite objective is resolvable
+        assert rec.objective > 0
+        assert rec.samples_per_sec > 0
+        assert rec.cross_check is None  # full-phase only
+
+    def test_full_trial_cross_checks_and_calibrates(self, tmp_path):
+        plans_dir = tmp_path / "plans"
+        plans_dir.mkdir()
+        (plans_dir / "engine-train-step.json").write_text(json.dumps(
+            {"entry": "engine-train-step",
+             "predicted_step_flops": 1000}))
+        calib = str(tmp_path / "calibration.json")
+        runner = TrialRunner(plans_dir=str(plans_dir),
+                             calibration_path=calib)
+        result = runner.measure(
+            lambda: FakeEngine(flops_fn=lambda: 1200.0), _batch_for,
+            label="c", phase=PHASE_FULL, steps=3,
+            predicted_cost=50000.0, calibrate=True)
+        rec = result.record
+        assert rec.status == "ok"
+        cross = rec.cross_check
+        assert cross is not None
+        assert cross["predicted_step_flops"] == 1000
+        assert cross["ratio"] == pytest.approx(1.2)
+        assert cross["consistent"] is True
+        # the measured-vs-predicted error landed in the calibration record
+        doc = json.load(open(calib))
+        entry = doc["engine-train-step"]
+        assert entry["samples"] == 1
+        assert entry["seconds_per_cost"] > 0
+        assert entry["flops_ratio"] == pytest.approx(1.2)
+
+    def test_calibration_ewma_converges_over_trials(self, tmp_path):
+        calib = str(tmp_path / "calibration.json")
+        runner = TrialRunner(calibration_path=calib)
+        for _ in range(3):
+            runner.measure(lambda: FakeEngine(flops_fn=lambda: 1000.0),
+                           _batch_for, label="c", phase=PHASE_FULL,
+                           steps=2, predicted_cost=1000.0, calibrate=True)
+        doc = json.load(open(calib))
+        assert doc["engine-train-step"]["samples"] == 3
+
+    def test_null_telemetry_engine_is_an_error_trial(self):
+        class Dark:
+            telemetry = NullTelemetry()
+
+            def train_batch(self, batch):
+                return 0.0
+
+        rec = TrialRunner().measure(Dark, _batch_for, label="c").record
+        assert rec.status.startswith("error:")
+        assert "telemetry" in rec.status and rec.objective == 0.0
+
+    def test_build_failure_is_an_error_trial_not_a_crash(self):
+        def exploding_engine():
+            raise RuntimeError("no such optimizer")
+
+        rec = TrialRunner().measure(exploding_engine, _batch_for,
+                                    label="c").record
+        assert rec.status == "error: RuntimeError: no such optimizer"
+        assert rec.objective == 0.0 and rec.steps == 0
+
+    def test_warmup_steps_are_not_scored(self):
+        engine = FakeEngine()
+        result = TrialRunner().measure(
+            lambda: engine, _batch_for, label="c", phase=PHASE_SHORT,
+            steps=3, warmup=2, predicted_flops=1e6)
+        # 5 train_batch calls happened, exactly 3 were scored
+        assert engine._step == 5
+        assert result.record.steps == 3
+        assert result.summary.get("steps_observed", 3) in (3, 3.0)

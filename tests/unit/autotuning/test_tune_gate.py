@@ -1,0 +1,83 @@
+"""The DSTPU_TUNE engine overlay (deepspeed_tpu.maybe_apply_tuned_config):
+off means OFF — the caller's config object passes through untouched, so
+engine construction is identical to a build that never heard of the
+autotuner."""
+
+import json
+
+import pytest
+
+import deepspeed_tpu
+
+
+@pytest.fixture
+def cfg():
+    return {"train_micro_batch_size_per_gpu": 1,
+            "zero_optimization": {"stage": 1},
+            "optimizer": {"type": "adamw", "params": {"lr": 1e-3}}}
+
+
+class TestGateOff:
+
+    def test_unset_returns_the_same_object(self, cfg, monkeypatch):
+        monkeypatch.delenv("DSTPU_TUNE", raising=False)
+        assert deepspeed_tpu.maybe_apply_tuned_config(cfg) is cfg
+
+    def test_zero_returns_the_same_object(self, cfg, monkeypatch):
+        monkeypatch.setenv("DSTPU_TUNE", "0")
+        out = deepspeed_tpu.maybe_apply_tuned_config(cfg)
+        assert out is cfg
+        assert cfg == {"train_micro_batch_size_per_gpu": 1,
+                       "zero_optimization": {"stage": 1},
+                       "optimizer": {"type": "adamw",
+                                     "params": {"lr": 1e-3}}}
+
+    def test_none_config_passes_through(self, monkeypatch):
+        monkeypatch.delenv("DSTPU_TUNE", raising=False)
+        assert deepspeed_tpu.maybe_apply_tuned_config(None) is None
+
+
+class TestGateOn:
+
+    def test_missing_best_file_degrades_to_untuned(self, cfg, monkeypatch,
+                                                   tmp_path):
+        monkeypatch.setenv("DSTPU_TUNE", str(tmp_path / "nope.json"))
+        assert deepspeed_tpu.maybe_apply_tuned_config(cfg) is cfg
+
+    def test_path_overlays_config_namespace_only(self, cfg, monkeypatch,
+                                                 tmp_path):
+        best = {"label": "w", "objective": 1.0,
+                "overrides": {"config": {"zero_optimization": {"stage": 2}},
+                              "batch": {"size": 64}}}
+        path = tmp_path / "best.json"
+        path.write_text(json.dumps(best))
+        monkeypatch.setenv("DSTPU_TUNE", str(path))
+        out = deepspeed_tpu.maybe_apply_tuned_config(cfg)
+        assert out is not cfg
+        assert out["zero_optimization"]["stage"] == 2
+        # untouched keys survive the deep merge; batch geometry (an
+        # audit-harness namespace) never leaks into a user config
+        assert out["optimizer"]["params"]["lr"] == 1e-3
+        assert "batch" not in out and "size" not in out
+        # and the caller's dict was not mutated
+        assert cfg["zero_optimization"]["stage"] == 1
+
+    def test_ledger_file_form_is_accepted(self, cfg, monkeypatch, tmp_path):
+        doc = {"version": 1, "plan": {}, "trials": [],
+               "best": {"label": "w", "overrides":
+                        {"config": {"gradient_clipping": 0.5}}}}
+        path = tmp_path / "run.json"
+        path.write_text(json.dumps(doc))
+        monkeypatch.setenv("DSTPU_TUNE", str(path))
+        out = deepspeed_tpu.maybe_apply_tuned_config(cfg)
+        assert out["gradient_clipping"] == 0.5
+
+    def test_apply_best_writes_where_the_gate_reads(self, cfg, monkeypatch,
+                                                    tmp_path):
+        from deepspeed_tpu.autotuning.cli import apply_best
+        best = {"label": "w", "objective": 2.0,
+                "overrides": {"config": {"zero_optimization": {"stage": 3}}}}
+        path = apply_best(best, path=str(tmp_path / "best.json"))
+        monkeypatch.setenv("DSTPU_TUNE", path)
+        out = deepspeed_tpu.maybe_apply_tuned_config(cfg)
+        assert out["zero_optimization"]["stage"] == 3

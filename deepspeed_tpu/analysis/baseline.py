@@ -25,18 +25,22 @@ TRACE_PREFIX = "<trace:"
 SPMD_PREFIX = "<spmd:"
 SCHED_PREFIX = "<sched:"
 PLAN_PREFIX = "<plan:"
+HOST_PREFIX = "<host:"
 
-#: the five layers a finding can come from, keyed by its path marker.
+#: the six layers a finding can come from, keyed by its path marker.
 #: Layers don't always run together (the jaxpr audit needs a working JAX,
 #: the SPMD/schedule/feasibility audits additionally compile), so baseline
 #: diffs must only cover the layers that actually ran — otherwise an
 #: AST-only run reports grandfathered jaxpr/spmd/schedule/feasibility
 #: entries as stale, and ``--write-baseline`` silently drops them.
-LAYER_KEYS = ("ast", "jaxpr", "spmd", "schedule", "feasibility")
+LAYER_KEYS = ("ast", "jaxpr", "spmd", "schedule", "feasibility", "hosts")
 
-#: path markers of the entry-point layers (everything except "ast") — the
-#: layers whose baseline entries are keyed by a registered entry-point
-#: name rather than a source file.
+#: path markers of the entry-point layers — the layers whose baseline
+#: entries are keyed by a registered entry-point name rather than a
+#: source file. Layer F ("hosts") is deliberately ABSENT: its ``<host:``
+#: marker wraps a repo-relative file path (or ``virtual:<entry>`` for the
+#: divergence harness), so its baseline entries must never be pruned by
+#: the unknown-entry-point sweep.
 ENTRY_PREFIXES = {"jaxpr": TRACE_PREFIX, "spmd": SPMD_PREFIX,
                   "schedule": SCHED_PREFIX, "feasibility": PLAN_PREFIX}
 
@@ -50,6 +54,8 @@ def finding_layer(f: Finding) -> str:
         return "schedule"
     if f.path.startswith(PLAN_PREFIX):
         return "feasibility"
+    if f.path.startswith(HOST_PREFIX):
+        return "hosts"
     return "ast"
 
 
@@ -85,8 +91,8 @@ def by_layer(findings: List[Finding]) -> Dict[str, List[Finding]]:
 
 
 def split_layers(findings: List[Finding]) -> Tuple[List[Finding], ...]:
-    """-> (ast, jaxpr, spmd, schedule, feasibility) findings, by path
-    marker."""
+    """-> (ast, jaxpr, spmd, schedule, feasibility, hosts) findings, by
+    path marker."""
     layers = by_layer(findings)
     return tuple(layers[k] for k in LAYER_KEYS)
 

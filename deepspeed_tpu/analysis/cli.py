@@ -178,10 +178,15 @@ def build_parser() -> argparse.ArgumentParser:
                              "audits (the `dstpu plan` oracle over the "
                              "HEAD default configs: HBM fit, compile, "
                              "exposure, donation)")
+    parser.add_argument("--hosts", action="store_true",
+                        help="also run the Layer-F cross-host divergence "
+                             "and host-seam concurrency audits (static "
+                             "thread/lock graph + rank-conditional "
+                             "collective scan; pure AST, no jax)")
     parser.add_argument("--all", action="store_true", dest="all_layers",
-                        help="run every layer (A-E: AST + --jaxpr + --spmd "
-                             "+ --schedule + --feasibility) off one shared "
-                             "compile per entry")
+                        help="run every layer (A-F: AST + --jaxpr + --spmd "
+                             "+ --schedule + --feasibility + --hosts) off "
+                             "one shared compile per entry")
     parser.add_argument("--maps-dir", default=None,
                         help="directory for the per-entry collective maps "
                              "a --schedule run emits (default: "
@@ -255,6 +260,7 @@ def _main(args) -> int:
         from . import spmd_audit  # noqa: F401 — registers Layer-C rules
         from . import schedule_audit  # noqa: F401 — registers Layer-D rules
         from . import feasibility  # noqa: F401 — registers Layer-E rules
+        from . import host_audit  # noqa: F401 — registers Layer-F rules
         for rule in all_rules():
             print(f"{rule.rule_id:26} [{rule.layer}/{rule.severity}] "
                   f"{rule.description}")
@@ -271,6 +277,7 @@ def _main(args) -> int:
         args.spmd = True
         args.schedule = True
         args.feasibility = True
+        args.hosts = True
     run_spmd = args.spmd or args.update_budgets
     run_sched = args.schedule
     run_feas = args.feasibility
@@ -310,6 +317,9 @@ def _main(args) -> int:
                     return 2
 
     findings = run_ast_layer(paths)
+    if args.hosts:
+        from .host_audit import run_host_layer
+        findings += run_host_layer(paths if args.paths else None)
     spmd_reports = {}
     sched_reports = {}
     feas_verdicts = {}
@@ -403,7 +413,8 @@ def _main(args) -> int:
     ran_layers = {"ast"} | ({"jaxpr"} if args.jaxpr else set()) \
         | ({"spmd"} if run_spmd else set()) \
         | ({"schedule"} if run_sched else set()) \
-        | ({"feasibility"} if run_feas else set())
+        | ({"feasibility"} if run_feas else set()) \
+        | ({"hosts"} if args.hosts else set())
     baseline_path = args.baseline or default_baseline_path()
     if args.write_baseline:
         # A partial run must not erase grandfathered entries for the

@@ -1,0 +1,1166 @@
+"""Layer F: cross-host divergence & host-seam concurrency audit.
+
+The two classic multi-host killers have no runtime signal on a one-host
+dev box: (1) a collective launched under a condition derived from *host
+identity* (rank, process index, hostname, env) deadlocks the fleet the
+first time a second host exists — every host must issue the identical
+collective sequence; (2) the repo's six-plus worker threads (async
+checkpoint, NVMe queues, swapper groups, watchdog, tune controller)
+cross the host seam through shared state and locks, and an inversion or
+an unguarded publish only manifests under real multi-host timing. Layer
+F makes both static, in the Layer-A mold (pure AST, no jax import, runs
+in milliseconds under the tier-1 gate):
+
+**Cross-host divergence pass** (over ``comm/``, ``runtime/zero/``,
+``moe/``, ``sequence/``, ``runtime/pipe/``, ``checkpoint/``):
+
+- ``rank-divergent-collective`` — a collective launch (``dist.*``,
+  ``jax.lax`` collectives, ``ppermute``, ``barrier``) reachable only
+  under a rank/host-identity-derived condition, including the
+  early-return form (``if rank != 0: return`` … collective). The
+  :data:`SANCTIONED_RANK0` registry names the audited legitimate sites;
+  a registry entry that no longer matches anything is itself reported
+  (stale sanctions must not accumulate).
+- ``unordered-collective-iteration`` — collective launches or
+  bucket/plan construction driven by iteration over a ``set`` (or other
+  unordered producer like ``os.listdir``): Python set order is
+  hash-seed-dependent, so two hosts silently build different launch
+  orders.
+
+**Host-seam concurrency pass** (over the whole package): builds the
+static thread/lock graph — which functions run on worker threads
+(``Thread(target=...)``/``executor.submit`` closure, per module), which
+locks exist (creation sites), which lock acquisitions nest (directly or
+through same-module calls made while holding a lock):
+
+- ``lock-order-inversion`` — a cycle in the acquisition-order graph.
+- ``unguarded-shared-mutation`` — generalizes Layer A's
+  ``unguarded-worker-state``: ANY function (not just the thread target)
+  assigning, outside a lock, shared state that a worker-reachable
+  function reads.
+- ``blocking-under-lock`` — a blocking call (``Future.result``,
+  ``device_get``/``block_until_ready``, aio/Event ``wait``, ``join``,
+  ``sleep``, or any collective) while holding a lock: the lock's
+  critical section inherits the block, and a collective under a lock is
+  cross-host deadlock bait.
+
+The static half is validated dynamically by two harnesses: the
+**virtual multi-host divergence harness** (:func:`virtual_host_ledgers`
+/ :func:`diff_host_ledgers`) re-traces registered entry specs once per
+virtual host with patched rank identity and diffs the per-host
+``CollectiveLedger`` sequences, and **lockdep-lite**
+(``analysis/lockdep.py``) records real acquisition order under the
+chaos/durability suites and cross-checks it against the static graph
+(:func:`crosscheck_observed`).
+
+Findings carry the ``<host:`` path marker (``<host:<repo-relative
+file>>`` for static findings, ``<host:virtual:<entry>>`` for harness
+findings) so the baseline machinery treats Layer F as its own layer.
+Per-line suppression is the shared ``# dstpu: ignore[rule-id]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import contextlib
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .findings import (Finding, SEVERITY_ERROR, SEVERITY_WARNING, dedupe,
+                       sort_findings)
+from .registry import LAYER_HOSTS, Rule, register
+from .ast_rules import (ModuleContext, _callee, _is_lock_guard,
+                        _last_segment, dotted_name)
+
+HOST_PREFIX = "<host:"
+
+#: packages the divergence pass walks — the collective-launching surface
+#: a second host must replay identically (ISSUE: comm, zero, moe,
+#: sequence, pipe, checkpoint). The concurrency pass runs repo-wide.
+DIVERGENCE_DIRS = (
+    "deepspeed_tpu/comm",
+    "deepspeed_tpu/runtime/zero",
+    "deepspeed_tpu/moe",
+    "deepspeed_tpu/sequence",
+    "deepspeed_tpu/runtime/pipe",
+    "deepspeed_tpu/checkpoint",
+)
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+RANK_DIVERGENT = register(Rule(
+    rule_id="rank-divergent-collective", layer=LAYER_HOSTS,
+    severity=SEVERITY_ERROR,
+    description="Collective launch reachable only under a rank/host-"
+                "identity condition (get_rank/process_index/hostname/env) "
+                "— the other hosts block forever on the launch this host "
+                "skipped",
+    fix_hint="launch the collective unconditionally on every rank and "
+             "guard only the host-side I/O; if the site is genuinely "
+             "uniform-by-construction, add it to "
+             "analysis/host_audit.py SANCTIONED_RANK0 with a reason",
+))
+
+UNORDERED_ITER = register(Rule(
+    rule_id="unordered-collective-iteration", layer=LAYER_HOSTS,
+    severity=SEVERITY_ERROR,
+    description="Collective launches or bucket/plan construction driven "
+                "by iteration over a set/unordered producer — hash-seed-"
+                "dependent order silently desyncs the cross-host launch "
+                "sequence",
+    fix_hint="iterate sorted(...) (or an explicitly ordered list) so "
+             "every host builds the identical sequence",
+))
+
+LOCK_INVERSION = register(Rule(
+    rule_id="lock-order-inversion", layer=LAYER_HOSTS,
+    severity=SEVERITY_ERROR,
+    description="Cycle in the static lock acquisition graph (lock B "
+                "taken while holding A on one path, A while holding B on "
+                "another) — a classic cross-thread deadlock",
+    fix_hint="impose one global acquisition order (document it on the "
+             "lock attributes) or collapse the critical sections onto a "
+             "single lock",
+))
+
+UNGUARDED_SHARED = register(Rule(
+    rule_id="unguarded-shared-mutation", layer=LAYER_HOSTS,
+    severity=SEVERITY_WARNING,
+    description="Assignment, outside a lock, to shared state that a "
+                "worker thread reads (generalizes unguarded-worker-state "
+                "beyond the thread target itself to every cross-thread "
+                "writer)",
+    fix_hint="hold the owning lock around the assignment, or publish "
+             "through a queue/Future handoff the worker consumes",
+))
+
+BLOCKING_UNDER_LOCK = register(Rule(
+    rule_id="blocking-under-lock", layer=LAYER_HOSTS,
+    severity=SEVERITY_WARNING,
+    description="Blocking call (Future.result/device_get/"
+                "block_until_ready/wait/join/sleep or a collective) while "
+                "holding a lock — every thread contending the lock "
+                "inherits the stall, and a collective under a lock can "
+                "deadlock across hosts",
+    fix_hint="snapshot the shared state under the lock, release it, then "
+             "block; never launch collectives or device syncs inside a "
+             "critical section",
+))
+
+
+# ---------------------------------------------------------------------------
+# sanctioned-rank-0 registry
+# ---------------------------------------------------------------------------
+#: (path suffix, enclosing function, collective last-segment) -> reason.
+#: The audited legitimate rank-conditional collective sites: places where
+#: every rank reaches the launch by construction and only the host-side
+#: work is rank-gated, but the guard structure makes that invisible to
+#: the AST pass. Entries are load-bearing: one that stops matching any
+#: finding is reported stale (the shrink-only discipline of the lint
+#: baselines, applied to sanctions). Workflow: docs/STATIC_ANALYSIS.md.
+SANCTIONED_RANK0: Dict[Tuple[str, str, str], str] = {
+}
+
+
+def _sanction_key(path: str, fn_name: str, collective: str
+                  ) -> Optional[Tuple[str, str, str]]:
+    norm = path.replace("\\", "/")
+    for (suffix, fn, coll), _reason in SANCTIONED_RANK0.items():
+        if norm.endswith(suffix) and fn == fn_name and coll == collective:
+            return (suffix, fn, coll)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# shared collective-launch detection
+# ---------------------------------------------------------------------------
+#: call last-segments that are unambiguously collective launches
+_COLLECTIVE_LAUNCH_SEGS = {
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "all_to_all_single", "ppermute", "pshuffle", "psum", "psum_scatter",
+    "pmean", "pmax", "pmin", "broadcast", "barrier", "monitored_barrier",
+    "inference_all_reduce", "sync_global_devices",
+}
+#: ambiguous last-segments (functools.reduce, list gather helpers...)
+#: that only count as collectives with a comm-namespace prefix
+_COLLECTIVE_AMBIGUOUS_SEGS = {"reduce", "gather", "scatter", "send", "recv"}
+_COMM_NS_RE = re.compile(r"(^|\.)(dist|comm|_comm|lax|jax\.lax)\.")
+
+
+def _is_collective_launch(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    seg = _last_segment(name)
+    if seg in _COLLECTIVE_LAUNCH_SEGS:
+        return True
+    return seg in _COLLECTIVE_AMBIGUOUS_SEGS and bool(
+        _COMM_NS_RE.search(name + "."))
+
+
+def _collective_calls(node: ast.AST) -> Iterable[ast.Call]:
+    """Collective launches anywhere under ``node`` (nested defs skipped —
+    they get their own scan)."""
+    for child in ast.walk(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)) and child is not node:
+            continue
+        if isinstance(child, ast.Call) and \
+                _is_collective_launch(_callee(child)):
+            yield child
+
+
+# ---------------------------------------------------------------------------
+# rank/host-identity taint
+# ---------------------------------------------------------------------------
+_IDENTITY_CALL_SEGS = {"get_rank", "process_index", "get_local_rank",
+                       "gethostname", "getfqdn"}
+_IDENTITY_CALL_DOTTED = {"platform.node", "os.uname", "socket.gethostname",
+                         "socket.getfqdn"}
+#: attribute names that carry host identity wherever they live
+_IDENTITY_ATTR_RE = re.compile(
+    r"^(rank|global_rank|local_rank|process_index|node_rank|host|hostname)$")
+#: env keys that are per-host by convention; uniform config env vars
+#: (feature flags) deliberately do NOT taint
+_IDENTITY_ENV_RE = re.compile(r"(RANK|HOST|NODE|SLURM|COORD|MASTER)", re.I)
+
+
+def _env_key_is_identity(call: ast.Call) -> bool:
+    for arg in list(call.args) + [k.value for k in call.keywords]:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return bool(_IDENTITY_ENV_RE.search(arg.value))
+    return True  # dynamic key: assume identity
+
+
+def _call_is_identity(call: ast.Call) -> bool:
+    name = _callee(call)
+    if not name:
+        return False
+    seg = _last_segment(name)
+    if seg in _IDENTITY_CALL_SEGS:
+        return True
+    if any(name == d or name.endswith("." + d)
+           for d in _IDENTITY_CALL_DOTTED):
+        return True
+    if seg == "getenv" or (seg == "get" and name.endswith("environ.get")):
+        return _env_key_is_identity(call)
+    return False
+
+
+def _expr_tainted(expr: ast.AST, tainted_names: Set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) and _call_is_identity(node):
+            return True
+        if isinstance(node, ast.Name) and node.id in tainted_names:
+            return True
+        if isinstance(node, ast.Attribute) and \
+                _IDENTITY_ATTR_RE.match(node.attr):
+            return True
+        if isinstance(node, ast.Subscript):
+            base = dotted_name(node.value)
+            if base and base.endswith("environ") and isinstance(
+                    node.slice, ast.Constant) and isinstance(
+                    node.slice.value, str):
+                if _IDENTITY_ENV_RE.search(node.slice.value):
+                    return True
+    return False
+
+
+def _tainted_names(fn: ast.AST) -> Set[str]:
+    """Local names carrying rank/host identity — fixpoint over simple
+    assignments (``rank = dist.get_rank()``, ``is_zero = rank == 0``).
+    Parameters NAMED like identity (``def save(rank):``) are seeded too:
+    in the audited dirs a ``rank`` argument is always the caller's
+    ``get_rank()`` threaded through."""
+    tainted: Set[str] = set()
+    fn_args = getattr(fn, "args", None)
+    if fn_args is not None:
+        for a in (list(fn_args.posonlyargs) + list(fn_args.args)
+                  + list(fn_args.kwonlyargs)):
+            if _IDENTITY_ATTR_RE.match(a.arg):
+                tainted.add(a.arg)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            targets: List[ast.AST] = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)) \
+                    and getattr(node, "value", None) is not None:
+                targets, value = [node.target], node.value
+            elif isinstance(node, ast.NamedExpr):
+                targets, value = [node.target], node.value
+            if value is None or not _expr_tainted(value, tainted):
+                continue
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    if isinstance(e, ast.Name) and e.id not in tainted:
+                        tainted.add(e.id)
+                        changed = True
+    return tainted
+
+
+# ---------------------------------------------------------------------------
+# rank-divergent-collective
+# ---------------------------------------------------------------------------
+def _body_terminates(body: Sequence[ast.stmt]) -> bool:
+    """True when control cannot fall out of ``body``'s end (the
+    ``if rank != 0: return`` early-exit shape)."""
+    if not body:
+        return False
+    last = body[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+        return True
+    if isinstance(last, ast.If):
+        return _body_terminates(last.body) and _body_terminates(last.orelse)
+    return False
+
+
+def _check_rank_divergence(ctx: ModuleContext) -> Iterable[Finding]:
+    matched_sanctions: Set[Tuple[str, str, str]] = set()
+
+    def scan_fn(fn):
+        tainted = _tainted_names(fn)
+
+        def emit(call: ast.Call, guard_line: int):
+            coll = _last_segment(_callee(call)) or "?"
+            key = _sanction_key(ctx.path, fn.name, coll)
+            if key is not None:
+                matched_sanctions.add(key)
+                return
+            yield Finding(
+                rule_id=RANK_DIVERGENT.rule_id, path=ctx.path,
+                line=call.lineno, severity=RANK_DIVERGENT.severity,
+                message=f"{coll}() in {fn.name}() is reachable only under "
+                        f"the rank/host-identity condition at line "
+                        f"{guard_line} — other hosts never launch it",
+                fix_hint=RANK_DIVERGENT.fix_hint)
+
+        def walk(body: Sequence[ast.stmt], guard_line: Optional[int]):
+            g = guard_line
+            for stmt in body:
+                if isinstance(stmt, ast.If):
+                    test_tainted = _expr_tainted(stmt.test, tainted)
+                    inner = stmt.lineno if test_tainted else g
+                    yield from walk(stmt.body, inner)
+                    yield from walk(stmt.orelse, inner)
+                    if test_tainted and (_body_terminates(stmt.body)
+                                         or _body_terminates(stmt.orelse)):
+                        # one side returns/raises: the fallthrough only
+                        # runs on the ranks the test let through
+                        g = g if g is not None else stmt.lineno
+                    continue
+                if isinstance(stmt, ast.While):
+                    inner = stmt.lineno \
+                        if _expr_tainted(stmt.test, tainted) else g
+                    yield from walk(stmt.body, inner)
+                    yield from walk(stmt.orelse, g)
+                    continue
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    yield from walk(stmt.body, g)
+                    yield from walk(stmt.orelse, g)
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    yield from walk(stmt.body, g)
+                    continue
+                if isinstance(stmt, ast.Try):
+                    yield from walk(stmt.body, g)
+                    for h in stmt.handlers:
+                        yield from walk(h.body, g)
+                    yield from walk(stmt.orelse, g)
+                    yield from walk(stmt.finalbody, g)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # nested defs scanned on their own
+                if g is not None:
+                    for call in _collective_calls(stmt):
+                        yield from emit(call, g)
+                # conditional expressions on identity inside a plain
+                # statement: `dist.barrier() if rank == 0 else None`
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.IfExp) and \
+                            _expr_tainted(node.test, tainted):
+                        for sub in (node.body, node.orelse):
+                            for call in _collective_calls(sub):
+                                yield from emit(call, node.lineno)
+
+        yield from walk(fn.body, None)
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield from scan_fn(node)
+    for key in SANCTIONED_RANK0:
+        suffix, fn, coll = key
+        if ctx.path.replace("\\", "/").endswith(suffix) \
+                and key not in matched_sanctions:
+            yield Finding(
+                rule_id=RANK_DIVERGENT.rule_id, path=ctx.path, line=0,
+                severity=SEVERITY_WARNING,
+                message=f"stale SANCTIONED_RANK0 entry ({suffix!r}, "
+                        f"{fn!r}, {coll!r}) matches no finding — remove "
+                        "it from analysis/host_audit.py",
+                fix_hint="sanctions shrink like baselines: delete entries "
+                         "whose site was fixed or deleted")
+
+
+# ---------------------------------------------------------------------------
+# unordered-collective-iteration
+# ---------------------------------------------------------------------------
+_UNORDERED_PRODUCER_SEGS = {"set", "frozenset", "listdir", "scandir",
+                            "glob", "iglob", "keys", "difference", "union",
+                            "intersection", "symmetric_difference"}
+_ORDERED_WRAPPER_SEGS = {"sorted", "list", "tuple", "enumerate"}
+_PLAN_NAME_RE = re.compile(r"(bucket|plan|schedule|order)", re.I)
+
+
+def _iterable_is_unordered(node: ast.AST, set_names: Set[str]) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Name):
+        return node.id in set_names
+    if isinstance(node, ast.Call):
+        seg = _last_segment(_callee(node))
+        if seg in _ORDERED_WRAPPER_SEGS and seg != "list":
+            return False
+        if seg == "list" and node.args:
+            return _iterable_is_unordered(node.args[0], set_names)
+        if seg == "keys":
+            # dicts are insertion-ordered; flag only set-typed receivers
+            recv = node.func.value if isinstance(node.func, ast.Attribute) \
+                else None
+            return isinstance(recv, ast.Name) and recv.id in set_names
+        if seg in _UNORDERED_PRODUCER_SEGS:
+            return True
+        return False
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub)):
+        # set algebra: a | b, a & b, a - b on set-typed names
+        return _iterable_is_unordered(node.left, set_names) \
+            or _iterable_is_unordered(node.right, set_names)
+    return False
+
+
+def _set_typed_names(fn: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if isinstance(node.value, (ast.Set, ast.SetComp)) or (
+                    isinstance(node.value, ast.Call)
+                    and _last_segment(_callee(node.value))
+                    in ("set", "frozenset")):
+                names.add(node.targets[0].id)
+    return names
+
+
+def _builds_plan(body: Sequence[ast.stmt]) -> Optional[ast.AST]:
+    """A bucket/plan-construction statement inside a loop body: an
+    append/extend/add on (or a subscript-store into) a *_bucket/*_plan/
+    *_order/*_schedule name."""
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and node.func.attr in (
+                    "append", "extend", "add", "setdefault"):
+                target = dotted_name(node.func.value)
+                if target and _PLAN_NAME_RE.search(target):
+                    return node
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        base = dotted_name(t.value)
+                        if base and _PLAN_NAME_RE.search(base):
+                            return node
+    return None
+
+
+def _check_unordered_iteration(ctx: ModuleContext) -> Iterable[Finding]:
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        set_names = _set_typed_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.For, ast.AsyncFor)):
+                continue
+            if not _iterable_is_unordered(node.iter, set_names):
+                continue
+            colls = list(_collective_calls(node))
+            plan = _builds_plan(node.body)
+            if colls:
+                coll = _last_segment(_callee(colls[0])) or "?"
+                yield Finding(
+                    rule_id=UNORDERED_ITER.rule_id, path=ctx.path,
+                    line=node.lineno, severity=UNORDERED_ITER.severity,
+                    message=f"{coll}() launched from a loop over an "
+                            f"unordered iterable in {fn.name}() — launch "
+                            "order differs per host",
+                    fix_hint=UNORDERED_ITER.fix_hint)
+            elif plan is not None:
+                yield Finding(
+                    rule_id=UNORDERED_ITER.rule_id, path=ctx.path,
+                    line=node.lineno, severity=UNORDERED_ITER.severity,
+                    message=f"bucket/plan construction in {fn.name}() "
+                            "iterates an unordered iterable — the derived "
+                            "collective order differs per host",
+                    fix_hint=UNORDERED_ITER.fix_hint)
+
+
+# ---------------------------------------------------------------------------
+# static thread/lock graph
+# ---------------------------------------------------------------------------
+_LOCK_CTOR_SEGS = {"Lock", "RLock", "Semaphore", "BoundedSemaphore",
+                   "Condition"}
+_LOCKISH_ATTR_RE = re.compile(
+    r"(lock|mutex|cond|cv|sem|queue|event|stop)", re.I)
+_BLOCKING_SEGS = {"result", "wait", "join", "sleep", "device_get",
+                  "block_until_ready", "effects_barrier"}
+
+
+class HostGraph:
+    """The static thread/lock picture of the repo, accumulated over every
+    audited module — the artifact ``tools/thread_report.py`` renders and
+    lockdep-lite cross-checks.
+
+    - ``lock_sites``: lock key -> [(path, line)] creation sites
+      (``self._lock = threading.Lock()`` under class C -> ``C._lock``)
+    - ``edges``: (held key, acquired key) -> (path, line) first witness
+    - ``workers``: (path, worker fn) -> sorted attrs the worker reads
+    - ``threads``: [(path, line, target name)] spawn sites
+    """
+
+    def __init__(self):
+        self.lock_sites: Dict[str, List[Tuple[str, int]]] = {}
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.workers: Dict[Tuple[str, str], List[str]] = {}
+        self.threads: List[Tuple[str, int, str]] = []
+
+    def add_lock_site(self, key: str, path: str, line: int) -> None:
+        self.lock_sites.setdefault(key, []).append((path, line))
+
+    def add_edge(self, held: str, acquired: str, path: str, line: int
+                 ) -> None:
+        if held != acquired:
+            self.edges.setdefault((held, acquired), (path, line))
+
+    def key_for_site(self, path: str, line: int) -> Optional[str]:
+        norm = path.replace("\\", "/")
+        for key, sites in self.lock_sites.items():
+            for p, ln in sites:
+                if ln == line and (norm.endswith(p.replace("\\", "/"))
+                                   or p.replace("\\", "/").endswith(norm)):
+                    return key
+        return None
+
+    def cycles(self) -> List[List[str]]:
+        """Elementary cycles in the acquisition graph (DFS, deduped by
+        node set — the graph is tiny)."""
+        adj: Dict[str, List[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+        seen_sets: Set[frozenset] = set()
+        out: List[List[str]] = []
+
+        def dfs(node: str, stack: List[str], on_stack: Set[str]):
+            for nxt in adj.get(node, []):
+                if nxt in on_stack:
+                    cyc = stack[stack.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        out.append(cyc)
+                    continue
+                stack.append(nxt)
+                on_stack.add(nxt)
+                dfs(nxt, stack, on_stack)
+                on_stack.discard(nxt)
+                stack.pop()
+
+        for start in sorted(adj):
+            dfs(start, [start], {start})
+        return out
+
+
+def _tree_memo(tree: ast.AST, key: str, build):
+    """Per-tree cache for derived structures (class map, function defs,
+    worker targets). The five rules and the graph builder each re-derive
+    the same structures per module; caching on the tree node itself keeps
+    the lifetime tied to the tree (no id-reuse hazard, no global growth)."""
+    cache = getattr(tree, "_host_memo", None)
+    if cache is None:
+        cache = {}
+        try:
+            tree._host_memo = cache  # type: ignore[attr-defined]
+        except AttributeError:
+            return build()
+    if key not in cache:
+        cache[key] = build()
+    return cache[key]
+
+
+def _enclosing_class_map(tree: ast.AST) -> Dict[int, str]:
+    """id(function node) -> enclosing class name."""
+    return _tree_memo(tree, "cls_of", lambda: _enclosing_class_map_u(tree))
+
+
+def _enclosing_class_map_u(tree: ast.AST) -> Dict[int, str]:
+    out: Dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for child in ast.walk(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    out.setdefault(id(child), node.name)
+    return out
+
+
+def _lock_key(expr: ast.AST, cls: Optional[str], mod: str) -> Optional[str]:
+    """Normalized graph key for a lock expression: ``self._lock`` under
+    class C -> ``C._lock``; module global ``_LOCK`` -> ``mod._LOCK``."""
+    if isinstance(expr, ast.Call):
+        expr = expr.func
+    name = dotted_name(expr)
+    if not name:
+        return None
+    parts = name.split(".")
+    if parts[0] in ("self", "cls") and len(parts) >= 2:
+        owner = cls or mod
+        return f"{owner}.{'.'.join(parts[1:])}"
+    if len(parts) == 1:
+        return f"{mod}.{parts[0]}"
+    return name
+
+
+def _module_basename(path: str) -> str:
+    return os.path.splitext(os.path.basename(path))[0]
+
+
+def _function_defs(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    return _tree_memo(tree, "defs", lambda: _function_defs_u(tree))
+
+
+def _function_defs_u(tree: ast.AST) -> Dict[str, List[ast.AST]]:
+    out: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.setdefault(node.name, []).append(node)
+    return out
+
+
+def _called_names(stmt: ast.AST) -> Iterable[Tuple[ast.Call, str]]:
+    for node in ast.walk(stmt):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            seg = _last_segment(_callee(node))
+            if seg:
+                yield node, seg
+
+
+def _direct_worker_targets(tree: ast.AST) -> Dict[str, Tuple[int, str]]:
+    """worker fn name -> (spawn line, spawn kind) for Thread(target=...)
+    and executor.submit(fn)/apply_async(fn) sites."""
+    return _tree_memo(tree, "workers", lambda: _direct_worker_targets_u(tree))
+
+
+def _direct_worker_targets_u(tree: ast.AST) -> Dict[str, Tuple[int, str]]:
+    out: Dict[str, Tuple[int, str]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = _last_segment(_callee(node))
+        if seg == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = _last_segment(dotted_name(kw.value))
+                    if target:
+                        out.setdefault(target, (node.lineno, "Thread"))
+        elif seg in ("submit", "apply_async") and node.args:
+            target = _last_segment(dotted_name(node.args[0]))
+            if target:
+                out.setdefault(target, (node.lineno, seg))
+    return out
+
+
+def _worker_closure(tree: ast.AST,
+                    roots: Optional[Set[str]] = None) -> Set[str]:
+    """Worker-reachable function names: direct Thread/submit targets (or
+    the given ``roots``) plus every same-module function they
+    (transitively) call by name."""
+    if roots is None:
+        return _tree_memo(tree, "closure",
+                          lambda: _worker_closure_u(tree, None))
+    return _worker_closure_u(tree, roots)
+
+
+def _worker_closure_u(tree: ast.AST,
+                      roots: Optional[Set[str]] = None) -> Set[str]:
+    defs = _function_defs(tree)
+    reachable = set(_direct_worker_targets(tree)) \
+        if roots is None else set(roots)
+    frontier = [n for n in reachable if n in defs]
+    while frontier:
+        name = frontier.pop()
+        for fn in defs.get(name, []):
+            for _call, seg in _called_names(fn):
+                if seg in defs and seg not in reachable:
+                    reachable.add(seg)
+                    frontier.append(seg)
+    return reachable
+
+
+def _attr_reads(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+            base = node.value
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                out.add(node.attr)
+    return out
+
+
+def _build_module_graph(ctx: ModuleContext, graph: HostGraph) -> None:
+    mod = _module_basename(ctx.path)
+    cls_of = _enclosing_class_map(ctx.tree)
+    defs = _function_defs(ctx.tree)
+
+    # lock creation sites: self.X = threading.Lock() / _LOCK = Lock()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        if _last_segment(_callee(node.value)) not in _LOCK_CTOR_SEGS:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id in ("self", "cls"):
+                cls = _class_of_line(ctx.tree, node.lineno)
+                key = f"{cls or mod}.{t.attr}"
+                graph.add_lock_site(key, ctx.path, node.lineno)
+            elif isinstance(t, ast.Name):
+                graph.add_lock_site(f"{mod}.{t.id}", ctx.path, node.lineno)
+
+    # thread spawn sites
+    for name, (line, kind) in _direct_worker_targets(ctx.tree).items():
+        graph.threads.append((ctx.path, line, name))
+
+    # worker read-sets
+    for name in _worker_closure(ctx.tree):
+        for fn in defs.get(name, []):
+            reads = _attr_reads(fn)
+            if reads:
+                key = (ctx.path, name)
+                merged = set(graph.workers.get(key, [])) | reads
+                graph.workers[key] = sorted(merged)
+
+    # per-function: locks acquired directly (with-blocks)
+    def direct_locks(fn) -> Set[str]:
+        cls = cls_of.get(id(fn))
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if _is_lock_guard(item):
+                        key = _lock_key(item.context_expr, cls, mod)
+                        if key:
+                            out.add(key)
+        return out
+
+    fn_locks: Dict[str, Set[str]] = {}
+    for name, fns in defs.items():
+        s: Set[str] = set()
+        for fn in fns:
+            s |= direct_locks(fn)
+        fn_locks[name] = s
+
+    # transitive: locks reachable through same-module calls
+    closure: Dict[str, Set[str]] = {n: set(s) for n, s in fn_locks.items()}
+    changed = True
+    while changed:
+        changed = False
+        for name, fns in defs.items():
+            for fn in fns:
+                for _call, seg in _called_names(fn):
+                    if seg in closure and not (closure[seg]
+                                               <= closure[name]):
+                        closure[name] |= closure[seg]
+                        changed = True
+
+    # acquisition edges: nested withs + calls made while holding a lock
+    def walk_held(fn, cls):
+        def rec(body, held: List[str]):
+            for stmt in body:
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    keys = [k for k in
+                            (_lock_key(i.context_expr, cls, mod)
+                             for i in stmt.items if _is_lock_guard(i))
+                            if k]
+                    for k in keys:
+                        if held:
+                            graph.add_edge(held[-1], k, ctx.path,
+                                           stmt.lineno)
+                    rec(stmt.body, held + keys)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    continue
+                if held:
+                    for call, seg in _called_names(stmt):
+                        for k in closure.get(seg, ()):
+                            graph.add_edge(held[-1], k, ctx.path,
+                                           call.lineno)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        rec(sub, held)
+                for h in getattr(stmt, "handlers", []):
+                    rec(h.body, held)
+
+        rec(fn.body, [])
+
+    for name, fns in defs.items():
+        for fn in fns:
+            walk_held(fn, cls_of.get(id(fn)))
+
+
+def _class_of_line(tree: ast.AST, line: int) -> Optional[str]:
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.lineno <= line <= (
+                getattr(node, "end_lineno", None) or node.lineno):
+            if best is None or node.lineno > best[0]:
+                best = (node.lineno, node.name)
+    return best[1] if best else None
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion (global, over the accumulated graph)
+# ---------------------------------------------------------------------------
+def _inversion_findings(graph: HostGraph) -> Iterable[Finding]:
+    for cyc in graph.cycles():
+        edge = (cyc[0], cyc[1])
+        path, line = graph.edges.get(edge, ("", 0))
+        yield Finding(
+            rule_id=LOCK_INVERSION.rule_id, path=path or cyc[0],
+            line=line, severity=LOCK_INVERSION.severity,
+            message="lock acquisition cycle: " + " -> ".join(cyc),
+            fix_hint=LOCK_INVERSION.fix_hint)
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+def _check_unguarded_shared(ctx: ModuleContext) -> Iterable[Finding]:
+    all_targets = _direct_worker_targets(ctx.tree)
+    # Long-running Thread targets only: executor.submit tasks get a
+    # happens-before edge at submission (the queue handoff publishes every
+    # prior write) and their internals are Layer A's unguarded-worker-
+    # state. A `# dstpu: ignore[unguarded-shared-mutation]` on the spawn
+    # line sanctions a whole worker whose exclusion is protocol-level
+    # (e.g. the escalation saver, which runs only once the main thread is
+    # declared wedged).
+    direct = {n for n, (line, kind) in all_targets.items()
+              if kind == "Thread"
+              and not ctx.suppressed(line, UNGUARDED_SHARED.rule_id)}
+    reachable = _worker_closure(ctx.tree, roots=direct)
+    if not reachable:
+        return
+    defs = _function_defs(ctx.tree)
+    worker_reads: Set[str] = set()
+    for name in reachable:
+        for fn in defs.get(name, []):
+            worker_reads |= _attr_reads(fn)
+    worker_reads = {a for a in worker_reads
+                    if not _LOCKISH_ATTR_RE.search(a)}
+    if not worker_reads:
+        return
+
+    for name, fns in defs.items():
+        if name in direct or name.startswith("__"):
+            # direct targets are Layer A's unguarded-worker-state;
+            # dunders (init/enter) run before the thread exists
+            continue
+        for fn in fns:
+            def rec(body, guarded):
+                for stmt in body:
+                    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                        rec(stmt.body, guarded or any(
+                            _is_lock_guard(i) for i in stmt.items))
+                        continue
+                    if isinstance(stmt, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef, ast.Lambda)):
+                        continue
+                    if not guarded:
+                        attr = _self_assign_attr(stmt)
+                        if attr and attr in worker_reads:
+                            findings.append(Finding(
+                                rule_id=UNGUARDED_SHARED.rule_id,
+                                path=ctx.path, line=stmt.lineno,
+                                severity=UNGUARDED_SHARED.severity,
+                                message=f"{name}() assigns shared "
+                                        f"attribute {attr!r} outside a "
+                                        "lock while a worker thread reads "
+                                        "it",
+                                fix_hint=UNGUARDED_SHARED.fix_hint))
+                    for a in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, a, None)
+                        if sub:
+                            rec(sub, guarded)
+                    for h in getattr(stmt, "handlers", []):
+                        rec(h.body, guarded)
+
+            findings: List[Finding] = []
+            rec(fn.body, False)
+            yield from findings
+
+
+def _self_assign_attr(stmt: ast.AST) -> Optional[str]:
+    targets: List[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    else:
+        return None
+    for t in targets:
+        elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for e in elts:
+            while isinstance(e, (ast.Subscript, ast.Starred)):
+                e = e.value
+            if isinstance(e, ast.Attribute) and isinstance(
+                    e.value, ast.Name) and e.value.id in ("self", "cls"):
+                return e.attr
+    return None
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+def _check_blocking_under_lock(ctx: ModuleContext) -> Iterable[Finding]:
+    mod = _module_basename(ctx.path)
+    cls_of = _enclosing_class_map(ctx.tree)
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        cls = cls_of.get(id(fn))
+
+        def scan_calls(node, held: List[str]):
+            for call, seg in _called_names(node):
+                name = _callee(call) or seg
+                blocking = seg in _BLOCKING_SEGS \
+                    or _is_collective_launch(name)
+                if not blocking:
+                    continue
+                if seg == "wait":
+                    # Condition.wait releases the lock it guards:
+                    # `with self._cv: self._cv.wait()` is the sanctioned
+                    # pattern, not a stall
+                    recv = _lock_key(
+                        call.func.value, cls, mod) if isinstance(
+                        call.func, ast.Attribute) else None
+                    if recv is not None and recv in held:
+                        continue
+                yield Finding(
+                    rule_id=BLOCKING_UNDER_LOCK.rule_id,
+                    path=ctx.path, line=call.lineno,
+                    severity=BLOCKING_UNDER_LOCK.severity,
+                    message=f"{seg}() called in {fn.name}() while "
+                            f"holding {held[-1]} — the critical "
+                            "section inherits the block",
+                    fix_hint=BLOCKING_UNDER_LOCK.fix_hint)
+
+        def rec(body, held: List[str]):
+            for stmt in body:
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    keys = [k for k in
+                            (_lock_key(i.context_expr, cls, mod)
+                             for i in stmt.items if _is_lock_guard(i))
+                            if k]
+                    yield from rec(stmt.body, held + keys)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                    continue
+                if isinstance(stmt, (ast.If, ast.While, ast.For,
+                                     ast.AsyncFor, ast.Try)):
+                    # header expressions here; bodies via recursion (a
+                    # single full walk would double-count nested calls)
+                    if held:
+                        for header in (getattr(stmt, "test", None),
+                                       getattr(stmt, "iter", None)):
+                            if header is not None:
+                                yield from scan_calls(header, held)
+                    for a in ("body", "orelse", "finalbody"):
+                        sub = getattr(stmt, a, None)
+                        if sub:
+                            yield from rec(sub, held)
+                    for h in getattr(stmt, "handlers", []):
+                        yield from rec(h.body, held)
+                    continue
+                if held:
+                    yield from scan_calls(stmt, held)
+
+        yield from rec(fn.body, [])
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def _in_divergence_scope(path: str) -> bool:
+    norm = path.replace("\\", "/")
+    return any(d in norm for d in DIVERGENCE_DIRS)
+
+
+def audit_host_files(paths: Optional[List[str]] = None
+                     ) -> Tuple[List[Finding], HostGraph]:
+    """Run both static passes -> (findings with ``<host:`` markers,
+    the accumulated :class:`HostGraph`)."""
+    from .cli import _relpath, collect_py_files, _package_root
+
+    files = collect_py_files(paths or [_package_root()])
+    graph = HostGraph()
+    findings: List[Finding] = []
+    for path in files:
+        rel = _relpath(path)
+        if "analysis/" in rel.replace("\\", "/"):
+            continue  # the auditor's own fixtures/self-matches
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                ctx = ModuleContext(rel, fh.read())
+        except (SyntaxError, OSError):
+            continue  # Layer A owns syntax errors
+        raw: List[Finding] = []
+        if _in_divergence_scope(rel):
+            raw += list(_check_rank_divergence(ctx))
+            raw += list(_check_unordered_iteration(ctx))
+        raw += list(_check_unguarded_shared(ctx))
+        raw += list(_check_blocking_under_lock(ctx))
+        _build_module_graph(ctx, graph)
+        findings += [f for f in raw
+                     if not ctx.suppressed(f.line, f.rule_id)]
+    findings += list(_inversion_findings(graph))
+    marked = [Finding(rule_id=f.rule_id, path=f"{HOST_PREFIX}{f.path}>",
+                      line=f.line, severity=f.severity, message=f.message,
+                      fix_hint=f.fix_hint)
+              for f in findings]
+    return sort_findings(dedupe(marked)), graph
+
+
+def run_host_layer(paths: Optional[List[str]] = None) -> List[Finding]:
+    """CLI entry (``dstpu lint --hosts``): findings only."""
+    findings, _graph = audit_host_files(paths)
+    return findings
+
+
+def build_host_graph(paths: Optional[List[str]] = None) -> HostGraph:
+    """The static thread/lock graph alone (``tools/thread_report.py``
+    and the lockdep cross-check)."""
+    _findings, graph = audit_host_files(paths)
+    return graph
+
+
+# ---------------------------------------------------------------------------
+# virtual multi-host divergence harness
+# ---------------------------------------------------------------------------
+@contextlib.contextmanager
+def as_virtual_host(host: int, hosts: int):
+    """Present the process as virtual host ``host`` of ``hosts`` to every
+    ``dist.get_rank()``/``get_world_size()`` caller. The 8-device CPU
+    mesh stays one real process — only the *identity* the host-side code
+    branches on is partitioned, which is exactly the surface
+    ``rank-divergent-collective`` audits.
+
+    Limit (documented in docs/STATIC_ANALYSIS.md): code that calls
+    ``jax.process_index()`` directly, bypassing the comm frontend, does
+    not see the virtual identity; the static pass taints those calls
+    instead."""
+    from ..comm import comm as comm_mod
+    from .. import comm as comm_pkg
+
+    saved = (comm_mod.get_rank, comm_mod.get_world_size,
+             comm_pkg.get_rank, comm_pkg.get_world_size)
+    comm_mod.get_rank = lambda: host
+    comm_mod.get_world_size = lambda: hosts
+    comm_pkg.get_rank = comm_mod.get_rank
+    comm_pkg.get_world_size = comm_mod.get_world_size
+    try:
+        yield
+    finally:
+        (comm_mod.get_rank, comm_mod.get_world_size,
+         comm_pkg.get_rank, comm_pkg.get_world_size) = saved
+
+
+def _ledger_sequence(ledger) -> List[Tuple[str, int, Tuple, int]]:
+    return [(r["op"], r["wire_bytes"], tuple(r["axes"]), r["count"])
+            for r in ledger.records]
+
+
+def virtual_host_ledgers(name: str, hosts: int = 2):
+    """Trace entry spec ``name`` once per virtual host and return the
+    per-host ``CollectiveLedger`` list. The spec is REBUILT per host
+    (``build_spec`` resets topology and constructs fresh closures) so jax
+    cannot serve a cached trace that would record nothing for hosts > 0;
+    an empty ledger on one host while another recorded launches is
+    reported by :func:`diff_host_ledgers` rather than silently passing."""
+    import jax
+
+    from .. import comm as dist
+    from .entry_points import build_spec
+
+    ledgers = []
+    for h in range(hosts):
+        with as_virtual_host(h, hosts):
+            spec = build_spec(name)
+            ledger = dist.CollectiveLedger()
+            with dist.record_into(ledger):
+                with spec.mesh_ctx():
+                    jax.eval_shape(spec.fn, *spec.args)
+        ledgers.append(ledger)
+    return ledgers
+
+
+def diff_host_ledgers(ledgers) -> List[str]:
+    """Divergences between per-host collective launch sequences
+    (kind/bytes/axes/order must be identical). Empty list = identical."""
+    if not ledgers:
+        return []
+    seqs = [_ledger_sequence(l) for l in ledgers]
+    ref = seqs[0]
+    out: List[str] = []
+    counts = {len(s) for s in seqs}
+    if len(counts) > 1 and 0 in counts and max(counts) > 0:
+        out.append("host ledger empty while another host recorded "
+                   "launches — stale trace cache or rank-gated trace")
+    for h, seq in enumerate(seqs[1:], start=1):
+        if len(seq) != len(ref):
+            out.append(f"host {h} launched {len(seq)} collective(s), "
+                       f"host 0 launched {len(ref)}")
+        for i, (a, b) in enumerate(zip(ref, seq)):
+            if a != b:
+                out.append(f"host {h} launch #{i}: {b} != host 0's {a}")
+    return out
+
+
+def audit_virtual_hosts(names: Iterable[str], hosts: int = 2
+                        ) -> List[Finding]:
+    """Run the divergence harness over entry specs -> findings (empty
+    when every host's launch sequence is identical)."""
+    findings: List[Finding] = []
+    for name in names:
+        for msg in diff_host_ledgers(virtual_host_ledgers(name, hosts)):
+            findings.append(Finding(
+                rule_id=RANK_DIVERGENT.rule_id,
+                path=f"{HOST_PREFIX}virtual:{name}>", line=0,
+                severity=SEVERITY_ERROR,
+                message=f"virtual {hosts}-host divergence: {msg}",
+                fix_hint=RANK_DIVERGENT.fix_hint))
+    return sort_findings(findings)

@@ -1,0 +1,260 @@
+"""lockdep-lite: instrumented locks that record real acquisition order.
+
+The dynamic half of Layer F's host-seam concurrency pass
+(``analysis/host_audit.py``): the static lock graph is an
+over-approximation built from ``with`` nesting and same-module calls, so
+it needs a ground-truth check — and a pure runtime detector needs the
+static graph to see orders that never happened to interleave in a test
+run. The shim closes the loop the way the kernel's lockdep does, scaled
+to this repo's handful of host-side locks:
+
+- :func:`install` swaps ``threading.Lock``/``RLock`` for wrappers that
+  remember their **creation site** (``file:line`` — the same key the
+  static graph records for ``self._lock = threading.Lock()``) and, on
+  every acquire, record an ordered edge *held-top -> acquired* into a
+  :class:`LockdepRegistry`, per real thread.
+- :meth:`LockdepRegistry.cycles` finds inversions in the observed graph
+  alone (the seeded-inversion reproducer).
+- :func:`crosscheck` maps observed creation-site labels back to static
+  lock keys via :meth:`HostGraph.key_for_site` and verifies the merged
+  static+observed graph stays acyclic — an observed order contradicting
+  the static order is exactly a latent inversion that one more thread
+  interleaving would deadlock.
+
+Used by the chaos/durability/autotuning test drives
+(``tests/unit/analysis/test_host_audit.py``) and
+``tools/thread_report.py``. Never imported by runtime code — zero
+overhead outside the harness.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+
+def _site_label(depth: int = 2) -> str:
+    """``<repo-relative file>:<line>`` of the caller's caller — the lock
+    construction site, matching the static graph's creation-site keys."""
+    frame = sys._getframe(depth)
+    path = frame.f_code.co_filename
+    parts = path.replace("\\", "/").split("/")
+    if "deepspeed_tpu" in parts:
+        path = "/".join(parts[parts.index("deepspeed_tpu"):])
+    else:
+        path = "/".join(parts[-2:])
+    return f"{path}:{frame.f_lineno}"
+
+
+class LockdepRegistry:
+    """Observed acquisition-order edges, per real thread."""
+
+    def __init__(self):
+        self._guard = threading.Lock()  # a REAL lock: the registry must
+        # never record itself
+        self._tls = threading.local()
+        #: (held label, acquired label) -> (thread name, ordinal)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        #: label -> creation site count (several locks can share a site)
+        self.locks: Dict[str, int] = {}
+        self._ordinal = 0
+
+    # -- bookkeeping called by the instrumented locks --------------------
+    def note_created(self, label: str) -> None:
+        with self._guard:
+            self.locks[label] = self.locks.get(label, 0) + 1
+
+    def _held(self) -> List[str]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def note_acquired(self, label: str) -> None:
+        held = self._held()
+        if held and held[-1] != label:
+            edge = (held[-1], label)
+            with self._guard:
+                if edge not in self.edges:
+                    self._ordinal += 1
+                    self.edges[edge] = (threading.current_thread().name,
+                                        self._ordinal)
+        held.append(label)
+
+    def note_released(self, label: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == label:
+                del held[i]
+                break
+
+    # -- analysis ---------------------------------------------------------
+    def cycles(self) -> List[List[str]]:
+        return _find_cycles(set(self.edges))
+
+    def observed_order(self) -> List[Tuple[str, str, str, int]]:
+        """[(held, acquired, thread, ordinal)] sorted by first
+        observation — the reviewable artifact ``thread_report.py``
+        prints."""
+        return sorted(((a, b, t, o)
+                       for (a, b), (t, o) in self.edges.items()),
+                      key=lambda r: r[3])
+
+
+class InstrumentedLock:
+    """Drop-in ``threading.Lock``/``RLock`` recording into a registry."""
+
+    def __init__(self, registry: LockdepRegistry, label: str,
+                 reentrant: bool = False):
+        self._registry = registry
+        self.label = label
+        self._real = (threading._original_rlock() if reentrant
+                      else threading._original_lock()) \
+            if hasattr(threading, "_original_lock") else None
+        if self._real is None:  # constructed outside install()
+            import _thread
+            self._real = _thread.RLock() if reentrant \
+                else _thread.allocate_lock()
+        registry.note_created(label)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._real.acquire(blocking, timeout) if blocking \
+            else self._real.acquire(False)
+        if got:
+            self._registry.note_acquired(self.label)
+        return got
+
+    def release(self):
+        self._registry.note_released(self.label)
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked() if hasattr(self._real, "locked") \
+            else False
+
+    def __getattr__(self, name):
+        # stdlib pokes at lock internals (`_at_fork_reinit`,
+        # `acquire_lock`...): forward anything we don't wrap
+        if name == "_real":
+            raise AttributeError(name)
+        return getattr(self._real, name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<InstrumentedLock {self.label}>"
+
+
+@contextlib.contextmanager
+def install(registry: Optional[LockdepRegistry] = None):
+    """Swap ``threading.Lock``/``RLock`` for instrumented factories for
+    the duration of the context; yields the registry. Locks created
+    inside the context keep recording after it exits (their registry
+    reference survives), so a subsystem constructed under ``install``
+    can be driven afterwards — only the *construction* window is
+    patched."""
+    reg = registry or LockdepRegistry()
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    # stash originals where InstrumentedLock can reach the REAL ctors
+    # even while the names are patched
+    threading._original_lock = orig_lock
+    threading._original_rlock = orig_rlock
+
+    def make_lock():
+        return InstrumentedLock(reg, _site_label(), reentrant=False)
+
+    def make_rlock():
+        return InstrumentedLock(reg, _site_label(), reentrant=True)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    try:
+        yield reg
+    finally:
+        threading.Lock, threading.RLock = orig_lock, orig_rlock
+        del threading._original_lock
+        del threading._original_rlock
+
+
+# ---------------------------------------------------------------------------
+# cross-check against the static graph
+# ---------------------------------------------------------------------------
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+    seen: Set[frozenset] = set()
+    out: List[List[str]] = []
+
+    def dfs(node: str, stack: List[str], on_stack: Set[str]):
+        for nxt in adj.get(node, []):
+            if nxt in on_stack:
+                cyc = stack[stack.index(nxt):] + [nxt]
+                key = frozenset(cyc)
+                if key not in seen:
+                    seen.add(key)
+                    out.append(cyc)
+                continue
+            stack.append(nxt)
+            on_stack.add(nxt)
+            dfs(nxt, stack, on_stack)
+            on_stack.discard(nxt)
+            stack.pop()
+
+    for start in sorted(adj):
+        dfs(start, [start], {start})
+    return out
+
+
+def map_observed_edges(registry: LockdepRegistry, graph
+                       ) -> List[Tuple[str, str]]:
+    """Observed (creation-site) edges translated to static lock keys;
+    edges touching a lock the static graph does not know (jax internals,
+    executor plumbing created under ``install``) are dropped — the
+    cross-check only speaks where both sides have an opinion."""
+    out: List[Tuple[str, str]] = []
+    for (a, b) in registry.edges:
+        ka = _label_to_key(a, graph)
+        kb = _label_to_key(b, graph)
+        if ka and kb and ka != kb:
+            out.append((ka, kb))
+    return out
+
+
+def _label_to_key(label: str, graph) -> Optional[str]:
+    path, _, line = label.rpartition(":")
+    try:
+        return graph.key_for_site(path, int(line))
+    except ValueError:
+        return None
+
+
+def crosscheck(registry: LockdepRegistry, graph) -> List[str]:
+    """Merge the static acquisition graph with the observed (mapped)
+    edges and report contradictions: a cycle in the merged graph that is
+    acyclic in each half alone means the runtime took an order the
+    static graph's order cannot coexist with. Returns human-readable
+    violation strings (empty = consistent)."""
+    static_edges = set(graph.edges)
+    observed = set(map_observed_edges(registry, graph))
+    merged = static_edges | observed
+    violations = []
+    for cyc in _find_cycles(merged):
+        cyc_edges = set(zip(cyc, cyc[1:]))
+        if cyc_edges <= static_edges:
+            continue  # purely static cycle: lock-order-inversion's job
+        if cyc_edges <= observed:
+            kind = "observed-only cycle"
+        else:
+            kind = "observed order contradicts static order"
+        violations.append(f"{kind}: " + " -> ".join(cyc))
+    return violations

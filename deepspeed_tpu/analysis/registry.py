@@ -18,20 +18,22 @@ LAYER_JAXPR = "jaxpr"
 LAYER_SPMD = "spmd"
 LAYER_SCHEDULE = "schedule"
 LAYER_FEASIBILITY = "feasibility"
+LAYER_HOSTS = "hosts"
 
 
 @dataclasses.dataclass(frozen=True)
 class Rule:
     rule_id: str
     layer: str           # LAYER_AST | LAYER_JAXPR | LAYER_SPMD |
-                         # LAYER_SCHEDULE | LAYER_FEASIBILITY
+                         # LAYER_SCHEDULE | LAYER_FEASIBILITY | LAYER_HOSTS
     severity: str        # default severity of findings from this rule
     description: str     # one-liner for docs / --fix-hints
     fix_hint: str        # how to fix, rendered with the finding
 
     def __post_init__(self):
         assert self.layer in (LAYER_AST, LAYER_JAXPR, LAYER_SPMD,
-                              LAYER_SCHEDULE, LAYER_FEASIBILITY), self.layer
+                              LAYER_SCHEDULE, LAYER_FEASIBILITY,
+                              LAYER_HOSTS), self.layer
 
 
 _RULES: Dict[str, Rule] = {}

@@ -186,8 +186,10 @@ class TuneController:
                              "axes": axes, "best": new_best,
                              "payload": payload})
         if new_best:
-            self.best = dict(new_best)
+            # `best` is read by the publisher-thread hooks (on_summary);
+            # publish the new pin under the same lock as the streak reset
             with self._lock:
+                self.best = dict(new_best)
                 self._regressed_streak = 0
                 self._ab_done = False
             self.apply_fn(new_best, reason)
@@ -221,7 +223,8 @@ class TuneController:
             logger.warning(
                 f"dstpu tune controller: A/B adopted runner-up "
                 f"{runner_up['label']} (objective {objective:.3e})")
-            self.best = new_best
+            with self._lock:
+                self.best = new_best
             self.apply_fn(new_best, "regression:ab")
 
     # -- default re-tune wiring ------------------------------------------

@@ -33,6 +33,86 @@ def pytest_configure(config):
         "markers", "slow: excluded from the tier-1 gate (-m 'not slow')")
 
 
+# ---------------------------------------------------------------------------
+# capability probe: cross-process CPU collectives
+#
+# tests/unit/runtime/test_multiprocess.py launches REAL two-process runs
+# whose collectives must cross the process boundary. Some jaxlib builds
+# (including the current pin) refuse this outright — the CPU backend
+# raises "Multiprocess computations aren't implemented" on the first
+# cross-process program. That is a toolchain capability gap, not a repo
+# regression, so those tests SKIP (with the probe's evidence) instead of
+# failing. The probe runs at most once per session, and only when a
+# multiprocess test was actually collected.
+# ---------------------------------------------------------------------------
+
+_MP_PROBE_SRC = """
+import os, sys
+port, pid = sys.argv[1], int(sys.argv[2])
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ.pop("JAX_PLATFORMS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(f"localhost:{port}", num_processes=2,
+                           process_id=pid)
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+x = multihost_utils.process_allgather(jnp.ones((1,)))
+assert x.shape == (2, 1), x.shape
+"""
+
+_mp_capability = None  # None = not probed yet; (bool, reason)
+
+
+def _cross_process_cpu_collectives_work():
+    global _mp_capability
+    if _mp_capability is not None:
+        return _mp_capability
+    import socket
+    import subprocess
+    import sys
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("JAX_", "XLA_"))}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _MP_PROBE_SRC, str(port), str(i)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs, ok = [], True
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=120)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, ok = "probe timeout", False
+        outs.append(out or "")
+        ok = ok and p.returncode == 0
+    if ok:
+        _mp_capability = (True, "")
+    else:
+        tail = next((l for o in outs for l in reversed(o.splitlines())
+                     if "Error" in l or "error" in l), "see probe output")
+        _mp_capability = (False, tail.strip()[:200])
+    return _mp_capability
+
+
+def pytest_collection_modifyitems(config, items):
+    mp_items = [i for i in items
+                if "test_multiprocess" in os.path.basename(str(i.fspath))]
+    if not mp_items:
+        return
+    capable, reason = _cross_process_cpu_collectives_work()
+    if capable:
+        return
+    marker = pytest.mark.skip(
+        reason="cross-process CPU collectives unavailable in this "
+               f"jaxlib (capability probe: {reason})")
+    for item in mp_items:
+        item.add_marker(marker)
+
+
 @pytest.fixture(autouse=True)
 def _reset_topology():
     topo_mod.reset()
@@ -57,3 +137,13 @@ def eight_devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def host_lock_graph():
+    """Layer F's static lock-acquisition graph over the package, built
+    once per session — the reference the lockdep-lite cross-check
+    (chaos/durability/autotuning suite conftests) compares observed
+    acquisition order against."""
+    from deepspeed_tpu.analysis.host_audit import build_host_graph
+    return build_host_graph(None)

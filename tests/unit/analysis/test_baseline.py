@@ -38,21 +38,24 @@ def test_finding_layer_markers():
     assert finding_layer(_f(path="runtime/engine.py")) == "ast"
     assert finding_layer(_f(path="<trace:engine-train-step>")) == "jaxpr"
     assert finding_layer(_f(path="<spmd:engine-train-step>")) == "spmd"
+    assert finding_layer(_f(path="<host:comm/comm.py>")) == "hosts"
 
 
-def test_split_layers_five_way():
-    ast, jaxpr, spmd, sched, feas = split_layers([
+def test_split_layers_six_way():
+    ast, jaxpr, spmd, sched, feas, hosts = split_layers([
         _f(path="a.py"), _f(path="<trace:e>"), _f(path="<spmd:e>"),
-        _f(path="<sched:e>"), _f(path="<plan:e>")])
+        _f(path="<sched:e>"), _f(path="<plan:e>"), _f(path="<host:a.py>")])
     assert [f.path for f in ast] == ["a.py"]
     assert [f.path for f in jaxpr] == ["<trace:e>"]
     assert [f.path for f in spmd] == ["<spmd:e>"]
     assert [f.path for f in sched] == ["<sched:e>"]
     assert [f.path for f in feas] == ["<plan:e>"]
+    assert [f.path for f in hosts] == ["<host:a.py>"]
     layers = by_layer([_f(path="<spmd:e>")])
     assert [f.path for f in layers["spmd"]] == ["<spmd:e>"]
     assert layers["ast"] == [] and layers["jaxpr"] == []
     assert layers["schedule"] == [] and layers["feasibility"] == []
+    assert layers["hosts"] == []
 
 
 def test_entry_name_and_prune_unknown():

@@ -9,6 +9,8 @@ about the driver's plumbing, so the whole module stays sub-second.
 import json
 import textwrap
 
+import pytest
+
 from deepspeed_tpu.analysis import cli
 from deepspeed_tpu.analysis.baseline import load_baseline, write_baseline
 from deepspeed_tpu.analysis.budgets import load_budgets, write_budgets
@@ -343,6 +345,10 @@ def test_schedule_missing_exposure_file_prints_skip_note(tmp_path,
     assert "exposure budget checks skipped" in capsys.readouterr().err
 
 
+@pytest.mark.slow  # ~109 s: --update-budgets bootstraps the real memory
+# layer (compiles every entry spec). The shrink-only merge semantics are
+# pinned cheaply by test_update_budgets_writes_only_downward (mocked
+# layers) and the exposure-check math by the schedule_audit unit tests.
 def test_update_budgets_with_schedule_writes_exposure_downward(
         tmp_path, monkeypatch, capsys):
     from deepspeed_tpu.analysis.schedule_audit import (

@@ -1,0 +1,677 @@
+"""Layer F: cross-host divergence & host-seam concurrency auditor.
+
+Three validation fronts, mirroring the layer's own structure:
+
+1. AST fixtures — every rule has a *fires* and a *stays-quiet* pair, so
+   a regression in either direction (missed bug or new false positive)
+   breaks a named test.
+2. The virtual multi-host divergence harness — real engine-built entry
+   specs traced once per virtual host must produce identical
+   ``CollectiveLedger`` sequences, and a PLANTED rank-conditional
+   collective must be caught (the negative control that proves the
+   ledger diff has teeth).
+3. lockdep-lite — the instrumented-lock shim reproduces a seeded
+   lock-order inversion, and real subsystems (async checkpoint engine,
+   stall watchdog, tune controller) driven under ``install()`` must
+   record no acquisition order contradicting the static graph.
+"""
+
+import importlib.util
+import os
+import textwrap
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.analysis import lockdep
+from deepspeed_tpu.analysis.ast_rules import ModuleContext
+from deepspeed_tpu.analysis.baseline import finding_layer, split_layers
+from deepspeed_tpu.analysis.findings import Finding, SEVERITY_WARNING
+from deepspeed_tpu.analysis.host_audit import (
+    HOST_PREFIX, SANCTIONED_RANK0, HostGraph, _build_module_graph,
+    _check_blocking_under_lock, _check_rank_divergence,
+    _check_unguarded_shared, _check_unordered_iteration,
+    _inversion_findings, as_virtual_host, audit_virtual_hosts,
+    build_host_graph, diff_host_ledgers, run_host_layer,
+    virtual_host_ledgers)
+
+
+def _ctx(source, path="deepspeed_tpu/comm/fixture.py"):
+    return ModuleContext(path, textwrap.dedent(source))
+
+
+def _rules(findings):
+    return [f.rule_id for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# rank-divergent-collective
+# ---------------------------------------------------------------------------
+
+def test_rank_divergent_fires_on_guarded_collective():
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def save(rank):
+            if rank == 0:
+                dist.barrier()
+    """)
+    findings = list(_check_rank_divergence(ctx))
+    assert _rules(findings) == ["rank-divergent-collective"]
+    assert "barrier" in findings[0].message
+
+
+def test_rank_divergent_fires_on_early_return_guard():
+    # the CFG form: non-zero ranks leave, the fallthrough collective only
+    # runs on rank 0 — no syntactic if around the launch at all
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def publish(x):
+            if dist.get_rank() != 0:
+                return
+            dist.all_reduce(x)
+    """)
+    findings = list(_check_rank_divergence(ctx))
+    assert _rules(findings) == ["rank-divergent-collective"]
+
+
+def test_rank_divergent_fires_on_conditional_expression():
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def maybe(rank, x):
+            return dist.all_gather(x) if rank == 0 else None
+    """)
+    assert _rules(_check_rank_divergence(ctx)) == \
+        ["rank-divergent-collective"]
+
+
+def test_rank_divergent_quiet_on_unconditional_collective():
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def step(x):
+            dist.all_reduce(x)
+            if dist.get_rank() == 0:
+                print("host io only")
+            dist.barrier()
+    """)
+    assert list(_check_rank_divergence(ctx)) == []
+
+
+def test_rank_divergent_quiet_on_non_identity_condition():
+    # world_size is uniform across hosts — branching on it cannot diverge
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def step(x):
+            if dist.get_world_size() > 1:
+                dist.all_reduce(x)
+    """)
+    assert list(_check_rank_divergence(ctx)) == []
+
+
+def test_rank_divergent_sanction_suppresses_and_stale_fires():
+    src = """
+        from deepspeed_tpu import comm as dist
+
+        def announce(rank):
+            if rank == 0:
+                dist.barrier()
+    """
+    key = ("comm/fixture.py", "announce", "barrier")
+    SANCTIONED_RANK0[key] = "test: all hosts reach announce()"
+    try:
+        assert list(_check_rank_divergence(_ctx(src))) == []
+        # the guarded launch removed -> the entry is stale and must say so
+        stale = list(_check_rank_divergence(_ctx("""
+            def announce(rank):
+                pass
+        """)))
+        assert len(stale) == 1
+        assert stale[0].severity == SEVERITY_WARNING
+        assert "stale SANCTIONED_RANK0" in stale[0].message
+    finally:
+        del SANCTIONED_RANK0[key]
+
+
+def test_rank_divergent_inline_suppression():
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def save(rank):
+            if rank == 0:
+                dist.barrier()  # dstpu: ignore[rank-divergent-collective]
+    """)
+    findings = [f for f in _check_rank_divergence(ctx)
+                if not ctx.suppressed(f.line, f.rule_id)]
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# unordered-collective-iteration
+# ---------------------------------------------------------------------------
+
+def test_unordered_fires_on_set_iteration_with_collective():
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def sync(params):
+            for p in set(params):
+                dist.all_gather(p)
+    """)
+    assert _rules(_check_unordered_iteration(ctx)) == \
+        ["unordered-collective-iteration"]
+
+
+def test_unordered_fires_on_set_built_plan():
+    ctx = _ctx("""
+        def build(params):
+            plan = []
+            for p in {id(q) for q in params}:
+                plan.append(p)
+            return plan
+    """)
+    assert _rules(_check_unordered_iteration(ctx)) == \
+        ["unordered-collective-iteration"]
+
+
+def test_unordered_quiet_when_sorted():
+    ctx = _ctx("""
+        from deepspeed_tpu import comm as dist
+
+        def sync(params):
+            for p in sorted(set(params)):
+                dist.all_gather(p)
+            order = []
+            for q in list(params):
+                order.append(q)
+    """)
+    assert list(_check_unordered_iteration(ctx)) == []
+
+
+# ---------------------------------------------------------------------------
+# unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+
+_UNGUARDED_SRC = """
+    import threading
+
+    class Daemon:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.status = None
+            self._thread = threading.Thread(target=self._run)
+
+        def _run(self):
+            while True:
+                s = self.status
+
+        def publish(self, s):
+            self.status = s
+"""
+
+
+def test_unguarded_fires_on_thread_shared_attr():
+    ctx = _ctx(_UNGUARDED_SRC)
+    findings = list(_check_unguarded_shared(ctx))
+    assert "unguarded-shared-mutation" in _rules(findings)
+    assert any("status" in f.message for f in findings)
+
+
+def test_unguarded_quiet_when_locked():
+    ctx = _ctx("""
+        import threading
+
+        class Daemon:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.status = None
+                self._thread = threading.Thread(target=self._run)
+
+            def _run(self):
+                with self._lock:
+                    s = self.status
+
+            def publish(self, s):
+                with self._lock:
+                    self.status = s
+    """)
+    assert list(_check_unguarded_shared(ctx)) == []
+
+
+def test_unguarded_quiet_for_executor_submit_workers():
+    # submit() has a happens-before at the queue handoff: writes made
+    # before submit are visible to the task; Layer A's
+    # unguarded-worker-state owns what happens inside the pool
+    ctx = _ctx("""
+        class Pump:
+            def __init__(self, pool):
+                self.buf = None
+                pool.submit(self._task)
+
+            def _task(self):
+                b = self.buf
+
+            def feed(self, b):
+                self.buf = b
+    """)
+    assert list(_check_unguarded_shared(ctx)) == []
+
+
+def test_unguarded_spawn_line_suppression_covers_worker():
+    src = _UNGUARDED_SRC.replace(
+        "threading.Thread(target=self._run)",
+        "threading.Thread(target=self._run)"
+        "  # dstpu: ignore[unguarded-shared-mutation]")
+    ctx = _ctx(src)
+    assert list(_check_unguarded_shared(ctx)) == []
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+def test_blocking_fires_on_future_result_under_lock():
+    ctx = _ctx("""
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def drain(self, fut):
+                with self._lock:
+                    return fut.result()
+    """)
+    findings = list(_check_blocking_under_lock(ctx))
+    assert _rules(findings) == ["blocking-under-lock"]
+    assert "result" in findings[0].message
+
+
+def test_blocking_fires_on_device_get_under_lock():
+    ctx = _ctx("""
+        import threading
+        import jax
+
+        class Snap:
+            def __init__(self):
+                self._state_lock = threading.Lock()
+
+            def host_copy(self, x):
+                with self._state_lock:
+                    return jax.device_get(x)
+    """)
+    assert _rules(_check_blocking_under_lock(ctx)) == \
+        ["blocking-under-lock"]
+
+
+def test_blocking_quiet_outside_lock_and_for_condition_wait():
+    ctx = _ctx("""
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._cv = threading.Condition()
+
+            def drain(self, fut):
+                r = fut.result()
+                with self._cv:
+                    self._cv.wait()
+                return r
+    """)
+    assert list(_check_blocking_under_lock(ctx)) == []
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion (static)
+# ---------------------------------------------------------------------------
+
+_INVERSION_SRC = """
+    import threading
+
+    class Owner:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._io_lock = threading.Lock()
+
+        def fwd(self):
+            with self._lock:
+                with self._io_lock:
+                    pass
+
+        def bwd(self):
+            with self._io_lock:
+                with self._lock:
+                    pass
+"""
+
+
+def test_inversion_fires_on_opposite_nesting():
+    ctx = _ctx(_INVERSION_SRC)
+    graph = HostGraph()
+    _build_module_graph(ctx, graph)
+    findings = list(_inversion_findings(graph))
+    assert _rules(findings) == ["lock-order-inversion"]
+    assert "Owner._lock" in findings[0].message
+    assert "Owner._io_lock" in findings[0].message
+
+
+def test_inversion_sees_through_calls_while_holding():
+    # fwd holds _lock and CALLS a helper that takes _io_lock; bwd nests
+    # directly the other way — the cycle spans a call edge
+    ctx = _ctx("""
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._io_lock = threading.Lock()
+
+            def _flush(self):
+                with self._io_lock:
+                    pass
+
+            def fwd(self):
+                with self._lock:
+                    self._flush()
+
+            def bwd(self):
+                with self._io_lock:
+                    with self._lock:
+                        pass
+    """)
+    graph = HostGraph()
+    _build_module_graph(ctx, graph)
+    assert _rules(_inversion_findings(graph)) == ["lock-order-inversion"]
+
+
+def test_inversion_quiet_on_consistent_order():
+    ctx = _ctx("""
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._io_lock = threading.Lock()
+
+            def fwd(self):
+                with self._lock:
+                    with self._io_lock:
+                        pass
+
+            def also_fwd(self):
+                with self._lock:
+                    with self._io_lock:
+                        pass
+    """)
+    graph = HostGraph()
+    _build_module_graph(ctx, graph)
+    assert list(_inversion_findings(graph)) == []
+
+
+# ---------------------------------------------------------------------------
+# driver + baseline plumbing
+# ---------------------------------------------------------------------------
+
+def test_run_host_layer_marks_paths_and_layer(tmp_path):
+    fix = tmp_path / "divergent.py"
+    fix.write_text(textwrap.dedent("""
+        from deepspeed_tpu import comm as dist
+
+        def save(rank):
+            if rank == 0:
+                dist.barrier()
+    """))
+    findings = run_host_layer([str(tmp_path)])
+    # tmp fixtures live outside DIVERGENCE_DIRS: the divergence pass is
+    # scoped to the six audited package dirs, so only the repo-wide
+    # concurrency rules apply here
+    assert all(f.path.startswith(HOST_PREFIX) for f in findings)
+    for f in findings:
+        assert finding_layer(f) == "hosts"
+
+
+def test_host_findings_route_to_hosts_layer_bucket():
+    f = Finding(rule_id="rank-divergent-collective",
+                path=f"{HOST_PREFIX}deepspeed_tpu/comm/comm.py>",
+                line=3, severity="error", message="m")
+    assert finding_layer(f) == "hosts"
+    layers = split_layers([f])
+    assert layers[5] == [f]
+    assert all(not bucket for bucket in layers[:5])
+
+
+def test_repo_is_host_clean():
+    # the committed Layer-F baseline is EMPTY: the repo must stay clean
+    # outright, not grandfathered (every real finding was fixed in the
+    # PR that introduced this layer)
+    findings = run_host_layer(None)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: [{f.rule_id}] {f.message}" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# lockdep-lite
+# ---------------------------------------------------------------------------
+
+def test_lockdep_reproduces_seeded_inversion():
+    with lockdep.install() as reg:
+        a = threading.Lock()
+        b = threading.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    # sequential execution suffices: lockdep records ORDER, not races —
+    # exactly why it catches inversions no timing-dependent test can
+    th1 = threading.Thread(target=t1)
+    th2 = threading.Thread(target=t2)
+    th1.start(); th1.join()
+    th2.start(); th2.join()
+    cycles = reg.cycles()
+    assert cycles, "seeded lock-order inversion not observed"
+    assert len(reg.edges) == 2
+
+
+def test_lockdep_records_no_edge_for_single_lock():
+    before = threading.Lock  # install() must restore the real factory
+    with lockdep.install() as reg:
+        a = threading.Lock()
+    with a:
+        pass
+    assert reg.edges == {}
+    assert reg.locks  # but the creation site was noted
+    assert threading.Lock is before
+
+
+def test_lockdep_crosscheck_flags_order_contradicting_static(tmp_path):
+    src = textwrap.dedent("""
+        import threading
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._io_lock = threading.Lock()
+
+            def fwd(self):
+                with self._lock:
+                    with self._io_lock:
+                        pass
+    """)
+    p = tmp_path / "fixmod.py"
+    p.write_text(src)
+    graph = build_host_graph([str(p)])
+    assert ("Owner._lock", "Owner._io_lock") in graph.edges
+
+    spec = importlib.util.spec_from_file_location("lockdep_fixmod", str(p))
+    mod = importlib.util.module_from_spec(spec)
+    with lockdep.install() as reg:
+        spec.loader.exec_module(mod)
+        o = mod.Owner()
+    # runtime takes the OPPOSITE order through direct acquires the
+    # static with-nesting pass never sees
+    with o._io_lock:
+        with o._lock:
+            pass
+    violations = lockdep.crosscheck(reg, graph)
+    assert violations and "contradicts" in violations[0]
+    # and the consistent order on its own is no violation
+    reg2 = lockdep.LockdepRegistry()
+    with lockdep.install(reg2):
+        o2 = mod.Owner.__new__(mod.Owner)
+        mod.Owner.__init__(o2)
+    o2.fwd()
+    assert lockdep.crosscheck(reg2, graph) == []
+
+
+@pytest.fixture()
+def repo_graph():
+    return build_host_graph(None)
+
+
+def test_lockdep_async_checkpoint_engine_consistent(repo_graph, tmp_path):
+    """Drive the real async checkpoint engine (save -> commit -> close)
+    under instrumented locks; no observed acquisition order may
+    contradict the repo's static lock graph."""
+    import numpy as np
+    with lockdep.install() as reg:
+        from deepspeed_tpu.checkpoint.checkpoint_engine import \
+            AsyncCheckpointEngine
+        eng = AsyncCheckpointEngine()
+        state = {"w": np.ones((4,), dtype=np.float32)}
+        eng.save(state, str(tmp_path / "w.npz"))
+        assert eng.commit("t0")
+        eng.close()
+    violations = lockdep.crosscheck(reg, repo_graph)
+    assert violations == [], violations
+
+
+def test_lockdep_watchdog_and_controller_consistent(repo_graph):
+    """The two long-running host daemons (stall watchdog, tune
+    controller) beat a few times under instrumented locks; the observed
+    order must merge cleanly with the static graph."""
+    with lockdep.install() as reg:
+        from deepspeed_tpu.autotuning.controller import TuneController
+        from deepspeed_tpu.telemetry.watchdog import StallWatchdog
+
+        wd = StallWatchdog(min_deadline_s=30.0, poll_s=0.01)
+        wd.step_begin(1)
+        wd.step_end(1, 0.01)
+
+        ctl = TuneController(
+            grid={"axes": {}},
+            best={"label": "seed", "objective": 1.0,
+                  "runner_up": {"label": "ru", "overrides": {}}},
+            tune_fn=lambda grid, reason: {"label": "re", "objective": 2.0},
+            ab_fn=lambda ru: 3.0,
+            regression_patience=1)
+        ctl.on_event("guardian_rollback", {"step": 1})
+        for _ in range(3):
+            ctl.on_summary(1, {"tuning_objective": 0.0})
+        ctl.poll()
+        time.sleep(0.05)
+        wd.stop()
+        ctl.stop()
+    violations = lockdep.crosscheck(reg, repo_graph)
+    assert violations == [], violations
+    assert reg.cycles() == []
+
+
+# ---------------------------------------------------------------------------
+# virtual multi-host divergence harness
+# ---------------------------------------------------------------------------
+
+#: engine-built specs whose per-host launch sequences must be identical,
+#: plus the explicit-collective transport spec. The ledger records the
+#: comm FRONTEND (dist.*): shard_map specs (gather/partition, ZeRO++
+#: micro, quantized transport) record every launch; the GSPMD-sharded
+#: full train step records none by design (the partitioner inserts its
+#: collectives below the frontend) — for it the harness proves the
+#: HOST-SIDE trace makes zero rank-conditional launches, which is the
+#: divergence class the frontend can create.
+HARNESS_SPECS = ("engine-train-step", "zero-gather-partition",
+                 "zeropp-micro-overlap", "quantized-transport")
+_LEDGER_NONEMPTY = ("zero-gather-partition", "zeropp-micro-overlap",
+                    "quantized-transport")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", HARNESS_SPECS)
+def test_virtual_hosts_identical_ledgers(name):
+    ledgers = virtual_host_ledgers(name, hosts=2)
+    if name in _LEDGER_NONEMPTY:
+        assert all(l.records for l in ledgers), \
+            f"{name}: a virtual host recorded no launches " \
+            "(stale trace cache?)"
+    assert diff_host_ledgers(ledgers) == []
+
+
+@pytest.mark.slow
+def test_audit_virtual_hosts_clean_for_gather_partition():
+    assert audit_virtual_hosts(["zero-gather-partition"], hosts=2) == []
+
+
+def test_virtual_host_patches_both_comm_surfaces():
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.comm import comm as comm_mod
+    with as_virtual_host(1, 4):
+        assert dist.get_rank() == 1 and comm_mod.get_rank() == 1
+        assert dist.get_world_size() == 4
+    assert dist.get_rank() == comm_mod.get_rank()
+
+
+def test_harness_catches_planted_rank_conditional_collective():
+    """The negative control: a trace-time rank branch that launches one
+    extra all-reduce on host 0 must show up in the ledger diff."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from deepspeed_tpu import comm as dist
+    from deepspeed_tpu.runtime import topology as topo_mod
+    from deepspeed_tpu.runtime.topology import DATA_AXIS, TopologyConfig
+    from deepspeed_tpu.utils.jax_compat import shard_map
+
+    ledgers = []
+    for h in range(2):
+        with as_virtual_host(h, 2):
+            # fresh closure per host, like virtual_host_ledgers, so jax
+            # cannot serve host 0's cached trace to host 1
+            topo = topo_mod.initialize(TopologyConfig(data=-1), force=True)
+
+            def local(x):
+                y = dist.all_reduce(x)
+                if dist.get_rank() == 0:   # the planted divergence
+                    y = dist.all_reduce(y)
+                return y
+
+            fn = shard_map(local, mesh=topo.mesh,
+                           in_specs=P(DATA_AXIS), out_specs=P(None),
+                           check_vma=False)
+            ledger = dist.CollectiveLedger()
+            with dist.record_into(ledger):
+                jax.eval_shape(fn, jnp.zeros((8,), jnp.float32))
+            ledgers.append(ledger)
+    diffs = diff_host_ledgers(ledgers)
+    assert diffs, "planted rank-conditional all-reduce went undetected"
+    assert any("launched" in d for d in diffs)
+
+
+def test_diff_host_ledgers_flags_empty_vs_nonempty():
+    class L:
+        def __init__(self, records):
+            self.records = records
+
+    rec = {"op": "all_reduce", "wire_bytes": 32, "axes": ["dp"],
+           "count": 1}
+    diffs = diff_host_ledgers([L([rec]), L([])])
+    assert any("empty" in d for d in diffs)

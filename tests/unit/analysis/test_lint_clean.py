@@ -8,7 +8,9 @@ Layer-C compiled-artifact audit AND the Layer-D schedule audit over the
 CHEAP entry-point subset (GATE_SPMD_ENTRY_POINTS: no engine build,
 sub-second compiles) — ONE compile pass feeds both layers — checked
 against the committed shrink-only tools/memory_budgets.json and
-tools/exposure_budgets.json. The full sets run off-gate via `dstpu lint
+tools/exposure_budgets.json, plus the Layer-F host-seam audit (pure
+AST, shares the compiled gate's wall ceiling) whose committed baseline
+is EMPTY by construction. The full sets run off-gate via `dstpu lint
 --spmd --schedule` (docs/STATIC_ANALYSIS.md, "Tier-1 cost control"). A
 failure here means a new TPU-graph invariant violation: fix it
 (preferred), suppress with `# dstpu: ignore[rule-id]` (Layer A), or —
@@ -61,6 +63,35 @@ def test_ast_layer_clean_against_baseline():
     assert not stale, (
         "stale baseline entries (fixed findings still grandfathered) — "
         f"regenerate with `dstpu lint --write-baseline`:\n{_render(stale)}")
+
+
+# ---------------------------------------------------------------------------
+# Layer F gate: the host-seam auditor, AST-speed, shares the compiled
+# gate's wall budget (its cost is measured INTO the same ceiling below)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def host_gate_run():
+    from deepspeed_tpu.analysis.host_audit import run_host_layer
+    start = time.monotonic()
+    findings = run_host_layer([os.path.normpath(PACKAGE)])
+    return findings, time.monotonic() - start
+
+
+def test_host_layer_clean_against_baseline(host_gate_run):
+    findings, _elapsed = host_gate_run
+    baseline = split_layers(load_baseline(default_baseline_path()))[5]
+    new, stale = diff_against_baseline(findings, baseline)
+    assert not new, f"Layer-F host-audit findings:\n{_render(new)}"
+    assert not stale, (
+        "stale Layer-F baseline entries — the committed baseline is "
+        f"EMPTY and must stay so:\n{_render(stale)}")
+
+
+def test_host_layer_baseline_is_empty():
+    # Layer F launched with every real finding FIXED, not grandfathered
+    # (docs/STATIC_ANALYSIS.md): no <host: entry may ever land here
+    assert split_layers(load_baseline(default_baseline_path()))[5] == []
 
 
 def test_baseline_stays_small():
@@ -136,13 +167,14 @@ def test_spmd_gate_budgets_were_checked(spmd_gate_run):
         f"{budgets['mesh_devices']} devices")
 
 
-def test_spmd_gate_stays_under_wall_budget(spmd_gate_run):
-    elapsed = spmd_gate_run[2]
+def test_spmd_gate_stays_under_wall_budget(spmd_gate_run, host_gate_run):
+    elapsed = spmd_gate_run[2] + host_gate_run[1]
     assert elapsed < GATE_SPMD_WALL_BUDGET_S, (
-        f"compiled gate subset (Layers C+D) took {elapsed:.1f}s (> "
-        f"{GATE_SPMD_WALL_BUDGET_S}s) — an expensive spec crept into "
-        "GATE_SPMD_ENTRY_POINTS; move it to the off-gate `dstpu lint "
-        "--spmd --schedule` set")
+        f"gate subset (Layers C+D compile pass + Layer-F host audit) "
+        f"took {elapsed:.1f}s (> {GATE_SPMD_WALL_BUDGET_S}s) — an "
+        "expensive spec crept into GATE_SPMD_ENTRY_POINTS or the host "
+        "audit stopped being AST-cheap; move specs to the off-gate "
+        "`dstpu lint --spmd --schedule` set")
 
 
 # ---------------------------------------------------------------------------

@@ -1,5 +1,6 @@
 import pytest
 
+from deepspeed_tpu.analysis import lockdep
 from deepspeed_tpu.resilience import events
 
 
@@ -10,3 +11,19 @@ def _reset_event_bus():
     events.reset()
     yield
     events.reset()
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_crosscheck(host_lock_graph):
+    """The whole suite rides under lockdep-lite: the tune controller's
+    publisher-thread hooks vs worker-loop writes are exactly the race
+    class Layer F's `unguarded-shared-mutation` fixed in `controller.py`
+    — each test runs with instrumented locks (analysis/lockdep.py) and
+    its observed acquisition order is cross-checked against the static
+    lock graph at teardown (see tests/unit/checkpoint/conftest.py)."""
+    with lockdep.install() as reg:
+        yield
+    violations = lockdep.crosscheck(reg, host_lock_graph)
+    assert violations == [], (
+        "lockdep: observed lock acquisition order contradicts the "
+        f"static Layer-F graph: {violations}")

@@ -1,0 +1,24 @@
+"""Durability suite rides under lockdep-lite.
+
+Every test here runs with `threading.Lock`/`RLock` swapped for the
+instrumented wrappers (analysis/lockdep.py): the checkpoint store's
+commit/retention/rollback machinery and the async engine's worker pool
+exercise the real host-side locking, and at teardown the acquisition
+order each test actually took is cross-checked against Layer F's static
+lock graph — an order the static graph's order cannot coexist with is a
+latent deadlock, caught here instead of in a wedged production save.
+"""
+
+import pytest
+
+from deepspeed_tpu.analysis import lockdep
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_crosscheck(host_lock_graph):
+    with lockdep.install() as reg:
+        yield
+    violations = lockdep.crosscheck(reg, host_lock_graph)
+    assert violations == [], (
+        "lockdep: observed lock acquisition order contradicts the "
+        f"static Layer-F graph: {violations}")

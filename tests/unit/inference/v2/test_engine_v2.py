@@ -172,6 +172,10 @@ class TestMoEServing:
         np.testing.assert_allclose(out[0], ref, rtol=2e-4, atol=2e-4)
         eng.flush(81)
 
+    @pytest.mark.slow  # ~30 s: the MoE × continuous-batching composite.
+    # Each half is pinned cheaply — MoE serving parity by
+    # test_mixtral_prefill_matches_dropless_forward, ragged decode by the
+    # dense-model TestSplitFuseBatching/TestDecodeBurst tests.
     def test_mixtral_continuous_batching_decode(self):
         from deepspeed_tpu.models import mixtral_model
         m = mixtral_model("mixtral-tiny", dtype=jnp.float32, remat=False,
@@ -427,6 +431,10 @@ class TestTensorParallelServing:
         spec = eng.kv_cache.k_pages.sharding.spec  # page-dim fallback
         assert len(spec) > 2 and spec[2] == "model", spec
 
+    @pytest.mark.slow  # ~22 s: the TP2+MQA build path and its output
+    # parity are already pinned by test_tp2_mqa_fallback_matches and
+    # test_tp2_matches_single_chip; this adds only the prime-block-count
+    # replication corner.
     def test_tp2_mqa_prime_blocks_replicates(self, eight_devices):
         """MQA + prime block count: neither heads nor pages divide — the KV
         replicates rather than erroring at build, and still matches."""

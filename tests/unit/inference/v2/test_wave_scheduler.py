@@ -217,6 +217,10 @@ class TestPreemptionEquivalence:
                 break
         return eng, [list(r.generated) for r in reqs]
 
+    @pytest.mark.slow  # ~20 s: preemption parity under the wave dispatch
+    # is also pinned by TestShardedPool::
+    # test_sharded_pool_parity_and_preemption; this adds the two-strategy
+    # (stash/restore vs fold-into-prompt) comparison only.
     def test_offload_and_fold_match_unpreempted(self):
         """Both preemption strategies — host-RAM stash/restore and the
         fold-into-prompt re-prefill fallback — reproduce the unpreempted

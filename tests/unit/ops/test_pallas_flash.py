@@ -209,6 +209,10 @@ def test_lse_matches_reference_logsumexp(eight_devices):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # ~21 s: the hop/LSE merge contract is exercised
+# end-to-end by tests/unit/runtime/test_ring_attention.py
+# (ring_matches_dense, ring_flash_body parity and gradients); this is the
+# kernel-level restatement of the same accumulation identity.
 def test_ring_lse_accumulation_equivalence(eight_devices):
     """The ring-attention hop contract: per-hop kernel partials merged via
     LSE accumulation (merge_partials) — including hops entirely in the

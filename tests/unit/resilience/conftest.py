@@ -1,0 +1,22 @@
+"""Chaos/resilience suite rides under lockdep-lite.
+
+The fault-plan harness, guardian policy and escalation paths spin the
+real daemon threads (watchdog, escalation saver) — each test here runs
+with instrumented locks (analysis/lockdep.py) and its observed
+acquisition order is cross-checked against Layer F's static lock graph
+at teardown (see tests/unit/checkpoint/conftest.py for the rationale).
+"""
+
+import pytest
+
+from deepspeed_tpu.analysis import lockdep
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_crosscheck(host_lock_graph):
+    with lockdep.install() as reg:
+        yield
+    violations = lockdep.crosscheck(reg, host_lock_graph)
+    assert violations == [], (
+        "lockdep: observed lock acquisition order contradicts the "
+        f"static Layer-F graph: {violations}")

@@ -126,6 +126,10 @@ class TestOnebitEngines:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0], losses
 
+    @pytest.mark.slow  # ~23 s: the warmup phase (freeze_step not yet
+    # reached -> plain Adam) is traversed by all three
+    # test_trains_through_both_stages parametrizations; this adds only the
+    # exact-tracking assertion against a second engine.
     def test_onebit_warmup_matches_uncompressed(self):
         """During warmup 1-bit Adam IS Adam (no bias correction variant):
         two engines with huge freeze_step must track each other exactly."""

@@ -174,6 +174,10 @@ class TestZeroPlusPlus:
                          hpz._secondary["blocks"]["fc_in"]["kernel"]))[0]
         assert "mics" in str(spec) and "'data'" not in str(spec)
 
+    @pytest.mark.slow  # ~32 s: each knob (qwz, qgz, hpz) has its own
+    # parity test above and the composed step is traced structurally by
+    # the zeropp-micro-overlap lint entry; this adds only the
+    # all-knobs-at-once trajectory.
     def test_all_three_knobs_compose(self, eight_devices):
         base = make_engine()
         base_losses = train_losses(base)

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""The host-seam scoreboard: static thread/lock graph, optionally
+cross-checked against a live lockdep run.
+
+    python tools/thread_report.py [--paths P ...] [--lockdep] [--hosts N]
+
+Renders what Layer F (``dstpu lint --hosts``, analysis/host_audit.py)
+knows about the repo's host-side concurrency:
+
+- **locks** — every ``threading.Lock/RLock/Condition/Semaphore`` creation
+  site, keyed the way the audit names them (``Class._lock`` /
+  ``module.NAME``);
+- **acquisition order** — the static held->acquired edges (``with``
+  nesting plus same-module calls made while holding), the graph whose
+  cycles are ``lock-order-inversion`` findings;
+- **threads/workers** — ``Thread(target=...)`` spawn sites and
+  executor-submit workers with the shared attributes each worker closure
+  reads (the ``unguarded-shared-mutation`` surface).
+
+With ``--lockdep`` the report also DRIVES the instrumented-lock shim
+(analysis/lockdep.py) over the cheap host subsystems — async checkpoint
+engine, stall watchdog, tune controller — and prints the acquisition
+order actually observed per thread, then the cross-check verdict: any
+observed order that cannot coexist with the static graph is a latent
+deadlock a different interleaving would hit. ``--hosts N`` additionally
+runs the virtual multi-host divergence harness over the explicit-
+collective entry specs and prints the per-host ledger diff (empty =
+every virtual host launches the identical collective sequence).
+"""
+
+import argparse
+import os
+import sys
+
+if __package__ in (None, ""):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.realpath(__file__))))
+
+#: the divergence-harness subset: engine-built explicit-collective specs
+#: (GSPMD-sharded steps record nothing at the comm frontend by design)
+HARNESS_ENTRIES = ("zero-gather-partition", "zeropp-micro-overlap",
+                   "quantized-transport")
+
+
+def _drive_subsystems(reg):
+    """The same cheap host-subsystem drives the tier-1 lockdep tests
+    use: construct under instrumented locks, beat once, tear down."""
+    import time
+
+    import numpy as np
+
+    from deepspeed_tpu.autotuning.controller import TuneController
+    from deepspeed_tpu.checkpoint.checkpoint_engine import \
+        AsyncCheckpointEngine
+    from deepspeed_tpu.telemetry.watchdog import StallWatchdog
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        eng = AsyncCheckpointEngine()
+        eng.save({"w": np.ones((4,), np.float32)},
+                 os.path.join(d, "w.npz"))
+        eng.commit("t0")
+        eng.close()
+
+    wd = StallWatchdog(min_deadline_s=30.0, poll_s=0.01)
+    wd.step_begin(1)
+    wd.step_end(1, 0.01)
+    ctl = TuneController(
+        grid={"axes": {}},
+        best={"label": "seed", "objective": 1.0,
+              "runner_up": {"label": "ru", "overrides": {}}},
+        tune_fn=lambda grid, reason: {"label": "re", "objective": 2.0},
+        ab_fn=lambda ru: 3.0, regression_patience=1)
+    ctl.on_event("guardian_rollback", {"step": 1})
+    for _ in range(3):
+        ctl.on_summary(1, {"tuning_objective": 0.0})
+    ctl.poll()
+    time.sleep(0.05)
+    wd.stop()
+    ctl.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="static thread/lock graph + lockdep cross-check")
+    parser.add_argument("--paths", nargs="*", default=None,
+                        help="files/dirs to audit (default: the package)")
+    parser.add_argument("--lockdep", action="store_true",
+                        help="drive the host subsystems under "
+                             "instrumented locks and cross-check the "
+                             "observed acquisition order")
+    parser.add_argument("--hosts", type=int, default=0, metavar="N",
+                        help="also run the virtual N-host divergence "
+                             "harness over the explicit-collective "
+                             "entry specs")
+    args = parser.parse_args(argv)
+
+    from deepspeed_tpu.analysis.host_audit import build_host_graph
+    graph = build_host_graph(args.paths)
+
+    print(f"== locks ({len(graph.lock_sites)}) ==")
+    for key in sorted(graph.lock_sites):
+        for path, line in graph.lock_sites[key]:
+            print(f"  {key:40} {path}:{line}")
+
+    print(f"\n== static acquisition order ({len(graph.edges)} edges) ==")
+    for (a, b), (path, line) in sorted(graph.edges.items()):
+        print(f"  {a} -> {b}   first witness {path}:{line}")
+    cycles = graph.cycles()
+    if cycles:
+        for c in cycles:
+            print(f"  CYCLE: {' -> '.join(c)}")
+    else:
+        print("  acyclic (no lock-order-inversion)")
+
+    print(f"\n== thread spawns ({len(graph.threads)}) ==")
+    for path, line, target in sorted(graph.threads):
+        print(f"  {path}:{line}  target={target}")
+
+    print(f"\n== workers and their shared reads ({len(graph.workers)}) ==")
+    for (path, fn), attrs in sorted(graph.workers.items()):
+        reads = ", ".join(attrs) if attrs else "(none)"
+        print(f"  {path}::{fn}  reads: {reads}")
+
+    rc = 1 if cycles else 0
+
+    if args.lockdep:
+        from deepspeed_tpu.analysis import lockdep
+        with lockdep.install() as reg:
+            _drive_subsystems(reg)
+        print(f"\n== lockdep: observed acquisition order "
+              f"({len(reg.edges)} edges over {len(reg.locks)} "
+              "instrumented sites) ==")
+        for held, acq, thread, _ord in reg.observed_order():
+            print(f"  {held} -> {acq}   [{thread}]")
+        violations = lockdep.crosscheck(reg, graph)
+        if violations:
+            for v in violations:
+                print(f"  VIOLATION: {v}")
+            rc = 1
+        else:
+            print("  consistent with the static graph")
+
+    if args.hosts:
+        from deepspeed_tpu.analysis.host_audit import (diff_host_ledgers,
+                                                       virtual_host_ledgers)
+        print(f"\n== virtual {args.hosts}-host divergence harness ==")
+        for name in HARNESS_ENTRIES:
+            ledgers = virtual_host_ledgers(name, hosts=args.hosts)
+            diffs = diff_host_ledgers(ledgers)
+            counts = "/".join(str(len(l.records)) for l in ledgers)
+            if diffs:
+                print(f"  {name}: DIVERGED ({counts} launches)")
+                for d in diffs:
+                    print(f"    {d}")
+                rc = 1
+            else:
+                print(f"  {name}: identical ({counts} launches per host)")
+
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
